@@ -1,0 +1,21 @@
+"""distributedkernelshap_tpu — TPU-native distributed KernelSHAP.
+
+A from-scratch JAX/XLA re-design of the capabilities of
+alexcoca/DistributedKernelShap: the per-instance Python hot loop of
+``shap.KernelExplainer`` becomes a jit+vmap'd XLA pipeline (coalition
+sampling, masked synthetic evaluation, constrained weighted-least-squares
+solve), and the Ray actor-pool / Ray Serve orchestration becomes sharded
+computation over a ``jax.sharding.Mesh`` with XLA collectives over ICI/DCN.
+"""
+
+from distributedkernelshap_tpu.interface import (  # noqa: F401
+    DEFAULT_DATA_KERNEL_SHAP,
+    DEFAULT_META_KERNEL_SHAP,
+    Explainer,
+    Explanation,
+    FitMixin,
+    NumpyEncoder,
+)
+from distributedkernelshap_tpu.utils import Bunch, batch, get_filename, methdispatch  # noqa: F401
+
+__version__ = "0.1.0"

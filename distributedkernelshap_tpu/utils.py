@@ -1,0 +1,131 @@
+"""Utilities / data plane.
+
+TPU-native counterpart of the reference's ``explainers/utils.py`` (Bunch,
+``methdispatch``, minibatcher, result-filename convention, data/model
+load-and-cache).  The reference downloads pickles from GCS buckets
+(``utils.py:14-19,124-188``); this build runs in a zero-egress environment, so
+``load_data``/``load_model`` first look for local caches and otherwise fall
+back to a deterministic offline generator (``scripts/process_adult_data.py``)
+that reproduces the same shapes/structure (2560+ test instances, 100-row
+background set, one-hot groups).
+"""
+
+import logging
+import os
+import pickle
+
+from functools import singledispatch, update_wrapper
+from typing import Callable, List, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+EXPLANATIONS_SET_LOCAL = "data/adult_processed.pkl"
+BACKGROUND_SET_LOCAL = "data/adult_background.pkl"
+MODEL_LOCAL = "assets/predictor.pkl"
+
+
+class Bunch(dict):
+    """Dictionary exposing its keys as attributes (reference utils.py:22-40)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(kwargs)
+
+    def __setattr__(self, key, value):
+        self[key] = value
+
+    def __dir__(self):
+        return self.keys()
+
+    def __getattr__(self, key):
+        try:
+            return self[key]
+        except KeyError:
+            raise AttributeError(key)
+
+
+def methdispatch(func: Callable):
+    """singledispatch on ``args[1]`` so it works for instance methods
+    (reference utils.py:43-64)."""
+
+    dispatcher = singledispatch(func)
+
+    def wrapper(*args, **kw):
+        return dispatcher.dispatch(args[1].__class__)(*args, **kw)
+
+    wrapper.register = dispatcher.register
+    update_wrapper(wrapper, dispatcher)
+    return wrapper
+
+
+def get_filename(workers: int, batch_size: int, cpu_fraction: float = 1.0, serve: bool = True) -> str:
+    """Result-file naming convention, kept identical to the reference
+    (``utils.py:67-86``) so the Analysis notebook keeps working.  ``workers``
+    maps to devices/replicas in the TPU build."""
+
+    if serve:
+        return f"results/ray_replicas_{workers}_maxbatch_{batch_size}_actorfr_{cpu_fraction}.pkl"
+    return f"results/ray_workers_{workers}_bsize_{batch_size}_actorfr_{cpu_fraction}.pkl"
+
+
+def batch(X: np.ndarray, batch_size: Optional[int] = None, n_batches: int = 4) -> List[np.ndarray]:
+    """Split ``X`` into mini-batches (reference utils.py:89-121).
+
+    If ``batch_size`` is given, produces ceil(n/batch_size) chunks of that
+    size (last one smaller); otherwise ``n_batches`` roughly-equal parts.
+    Sparse input is densified.
+    """
+
+    n_records = X.shape[0]
+    if hasattr(X, "toarray"):  # scipy sparse
+        X = X.toarray()
+
+    if batch_size:
+        n = n_records // batch_size
+        if n_records % batch_size != 0:
+            n += 1
+        slices = [batch_size * i for i in range(1, n)]
+        return np.array_split(X, slices)
+    return np.array_split(X, n_batches)
+
+
+def load_model(path: str = MODEL_LOCAL):
+    """Load a predictor saved locally; generate + fit the default Adult
+    logistic-regression predictor offline if absent (reference utils.py:137-157
+    downloads it from a bucket instead)."""
+
+    try:
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    except FileNotFoundError:
+        logger.info("Could not find model %s. Fitting the default Adult model offline...", path)
+        from scripts.fit_adult_model import fit_adult_logistic_regression
+
+        model = fit_adult_logistic_regression(save_path=path)
+        return model
+
+
+def load_data():
+    """Load instances to be explained + background data, from local cache when
+    present, otherwise generating them offline (reference utils.py:160-188
+    downloads from GCS)."""
+
+    data = {"all": None, "background": None}
+    try:
+        with open(BACKGROUND_SET_LOCAL, "rb") as f:
+            data["background"] = pickle.load(f)
+        with open(EXPLANATIONS_SET_LOCAL, "rb") as f:
+            data["all"] = pickle.load(f)
+    except FileNotFoundError:
+        logger.info("Local data cache missing; generating the Adult dataset offline...")
+        from scripts.process_adult_data import generate_and_save
+
+        data["all"], data["background"] = generate_and_save()
+    return data
+
+
+def ensure_dir(path: str) -> None:
+    d = os.path.dirname(path) if os.path.splitext(path)[1] else path
+    if d and not os.path.exists(d):
+        os.makedirs(d, exist_ok=True)

@@ -1,0 +1,37 @@
+"""Test configuration.
+
+Runs the whole suite on CPU with 8 virtual XLA devices so the multi-chip
+sharding paths (mesh, collectives) are exercised without TPU hardware — the
+TPU-native analog of the reference's Ray local mode
+(``explainers/distributed.py:107-109`` simulating a cluster with local worker
+processes).  Environment must be set before the first ``import jax``.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session")
+def adult_like_data():
+    """Small Adult-shaped fixture: grouped one-hot features + linear predictor."""
+    rng = np.random.default_rng(1)
+    groups = [[0], [1], [2, 3, 4], [5, 6], [7, 8, 9, 10]]
+    D = 11
+    n_bg, n_x = 20, 8
+    background = rng.normal(size=(n_bg, D)).astype(np.float32)
+    X = rng.normal(size=(n_x, D)).astype(np.float32)
+    W = rng.normal(size=(D, 2)).astype(np.float32)
+    b = rng.normal(size=(2,)).astype(np.float32)
+    return {"groups": groups, "background": background, "X": X, "W": W, "b": b}

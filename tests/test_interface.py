@@ -1,0 +1,84 @@
+"""Tests for the Explainer/Explanation API (reference interface.py semantics)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu.interface import (
+    DEFAULT_DATA_KERNEL_SHAP,
+    DEFAULT_META_KERNEL_SHAP,
+    Explainer,
+    Explanation,
+    FitMixin,
+    NumpyEncoder,
+)
+
+
+def test_default_schemas():
+    assert set(DEFAULT_META_KERNEL_SHAP) == {"name", "type", "task", "explanations", "params"}
+    assert DEFAULT_META_KERNEL_SHAP["type"] == ["blackbox"]
+    assert set(DEFAULT_DATA_KERNEL_SHAP) == {
+        "shap_values", "expected_value", "link", "categorical_names", "feature_names", "raw",
+    }
+    assert set(DEFAULT_DATA_KERNEL_SHAP["raw"]) == {
+        "raw_prediction", "prediction", "instances", "importances",
+    }
+
+
+def test_explainer_meta_name_and_attrs():
+    class Dummy(Explainer, FitMixin):
+        def fit(self, X):
+            return self
+
+        def explain(self, X):
+            return Explanation(meta=self.meta, data={"shap_values": []})
+
+    d = Dummy()
+    assert d.meta["name"] == "Dummy"
+    # meta keys exposed as attributes
+    assert d.params == {}
+
+
+def test_explanation_attribute_access_and_json_roundtrip():
+    meta = {"name": "KernelShap", "params": {"link": "logit"}}
+    data = {
+        "shap_values": [np.arange(6, dtype=np.float32).reshape(2, 3)],
+        "expected_value": np.array([0.5]),
+        "raw": {"instances": np.ones((2, 3))},
+    }
+    exp = Explanation(meta=meta, data=data)
+    assert exp.name == "KernelShap"
+    assert np.allclose(exp.shap_values[0], data["shap_values"][0])
+
+    s = exp.to_json()
+    decoded = json.loads(s)
+    assert decoded["meta"]["name"] == "KernelShap"
+    exp2 = Explanation.from_json(s)
+    assert exp2.meta["name"] == "KernelShap"
+    assert np.allclose(np.array(exp2.data["shap_values"][0]), data["shap_values"][0])
+
+
+def test_explanation_getitem_deprecated():
+    exp = Explanation(meta={"name": "x"}, data={"shap_values": [1]})
+    with pytest.warns(DeprecationWarning):
+        assert exp["name"] == "x"
+
+
+def test_numpy_encoder_scalars():
+    payload = {
+        "i": np.int64(3),
+        "f": np.float32(0.5),
+        "b": np.bool_(True),
+        "a": np.zeros((2, 2)),
+    }
+    out = json.loads(json.dumps(payload, cls=NumpyEncoder))
+    assert out["i"] == 3 and abs(out["f"] - 0.5) < 1e-9 and out["b"] is True
+    assert out["a"] == [[0.0, 0.0], [0.0, 0.0]]
+
+
+def test_numpy_encoder_jax_array():
+    import jax.numpy as jnp
+
+    out = json.loads(json.dumps({"x": jnp.ones((2,))}, cls=NumpyEncoder))
+    assert out["x"] == [1.0, 1.0]

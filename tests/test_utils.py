@@ -1,0 +1,69 @@
+"""Tests for utilities (reference utils.py semantics)."""
+
+import numpy as np
+import pytest
+from scipy import sparse
+
+from distributedkernelshap_tpu.utils import Bunch, batch, get_filename, methdispatch
+
+
+def test_bunch():
+    b = Bunch(a=1, c=[2])
+    assert b.a == 1 and b["c"] == [2]
+    b.d = 4
+    assert b["d"] == 4
+    with pytest.raises(AttributeError):
+        _ = b.missing
+
+
+def test_methdispatch():
+    class C:
+        @methdispatch
+        def f(self, x):
+            return "default"
+
+        @f.register(int)
+        def _(self, x):
+            return "int"
+
+        @f.register(np.ndarray)
+        def _(self, x):
+            return "array"
+
+    c = C()
+    assert c.f(1) == "int"
+    assert c.f(np.zeros(2)) == "array"
+    assert c.f("s") == "default"
+
+
+def test_get_filename_convention():
+    # exact parity with reference utils.py:67-86 so the Analysis notebook works
+    assert get_filename(4, 10) == "results/ray_replicas_4_maxbatch_10_actorfr_1.0.pkl"
+    assert get_filename(4, 10, serve=False) == "results/ray_workers_4_bsize_10_actorfr_1.0.pkl"
+
+
+@pytest.mark.parametrize("n,batch_size,n_batches", [(10, 3, None), (10, None, 4), (12, 4, None), (5, 7, None)])
+def test_batch_sizes(n, batch_size, n_batches):
+    X = np.arange(n * 2).reshape(n, 2)
+    out = batch(X, batch_size=batch_size, n_batches=n_batches or 4)
+    assert np.concatenate(out).shape == X.shape
+    np.testing.assert_array_equal(np.concatenate(out), X)
+    if batch_size:
+        # all chunks are batch_size except possibly the last
+        for c in out[:-1]:
+            assert c.shape[0] == batch_size
+        assert out[-1].shape[0] == n - batch_size * (len(out) - 1)
+
+
+def test_batch_sparse_densified():
+    X = sparse.csr_matrix(np.eye(6))
+    out = batch(X, batch_size=4)
+    assert isinstance(out[0], np.ndarray)
+    np.testing.assert_array_equal(np.concatenate(out), np.eye(6))
+
+
+def test_batch_n_batches_split():
+    X = np.arange(10)[:, None]
+    out = batch(X, n_batches=4)
+    # np.array_split semantics: l % n parts of size l//n + 1
+    assert [len(c) for c in out] == [3, 3, 2, 2]
