@@ -58,7 +58,9 @@ class Explainer(abc.ABC):
     """Base class for explainer algorithms (reference interface.py:55-72)."""
 
     def __init__(self, meta: dict = None):
-        self.meta = copy.deepcopy(DEFAULT_META) if meta is None else meta
+        # deepcopy either way: a caller-supplied dict (often one of the
+        # module-level DEFAULT_* constants) must not be mutated in place
+        self.meta = copy.deepcopy(DEFAULT_META if meta is None else meta)
         # record the concrete class name and expose meta keys as attributes
         self.meta["name"] = self.__class__.__name__
         for key, value in self.meta.items():
@@ -101,12 +103,12 @@ class Explanation:
     def from_json(cls, jsonrepr) -> "Explanation":
         """Rebuild an Explanation from its json representation."""
         dictrepr = json.loads(jsonrepr)
-        meta, data = None, None
         try:
             meta = dictrepr["meta"]
             data = dictrepr["data"]
-        except KeyError:
+        except KeyError as e:
             logger.exception("Invalid explanation representation")
+            raise ValueError(f"Invalid explanation representation: missing {e}") from e
         return cls(meta=meta, data=data)
 
     def __getitem__(self, item):

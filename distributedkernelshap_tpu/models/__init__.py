@@ -1,0 +1,7 @@
+from distributedkernelshap_tpu.models.predictors import (  # noqa: F401
+    BasePredictor,
+    CallbackPredictor,
+    JaxPredictor,
+    LinearPredictor,
+    as_predictor,
+)

@@ -1,0 +1,196 @@
+"""Predictor protocol — how models under explanation run on TPU.
+
+The reference treats the predictor as an opaque pickled callable evaluated in
+every worker process (``explainers/wrappers.py:33-37``; sklearn
+``predict_proba`` passed at ``benchmarks/ray_pool.py:34-36``).  On TPU the
+predictor must live *inside* the jitted pipeline, so this module defines a
+small protocol with three concrete escape hatches (SURVEY.md §7.1):
+
+* ``LinearPredictor`` — native JAX evaluation of (generalised) linear models;
+  additionally exposes its ``(W, b, activation)`` decomposition, which the
+  explain kernel exploits to collapse the ``B×S×N×D`` synthetic-data tensor
+  into three small einsums (the MXU fast path).
+* ``JaxPredictor`` — any user-supplied jittable ``(n, D) -> (n, K)`` function
+  (e.g. a flax CNN apply).
+* ``CallbackPredictor`` — arbitrary host Python callables (XGBoost, pickled
+  sklearn pipelines, ...) bridged with ``jax.pure_callback``; calls are
+  batched per coalition chunk so host↔device transitions stay coarse.
+
+``as_predictor`` auto-detects what it was given: framework predictors pass
+through, sklearn linear estimators behind ``predict_proba``/``predict``/
+``decision_function`` bound methods are *lifted* into ``LinearPredictor``
+(coefficients hoisted on-device — the reference's pickle round-trip becomes a
+one-time weight upload), jit-traceable callables become ``JaxPredictor``, and
+everything else falls back to ``CallbackPredictor``.
+"""
+
+import logging
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+ACTIVATIONS = {
+    "identity": lambda z: z,
+    "softmax": lambda z: jax.nn.softmax(z, axis=-1),
+    "sigmoid": jax.nn.sigmoid,
+}
+
+
+class BasePredictor:
+    """Protocol: a device-side model of signature ``(n, D) -> (n, K)``.
+
+    Attributes
+    ----------
+    n_outputs
+        Output dimension K (1 for scalar-output models).
+    vector_out
+        False when the underlying user callable returned a scalar per row
+        (reference reads ``vector_out`` at ``kernel_shap.py:790``).
+    """
+
+    n_outputs: int = 1
+    vector_out: bool = True
+
+    def __call__(self, X: jnp.ndarray) -> jnp.ndarray:
+        raise NotImplementedError
+
+    @property
+    def linear_decomposition(self):
+        """``(W, b, activation_name)`` when the model is logits-linear, else None."""
+        return None
+
+
+class LinearPredictor(BasePredictor):
+    """Generalised linear model evaluated natively in JAX.
+
+    ``outputs = activation(X @ W + b)`` with ``W: (D, K)``, ``b: (K,)`` and
+    ``activation`` one of 'identity' | 'softmax' | 'sigmoid'.
+    """
+
+    def __init__(self, W, b, activation: str = "identity", vector_out: bool = True):
+        if activation not in ACTIVATIONS:
+            raise ValueError(f"activation must be one of {sorted(ACTIVATIONS)}")
+        self.W = jnp.asarray(W, dtype=jnp.float32)
+        self.b = jnp.asarray(b, dtype=jnp.float32)
+        if self.W.ndim != 2 or self.b.ndim != 1 or self.W.shape[1] != self.b.shape[0]:
+            raise ValueError(f"Bad linear shapes W={self.W.shape} b={self.b.shape}")
+        self.activation = activation
+        self.n_outputs = int(self.W.shape[1])
+        self.vector_out = vector_out
+
+    def __call__(self, X):
+        return ACTIVATIONS[self.activation](X @ self.W + self.b)
+
+    @property
+    def linear_decomposition(self):
+        return self.W, self.b, self.activation
+
+
+class JaxPredictor(BasePredictor):
+    """Wraps a user-supplied jittable function ``(n, D) -> (n, K)``."""
+
+    def __init__(self, fn: Callable, n_outputs: int, vector_out: bool = True):
+        self.fn = fn
+        self.n_outputs = int(n_outputs)
+        self.vector_out = vector_out
+
+    def __call__(self, X):
+        out = self.fn(X)
+        if out.ndim == 1:
+            out = out[:, None]
+        return out
+
+
+class CallbackPredictor(BasePredictor):
+    """Host-side black-box predictor bridged via ``jax.pure_callback``.
+
+    The callback receives a numpy ``(n, D)`` array and must return ``(n, K)``
+    (scalar-per-row outputs are reshaped).  Inside the explain pipeline the
+    callback fires once per coalition chunk, so the number of host↔device
+    round-trips is ``S / coalition_chunk`` per batch, not per synthetic row.
+    """
+
+    def __init__(self, fn: Callable, n_outputs: Optional[int] = None,
+                 example_dim: Optional[int] = None, vector_out: Optional[bool] = None):
+        self.raw_fn = fn
+        if n_outputs is None:
+            if example_dim is None:
+                raise ValueError("CallbackPredictor needs n_outputs or example_dim to probe the model")
+            probe = np.asarray(fn(np.zeros((2, example_dim), dtype=np.float32)))
+            vector_out = probe.ndim > 1
+            n_outputs = probe.shape[1] if probe.ndim > 1 else 1
+        self.n_outputs = int(n_outputs)
+        self.vector_out = bool(vector_out) if vector_out is not None else True
+
+    def _host_fn(self, X: np.ndarray) -> np.ndarray:
+        out = np.asarray(self.raw_fn(np.asarray(X)), dtype=np.float32)
+        if out.ndim == 1:
+            out = out[:, None]
+        return out
+
+    def __call__(self, X):
+        shape = jax.ShapeDtypeStruct((X.shape[0], self.n_outputs), jnp.float32)
+        return jax.pure_callback(self._host_fn, shape, X, vmap_method="sequential")
+
+
+def _lift_sklearn(method) -> Optional[LinearPredictor]:
+    """Lift a bound method of a linear sklearn estimator into a LinearPredictor."""
+
+    owner = getattr(method, "__self__", None)
+    if owner is None:
+        return None
+    coef = getattr(owner, "coef_", None)
+    intercept = getattr(owner, "intercept_", None)
+    if coef is None or intercept is None:
+        return None
+    coef = np.atleast_2d(np.asarray(coef, dtype=np.float32))  # (K_raw, D)
+    intercept = np.atleast_1d(np.asarray(intercept, dtype=np.float32))
+    name = getattr(method, "__name__", "")
+
+    if name == "predict_proba":
+        if coef.shape[0] == 1:
+            # binary LR: predict_proba == [1-sigmoid(z), sigmoid(z)] == softmax([0, z])
+            W = np.concatenate([np.zeros_like(coef), coef], axis=0).T
+            b = np.concatenate([np.zeros_like(intercept), intercept])
+        else:
+            W, b = coef.T, intercept
+        return LinearPredictor(W, b, activation="softmax")
+    if name == "decision_function":
+        return LinearPredictor(coef.T, intercept, activation="identity",
+                               vector_out=coef.shape[0] > 1)
+    if name == "predict" and not hasattr(owner, "classes_"):
+        # linear regression: scalar margin output
+        return LinearPredictor(coef.T, intercept, activation="identity",
+                               vector_out=coef.shape[0] > 1)
+    return None
+
+
+def as_predictor(predictor, example_dim: Optional[int] = None,
+                 n_outputs: Optional[int] = None) -> BasePredictor:
+    """Normalise whatever the user passed into a :class:`BasePredictor`."""
+
+    if isinstance(predictor, BasePredictor):
+        return predictor
+
+    lifted = _lift_sklearn(predictor)
+    if lifted is not None:
+        logger.info("Lifted sklearn linear model into a native JAX LinearPredictor "
+                    "(K=%d, activation=%s)", lifted.n_outputs, lifted.activation)
+        return lifted
+
+    if example_dim is not None:
+        # is it jit-traceable?
+        try:
+            out_shape = jax.eval_shape(predictor, jax.ShapeDtypeStruct((2, example_dim), jnp.float32))
+            k = out_shape.shape[1] if len(out_shape.shape) > 1 else 1
+            return JaxPredictor(predictor, n_outputs=k, vector_out=len(out_shape.shape) > 1)
+        except Exception:  # host python callable
+            return CallbackPredictor(predictor, n_outputs=n_outputs, example_dim=example_dim)
+
+    if n_outputs is None:
+        raise ValueError("Cannot infer predictor output dim; pass example_dim or n_outputs")
+    return CallbackPredictor(predictor, n_outputs=n_outputs)

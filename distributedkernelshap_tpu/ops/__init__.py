@@ -1,0 +1,3 @@
+from distributedkernelshap_tpu.ops.coalitions import CoalitionPlan, coalition_plan  # noqa: F401
+from distributedkernelshap_tpu.ops.links import convert_to_link, identity_link, logit_link  # noqa: F401
+from distributedkernelshap_tpu.ops.explain import ShapConfig, build_explainer_fn, groups_to_matrix  # noqa: F401

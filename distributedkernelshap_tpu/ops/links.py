@@ -1,0 +1,35 @@
+"""Link functions.
+
+The reference delegates to ``shap.common.convert_to_link`` (used at
+``explainers/kernel_shap.py:949``) supporting ``'identity'`` and ``'logit'``.
+Here the links are jittable jnp functions applied on-device; ``logit`` clips
+probabilities away from {0,1} so float32 TPU arithmetic never produces inf.
+"""
+
+import jax.numpy as jnp
+
+_LOGIT_EPS = 1e-7
+
+
+def identity_link(x):
+    return x
+
+
+def logit_link(p):
+    p = jnp.clip(p, _LOGIT_EPS, 1.0 - _LOGIT_EPS)
+    return jnp.log(p / (1.0 - p))
+
+
+_LINKS = {"identity": identity_link, "logit": logit_link}
+
+
+def convert_to_link(link):
+    """Map a link name (or callable) to a jittable function
+    (parity with shap.common.convert_to_link semantics)."""
+
+    if callable(link):
+        return link
+    try:
+        return _LINKS[link]
+    except KeyError:
+        raise ValueError(f"link must be one of {sorted(_LINKS)} or a callable, got {link!r}")

@@ -21,9 +21,12 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
-EXPLANATIONS_SET_LOCAL = "data/adult_processed.pkl"
-BACKGROUND_SET_LOCAL = "data/adult_background.pkl"
-MODEL_LOCAL = "assets/predictor.pkl"
+# caches are anchored to the repo root (parent of this package) so behaviour
+# does not depend on the caller's working directory
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXPLANATIONS_SET_LOCAL = os.path.join(REPO_ROOT, "data", "adult_processed.pkl")
+BACKGROUND_SET_LOCAL = os.path.join(REPO_ROOT, "data", "adult_background.pkl")
+MODEL_LOCAL = os.path.join(REPO_ROOT, "assets", "predictor.pkl")
 
 
 class Bunch(dict):
@@ -100,10 +103,8 @@ def load_model(path: str = MODEL_LOCAL):
             return pickle.load(f)
     except FileNotFoundError:
         logger.info("Could not find model %s. Fitting the default Adult model offline...", path)
-        from scripts.fit_adult_model import fit_adult_logistic_regression
-
-        model = fit_adult_logistic_regression(save_path=path)
-        return model
+        fit = _load_script("fit_adult_model").fit_adult_logistic_regression
+        return fit(save_path=path)
 
 
 def load_data():
@@ -119,10 +120,21 @@ def load_data():
             data["all"] = pickle.load(f)
     except FileNotFoundError:
         logger.info("Local data cache missing; generating the Adult dataset offline...")
-        from scripts.process_adult_data import generate_and_save
-
-        data["all"], data["background"] = generate_and_save()
+        data["all"], data["background"] = _load_script("process_adult_data").generate_and_save()
     return data
+
+
+def _load_script(name: str):
+    """Import a module from the repo-root ``scripts/`` directory regardless of
+    the caller's working directory or sys.path."""
+
+    import importlib.util
+
+    path = os.path.join(REPO_ROOT, "scripts", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"scripts.{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def ensure_dir(path: str) -> None:

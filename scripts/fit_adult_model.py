@@ -20,15 +20,17 @@ sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 logger = logging.getLogger(__name__)
 
 
-def fit_adult_logistic_regression(data_dict=None, save_path: str = "assets/predictor.pkl"):
+def fit_adult_logistic_regression(data_dict=None, save_path: str = None):
     """Fit an LR predictor on the processed Adult data and pickle it."""
 
     from sklearn.linear_model import LogisticRegression
     from sklearn.metrics import accuracy_score
 
-    if data_dict is None:
-        from distributedkernelshap_tpu.utils import load_data
+    from distributedkernelshap_tpu.utils import MODEL_LOCAL, ensure_dir, load_data
 
+    if save_path is None:
+        save_path = MODEL_LOCAL
+    if data_dict is None:
         data_dict = load_data()["all"]
 
     X_train_proc = data_dict["X"]["processed"]["train"]
@@ -42,9 +44,7 @@ def fit_adult_logistic_regression(data_dict=None, save_path: str = "assets/predi
     logger.info("Test accuracy: %s", accuracy_score(y_test, classifier.predict(X_test_proc)))
 
     if save_path:
-        d = os.path.dirname(save_path)
-        if d and not os.path.exists(d):
-            os.makedirs(d, exist_ok=True)
+        ensure_dir(save_path)
         with open(save_path, "wb") as f:
             pickle.dump(classifier, f)
     return classifier
@@ -56,5 +56,5 @@ def main(args):
 
 if __name__ == "__main__":
     parser = argparse.ArgumentParser()
-    parser.add_argument("-save_path", type=str, default="assets/predictor.pkl")
+    parser.add_argument("-save_path", type=str, default=None)
     main(parser.parse_args())

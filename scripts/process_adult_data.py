@@ -29,7 +29,13 @@ import sys
 
 sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from distributedkernelshap_tpu.utils import Bunch  # noqa: E402
+from distributedkernelshap_tpu.utils import (  # noqa: E402
+    BACKGROUND_SET_LOCAL,
+    EXPLANATIONS_SET_LOCAL,
+    REPO_ROOT,
+    Bunch,
+    ensure_dir,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -63,7 +69,7 @@ def fetch_adult(return_X_y: bool = False, seed: int = 42):
     generates a synthetic lookalike deterministically from ``seed``.
     """
 
-    cache = "data/adult_raw.pkl"
+    cache = os.path.join(REPO_ROOT, "data", "adult_raw.pkl")
     if os.path.exists(cache):
         with open(cache, "rb") as f:
             bunch = pickle.load(f)
@@ -189,8 +195,7 @@ def generate_and_save(n_background_samples: int = 100, n_train_examples: int = 3
     """Build the processed + background pickles (reference main(),
     process_adult_data.py:232-249) and return them."""
 
-    if not os.path.exists("data"):
-        os.makedirs("data", exist_ok=True)
+    ensure_dir(BACKGROUND_SET_LOCAL)
 
     adult_dataset = load_adult_dataset()
     adult_preprocessed = preprocess_adult_dataset(adult_dataset, n_train_examples=n_train_examples)
@@ -199,9 +204,9 @@ def generate_and_save(n_background_samples: int = 100, n_train_examples: int = 3
     background_dataset["X"]["raw"] = adult_preprocessed["X"]["raw"]["train"][0:n, :]
     background_dataset["X"]["preprocessed"] = adult_preprocessed["X"]["processed"]["train"][0:n, :]
     background_dataset["y"] = adult_preprocessed["y"]["train"][0:n]
-    with open("data/adult_background.pkl", "wb") as f:
+    with open(BACKGROUND_SET_LOCAL, "wb") as f:
         pickle.dump(background_dataset, f)
-    with open("data/adult_processed.pkl", "wb") as f:
+    with open(EXPLANATIONS_SET_LOCAL, "wb") as f:
         pickle.dump(adult_preprocessed, f)
     return adult_preprocessed, background_dataset
 

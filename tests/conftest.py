@@ -8,11 +8,23 @@ processes).  Environment must be set before the first ``import jax``.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# repo root on sys.path so the suite runs from any cwd without installation
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Force CPU even when the session environment preselects a TPU platform
+# (JAX_PLATFORMS=axon, with jax pre-imported by a sitecustomize hook): the
+# suite validates numerics in f32 and sharding on virtual devices; hardware
+# benchmarking lives in bench.py.  jax is already imported at this point, so
+# env vars are too late — use config updates, which are honoured as long as
+# no backend has been initialised yet.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

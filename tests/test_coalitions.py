@@ -1,0 +1,93 @@
+"""Tests for the static coalition plan."""
+
+import math
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu.ops.coalitions import (
+    CoalitionPlan,
+    coalition_plan,
+    default_nsamples,
+    kernel_size_masses,
+)
+
+
+def test_default_nsamples_matches_shap():
+    assert default_nsamples(12) == 2 * 12 + 2048
+
+
+def test_size_masses_normalised_and_symmetric():
+    m = kernel_size_masses(10)
+    assert np.isclose(m.sum(), 1.0)
+    np.testing.assert_allclose(m, m[::-1])  # w(s) == w(M-s)
+    assert m[0] == m.max()  # extremes carry the most kernel mass
+
+
+@pytest.mark.parametrize("M", [2, 3, 5, 8])
+def test_full_enumeration_when_budget_allows(M):
+    plan = coalition_plan(M, nsamples=2 ** M)
+    assert plan.exact
+    assert plan.n_rows == 2 ** M - 2
+    # every row non-trivial, all distinct
+    sizes = plan.mask.sum(1)
+    assert sizes.min() >= 1 and sizes.max() <= M - 1
+    assert len(np.unique(plan.mask, axis=0)) == plan.n_rows
+    assert np.isclose(plan.weights.sum(), 1.0)
+    # per-size mass matches the Shapley kernel
+    masses = kernel_size_masses(M)
+    for s in range(1, M):
+        w_s = plan.weights[sizes == s].sum()
+        assert np.isclose(w_s, masses[s - 1], atol=1e-6)
+
+
+def test_sampled_plan_structure():
+    M, nsamples = 20, 256
+    plan = coalition_plan(M, nsamples=nsamples, seed=0)
+    assert not plan.exact
+    assert plan.mask.shape == (plan.n_rows, M)
+    assert plan.n_rows <= nsamples
+    assert np.isclose(plan.weights.sum(), 1.0)
+    # enumerated prefix covers complete small/large sizes
+    sizes = plan.mask[: plan.n_enumerated].sum(1)
+    assert set(np.unique(sizes)) == {1, M - 1}
+    assert plan.n_enumerated == 2 * M
+    # zero-weight padded rows only at the very end
+    nz = plan.weights > 0
+    first_zero = np.argmin(nz) if not nz.all() else len(nz)
+    assert nz[:first_zero].all()
+
+
+def test_sampled_plan_seed_determinism_and_fixed_shape():
+    a = coalition_plan(15, nsamples=200, seed=1)
+    b = coalition_plan(15, nsamples=200, seed=1)
+    c = coalition_plan(15, nsamples=200, seed=2)
+    np.testing.assert_array_equal(a.mask, b.mask)
+    np.testing.assert_array_equal(a.weights, b.weights)
+    # different seed -> same shape (no retrace), different rows
+    assert c.mask.shape == a.mask.shape
+    assert not np.array_equal(a.mask, c.mask)
+
+
+def test_single_group_plan():
+    plan = coalition_plan(1)
+    assert isinstance(plan, CoalitionPlan) and plan.exact and plan.n_rows == 1
+
+
+def test_pair_sampling_complements_present():
+    plan = coalition_plan(16, nsamples=300, seed=0)
+    sampled = plan.mask[plan.n_enumerated:]
+    w = plan.weights[plan.n_enumerated:]
+    sampled = sampled[w > 0]
+    # for every sampled row, its complement appears too (paired sampling)
+    rows = {tuple(r) for r in sampled.astype(int).tolist()}
+    n_with_complement = sum(tuple(1 - np.array(r)) in rows for r in rows)
+    assert n_with_complement == len(rows)
+
+
+def test_enumeration_greedy_pairs():
+    # M=12, budget 2072 (shap default): sizes 1..4 & 8..11 fit fully
+    plan = coalition_plan(12, nsamples=default_nsamples(12), seed=0)
+    expected_enum = sum(math.comb(12, s) + math.comb(12, 12 - s) for s in (1, 2, 3, 4))
+    assert plan.n_enumerated == expected_enum
+    assert not plan.exact

@@ -1,0 +1,191 @@
+"""Correctness oracles for the core explain kernel (SURVEY.md §4):
+
+1. additivity: Σφ + E[f] == link(f(x)) per instance/class;
+2. exact Shapley values for linear models with identity link:
+   φ_j = Σ_{d∈group j} W_dk · (x_d - E_bg[x_d]) under full enumeration;
+3. linear fast path ≡ generic path;
+4. sequential == batched (order invariance).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributedkernelshap_tpu.models.predictors import (
+    CallbackPredictor,
+    JaxPredictor,
+    LinearPredictor,
+    as_predictor,
+)
+from distributedkernelshap_tpu.ops.coalitions import coalition_plan
+from distributedkernelshap_tpu.ops.explain import (
+    ShapConfig,
+    build_explainer_fn,
+    groups_to_matrix,
+    split_shap_values,
+)
+
+
+def run_explain(predictor, X, bg, groups=None, nsamples=None, link="identity",
+                bgw=None, seed=0, **cfg):
+    D = X.shape[1]
+    G = groups_to_matrix(groups, D)
+    M = G.shape[0]
+    plan = coalition_plan(M, nsamples=nsamples, seed=seed)
+    if bgw is None:
+        bgw = np.ones(bg.shape[0], dtype=np.float32)
+    fn = jax.jit(build_explainer_fn(predictor, ShapConfig(link=link, **cfg)))
+    return fn(jnp.asarray(X), jnp.asarray(bg), jnp.asarray(bgw),
+              jnp.asarray(plan.mask), jnp.asarray(plan.weights), jnp.asarray(G))
+
+
+@pytest.fixture(scope="module")
+def linear_setup():
+    rng = np.random.default_rng(0)
+    D, K, N, B = 7, 3, 12, 5
+    W = rng.normal(size=(D, K)).astype(np.float32)
+    b = rng.normal(size=(K,)).astype(np.float32)
+    X = rng.normal(size=(B, D)).astype(np.float32)
+    bg = rng.normal(size=(N, D)).astype(np.float32)
+    return W, b, X, bg
+
+
+def test_exact_shapley_linear_identity(linear_setup):
+    W, b, X, bg = linear_setup
+    pred = LinearPredictor(W, b, activation="identity")
+    out = run_explain(pred, X, bg, nsamples=2 ** 7)  # full enumeration, M=D=7
+    phi = np.asarray(out["shap_values"])  # (B, K, M)
+    expected = (X - bg.mean(0))[:, None, :] * W.T[None, :, :]  # (B, K, D)
+    np.testing.assert_allclose(phi, expected, atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(out["expected_value"]), bg.mean(0) @ W + b, atol=1e-4
+    )
+
+
+def test_exact_shapley_linear_grouped(linear_setup):
+    W, b, X, bg = linear_setup
+    groups = [[0], [1, 2], [3, 4, 5], [6]]
+    pred = LinearPredictor(W, b, activation="identity")
+    out = run_explain(pred, X, bg, groups=groups, nsamples=64)  # 2^4-2=14 → exact
+    phi = np.asarray(out["shap_values"])  # (B, K, 4)
+    diff = (X - bg.mean(0))  # (B, D)
+    for j, cols in enumerate(groups):
+        expected_j = diff[:, cols] @ W[cols, :]  # (B, K)
+        np.testing.assert_allclose(phi[:, :, j], expected_j, atol=2e-4)
+
+
+@pytest.mark.parametrize("link,activation", [("identity", "identity"),
+                                             ("logit", "softmax")])
+def test_additivity(linear_setup, link, activation):
+    W, b, X, bg = linear_setup
+    pred = LinearPredictor(W, b, activation=activation)
+    out = run_explain(pred, X, bg, nsamples=200, link=link)
+    phi = np.asarray(out["shap_values"])
+    total = phi.sum(-1) + np.asarray(out["expected_value"])[None, :]
+    np.testing.assert_allclose(total, np.asarray(out["raw_prediction"]), atol=1e-4)
+
+
+def test_additivity_sampled_many_features():
+    rng = np.random.default_rng(3)
+    D, K, N, B = 25, 2, 10, 4
+    W = rng.normal(size=(D, K)).astype(np.float32)
+    b = np.zeros(K, dtype=np.float32)
+    X = rng.normal(size=(B, D)).astype(np.float32)
+    bg = rng.normal(size=(N, D)).astype(np.float32)
+    pred = LinearPredictor(W, b, activation="softmax")
+    out = run_explain(pred, X, bg, nsamples=500, link="logit")
+    phi = np.asarray(out["shap_values"])
+    total = phi.sum(-1) + np.asarray(out["expected_value"])[None, :]
+    np.testing.assert_allclose(total, np.asarray(out["raw_prediction"]), atol=1e-3)
+
+
+def test_linear_fast_path_matches_generic(linear_setup):
+    W, b, X, bg = linear_setup
+    fast = LinearPredictor(W, b, activation="softmax")
+    generic = JaxPredictor(lambda x: jax.nn.softmax(x @ W + b, axis=-1), n_outputs=3)
+    out_fast = run_explain(fast, X, bg, nsamples=150, link="logit")
+    out_gen = run_explain(generic, X, bg, nsamples=150, link="logit")
+    np.testing.assert_allclose(np.asarray(out_fast["shap_values"]),
+                               np.asarray(out_gen["shap_values"]), atol=1e-4)
+
+
+def test_callback_predictor_matches_native(linear_setup):
+    W, b, X, bg = linear_setup
+
+    def host_model(x):
+        z = x @ np.asarray(W) + np.asarray(b)
+        e = np.exp(z - z.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    cb = CallbackPredictor(host_model, example_dim=X.shape[1])
+    native = LinearPredictor(W, b, activation="softmax")
+    out_cb = run_explain(cb, X, bg, nsamples=100, link="logit")
+    out_na = run_explain(native, X, bg, nsamples=100, link="logit")
+    np.testing.assert_allclose(np.asarray(out_cb["shap_values"]),
+                               np.asarray(out_na["shap_values"]), atol=1e-4)
+
+
+def test_batch_order_invariance(linear_setup):
+    W, b, X, bg = linear_setup
+    pred = LinearPredictor(W, b, activation="identity")
+    out_all = np.asarray(run_explain(pred, X, bg, nsamples=128)["shap_values"])
+    out_rows = np.concatenate(
+        [np.asarray(run_explain(pred, X[i:i + 1], bg, nsamples=128)["shap_values"])
+         for i in range(X.shape[0])], 0)
+    np.testing.assert_allclose(out_all, out_rows, atol=1e-4)
+
+
+def test_background_weights(linear_setup):
+    W, b, X, bg = linear_setup
+    pred = LinearPredictor(W, b, activation="identity")
+    bgw = np.zeros(bg.shape[0], dtype=np.float32)
+    bgw[0] = 5.0  # only background row 0 matters
+    out = run_explain(pred, X, bg, nsamples=128, bgw=bgw)
+    expected = (X - bg[0]) [:, None, :] * W.T[None, :, :]
+    np.testing.assert_allclose(np.asarray(out["shap_values"]), expected, atol=2e-4)
+
+
+def test_chunking_invariance(linear_setup):
+    W, b, X, bg = linear_setup
+    pred = LinearPredictor(W, b, activation="softmax")
+    out_small = run_explain(pred, X, bg, nsamples=100, link="logit", coalition_chunk=7)
+    out_large = run_explain(pred, X, bg, nsamples=100, link="logit", coalition_chunk=1000)
+    np.testing.assert_allclose(np.asarray(out_small["shap_values"]),
+                               np.asarray(out_large["shap_values"]), atol=1e-5)
+
+
+def test_single_group():
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(3, 4)).astype(np.float32)
+    bg = rng.normal(size=(6, 4)).astype(np.float32)
+    W = rng.normal(size=(4, 2)).astype(np.float32)
+    pred = LinearPredictor(W, np.zeros(2, np.float32), activation="identity")
+    out = run_explain(pred, X, bg, groups=[[0, 1, 2, 3]])
+    phi = np.asarray(out["shap_values"])  # (3, 2, 1)
+    expected = (X @ W - (bg.mean(0) @ W)[None])[:, :, None]
+    np.testing.assert_allclose(phi, expected, atol=1e-4)
+
+
+def test_split_shap_values_layout():
+    phi = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    out = split_shap_values(phi)
+    assert isinstance(out, list) and len(out) == 3
+    np.testing.assert_array_equal(out[1], phi[:, 1, :])
+    single = split_shap_values(phi[:, :1, :], vector_out=False)
+    assert isinstance(single, np.ndarray) and single.shape == (2, 4)
+
+
+def test_as_predictor_sklearn_lift():
+    from sklearn.linear_model import LogisticRegression
+
+    rng = np.random.default_rng(7)
+    Xtr = rng.normal(size=(200, 5))
+    ytr = (Xtr @ rng.normal(size=5) > 0).astype(int)
+    clf = LogisticRegression(max_iter=200).fit(Xtr, ytr)
+    pred = as_predictor(clf.predict_proba, example_dim=5)
+    assert isinstance(pred, LinearPredictor)
+    probe = np.asarray(Xtr[:10], dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(pred(jnp.asarray(probe))),
+                               clf.predict_proba(probe), atol=1e-5)

@@ -59,6 +59,23 @@ def test_explanation_attribute_access_and_json_roundtrip():
     assert np.allclose(np.array(exp2.data["shap_values"][0]), data["shap_values"][0])
 
 
+def test_from_json_invalid_payload_raises():
+    with pytest.raises(ValueError, match="Invalid explanation representation"):
+        Explanation.from_json('{"foo": 1}')
+
+
+def test_explainer_does_not_mutate_passed_meta():
+    class Dummy(Explainer):
+        def __init__(self):
+            super().__init__(meta=DEFAULT_META_KERNEL_SHAP)
+
+        def explain(self, X):
+            pass
+
+    Dummy()
+    assert DEFAULT_META_KERNEL_SHAP["name"] is None
+
+
 def test_explanation_getitem_deprecated():
     exp = Explanation(meta={"name": "x"}, data={"shap_values": [1]})
     with pytest.warns(DeprecationWarning):
