@@ -17,5 +17,15 @@ from distributedkernelshap_tpu.interface import (  # noqa: F401
     NumpyEncoder,
 )
 from distributedkernelshap_tpu.utils import Bunch, batch, get_filename, methdispatch  # noqa: F401
+from distributedkernelshap_tpu.data import Data, DenseData, DenseDataWithIndex  # noqa: F401
+from distributedkernelshap_tpu.kernel_shap import (  # noqa: F401
+    DISTRIBUTED_OPTS,
+    KERNEL_SHAP_BACKGROUND_THRESHOLD,
+    KERNEL_SHAP_PARAMS,
+    KernelExplainerEngine,
+    KernelShap,
+    rank_by_importance,
+    sum_categories,
+)
 
 __version__ = "0.1.0"

@@ -169,6 +169,25 @@ def _lift_sklearn(method) -> Optional[LinearPredictor]:
     return None
 
 
+def _lift_is_faithful(lifted: LinearPredictor, method, example_dim: int,
+                      tol: float = 1e-4) -> bool:
+    """Numerically check that the lifted JAX predictor reproduces the original
+    callable.  Guards against estimators that expose ``coef_`` but whose
+    ``predict_proba`` is NOT softmax-of-margin (Platt-scaled SVC, one-vs-rest
+    logistic regression, ...)."""
+
+    rng = np.random.default_rng(0)
+    probe = rng.normal(scale=0.5, size=(16, example_dim)).astype(np.float32)
+    try:
+        expected = np.asarray(method(probe), dtype=np.float32)
+    except Exception:
+        return False
+    got = np.asarray(lifted(jnp.asarray(probe)))
+    if expected.ndim == 1:
+        expected = expected[:, None]
+    return expected.shape == got.shape and bool(np.abs(expected - got).max() < tol)
+
+
 def as_predictor(predictor, example_dim: Optional[int] = None,
                  n_outputs: Optional[int] = None) -> BasePredictor:
     """Normalise whatever the user passed into a :class:`BasePredictor`."""
@@ -178,9 +197,15 @@ def as_predictor(predictor, example_dim: Optional[int] = None,
 
     lifted = _lift_sklearn(predictor)
     if lifted is not None:
-        logger.info("Lifted sklearn linear model into a native JAX LinearPredictor "
-                    "(K=%d, activation=%s)", lifted.n_outputs, lifted.activation)
-        return lifted
+        if example_dim is None or _lift_is_faithful(lifted, predictor, example_dim):
+            logger.info("Lifted sklearn linear model into a native JAX LinearPredictor "
+                        "(K=%d, activation=%s)", lifted.n_outputs, lifted.activation)
+            return lifted
+        logger.warning(
+            "Estimator exposes linear coefficients but its outputs do not match "
+            "the lifted linear model; falling back to the host-callback path."
+        )
+        lifted = None
 
     if example_dim is not None:
         # is it jit-traceable?

@@ -131,6 +131,37 @@ def _ey_linear(W, b, activation: str, X, bg, bgw_n, zc, chunk):
     return ey[:, :S]
 
 
+def normal_equations(mask, w, ey_adj, fx_minus_e):
+    """Gram matrix and right-hand sides of the constrained WLS.
+
+    Both are sums over coalition rows, so partial results computed on a
+    coalition-sharded mesh axis combine exactly with a ``psum`` — the basis
+    of the coalition-parallel path in ``parallel/coalition_sharding.py``
+    (SURVEY.md §5.7's context-parallel analog).
+    """
+
+    zl = mask[:, -1]
+    Zt = mask[:, :-1] - zl[:, None]            # (S, M-1)
+    Aw = Zt * w[:, None]                       # (S, M-1)
+    A = Aw.T @ Zt
+    rhs = jnp.einsum("sm,bsk->bkm", Aw, ey_adj - zl[None, :, None] * fx_minus_e[:, None, :])
+    return A, rhs
+
+
+def solve_from_normal(A, rhs, fx_minus_e, ridge):
+    """Cholesky-solve the eliminated system and restore the last coefficient
+    from the additivity constraint."""
+
+    B, K = fx_minus_e.shape
+    M1 = A.shape[0]
+    A = A + ridge * jnp.eye(M1, dtype=A.dtype)
+    c, low = jax.scipy.linalg.cho_factor(A)
+    sol = jax.scipy.linalg.cho_solve((c, low), rhs.reshape(B * K, M1).T)  # (M1, B*K)
+    phi_rest = sol.T.reshape(B, K, M1)
+    phi_last = fx_minus_e - phi_rest.sum(-1)
+    return jnp.concatenate([phi_rest, phi_last[..., None]], axis=-1)
+
+
 def _wls_solve(mask, w, ey_adj, fx_minus_e, ridge):
     """Constrained weighted least squares, shared Gram matrix.
 
@@ -140,24 +171,14 @@ def _wls_solve(mask, w, ey_adj, fx_minus_e, ridge):
     """
 
     S, M = mask.shape
-    B, K = fx_minus_e.shape
     if M == 1:
         return fx_minus_e[:, :, None]
-
-    zl = mask[:, -1]
-    Zt = mask[:, :-1] - zl[:, None]            # (S, M-1)
-    Aw = Zt * w[:, None]                       # (S, M-1)
-    A = Aw.T @ Zt + ridge * jnp.eye(M - 1, dtype=mask.dtype)
-    rhs = jnp.einsum("sm,bsk->bkm", Aw, ey_adj - zl[None, :, None] * fx_minus_e[:, None, :])
-
-    c, low = jax.scipy.linalg.cho_factor(A)
-    sol = jax.scipy.linalg.cho_solve((c, low), rhs.reshape(B * K, M - 1).T)  # (M-1, B*K)
-    phi_rest = sol.T.reshape(B, K, M - 1)
-    phi_last = fx_minus_e - phi_rest.sum(-1)
-    return jnp.concatenate([phi_rest, phi_last[..., None]], axis=-1)
+    A, rhs = normal_equations(mask, w, ey_adj, fx_minus_e)
+    return solve_from_normal(A, rhs, fx_minus_e, ridge)
 
 
-def build_explainer_fn(predictor: BasePredictor, config: ShapConfig = ShapConfig()):
+def build_explainer_fn(predictor: BasePredictor, config: ShapConfig = ShapConfig(),
+                       with_ey: bool = False):
     """Build the pure explain function for ``predictor``.
 
     Returns ``explain(X, bg, bgw, mask, weights, G) -> dict`` with:
@@ -165,6 +186,9 @@ def build_explainer_fn(predictor: BasePredictor, config: ShapConfig = ShapConfig
     * ``shap_values``: ``(B, K, M)``
     * ``expected_value``: ``(K,)`` link-space expected model output
     * ``raw_prediction``: ``(B, K)`` link-space model output on ``X``
+    * ``ey_adj`` (only when ``with_ey``): ``(B, S, K)`` link-space expected
+      outputs per coalition minus the expected value — consumed by host-side
+      l1 feature selection so coalitions are never re-evaluated off-device.
 
     All inputs are arrays; the function contains no data-dependent Python
     control flow, so it can be wrapped in ``jax.jit`` (optionally with mesh
@@ -205,11 +229,14 @@ def build_explainer_fn(predictor: BasePredictor, config: ShapConfig = ShapConfig
         fx_minus_e = fx - expected_value[None, :]
         phi = _wls_solve(mask, weights, ey_adj, fx_minus_e, config.ridge)
 
-        return {
+        out = {
             "shap_values": phi,                # (B, K, M)
             "expected_value": expected_value,  # (K,)
             "raw_prediction": fx,              # (B, K) in link space
         }
+        if with_ey:
+            out["ey_adj"] = ey_adj             # (B, S, K)
+        return out
 
     return explain
 

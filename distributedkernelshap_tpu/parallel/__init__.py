@@ -1,0 +1,11 @@
+from distributedkernelshap_tpu.parallel.mesh import (  # noqa: F401
+    device_mesh,
+    initialize_multihost,
+    local_device_count,
+)
+from distributedkernelshap_tpu.parallel.distributed import (  # noqa: F401
+    DistributedExplainer,
+    invert_permutation,
+    kernel_shap_postprocess_fn,
+    kernel_shap_target_fn,
+)

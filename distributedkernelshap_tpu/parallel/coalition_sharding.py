@@ -1,0 +1,122 @@
+"""Coalition-axis sharding: context parallelism for KernelSHAP.
+
+The reference has no intra-explanation parallelism — one instance is always
+explained by exactly one process, noted as the design's scaling limit
+(SURVEY.md §2.3; `Analysis.ipynb` cell 27).  On TPU we shard the ``nsamples``
+coalition axis across a second mesh axis: each device evaluates a slice of
+the synthetic-data tensor for its share of coalitions and accumulates
+*partial normal equations* ``A_part = Zt'·W·Zt`` and ``rhs_part`` — both
+plain sums over coalition rows — which combine exactly with one ``psum``
+over ICI.  This is the WLS analog of blockwise/ring attention: the large
+``S×N`` work never materialises on one chip, and the only communication is
+two small ``(M-1)``-sized reductions (SURVEY.md §5.7).
+
+Used for the stress configurations (bg=1000 / nsamples=2048 and image
+KernelSHAP) where one instance's ``nsamples × N × D`` tensor exceeds a
+chip's HBM.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributedkernelshap_tpu.models.predictors import BasePredictor
+from distributedkernelshap_tpu.ops.explain import (
+    ShapConfig,
+    _auto_chunk,
+    _ey_generic,
+    _ey_linear,
+    normal_equations,
+    solve_from_normal,
+)
+from distributedkernelshap_tpu.ops.links import convert_to_link
+from distributedkernelshap_tpu.parallel.mesh import COALITION_AXIS, DATA_AXIS
+
+
+def build_coalition_sharded_fn(predictor: BasePredictor,
+                               config: ShapConfig,
+                               mesh: Mesh):
+    """Build the 2-D-sharded explain function over ``mesh`` (data, coalition).
+
+    Same signature/outputs as ``ops.explain.build_explainer_fn``; the
+    coalition row count must be divisible by the coalition axis size (the
+    caller pads plans with zero-weight rows).
+    """
+
+    link_fn = convert_to_link(config.link)
+    linear = predictor.linear_decomposition
+    n_coal = mesh.shape[COALITION_AXIS]
+
+    def local_ey(X, bg, bgw_n, zc_local):
+        """Expected outputs for this shard's coalition rows."""
+        B, D = X.shape
+        N = bg.shape[0]
+        K = predictor.n_outputs
+        S_local = zc_local.shape[0]
+        if linear is not None:
+            W, b, activation = linear
+            chunk = config.coalition_chunk or _auto_chunk(S_local, B * N * K,
+                                                          config.target_chunk_elems)
+            return _ey_linear(W, b, activation, X, bg, bgw_n, zc_local, chunk)
+        chunk = config.coalition_chunk or _auto_chunk(S_local, B * N * D,
+                                                      config.target_chunk_elems)
+        return _ey_generic(predictor, X, bg, bgw_n, zc_local, chunk)
+
+    def shard_body(X, bg, bgw, mask_local, w_local, G):
+        """Runs per (data, coalition) shard: X is this data-shard's slice,
+        mask/w are this coalition-shard's rows; bg/G replicated."""
+
+        bgw_n = bgw / jnp.sum(bgw)
+        zc_local = mask_local @ G
+        ey = local_ey(X, bg, bgw_n, zc_local)            # (B_loc, S_loc, K)
+
+        fx = link_fn(predictor(X))                       # (B_loc, K)
+        e_out = jnp.einsum("nk,n->k", predictor(bg), bgw_n)
+        expected_value = link_fn(e_out)
+
+        ey_adj = link_fn(ey) - expected_value[None, None, :]
+        fx_minus_e = fx - expected_value[None, :]
+
+        M = mask_local.shape[1]
+        if M == 1:
+            phi = fx_minus_e[:, :, None]
+        else:
+            A_part, rhs_part = normal_equations(mask_local, w_local, ey_adj, fx_minus_e)
+            # the only cross-shard communication: two small reductions over ICI
+            A = jax.lax.psum(A_part, COALITION_AXIS)
+            rhs = jax.lax.psum(rhs_part, COALITION_AXIS)
+            phi = solve_from_normal(A, rhs, fx_minus_e, config.ridge)
+
+        return {
+            'shap_values': phi,
+            'expected_value': expected_value,
+            'raw_prediction': fx,
+        }
+
+    sharded = jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(), P(), P(COALITION_AXIS), P(COALITION_AXIS), P()),
+        out_specs={'shap_values': P(DATA_AXIS), 'expected_value': P(),
+                   'raw_prediction': P(DATA_AXIS)},
+        check_vma=False,
+    )
+
+    def explain(X, bg, bgw, mask, weights, G):
+        S = mask.shape[0]
+        pad = (-S) % n_coal
+        if pad:
+            # zero-weight rows contribute nothing to the normal equations
+            mask = jnp.concatenate([mask, jnp.zeros((pad, mask.shape[1]), mask.dtype)], 0)
+            weights = jnp.concatenate([weights, jnp.zeros((pad,), weights.dtype)], 0)
+        with jax.default_matmul_precision(config.matmul_precision):
+            return sharded(X, bg, bgw, mask, weights, G)
+
+    shard = NamedSharding(mesh, P(DATA_AXIS))
+    repl = NamedSharding(mesh, P())
+    return jax.jit(explain,
+                   in_shardings=(shard, repl, repl, repl, repl, repl),
+                   out_shardings={'shap_values': shard, 'expected_value': repl,
+                                  'raw_prediction': shard})

@@ -1,0 +1,95 @@
+"""Device mesh construction and multi-host bootstrap.
+
+TPU-native replacement for the reference's Ray runtime bootstrap
+(``explainers/distributed.py:107-109`` local ``ray.init(num_cpus=...)``;
+``benchmarks/k8s_ray_pool.py:90`` ``ray.init(address='auto')`` in-cluster;
+head/worker wiring in ``cluster/ray_cluster.yaml``).  There is no head node
+and no object store: ``jax.distributed.initialize`` joins the hosts, a
+``jax.sharding.Mesh`` spans the slice, and XLA moves data over ICI/DCN.
+
+Axis convention:
+
+* ``data`` — the instance axis (the reference's only parallelism axis:
+  minibatches over the actor pool, SURVEY.md §2.3);
+* ``coalition`` — optional second axis sharding the ``nsamples`` dimension of
+  a single explanation, used by the stress configs where one instance's
+  synthetic tensor exceeds a chip (SURVEY.md §5.7; no reference analog).
+"""
+
+import logging
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+logger = logging.getLogger(__name__)
+
+DATA_AXIS = "data"
+COALITION_AXIS = "coalition"
+
+
+def local_device_count() -> int:
+    return len(jax.devices())
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> None:
+    """Join a multi-host JAX runtime.
+
+    On Cloud TPU pods the arguments are discovered from the environment and
+    may all be None.  Replaces the reference's Ray head/worker bootstrap: no
+    redis, no raylet — just the JAX coordination service over DCN.
+    """
+
+    if jax.process_count() > 1:
+        logger.info("jax.distributed already initialised (%d processes)", jax.process_count())
+        return
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs.update(coordinator_address=coordinator_address,
+                      num_processes=num_processes, process_id=process_id)
+    try:
+        jax.distributed.initialize(**kwargs)
+        logger.info("jax.distributed initialised: %d processes, %d devices",
+                    jax.process_count(), len(jax.devices()))
+    except Exception as e:  # single-host / already-initialised environments
+        logger.info("multi-host init skipped: %s", e)
+
+
+def device_mesh(n_devices: Optional[int] = None,
+                coalition_parallel: int = 1,
+                devices: Optional[Sequence] = None) -> Mesh:
+    """Build a ``(data, coalition)`` mesh over ``n_devices`` devices.
+
+    ``n_devices=None`` uses every visible device.  ``coalition_parallel > 1``
+    carves that many devices out of each data-parallel group to co-operate on
+    a single explanation batch (normal-equation partial sums over ICI).
+    """
+
+    devices = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        if n_devices > len(devices):
+            logger.warning(
+                "Requested %d devices but only %d are attached; using %d. "
+                "(The reference similarly caps the actor pool at the CPU count.)",
+                n_devices, len(devices), len(devices),
+            )
+            n_devices = len(devices)
+        devices = devices[:n_devices]
+
+    n = len(devices)
+    if n % coalition_parallel != 0:
+        raise ValueError(
+            f"coalition_parallel={coalition_parallel} must divide the device count {n}"
+        )
+    grid = np.asarray(devices).reshape(n // coalition_parallel, coalition_parallel)
+    return Mesh(grid, (DATA_AXIS, COALITION_AXIS))
+
+
+def pad_to_multiple(n: int, k: int) -> Tuple[int, int]:
+    """Smallest ``m >= n`` with ``m % k == 0``; returns ``(m, m - n)``."""
+
+    m = ((n + k - 1) // k) * k
+    return m, m - n

@@ -1,0 +1,177 @@
+"""Tests for the mesh-sharded distributed layer (8 virtual CPU devices —
+the TPU-native analog of the reference's Ray local mode, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributedkernelshap_tpu import DenseData, KernelShap
+from distributedkernelshap_tpu.kernel_shap import KernelExplainerEngine
+from distributedkernelshap_tpu.models import LinearPredictor
+from distributedkernelshap_tpu.parallel.distributed import (
+    DistributedExplainer,
+    invert_permutation,
+    kernel_shap_postprocess_fn,
+    kernel_shap_target_fn,
+)
+from distributedkernelshap_tpu.parallel.mesh import device_mesh, pad_to_multiple
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(0)
+    D, K, N, B = 11, 2, 20, 24
+    groups = [[0], [1], [2, 3, 4], [5, 6], [7, 8, 9, 10]]
+    group_names = ["a", "b", "c", "d", "e"]
+    W = rng.normal(size=(D, K)).astype(np.float32)
+    b = rng.normal(size=(K,)).astype(np.float32)
+    bg = rng.normal(size=(N, D)).astype(np.float32)
+    X = rng.normal(size=(B, D)).astype(np.float32)
+    pred = LinearPredictor(W, b, activation="softmax")
+    data = DenseData(bg, group_names, groups)
+    return dict(pred=pred, data=data, X=X, groups=groups, group_names=group_names, bg=bg)
+
+
+def test_eight_virtual_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_invert_permutation():
+    p = [3, 0, 2, 1]
+    s = invert_permutation(p)
+    np.testing.assert_array_equal(s, [1, 3, 2, 0])
+    np.testing.assert_array_equal(np.asarray(p)[s], np.arange(4))
+
+
+def test_postprocess_single_output():
+    parts = [np.ones((2, 3)), 2 * np.ones((3, 3))]
+    out = kernel_shap_postprocess_fn(parts)
+    assert out.shape == (5, 3) and out[2:].mean() == 2.0
+
+
+def test_postprocess_multi_output():
+    parts = [[np.ones((2, 3)), np.zeros((2, 3))], [2 * np.ones((1, 3)), np.zeros((1, 3))]]
+    out = kernel_shap_postprocess_fn(parts)
+    assert len(out) == 2 and out[0].shape == (3, 3)
+    assert out[0][-1, 0] == 2.0
+
+
+def test_target_fn_dispatch(setup):
+    engine = KernelExplainerEngine(setup["pred"], setup["data"], link="logit", seed=0)
+    idx, sv = kernel_shap_target_fn(engine, (3, setup["X"][:2]), {"nsamples": 32})
+    assert idx == 3 and sv[0].shape == (2, 5)
+
+
+def test_mesh_shapes():
+    mesh = device_mesh(8)
+    assert mesh.shape == {"data": 8, "coalition": 1}
+    mesh2 = device_mesh(8, coalition_parallel=2)
+    assert mesh2.shape == {"data": 4, "coalition": 2}
+    with pytest.raises(ValueError):
+        device_mesh(6, coalition_parallel=4)
+    assert pad_to_multiple(10, 8) == (16, 6)
+    assert pad_to_multiple(16, 8) == (16, 0)
+
+
+def test_distributed_matches_sequential(setup):
+    seq = KernelExplainerEngine(setup["pred"], setup["data"], link="logit", seed=0)
+    sv_seq = seq.get_explanation(setup["X"], nsamples=64)
+
+    dist = DistributedExplainer(
+        {"n_devices": 8, "batch_size": None, "algorithm": "kernel_shap"},
+        KernelExplainerEngine,
+        (setup["pred"], setup["data"]),
+        {"link": "logit", "seed": 0},
+    )
+    sv_dist = dist.get_explanation(setup["X"], nsamples=64)
+    assert len(sv_dist) == 2
+    np.testing.assert_allclose(sv_dist[0], sv_seq[0], atol=1e-5)
+    np.testing.assert_allclose(sv_dist[1], sv_seq[1], atol=1e-5)
+
+
+def test_distributed_batch_size_slabs(setup):
+    dist = DistributedExplainer(
+        {"n_devices": 8, "batch_size": 2, "algorithm": "kernel_shap"},
+        KernelExplainerEngine,
+        (setup["pred"], setup["data"]),
+        {"link": "logit", "seed": 0},
+    )
+    # 24 rows -> slabs of 16, padded to 32
+    sv = dist.get_explanation(setup["X"], nsamples=64)
+    seq = KernelExplainerEngine(setup["pred"], setup["data"], link="logit", seed=0)
+    sv_seq = seq.get_explanation(setup["X"], nsamples=64)
+    np.testing.assert_allclose(sv[0], sv_seq[0], atol=1e-5)
+
+
+def test_distributed_ragged_batch(setup):
+    dist = DistributedExplainer(
+        {"n_devices": 8, "batch_size": None, "algorithm": "kernel_shap"},
+        KernelExplainerEngine,
+        (setup["pred"], setup["data"]),
+        {"link": "logit", "seed": 0},
+    )
+    # 13 rows is not divisible by 8: exercises padding
+    sv = dist.get_explanation(setup["X"][:13], nsamples=64)
+    assert sv[0].shape == (13, 5)
+
+
+def test_coalition_parallel_matches(setup):
+    seq = KernelExplainerEngine(setup["pred"], setup["data"], link="logit", seed=0)
+    sv_seq = seq.get_explanation(setup["X"], nsamples=64)
+
+    dist = DistributedExplainer(
+        {"n_devices": 8, "batch_size": None, "coalition_parallel": 2,
+         "algorithm": "kernel_shap"},
+        KernelExplainerEngine,
+        (setup["pred"], setup["data"]),
+        {"link": "logit", "seed": 0},
+    )
+    assert dist.mesh.shape == {"data": 4, "coalition": 2}
+    sv = dist.get_explanation(setup["X"], nsamples=64)
+    np.testing.assert_allclose(sv[0], sv_seq[0], atol=1e-5)
+    np.testing.assert_allclose(sv[1], sv_seq[1], atol=1e-5)
+
+
+def test_attribute_proxy(setup):
+    dist = DistributedExplainer(
+        {"n_devices": 4, "batch_size": None, "algorithm": "kernel_shap"},
+        KernelExplainerEngine,
+        (setup["pred"], setup["data"]),
+        {"link": "logit", "seed": 0},
+    )
+    assert dist.vector_out is True
+    assert np.asarray(dist.expected_value).shape == (2,)
+    assert dist.return_attribute("M") == 5
+
+
+def test_kernel_shap_distributed_end_to_end(setup):
+    # the reference call shape: distributed_opts with the n_cpus spelling
+    explainer = KernelShap(setup["pred"], link="logit",
+                           feature_names=setup["group_names"],
+                           distributed_opts={"n_cpus": 8, "batch_size": None}, seed=0)
+    explainer.fit(setup["bg"], group_names=setup["group_names"], groups=setup["groups"])
+    explanation = explainer.explain(setup["X"], silent=True, nsamples=64)
+    sv = explanation.shap_values
+    assert sv[0].shape == (24, 5)
+    total = np.stack(sv, 1).sum(-1) + np.asarray(explanation.expected_value)[None]
+    np.testing.assert_allclose(total, explanation.data["raw"]["raw_prediction"], atol=1e-4)
+
+    seq = KernelShap(setup["pred"], link="logit", seed=0)
+    seq.fit(setup["bg"], group_names=setup["group_names"], groups=setup["groups"])
+    sv_seq = seq.explain(setup["X"], silent=True, nsamples=64).shap_values
+    np.testing.assert_allclose(sv[0], sv_seq[0], atol=1e-5)
+
+
+def test_graft_entry_single_and_multichip():
+    import sys
+
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert np.asarray(out["shap_values"]).shape == (8, 2, 6)
+    ge.dryrun_multichip(8)
+    ge.dryrun_multichip(4)
+    ge.dryrun_multichip(1)

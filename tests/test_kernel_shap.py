@@ -1,0 +1,365 @@
+"""Tests for the public KernelShap API (reference parity per SURVEY.md §2.1)."""
+
+import logging
+
+import numpy as np
+import pytest
+from scipy import sparse
+
+from distributedkernelshap_tpu import (
+    DenseData,
+    Explanation,
+    KernelShap,
+    rank_by_importance,
+    sum_categories,
+)
+from distributedkernelshap_tpu.kernel_shap import KernelExplainerEngine
+from distributedkernelshap_tpu.models import LinearPredictor
+
+
+# --------------------------------------------------------------------------- #
+# helpers
+
+
+def sum_categories_oracle(values, start_idx, enc_feat_dim):
+    """Independent reimplementation used as a cross-check: explicit python
+    loop over output columns."""
+
+    blocks = dict(zip(start_idx, enc_feat_dim))
+    cols = []
+    j = 0
+    while j < values.shape[-1]:
+        width = blocks.get(j, 1)
+        cols.append(list(range(j, j + width)))
+        j += width
+    if values.ndim == 2:
+        return np.stack([values[:, c].sum(1) for c in cols], axis=1)
+    tmp = np.stack([values[:, :, c].sum(2) for c in cols], axis=2)
+    return np.stack([tmp[:, c, :].sum(1) for c in cols], axis=1)
+
+
+@pytest.fixture(scope="module")
+def fitted_setup():
+    rng = np.random.default_rng(0)
+    D, K, N, B = 11, 2, 30, 16
+    groups = [[0], [1], [2, 3, 4], [5, 6], [7, 8, 9, 10]]
+    group_names = ["num0", "num1", "catA", "catB", "catC"]
+    W = rng.normal(size=(D, K)).astype(np.float32)
+    b = rng.normal(size=(K,)).astype(np.float32)
+    bg = rng.normal(size=(N, D)).astype(np.float32)
+    X = rng.normal(size=(B, D)).astype(np.float32)
+    pred = LinearPredictor(W, b, activation="softmax")
+    return dict(groups=groups, group_names=group_names, W=W, b=b, bg=bg, X=X, pred=pred)
+
+
+# --------------------------------------------------------------------------- #
+# rank_by_importance / sum_categories
+
+
+def test_rank_by_importance_structure():
+    sv = [np.array([[1.0, -3.0, 0.5], [1.0, -3.0, 0.5]]),
+          np.array([[0.1, 0.2, 4.0], [0.1, 0.2, 4.0]])]
+    imp = rank_by_importance(sv, feature_names=["a", "b", "c"])
+    assert set(imp) == {"0", "1", "aggregated"}
+    assert imp["0"]["names"] == ["b", "a", "c"]
+    np.testing.assert_allclose(imp["0"]["ranked_effect"], [3.0, 1.0, 0.5])
+    assert imp["aggregated"]["names"][0] == "c"  # 0.5+4.0 largest
+
+
+def test_rank_by_importance_bad_names_falls_back():
+    sv = [np.ones((2, 3))]
+    imp = rank_by_importance(sv, feature_names=["only_two", "names"])
+    assert imp["0"]["names"][0].startswith("feature_")
+
+
+@pytest.mark.parametrize("ndim", [2, 3])
+def test_sum_categories_matches_oracle(ndim):
+    rng = np.random.default_rng(2)
+    ncols = 9
+    start_idx, enc_dim = [1, 5], [3, 2]  # cols: [0][1,2,3][4][5,6][7][8]
+    shape = (4, ncols) if ndim == 2 else (4, ncols, ncols)
+    values = rng.normal(size=shape)
+    out = sum_categories(values, start_idx, enc_dim)
+    expected = sum_categories_oracle(values, start_idx, enc_dim)
+    np.testing.assert_allclose(out, expected, atol=1e-12)
+    assert out.shape[-1] == 6
+
+
+def test_sum_categories_validation():
+    v = np.zeros((2, 5))
+    with pytest.raises(ValueError):
+        sum_categories(v, None, [2])
+    with pytest.raises(ValueError):
+        sum_categories(v, [0, 1], [2])  # length mismatch
+    with pytest.raises(ValueError):
+        sum_categories(v, [0], [9])  # exceeds dim
+    with pytest.raises(ValueError):
+        sum_categories(np.zeros(5), [0], [2])  # rank 1
+
+
+# --------------------------------------------------------------------------- #
+# engine
+
+
+def test_engine_expected_value_and_layout(fitted_setup):
+    s = fitted_setup
+    engine = KernelExplainerEngine(s["pred"], DenseData(
+        s["bg"], s["group_names"], s["groups"]), link="logit", seed=0)
+    assert engine.M == 5
+    sv = engine.get_explanation(s["X"][:4], nsamples=64)
+    assert isinstance(sv, list) and len(sv) == 2
+    assert sv[0].shape == (4, 5)
+    # (batch_idx, batch) tuple passthrough
+    idx, sv2 = engine.get_explanation((7, s["X"][:4]), nsamples=64)
+    assert idx == 7
+    np.testing.assert_allclose(sv[0], sv2[0], atol=1e-6)
+
+
+def test_engine_batch_bucketing_consistency(fitted_setup):
+    s = fitted_setup
+    engine = KernelExplainerEngine(s["pred"], DenseData(
+        s["bg"], s["group_names"], s["groups"]), link="logit", seed=0)
+    sv_all = engine.get_explanation(s["X"], nsamples=64)  # B=16 (pow2)
+    sv_odd = engine.get_explanation(s["X"][:13], nsamples=64)  # padded to 16
+    np.testing.assert_allclose(sv_all[1][:13], sv_odd[1], atol=1e-5)
+
+
+def test_engine_instance_chunking(fitted_setup):
+    from distributedkernelshap_tpu.kernel_shap import EngineConfig
+
+    s = fitted_setup
+    engine = KernelExplainerEngine(
+        s["pred"], DenseData(s["bg"], s["group_names"], s["groups"]),
+        link="logit", seed=0, config=EngineConfig(instance_chunk=5))
+    ref = KernelExplainerEngine(
+        s["pred"], DenseData(s["bg"], s["group_names"], s["groups"]),
+        link="logit", seed=0)
+    a = engine.get_explanation(s["X"], nsamples=64)
+    b = ref.get_explanation(s["X"], nsamples=64)
+    np.testing.assert_allclose(a[0], b[0], atol=1e-5)
+
+
+# --------------------------------------------------------------------------- #
+# KernelShap end-to-end
+
+
+def test_kernel_shap_end_to_end(fitted_setup):
+    s = fitted_setup
+    explainer = KernelShap(s["pred"], link="logit", feature_names=s["group_names"],
+                           task="classification", seed=0)
+    explainer.fit(s["bg"], group_names=s["group_names"], groups=s["groups"])
+    explanation = explainer.explain(s["X"], silent=True)
+
+    assert isinstance(explanation, Explanation)
+    assert explanation.meta["name"] == "KernelShap"
+    sv = explanation.shap_values
+    assert len(sv) == 2 and sv[0].shape == (16, 5)
+    # additivity against the payload's own raw predictions
+    total = np.stack(sv, 1).sum(-1) + np.asarray(explanation.expected_value)[None, :]
+    np.testing.assert_allclose(total, explanation.data["raw"]["raw_prediction"], atol=1e-4)
+    # importances present and prediction is argmax
+    assert "aggregated" in explanation.data["raw"]["importances"]
+    np.testing.assert_array_equal(
+        explanation.data["raw"]["prediction"],
+        np.argmax(explanation.data["raw"]["raw_prediction"], axis=1))
+    # whitelisted params recorded ('grouped' is filtered by KERNEL_SHAP_PARAMS,
+    # matching the reference whitelist kernel_shap.py:23-31)
+    assert explainer.meta["params"]["groups"] == s["groups"]
+    assert "grouped" not in explainer.meta["params"]
+
+
+def test_kernel_shap_exact_linear_end_to_end(fitted_setup):
+    s = fitted_setup
+    pred = LinearPredictor(s["W"], s["b"], activation="identity")
+    explainer = KernelShap(pred, link="identity", seed=0)
+    explainer.fit(s["bg"], group_names=s["group_names"], groups=s["groups"])
+    explanation = explainer.explain(s["X"], nsamples=64, l1_reg=False)
+    diff = s["X"] - s["bg"].mean(0)
+    for j, cols in enumerate(s["groups"]):
+        expected_j = diff[:, cols] @ s["W"][cols, :]
+        np.testing.assert_allclose(explanation.shap_values[0][:, j], expected_j[:, 0], atol=3e-4)
+
+
+def test_unfitted_explain_raises(fitted_setup):
+    explainer = KernelShap(fitted_setup["pred"])
+    with pytest.raises(TypeError, match="unfitted"):
+        explainer.explain(np.zeros((1, 11)))
+
+
+def test_distributed_type_guard(fitted_setup):
+    import pandas as pd
+
+    s = fitted_setup
+    explainer = KernelShap(s["pred"], distributed_opts={"n_cpus": 2})
+    assert explainer.distribute
+    explainer._fitted = True
+    explainer._explainer = None
+    with pytest.raises(TypeError, match="distributed context"):
+        explainer.explain(pd.DataFrame(np.zeros((2, 11))))
+
+
+def test_groups_degrade_on_bad_sizes(fitted_setup, caplog):
+    s = fitted_setup
+    explainer = KernelShap(s["pred"], link="logit", seed=0)
+    bad_groups = [[0], [1, 2]]  # only covers 3 of 11 columns
+    with caplog.at_level(logging.WARNING):
+        explainer.fit(s["bg"], groups=bad_groups, group_names=["a", "b"])
+    assert explainer.use_groups is False
+    # engine falls back to singleton groups over all 11 columns
+    assert explainer._explainer.M == 11
+
+
+def test_group_names_only_wrong_count_degrades(fitted_setup):
+    s = fitted_setup
+    explainer = KernelShap(s["pred"], link="logit", seed=0)
+    explainer.fit(s["bg"], group_names=["x", "y", "z"])  # no groups, wrong count
+    assert explainer.use_groups is False
+
+
+def test_weights_mismatch_ignored(fitted_setup):
+    s = fitted_setup
+    explainer = KernelShap(s["pred"], link="logit", seed=0)
+    explainer.fit(s["bg"], group_names=s["group_names"], groups=s["groups"],
+                  weights=np.ones(7))  # 30 rows, 7 weights
+    assert explainer.ignore_weights is True
+
+
+def test_summarise_background_kmeans(fitted_setup):
+    s = fitted_setup
+    explainer = KernelShap(s["pred"], link="logit", seed=0)
+    explainer.fit(s["bg"], summarise_background=True, n_background_samples=5)
+    assert explainer.summarise_background is True
+    assert isinstance(explainer.background_data, DenseData)
+    assert explainer.background_data.data.shape == (5, 11)
+    # centroids snapped to observed values
+    assert np.isin(explainer.background_data.data[:, 0], s["bg"][:, 0]).all()
+
+
+def test_summarise_background_subsample_with_groups(fitted_setup):
+    s = fitted_setup
+    explainer = KernelShap(s["pred"], link="logit", seed=0)
+    explainer.fit(s["bg"], summarise_background="auto",
+                  group_names=s["group_names"], groups=s["groups"])
+    # auto caps at min(n, 300) = 30 -> no reduction, but subsample path taken
+    assert explainer.summarise_background is True
+    assert explainer._explainer.background.shape[0] == 30
+
+
+def test_sparse_background_and_explain(fitted_setup):
+    s = fitted_setup
+    explainer = KernelShap(s["pred"], link="logit", seed=0)
+    explainer.fit(sparse.csr_matrix(s["bg"]),
+                  group_names=s["group_names"], groups=s["groups"])
+    explanation = explainer.explain(sparse.csr_matrix(s["X"][:3]), nsamples=64)
+    assert explanation.shap_values[0].shape == (3, 5)
+
+
+def test_summarise_result(fitted_setup):
+    s = fitted_setup
+    pred = LinearPredictor(s["W"], s["b"], activation="softmax")
+    explainer = KernelShap(pred, link="logit", seed=0)
+    explainer.fit(s["bg"])  # no grouping: phi per column (11)
+    explanation = explainer.explain(
+        s["X"][:4], summarise_result=True,
+        cat_vars_start_idx=[2, 5, 7], cat_vars_enc_dim=[3, 2, 4], nsamples=128)
+    assert explainer.summarise_result is True
+    assert explanation.shap_values[0].shape == (4, 5)
+
+
+def test_summarise_result_with_groups_skipped(fitted_setup):
+    s = fitted_setup
+    explainer = KernelShap(s["pred"], link="logit", seed=0)
+    explainer.fit(s["bg"], group_names=s["group_names"], groups=s["groups"])
+    explanation = explainer.explain(
+        s["X"][:2], summarise_result=True,
+        cat_vars_start_idx=[2], cat_vars_enc_dim=[3], nsamples=64)
+    assert explainer.summarise_result is False
+    assert explanation.shap_values[0].shape == (2, 5)
+
+
+def test_l1_reg_num_features(fitted_setup):
+    s = fitted_setup
+    pred = LinearPredictor(s["W"], s["b"], activation="identity")
+    engine = KernelExplainerEngine(pred, DenseData(
+        s["bg"], s["group_names"], s["groups"]), link="identity", seed=0)
+    sv = engine.get_explanation(s["X"][:2], nsamples=20, l1_reg="num_features(3)")
+    nz = (np.abs(sv[0]) > 1e-9).sum(1)
+    assert (nz <= 4).all()  # 3 selected + constrained last feature
+    # additivity still holds exactly by construction
+    fx = engine.predict(s["X"][:2], link=True)
+    ev = np.atleast_1d(engine.expected_value)
+    total = np.stack(sv, 1).sum(-1) + ev[None]
+    np.testing.assert_allclose(total, fx, atol=1e-4)
+
+
+def test_sklearn_lift_faithfulness_guard():
+    """Estimators exposing coef_ whose predict_proba is NOT softmax-of-margin
+    must not be lifted (review finding: Platt-scaled SVC, ovr-LR)."""
+
+    from sklearn.svm import SVC
+
+    from distributedkernelshap_tpu.models import CallbackPredictor, as_predictor
+
+    rng = np.random.default_rng(0)
+    Xtr = rng.normal(size=(80, 5))
+    ytr = (Xtr @ rng.normal(size=5) > 0).astype(int)
+    svc = SVC(kernel="linear", probability=True, random_state=0).fit(Xtr, ytr)
+    pred = as_predictor(svc.predict_proba, example_dim=5)
+    assert isinstance(pred, CallbackPredictor)  # lift rejected, callback fallback
+
+
+def test_engine_config_not_mutated():
+    from distributedkernelshap_tpu.kernel_shap import EngineConfig
+
+    rng = np.random.default_rng(0)
+    bg = rng.normal(size=(5, 3)).astype(np.float32)
+    pred = LinearPredictor(rng.normal(size=(3, 2)).astype(np.float32),
+                           np.zeros(2, np.float32), activation="softmax")
+    cfg = EngineConfig(link="logit")
+    engine = KernelExplainerEngine(pred, bg, config=cfg)
+    assert engine.config.link == "logit"  # config value kept when ctor arg absent
+    KernelExplainerEngine(pred, bg, link="identity", config=cfg)
+    assert cfg.link == "logit"  # caller's config untouched
+
+
+def test_subsample_preserves_container_type():
+    import pandas as pd
+
+    from distributedkernelshap_tpu.ops.summarise import subsample
+
+    df = pd.DataFrame(np.arange(20).reshape(10, 2), columns=["a", "b"])
+    out = subsample(df, 4, seed=0)
+    assert isinstance(out, pd.DataFrame) and list(out.columns) == ["a", "b"]
+    sp = sparse.csr_matrix(np.eye(10))
+    assert sparse.issparse(subsample(sp, 4, seed=0))
+
+
+def test_l1_auto_activates_on_device_ey(fitted_setup, caplog):
+    """M large + tiny nsamples -> auto AIC path, fed by device ey (no host
+    coalition loop)."""
+
+    rng = np.random.default_rng(1)
+    D = 20
+    W = rng.normal(size=(D, 2)).astype(np.float32)
+    bg = rng.normal(size=(10, D)).astype(np.float32)
+    X = rng.normal(size=(2, D)).astype(np.float32)
+    pred = LinearPredictor(W, np.zeros(2, np.float32), activation="identity")
+    engine = KernelExplainerEngine(pred, bg, link="identity", seed=0)
+    with caplog.at_level(logging.WARNING):
+        sv = engine.get_explanation(X, nsamples=300, l1_reg="auto")
+    assert any("l1_reg='auto'" in r.message for r in caplog.records)
+    # additivity preserved by the restricted solve
+    fx = engine.predict(X, link=True)
+    total = np.stack(sv, 1).sum(-1) + np.atleast_1d(engine.expected_value)[None]
+    np.testing.assert_allclose(total, fx, atol=1e-4)
+
+
+def test_explanation_json_roundtrip_end_to_end(fitted_setup):
+    s = fitted_setup
+    explainer = KernelShap(s["pred"], link="logit", seed=0)
+    explainer.fit(s["bg"], group_names=s["group_names"], groups=s["groups"])
+    explanation = explainer.explain(s["X"][:2], nsamples=64)
+    rebuilt = Explanation.from_json(explanation.to_json())
+    np.testing.assert_allclose(
+        np.asarray(rebuilt.data["shap_values"][0]),
+        explanation.shap_values[0], atol=1e-6)
