@@ -1,0 +1,2 @@
+JAX_PLATFORMS=cpu python benchmarks/pool.py -b 32 -w 4 -n 1 2>&1 | tail -3
+ls results/

@@ -1,0 +1,79 @@
+"""Headline benchmark: explain 2560 Adult instances, bg=100, link='logit'.
+
+The reference's benchmark task (``benchmarks/ray_pool.py:82-110``,
+``README.md:3``): sequential baseline 1736.89 s, best 32-vCPU Ray actor-pool
+time 125.05 s (BASELINE.md).  This script runs the same task end-to-end on
+the attached TPU device(s) and prints ONE JSON line:
+
+    {"metric": "adult_2560_bg100_wall_s", "value": <seconds>, "unit": "s",
+     "vs_baseline": <125.05 / seconds>}
+
+``vs_baseline`` is the speed-up over the reference's best single-node
+(32-vCPU) actor-pool configuration.  Timing excludes compilation (one warmup
+run, like the reference's multi-run protocol that reuses fitted explainers)
+and includes host->device transfer of the batch + full retrieval of the
+Explanation payload.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+RAY_POOL_32VCPU_BASELINE_S = 125.05  # BASELINE.md: best single-node reference
+
+
+def main() -> int:
+    import jax
+
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.utils import load_data, load_model
+
+    data = load_data()
+    clf = load_model()
+    group_names, groups = data["all"]["group_names"], data["all"]["groups"]
+    X_explain = np.ascontiguousarray(
+        data["all"]["X"]["processed"]["test"].toarray(), dtype=np.float32)
+    background = data["background"]["X"]["preprocessed"]
+    assert X_explain.shape[0] == 2560, X_explain.shape
+    assert background.shape[0] == 100, background.shape
+
+    n_devices = len(jax.devices())
+    distributed_opts = {"n_devices": n_devices} if n_devices > 1 else None
+
+    explainer = KernelShap(clf.predict_proba, link="logit",
+                           feature_names=group_names, seed=0,
+                           distributed_opts=distributed_opts)
+    explainer.fit(background, group_names=group_names, groups=groups)
+
+    # warmup: compile + first run (the reference also reuses a fitted
+    # explainer across its nruns timing loop, ray_pool.py:70-79)
+    explainer.explain(X_explain, silent=True)
+
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        explanation = explainer.explain(X_explain, silent=True)
+        times.append(time.perf_counter() - t0)
+
+    # sanity: additivity of the produced explanation
+    sv = explanation.shap_values
+    total = np.stack(sv, 1).sum(-1) + np.asarray(explanation.expected_value)[None, :]
+    err = float(np.abs(total - explanation.data["raw"]["raw_prediction"]).max())
+    if not err < 1e-3:
+        print(json.dumps({"error": f"additivity violated: {err}"}))
+        return 1
+
+    value = float(np.median(times))
+    print(json.dumps({
+        "metric": "adult_2560_bg100_wall_s",
+        "value": round(value, 4),
+        "unit": "s",
+        "vs_baseline": round(RAY_POOL_32VCPU_BASELINE_S / value, 1),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

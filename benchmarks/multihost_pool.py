@@ -1,0 +1,84 @@
+"""Multi-host pool benchmark — translation of ``benchmarks/k8s_ray_pool.py``.
+
+The reference joins an existing Ray cluster with ``ray.init(address='auto')``
+(``k8s_ray_pool.py:90``) from the head pod.  The TPU-native equivalent is a
+multi-controller JAX program: EVERY host runs this script,
+``jax.distributed.initialize`` discovers the slice (or takes explicit
+coordinator flags), and the mesh spans all hosts' devices with sharding
+transfers riding ICI/DCN.  Process 0 reports timings and writes result
+pickles in the reference format.
+
+Run on each host (TPU pod slices auto-discover; elsewhere pass flags):
+
+    python benchmarks/multihost_pool.py -b 32 -w 32 \
+        --coordinator 10.0.0.1:1234 --num_processes 4 --process_id $RANK
+
+One explainer is reused across batch-size settings by mutating the
+dispatcher's ``batch_size`` (the reference does the same via
+``explainer._explainer.batch_size``, ``k8s_ray_pool.py:74``).
+"""
+
+import argparse
+import logging
+import os
+import pickle
+import sys
+from timeit import default_timer as timer
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributedkernelshap_tpu.parallel.mesh import initialize_multihost  # noqa: E402
+from distributedkernelshap_tpu.utils import get_filename, load_data, load_model  # noqa: E402
+
+logging.basicConfig(level=logging.INFO)
+
+
+def main():
+    import jax
+
+    initialize_multihost(args.coordinator, args.num_processes, args.process_id)
+    is_lead = jax.process_index() == 0
+
+    data = load_data()
+    predictor = load_model()
+    X_explain = data['all']['X']['processed']['test'].toarray()
+
+    from benchmarks.pool import fit_kernel_shap_explainer
+
+    workers = args.workers if args.workers > 0 else len(jax.devices())
+    explainer = fit_kernel_shap_explainer(
+        predictor, data, {'batch_size': None, 'n_devices': workers})
+    explainer.explain(X_explain[:8 * workers], silent=True)  # warmup compile
+
+    nruns = args.nruns if args.benchmark else 1
+    if is_lead and not os.path.exists('./results'):
+        os.mkdir('./results')
+
+    for batch_size in [int(b) for b in args.batch]:
+        # reuse the fitted explainer across batch sizes (reference pattern)
+        explainer._explainer.batch_size = batch_size
+        result = {'t_elapsed': []}
+        for run in range(nruns):
+            t_start = timer()
+            explainer.explain(X_explain, silent=True)
+            t_elapsed = timer() - t_start
+            if is_lead:
+                logging.info("run %d batch %d: %.3fs", run, batch_size, t_elapsed)
+                result['t_elapsed'].append(t_elapsed)
+                with open(get_filename(workers, batch_size, serve=False), 'wb') as f:
+                    pickle.dump(result, f)
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-b", "--batch", nargs='+', required=True)
+    parser.add_argument("-w", "--workers", default=-1, type=int,
+                        help="Global device count to use; -1 = all visible.")
+    parser.add_argument("-benchmark", default=0, type=int)
+    parser.add_argument("-n", "--nruns", default=5, type=int)
+    parser.add_argument("--coordinator", default=None, type=str,
+                        help="coordinator host:port (omit on TPU pods)")
+    parser.add_argument("--num_processes", default=None, type=int)
+    parser.add_argument("--process_id", default=None, type=int)
+    args = parser.parse_args()
+    main()

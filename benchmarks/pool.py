@@ -1,0 +1,115 @@
+"""Device-pool benchmark — translation of ``benchmarks/ray_pool.py``.
+
+Same CLI flags (``-b/--batch``, ``-w/--workers``, ``-benchmark``,
+``-n/--nruns``), the same ``{'t_elapsed': [...]}`` incremental pickle format
+and the same result filename convention (``utils.get_filename``,- reference
+``utils.py:67-86``) so the reference's Analysis notebook ingests the results
+unchanged.  ``--workers`` maps to mesh devices instead of Ray actors:
+``-1`` runs the single-device sequential path (reference ``ray_pool.py:95-99``),
+otherwise a ``workers``-wide data-parallel mesh explains the batch
+(``ray.shutdown()`` between configurations has no analog — meshes are
+stateless).
+"""
+
+import argparse
+import logging
+import os
+import pickle
+import sys
+from timeit import default_timer as timer
+from typing import Any, Dict
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributedkernelshap_tpu import KernelShap  # noqa: E402
+from distributedkernelshap_tpu.utils import get_filename, load_data, load_model  # noqa: E402
+
+logging.basicConfig(level=logging.INFO)
+
+
+def fit_kernel_shap_explainer(clf, data: dict, distributed_opts: Dict[str, Any] = None):
+    """Fitted KernelShap explainer for ``clf`` with grouping from ``data``
+    (reference ray_pool.py:18-38 call shape)."""
+
+    pred_fcn = clf.predict_proba
+    group_names, groups = data['all']['group_names'], data['all']['groups']
+    explainer = KernelShap(pred_fcn, link='logit', feature_names=group_names,
+                           distributed_opts=distributed_opts, seed=0)
+    explainer.fit(data['background']['X']['preprocessed'],
+                  group_names=group_names, groups=groups)
+    return explainer
+
+
+def run_explainer(explainer, X_explain: np.ndarray, distributed_opts: dict, nruns: int):
+    """Timed explain runs with incremental result pickles
+    (reference ray_pool.py:41-79)."""
+
+    if not os.path.exists('./results'):
+        os.mkdir('./results')
+    batch_size = distributed_opts['batch_size']
+    workers = distributed_opts.get('n_devices') or distributed_opts.get('n_cpus')
+    result = {'t_elapsed': []}
+    for run in range(nruns):
+        logging.info("run: %d", run)
+        t_start = timer()
+        explainer.explain(X_explain, silent=True)
+        t_elapsed = timer() - t_start
+        logging.info("Time elapsed: %s", t_elapsed)
+        result['t_elapsed'].append(t_elapsed)
+        with open(get_filename(workers if workers else -1, batch_size, serve=False), 'wb') as f:
+            pickle.dump(result, f)
+
+
+def main():
+    nruns = args.nruns if args.benchmark else 1
+    batch_sizes = [int(elem) for elem in args.batch]
+
+    data = load_data()
+    predictor = load_model()
+    y_test = data['all']['y']['test']
+    X_test_proc = data['all']['X']['processed']['test']
+    from sklearn.metrics import accuracy_score
+    logging.info("Test accuracy: %s", accuracy_score(y_test, predictor.predict(X_test_proc)))
+    X_explain = X_test_proc.toarray()
+
+    if args.workers == -1:  # single-device sequential path
+        logging.info("Running sequential benchmark on a single device ...")
+        distributed_opts = {'batch_size': None, 'n_devices': None}
+        explainer = fit_kernel_shap_explainer(predictor, data, distributed_opts)
+        # warmup compile, then timed runs (the reference's 1-worker runs pay
+        # no compile cost; keep the timing comparable)
+        explainer.explain(X_explain[:8], silent=True)
+        run_explainer(explainer, X_explain, distributed_opts, nruns)
+        return
+
+    workers_range = (range(1, args.workers + 1) if args.benchmark == 1
+                     else range(args.workers, args.workers + 1))
+    for workers in workers_range:
+        for batch_size in batch_sizes:
+            logging.info("Running experiment on %d device(s), batch size %d",
+                         workers, batch_size)
+            distributed_opts = {'batch_size': int(batch_size), 'n_devices': workers}
+            explainer = fit_kernel_shap_explainer(predictor, data, distributed_opts)
+            explainer.explain(X_explain[:8 * workers], silent=True)  # warmup
+            run_explainer(explainer, X_explain, distributed_opts, nruns)
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "-b", "--batch", nargs='+', required=True,
+        help="Maximum per-device batch sizes to sweep.")
+    parser.add_argument(
+        "-w", "--workers", default=-1, type=int,
+        help="Number of devices to shard explanations over; -1 runs the "
+             "sequential single-device path.")
+    parser.add_argument(
+        "-benchmark", default=0, type=int,
+        help="Set to 1 to sweep devices in range(1, workers+1).")
+    parser.add_argument(
+        "-n", "--nruns", default=5, type=int,
+        help="Timed repetitions per configuration (benchmark mode).")
+    args = parser.parse_args()
+    main()

@@ -1,0 +1,104 @@
+"""Serving benchmark — translation of ``benchmarks/serve_explanations.py``.
+
+Same CLI flags (``--replicas``, ``-batch``, ``-benchmark``, ``--nruns``,
+``--host``, ``--port``) and the same result pickle format/naming
+(``utils.get_filename(serve=True)``) as the reference (:199-244).  The
+client fans out one request per instance (reference ``distribute_request``
+Ray tasks, :96-139 — here a thread pool); the server coalesces them into
+device batches of ``max_batch_size``.
+
+``--replicas`` has no hardware meaning on a single device (the reference
+spawned that many replica processes); it is kept for sweep/filename parity
+and sets the HTTP thread-pool width.
+"""
+
+import argparse
+import logging
+import os
+import pickle
+import sys
+from timeit import default_timer as timer
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributedkernelshap_tpu.serving import distribute_requests, serve_explainer  # noqa: E402
+from distributedkernelshap_tpu.utils import get_filename, load_data, load_model  # noqa: E402
+
+logging.basicConfig(level=logging.INFO)
+
+
+def prepare_explainer_args(data: dict):
+    """Constructor/fit args for the served explainer
+    (reference serve_explanations.py:70-93 call shape)."""
+
+    group_names, groups = data['all']['group_names'], data['all']['groups']
+    background = data['background']['X']['preprocessed']
+    constructor_kwargs = {'link': 'logit', 'feature_names': group_names, 'seed': 0}
+    fit_kwargs = {'group_names': group_names, 'groups': groups}
+    return background, constructor_kwargs, fit_kwargs
+
+
+def run_config(predictor, data, X_explain, replicas: int, max_batch_size: int,
+               host: str, port: int, nruns: int):
+    background, ctor_kwargs, fit_kwargs = prepare_explainer_args(data)
+    server = serve_explainer(predictor, background, ctor_kwargs, fit_kwargs,
+                             host=host, port=port, max_batch_size=max_batch_size)
+    url = f"http://{'127.0.0.1' if host == '0.0.0.0' else host}:{server.port}/explain"
+    try:
+        # warmup (compile)
+        distribute_requests(url, X_explain[:2], max_workers=2)
+        if not os.path.exists('./results'):
+            os.mkdir('./results')
+        result = {'t_elapsed': []}
+        for run in range(nruns):
+            logging.info("run: %d", run)
+            t_start = timer()
+            responses = distribute_requests(url, X_explain, max_workers=replicas)
+            t_elapsed = timer() - t_start
+            assert len(responses) == X_explain.shape[0]
+            logging.info("Time elapsed: %s", t_elapsed)
+            result['t_elapsed'].append(t_elapsed)
+            with open(get_filename(replicas, max_batch_size, serve=True), 'wb') as f:
+                pickle.dump(result, f)
+    finally:
+        server.stop()
+
+
+def main():
+    nruns = args.nruns if args.benchmark else 1
+    batch_sizes = [int(elem) for elem in args.batch]
+
+    data = load_data()
+    predictor = load_model()
+    X_explain = data['all']['X']['processed']['test'].toarray()
+    assert X_explain.shape[0] == 2560
+    assert data['background']['X']['preprocessed'].shape[0] == 100
+
+    replicas_range = (range(1, args.replicas + 1) if args.benchmark == 1
+                      else range(args.replicas, args.replicas + 1))
+    for replicas in replicas_range:
+        for max_batch_size in batch_sizes:
+            logging.info("Experiment: %d client workers, max_batch_size %d",
+                         replicas, max_batch_size)
+            run_config(predictor, data, X_explain, replicas, max_batch_size,
+                       args.host, args.port, nruns)
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "-r", "--replicas", default=1, type=int,
+        help="Client fan-out width (the reference's replica count; on TPU the "
+             "device is shared, this sets concurrent in-flight requests).")
+    parser.add_argument(
+        "-b", "--batch", nargs='+', required=True,
+        help="max_batch_size values to sweep for server-side request coalescing.")
+    parser.add_argument("-benchmark", default=0, type=int,
+                        help="Set to 1 to sweep replicas in range(1, replicas+1).")
+    parser.add_argument("-n", "--nruns", default=5, type=int)
+    parser.add_argument("--host", default="0.0.0.0", type=str)
+    parser.add_argument("--port", default=8000, type=int)
+    args = parser.parse_args()
+    main()

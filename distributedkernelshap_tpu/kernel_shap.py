@@ -174,6 +174,11 @@ class EngineConfig:
     instance_chunk: Optional[int] = None
     # pad batch sizes up to powers of two to bound jit retraces
     bucket_batches: bool = True
+    # evaluate the predictor on the host instead of inside the jitted
+    # pipeline: None = auto (host eval for CallbackPredictors on backends
+    # without host-callback support, e.g. the axon TPU tunnel); the WLS solve
+    # stays on device either way
+    host_eval: Optional[bool] = None
 
 
 class KernelExplainerEngine:
@@ -220,14 +225,35 @@ class KernelExplainerEngine:
         self._plan_cache: Dict[Any, Any] = {}
         self._fn_cache: Dict[Any, Any] = {}
 
+        # black-box predictors can't run inside jit on backends without host
+        # callbacks (axon PJRT rejects pure_callback): evaluate on the host,
+        # solve on device
+        if self.config.host_eval is None:
+            from distributedkernelshap_tpu.models.predictors import CallbackPredictor
+
+            self.config = replace(
+                self.config,
+                host_eval=(isinstance(self.predictor, CallbackPredictor)
+                           and jax.default_backend() not in ('cpu', 'gpu', 'tpu')))
+        if self.config.host_eval:
+            logger.info("Using host-side predictor evaluation (device keeps the "
+                        "WLS solve); backend=%s", jax.default_backend())
+
         # expected value: link-space weighted mean background prediction,
         # computed at the pipeline's matmul precision for exact consistency
-        link_fn = convert_to_link(self.config.link)
         bgw = self.bg_weights / self.bg_weights.sum()
-        with jax.default_matmul_precision(self.config.shap.matmul_precision):
-            e_out = np.asarray(
-                link_fn(jnp.einsum('nk,n->k', self.predictor(jnp.asarray(self.background)),
-                                   jnp.asarray(bgw))))
+        if self.config.host_eval:
+            from distributedkernelshap_tpu.ops.links import convert_to_link_np
+
+            out_bg = self.predictor.host_fn(self.background)
+            e_out = convert_to_link_np(self.config.link)(
+                np.einsum('nk,n->k', out_bg, bgw)).astype(np.float32)
+        else:
+            link_fn = convert_to_link(self.config.link)
+            with jax.default_matmul_precision(self.config.shap.matmul_precision):
+                e_out = np.asarray(
+                    link_fn(jnp.einsum('nk,n->k', self.predictor(jnp.asarray(self.background)),
+                                       jnp.asarray(bgw))))
         self.expected_value = e_out if self.vector_out else float(e_out[0])
 
     @staticmethod
@@ -267,7 +293,82 @@ class KernelExplainerEngine:
     def _bucket(n: int) -> int:
         return 1 << max(0, math.ceil(math.log2(n))) if n > 1 else 1
 
+    def _solve_fn(self):
+        if 'solve' not in self._fn_cache:
+            from distributedkernelshap_tpu.ops.explain import _wls_solve
+
+            ridge = self.config.shap.ridge
+            precision = self.config.shap.matmul_precision
+
+            def solve(mask, w, ey_adj, fx_minus_e):
+                with jax.default_matmul_precision(precision):
+                    return _wls_solve(mask, w, ey_adj, fx_minus_e, ridge)
+
+            self._fn_cache['solve'] = jax.jit(solve)
+        return self._fn_cache['solve']
+
+    def _hosteval_stats(self, X: np.ndarray, plan):
+        """Host-side ``(ey_adj, fx, e_val)`` for black-box predictors: the
+        masked batches are synthesised by the native OpenMP kernels
+        (``runtime/masked_eval.cc``) and fed to the host callable in
+        coalition chunks."""
+
+        from distributedkernelshap_tpu.ops.links import convert_to_link_np
+        from distributedkernelshap_tpu.runtime import native
+
+        link_np = convert_to_link_np(self.config.link)
+        B, D = X.shape
+        N = self.background.shape[0]
+        S = plan.n_rows
+        K = self.predictor.n_outputs
+        zc = (plan.mask @ self.G).astype(np.float32)
+        bgw = (self.bg_weights / self.bg_weights.sum()).astype(np.float32)
+
+        # chunk the coalition axis to the configured memory budget (same
+        # policy as the device pipeline, ops/explain._auto_chunk)
+        from distributedkernelshap_tpu.ops.explain import _auto_chunk
+
+        chunk = (self.config.shap.coalition_chunk
+                 or _auto_chunk(S, B * N * D, self.config.shap.target_chunk_elems))
+        ey = np.empty((B, S, K), dtype=np.float32)
+        for s0 in range(0, S, chunk):
+            zc_c = zc[s0:s0 + chunk]
+            rows = native.masked_fill(X, self.background, zc_c)
+            pred = self.predictor.host_fn(rows)
+            ey[:, s0:s0 + chunk] = native.weighted_mean(
+                pred, bgw, B * zc_c.shape[0]).reshape(B, zc_c.shape[0], K)
+
+        e_val = np.atleast_1d(np.asarray(self.expected_value, dtype=np.float32))
+        fx = link_np(self.predictor.host_fn(X)).astype(np.float32)
+        ey_adj = link_np(ey) - e_val[None, None, :]
+        return ey_adj, fx, e_val
+
+    def _explain_array_hosteval(self, X: np.ndarray, nsamples) -> Dict[str, np.ndarray]:
+        """Black-box path for backends without host callbacks: the predictor
+        runs on the host, the WLS solve runs on device.  Replaces the
+        reference's in-worker ``shap.KernelExplainer`` loop for opaque
+        predictors."""
+
+        plan = self._plan(nsamples)
+        B = X.shape[0]
+        # same power-of-two padding as the device path: bounds solve
+        # recompiles across varying (coalesced-request) batch sizes
+        pad = (self._bucket(B) - B) if self.config.bucket_batches else 0
+        Xp = np.concatenate([X, np.tile(X[-1:], (pad, 1))], 0) if pad else X
+        ey_adj, fx, e_val = self._hosteval_stats(Xp, plan)
+        fx_minus_e = fx - e_val[None, :]
+        phi = np.asarray(self._solve_fn()(
+            jnp.asarray(plan.mask), jnp.asarray(plan.weights),
+            jnp.asarray(ey_adj), jnp.asarray(fx_minus_e)))
+        return {
+            'shap_values': phi[:B],
+            'expected_value': e_val,
+            'raw_prediction': fx[:B],
+        }
+
     def _explain_array(self, X: np.ndarray, nsamples) -> Dict[str, np.ndarray]:
+        if self.config.host_eval:
+            return self._explain_array_hosteval(X, nsamples)
         plan = self._plan(nsamples)
         B = X.shape[0]
         pad = (self._bucket(B) - B) if self.config.bucket_batches else 0
@@ -359,14 +460,20 @@ class KernelExplainerEngine:
 
         from sklearn.linear_model import Lasso, LassoLarsIC, lars_path
 
-        # single device pass also returning the per-coalition expected outputs
-        out = self._fn(with_ey=True)(
-            jnp.asarray(X, jnp.float32), jnp.asarray(self.background),
-            jnp.asarray(self.bg_weights), jnp.asarray(plan.mask),
-            jnp.asarray(plan.weights), jnp.asarray(self.G))
-        ey_adj = np.asarray(out['ey_adj'], dtype=np.float64)      # (B, S, K)
-        fx = np.asarray(out['raw_prediction'], dtype=np.float64)  # link space
-        e_val = np.atleast_1d(np.asarray(out['expected_value'], dtype=np.float64))
+        if self.config.host_eval:
+            ey_adj, fx, e_val = self._hosteval_stats(X, plan)
+            ey_adj = ey_adj.astype(np.float64)
+            fx = fx.astype(np.float64)
+            e_val = e_val.astype(np.float64)
+        else:
+            # single device pass also returning per-coalition expected outputs
+            out = self._fn(with_ey=True)(
+                jnp.asarray(X, jnp.float32), jnp.asarray(self.background),
+                jnp.asarray(self.bg_weights), jnp.asarray(plan.mask),
+                jnp.asarray(plan.weights), jnp.asarray(self.G))
+            ey_adj = np.asarray(out['ey_adj'], dtype=np.float64)      # (B, S, K)
+            fx = np.asarray(out['raw_prediction'], dtype=np.float64)  # link space
+            e_val = np.atleast_1d(np.asarray(out['expected_value'], dtype=np.float64))
 
         mask = plan.mask.astype(np.float64)
         w = plan.weights.astype(np.float64)
@@ -412,6 +519,11 @@ class KernelExplainerEngine:
         Uses the same matmul precision as the explain pipeline so reported
         raw predictions satisfy additivity against the solved phi exactly."""
 
+        if self.config.host_eval:
+            from distributedkernelshap_tpu.ops.links import convert_to_link_np
+
+            out = self.predictor.host_fn(np.asarray(X, dtype=np.float32))
+            return convert_to_link_np(self.config.link)(out) if link else out
         link_fn = convert_to_link(self.config.link) if link else (lambda x: x)
         with jax.default_matmul_precision(self.config.shap.matmul_precision):
             return np.asarray(link_fn(self.predictor(jnp.asarray(X, jnp.float32))))
@@ -819,12 +931,16 @@ class KernelShap(Explainer, FitMixin):
                 for values in shap_values
             ]
 
-        # link-space raw predictions for the explained instances
+        # link-space raw predictions for the explained instances; callers that
+        # already hold them (serving re-splits of a batched run) pass them in
+        # to avoid a redundant predictor pass
         if sparse.issparse(X):
             X_arr = X.toarray()
         else:
             X_arr = np.asarray(X)
-        raw_predictions = self._raw_predictions(X_arr)
+        raw_predictions = kwargs.get('raw_predictions')
+        if raw_predictions is None:
+            raw_predictions = self._raw_predictions(X_arr)
 
         if self.task != 'regression':
             argmax_pred = np.argmax(np.atleast_2d(raw_predictions), axis=1)

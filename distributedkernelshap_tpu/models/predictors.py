@@ -58,6 +58,15 @@ class BasePredictor:
     def __call__(self, X: jnp.ndarray) -> jnp.ndarray:
         raise NotImplementedError
 
+    def host_fn(self, X: np.ndarray) -> np.ndarray:
+        """Evaluate on the host, returning a numpy ``(n, K)`` array.
+
+        Default routes through the device computation; CallbackPredictor
+        overrides with the raw host callable (no device involvement)."""
+
+        out = np.asarray(self(jnp.asarray(X, dtype=jnp.float32)))
+        return out[:, None] if out.ndim == 1 else out
+
     @property
     def linear_decomposition(self):
         """``(W, b, activation_name)`` when the model is logits-linear, else None."""
@@ -126,7 +135,7 @@ class CallbackPredictor(BasePredictor):
         self.n_outputs = int(n_outputs)
         self.vector_out = bool(vector_out) if vector_out is not None else True
 
-    def _host_fn(self, X: np.ndarray) -> np.ndarray:
+    def host_fn(self, X: np.ndarray) -> np.ndarray:
         out = np.asarray(self.raw_fn(np.asarray(X)), dtype=np.float32)
         if out.ndim == 1:
             out = out[:, None]
@@ -134,7 +143,7 @@ class CallbackPredictor(BasePredictor):
 
     def __call__(self, X):
         shape = jax.ShapeDtypeStruct((X.shape[0], self.n_outputs), jnp.float32)
-        return jax.pure_callback(self._host_fn, shape, X, vmap_method="sequential")
+        return jax.pure_callback(self.host_fn, shape, X, vmap_method="sequential")
 
 
 def _lift_sklearn(method) -> Optional[LinearPredictor]:

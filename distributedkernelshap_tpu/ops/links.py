@@ -23,6 +23,20 @@ def logit_link(p):
 _LINKS = {"identity": identity_link, "logit": logit_link}
 
 
+def identity_link_np(x):
+    return x
+
+
+def logit_link_np(p):
+    import numpy as np
+
+    p = np.clip(p, _LOGIT_EPS, 1.0 - _LOGIT_EPS)
+    return np.log(p / (1.0 - p))
+
+
+_LINKS_NP = {"identity": identity_link_np, "logit": logit_link_np}
+
+
 def convert_to_link(link):
     """Map a link name (or callable) to a jittable function
     (parity with shap.common.convert_to_link semantics)."""
@@ -33,3 +47,14 @@ def convert_to_link(link):
         return _LINKS[link]
     except KeyError:
         raise ValueError(f"link must be one of {sorted(_LINKS)} or a callable, got {link!r}")
+
+
+def convert_to_link_np(link):
+    """Numpy variant for host-side evaluation paths."""
+
+    if callable(link):
+        return link
+    try:
+        return _LINKS_NP[link]
+    except KeyError:
+        raise ValueError(f"link must be one of {sorted(_LINKS_NP)} or a callable, got {link!r}")

@@ -1,0 +1,1 @@
+from distributedkernelshap_tpu.runtime.native import get_lib, masked_fill, weighted_mean  # noqa: F401

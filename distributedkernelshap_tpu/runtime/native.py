@@ -1,0 +1,103 @@
+"""ctypes bindings for the native host-side kernels (see masked_eval.cc).
+
+Builds the shared library on first use with the baked-in g++ toolchain and
+caches it next to the sources; every entry point degrades to a numpy
+implementation when compilation is unavailable, so the framework never hard-
+depends on the native path.
+"""
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "masked_eval.cc")
+_LIB = os.path.join(_DIR, "libdksruntime.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-fopenmp", "-march=native",
+           _SRC, "-o", _LIB]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception as e:
+        logger.info("native runtime build failed (%s); using numpy fallback", e)
+        return False
+
+
+def get_lib():
+    """Load (building if needed) the native library, or None."""
+
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB) or (
+                os.path.exists(_SRC) and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as e:
+            logger.info("native runtime load failed (%s); using numpy fallback", e)
+            return None
+        f32p = np.ctypeslib.ndpointer(dtype=np.float32, flags="C_CONTIGUOUS")
+        lib.dks_masked_fill.argtypes = [f32p, f32p, f32p, f32p] + [ctypes.c_int64] * 4
+        lib.dks_masked_fill.restype = None
+        lib.dks_weighted_mean.argtypes = [f32p, f32p, f32p] + [ctypes.c_int64] * 3
+        lib.dks_weighted_mean.restype = None
+        _lib = lib
+        logger.info("native runtime loaded: %s", _LIB)
+        return _lib
+
+
+def masked_fill(X: np.ndarray, bg: np.ndarray, zc: np.ndarray,
+                out: np.ndarray = None) -> np.ndarray:
+    """``out[b,s,n,:] = X[b]*zc[s] + bg[n]*(1-zc[s])`` flattened to rows."""
+
+    B, D = X.shape
+    N = bg.shape[0]
+    S = zc.shape[0]
+    if out is None:
+        out = np.empty((B * S * N, D), dtype=np.float32)
+    lib = get_lib()
+    if lib is not None:
+        lib.dks_masked_fill(np.ascontiguousarray(X, np.float32),
+                            np.ascontiguousarray(bg, np.float32),
+                            np.ascontiguousarray(zc, np.float32),
+                            out, B, S, N, D)
+        return out
+    masked = (X[:, None, None, :] * zc[None, :, None, :]
+              + bg[None, None, :, :] * (1.0 - zc[None, :, None, :]))
+    np.copyto(out, masked.reshape(-1, D).astype(np.float32, copy=False))
+    return out
+
+
+def weighted_mean(pred: np.ndarray, w: np.ndarray, R: int) -> np.ndarray:
+    """``ey[r] = Σ_n w[n]·pred[r·N+n]`` for row-major blocks of N rows."""
+
+    N = w.shape[0]
+    K = pred.shape[1]
+    if pred.shape[0] != R * N:
+        raise ValueError(
+            f"predictor returned {pred.shape[0]} rows for {R * N} inputs "
+            f"(R={R}, N={N}); black-box predictors must preserve row count")
+    ey = np.empty((R, K), dtype=np.float32)
+    lib = get_lib()
+    if lib is not None:
+        lib.dks_weighted_mean(np.ascontiguousarray(pred, np.float32),
+                              np.ascontiguousarray(w, np.float32), ey, R, N, K)
+        return ey
+    return np.einsum("rnk,n->rk", pred.reshape(R, N, K), w).astype(np.float32)

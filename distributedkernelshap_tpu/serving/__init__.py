@@ -1,0 +1,6 @@
+from distributedkernelshap_tpu.serving.wrappers import (  # noqa: F401
+    BatchKernelShapModel,
+    KernelShapModel,
+)
+from distributedkernelshap_tpu.serving.server import ExplainerServer, serve_explainer  # noqa: F401
+from distributedkernelshap_tpu.serving.client import distribute_requests, explain_request  # noqa: F401

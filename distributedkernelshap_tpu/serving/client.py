@@ -1,0 +1,50 @@
+"""Client-side request fan-out.
+
+The reference fans out one HTTP request per instance as Ray remote tasks
+(``benchmarks/serve_explanations.py:96-139``: ``distribute_request.remote``
+doing ``requests.get(url, json={'array': ...})``).  Here the fan-out is a
+thread pool — requests are IO-bound HTTP calls, the server coalesces them
+into device batches.
+"""
+
+import json
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+def explain_request(url: str, instance: np.ndarray, timeout: float = 300.0) -> str:
+    """POST one instance (or minibatch) to the explanation endpoint and
+    return the JSON payload."""
+
+    body = json.dumps({"array": np.asarray(instance).tolist()}).encode()
+    req = urllib.request.Request(url, data=body,
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def distribute_requests(url: str,
+                        data: np.ndarray,
+                        batch_mode: str = "ray",
+                        minibatches: Optional[Sequence[np.ndarray]] = None,
+                        max_workers: int = 64,
+                        timeout: float = 300.0) -> List[str]:
+    """Fan requests out to the endpoint.
+
+    ``batch_mode='ray'`` mirrors the reference's server-side batching mode
+    (one single-row request per instance, ``k8s_serve_explanations.py:181``);
+    ``'default'`` sends client-side minibatches (``:184``), either supplied
+    via ``minibatches`` or one row each.
+    """
+
+    if batch_mode == "ray" or minibatches is None:
+        parts = np.split(data, data.shape[0])
+    else:
+        parts = list(minibatches)
+
+    with ThreadPoolExecutor(max_workers=max_workers) as pool:
+        futures = [pool.submit(explain_request, url, p, timeout) for p in parts]
+        return [f.result() for f in futures]

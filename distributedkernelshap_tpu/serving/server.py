@@ -1,0 +1,209 @@
+"""HTTP explanation service with request micro-batching.
+
+TPU-native replacement for Ray Serve's replica/router machinery
+(``benchmarks/serve_explanations.py:42-67``: ``serve.init`` + HTTP proxy on
+port 8000, ``create_backend`` with ``num_replicas``/``max_batch_size``,
+``create_endpoint`` routing ``/explain``).  There is no controller process
+and no replica fleet: one server owns the compiled explain function, and a
+micro-batcher coalesces concurrent requests (up to ``max_batch_size`` within
+``batch_timeout_s``) into a single device call — the role Ray Serve's
+``@serve.accept_batch`` played (``wrappers.py:65``), but with the batch
+actually exploiting the hardware.
+
+Implementation is stdlib-only (ThreadingHTTPServer + queue): the explain
+engine serialises device work anyway, so the natural architecture is one
+dispatcher thread feeding the device and N cheap HTTP threads parking on
+response events.
+"""
+
+import json
+import logging
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class _HTTPServer(ThreadingHTTPServer):
+    # the reference clients fan out thousands of concurrent single-row
+    # requests (serve_explanations.py:131-134); the default listen backlog of
+    # 5 resets connections under that load
+    request_queue_size = 1024
+    daemon_threads = True
+
+
+class _Pending:
+    __slots__ = ("array", "event", "response", "error")
+
+    def __init__(self, array: np.ndarray):
+        self.array = array
+        self.event = threading.Event()
+        self.response: Optional[str] = None
+        self.error: Optional[str] = None
+
+
+class ExplainerServer:
+    """Serves a fitted serving model over HTTP on ``/explain``.
+
+    Parameters
+    ----------
+    model
+        A ``KernelShapModel``-like object exposing ``explain_batch``.
+    host, port
+        Bind address (reference default: Serve HTTP proxy on 8000,
+        ``cluster/ray_cluster.yaml:33-35``).
+    max_batch_size
+        Maximum requests coalesced into one device call (the reference's
+        ``serve.update_backend_config({'max_batch_size': ...})`` knob,
+        ``serve_explanations.py:65``).  1 disables batching.
+    batch_timeout_s
+        How long the dispatcher waits to fill a batch once a first request
+        has arrived.
+    """
+
+    def __init__(self, model, host: str = "0.0.0.0", port: int = 8000,
+                 max_batch_size: int = 1, batch_timeout_s: float = 0.01):
+        self.model = model
+        self.host = host
+        self.port = port
+        self.max_batch_size = max(1, int(max_batch_size))
+        self.batch_timeout_s = batch_timeout_s
+        self._queue: "queue.Queue[_Pending]" = queue.Queue()
+        self._stop = threading.Event()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._threads = []
+
+    # ------------------------------------------------------------------ #
+
+    def _dispatch_loop(self):
+        """Coalesce queued requests and run one device call per batch."""
+
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            batch = [first]
+            if self.max_batch_size > 1:
+                deadline = threading.Event()
+                timer = threading.Timer(self.batch_timeout_s, deadline.set)
+                timer.start()
+                while len(batch) < self.max_batch_size and not deadline.is_set():
+                    try:
+                        batch.append(self._queue.get(timeout=self.batch_timeout_s / 4))
+                    except queue.Empty:
+                        pass
+                timer.cancel()
+
+            sizes = [p.array.shape[0] for p in batch]
+            try:
+                stacked = np.concatenate([p.array for p in batch], axis=0)
+                payloads = self.model.explain_batch(stacked, split_sizes=sizes)
+                for p, payload in zip(batch, payloads):
+                    p.response = payload
+            except Exception as e:  # surface errors to each waiting request
+                logger.exception("explain batch failed")
+                for p in batch:
+                    p.error = str(e)
+            for p in batch:
+                p.event.set()
+
+    def _make_handler(server):  # noqa: N805 - closure over the server
+        class Handler(BaseHTTPRequestHandler):
+            def _reply(self, code: int, body: str, ctype="application/json"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _handle(self):
+                if self.path.rstrip("/") != "/explain":
+                    self._reply(404, json.dumps({"error": "unknown route"}))
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length) or b"{}")
+                    array = np.atleast_2d(np.asarray(payload["array"], dtype=np.float32))
+                except (KeyError, ValueError, json.JSONDecodeError) as e:
+                    self._reply(400, json.dumps({"error": f"bad request: {e}"}))
+                    return
+                pending = _Pending(array)
+                server._queue.put(pending)
+                # re-check shutdown periodically so in-flight requests fail
+                # fast instead of hanging on a dead dispatcher
+                while not pending.event.wait(timeout=1.0):
+                    if server._stop.is_set():
+                        pending.error = pending.error or "server shutting down"
+                        break
+                if pending.error is not None:
+                    self._reply(500, json.dumps({"error": pending.error}))
+                else:
+                    self._reply(200, pending.response)
+
+            # the reference clients issue GETs with a JSON body
+            # (serve_explanations.py:111); accept both verbs
+            do_GET = _handle
+            do_POST = _handle
+
+            def log_message(self, fmt, *args):
+                logger.debug("http: " + fmt, *args)
+
+        return Handler
+
+    # ------------------------------------------------------------------ #
+
+    def start(self):
+        self._httpd = _HTTPServer((self.host, self.port), self._make_handler())
+        self.port = self._httpd.server_address[1]  # resolve port 0
+        t_http = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        t_disp = threading.Thread(target=self._dispatch_loop, daemon=True)
+        t_http.start()
+        t_disp.start()
+        self._threads = [t_http, t_disp]
+        logger.info("ExplainerServer listening on %s:%d/explain (max_batch_size=%d)",
+                    self.host, self.port, self.max_batch_size)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        # fail anything still queued so no handler thread waits forever
+        while True:
+            try:
+                pending = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            pending.error = "server shutting down"
+            pending.event.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def serve_explainer(predictor, background_data, constructor_kwargs, fit_kwargs,
+                    host: str = "0.0.0.0", port: int = 8000,
+                    max_batch_size: int = 1, batched: bool = None) -> ExplainerServer:
+    """Build, fit and serve an explainer in one call — the analog of the
+    reference's ``backend_setup`` + ``endpont_setup``
+    (``serve_explanations.py:27-67``)."""
+
+    from distributedkernelshap_tpu.serving.wrappers import (
+        BatchKernelShapModel,
+        KernelShapModel,
+    )
+
+    cls = BatchKernelShapModel if (batched or max_batch_size > 1) else KernelShapModel
+    model = cls(predictor, background_data, constructor_kwargs, fit_kwargs)
+    return ExplainerServer(model, host=host, port=port,
+                           max_batch_size=max_batch_size).start()

@@ -1,0 +1,135 @@
+"""Tests for the serving layer: wrappers, HTTP server, micro-batching,
+client fan-out (reference wrappers.py + serve_explanations.py semantics)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu.interface import Explanation
+from distributedkernelshap_tpu.models import LinearPredictor
+from distributedkernelshap_tpu.serving import (
+    BatchKernelShapModel,
+    ExplainerServer,
+    KernelShapModel,
+    distribute_requests,
+    explain_request,
+    serve_explainer,
+)
+
+
+@pytest.fixture(scope="module")
+def model_setup():
+    rng = np.random.default_rng(0)
+    D, K, N = 8, 2, 16
+    W = rng.normal(size=(D, K)).astype(np.float32)
+    b = rng.normal(size=(K,)).astype(np.float32)
+    bg = rng.normal(size=(N, D)).astype(np.float32)
+    X = rng.normal(size=(6, D)).astype(np.float32)
+    pred = LinearPredictor(W, b, activation="softmax")
+    kwargs = dict(constructor_kwargs={"link": "logit", "seed": 0},
+                  fit_kwargs={})
+    return dict(pred=pred, bg=bg, X=X, **kwargs)
+
+
+class FakeRequest:
+    """Flask-style request stand-in (the reference handlers read
+    ``flask_request.json['array']``, wrappers.py:56)."""
+
+    def __init__(self, array):
+        self.json = {"array": np.asarray(array).tolist()}
+
+
+def test_kernel_shap_model_single(model_setup):
+    s = model_setup
+    model = KernelShapModel(s["pred"], s["bg"], s["constructor_kwargs"], s["fit_kwargs"])
+    payload = model(FakeRequest(s["X"][0]))
+    exp = Explanation.from_json(payload)
+    sv = np.asarray(exp.data["shap_values"][0])
+    assert sv.shape == (1, 8)
+    total = (np.asarray(exp.data["shap_values"]).sum(-1)
+             + np.asarray(exp.data["expected_value"])[:, None])
+    np.testing.assert_allclose(total[:, 0],
+                               np.asarray(exp.data["raw"]["raw_prediction"])[0],
+                               atol=1e-4)
+
+
+def test_sklearn_predictor_detection(model_setup):
+    from sklearn.linear_model import LogisticRegression
+
+    rng = np.random.default_rng(1)
+    Xtr = rng.normal(size=(100, 8))
+    ytr = (Xtr.sum(1) > 0).astype(int)
+    clf = LogisticRegression(max_iter=200).fit(Xtr, ytr)
+    model = KernelShapModel(clf, model_setup["bg"],
+                            model_setup["constructor_kwargs"], {})
+    payload = model(FakeRequest(Xtr[0]))
+    assert json.loads(payload)["data"]["shap_values"]
+
+
+def test_batch_model_matches_singles(model_setup):
+    s = model_setup
+    batched = BatchKernelShapModel(s["pred"], s["bg"], s["constructor_kwargs"], s["fit_kwargs"])
+    requests = [FakeRequest(x) for x in s["X"]]
+    payloads = batched(requests)
+    assert len(payloads) == len(requests)
+
+    single = KernelShapModel(s["pred"], s["bg"], s["constructor_kwargs"], s["fit_kwargs"])
+    for i, payload in enumerate(payloads):
+        got = np.asarray(json.loads(payload)["data"]["shap_values"])
+        want = np.asarray(json.loads(single(requests[i]))["data"]["shap_values"])
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+@pytest.fixture(scope="module")
+def server(model_setup):
+    s = model_setup
+    srv = serve_explainer(s["pred"], s["bg"], s["constructor_kwargs"], s["fit_kwargs"],
+                          host="127.0.0.1", port=0, max_batch_size=4)
+    yield srv
+    srv.stop()
+
+
+def test_http_explain_roundtrip(server, model_setup):
+    url = f"http://127.0.0.1:{server.port}/explain"
+    payload = explain_request(url, model_setup["X"][0])
+    exp = Explanation.from_json(payload)
+    assert np.asarray(exp.data["shap_values"][0]).shape == (1, 8)
+
+
+def test_http_fanout_batched(server, model_setup):
+    url = f"http://127.0.0.1:{server.port}/explain"
+    payloads = distribute_requests(url, model_setup["X"], batch_mode="ray")
+    assert len(payloads) == 6
+    # responses line up with their requests (micro-batching must not shuffle)
+    single = KernelShapModel(model_setup["pred"], model_setup["bg"],
+                             model_setup["constructor_kwargs"], model_setup["fit_kwargs"])
+    for i, payload in enumerate(payloads):
+        got = np.asarray(json.loads(payload)["data"]["shap_values"])
+        want = np.asarray(json.loads(single(FakeRequest(model_setup["X"][i])))["data"]["shap_values"])
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_http_minibatch_mode(server, model_setup):
+    url = f"http://127.0.0.1:{server.port}/explain"
+    X = model_setup["X"]
+    payloads = distribute_requests(url, X, batch_mode="default",
+                                   minibatches=[X[:4], X[4:]])
+    shapes = [np.asarray(json.loads(p)["data"]["shap_values"]).shape for p in payloads]
+    assert shapes == [(2, 4, 8), (2, 2, 8)]
+
+
+def test_http_error_paths(server):
+    import urllib.error
+    import urllib.request
+
+    url = f"http://127.0.0.1:{server.port}"
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(url + "/nope", data=b"{}")
+    assert e.value.code == 404
+
+    req = urllib.request.Request(url + "/explain", data=b'{"wrong": 1}',
+                                 headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req)
+    assert e.value.code == 400
