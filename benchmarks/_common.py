@@ -1,0 +1,17 @@
+"""Shared benchmark CLI helpers."""
+
+
+def add_platform_flag(parser) -> None:
+    parser.add_argument(
+        "--platform", default=None, type=str,
+        help="Override the JAX platform (e.g. 'cpu'). NB: in environments "
+             "where jax is pre-imported at interpreter start, the "
+             "JAX_PLATFORMS env var is not a reliable override; this flag "
+             "uses jax.config.update before any backend is initialised.")
+
+
+def apply_platform(args) -> None:
+    if getattr(args, "platform", None):
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)

@@ -28,6 +28,7 @@ from timeit import default_timer as timer
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from distributedkernelshap_tpu.parallel.mesh import initialize_multihost  # noqa: E402
+from benchmarks._common import add_platform_flag, apply_platform  # noqa: E402
 from distributedkernelshap_tpu.utils import get_filename, load_data, load_model  # noqa: E402
 
 logging.basicConfig(level=logging.INFO)
@@ -80,5 +81,7 @@ if __name__ == '__main__':
                         help="coordinator host:port (omit on TPU pods)")
     parser.add_argument("--num_processes", default=None, type=int)
     parser.add_argument("--process_id", default=None, type=int)
+    add_platform_flag(parser)
     args = parser.parse_args()
+    apply_platform(args)
     main()

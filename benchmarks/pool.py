@@ -24,6 +24,7 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from distributedkernelshap_tpu import KernelShap  # noqa: E402
+from benchmarks._common import add_platform_flag, apply_platform  # noqa: E402
 from distributedkernelshap_tpu.utils import get_filename, load_data, load_model  # noqa: E402
 
 logging.basicConfig(level=logging.INFO)
@@ -111,5 +112,7 @@ if __name__ == '__main__':
     parser.add_argument(
         "-n", "--nruns", default=5, type=int,
         help="Timed repetitions per configuration (benchmark mode).")
+    add_platform_flag(parser)
     args = parser.parse_args()
+    apply_platform(args)
     main()

@@ -24,6 +24,7 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from distributedkernelshap_tpu.serving import distribute_requests, serve_explainer  # noqa: E402
+from benchmarks._common import add_platform_flag, apply_platform  # noqa: E402
 from distributedkernelshap_tpu.utils import get_filename, load_data, load_model  # noqa: E402
 
 logging.basicConfig(level=logging.INFO)
@@ -100,5 +101,7 @@ if __name__ == '__main__':
     parser.add_argument("-n", "--nruns", default=5, type=int)
     parser.add_argument("--host", default="0.0.0.0", type=str)
     parser.add_argument("--port", default=8000, type=int)
+    add_platform_flag(parser)
     args = parser.parse_args()
+    apply_platform(args)
     main()

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Sweep the pool benchmark across device counts (reference
+# benchmarks/k8s_benchmark_pool.sh swept Ray worker counts with a full
+# cluster redeploy per configuration; a mesh needs no redeploy).
+# Usage: bash tpu_benchmark_pool.sh START END
+set -euo pipefail
+START=${1:?usage: tpu_benchmark_pool.sh START END}
+END=${2:?usage: tpu_benchmark_pool.sh START END}
+for workers in $(seq "$START" "$END"); do
+    echo "=== workers=$workers ==="
+    python benchmarks/pool.py -b 1 5 10 -w "$workers" -n 5
+done

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Sweep the serving benchmark across client widths x batch sizes (reference
+# benchmarks/k8s_benchmark_serve.sh swept replicas x {1,5,10}).
+# Usage: bash tpu_benchmark_serve.sh START END
+set -euo pipefail
+START=${1:?usage: tpu_benchmark_serve.sh START END}
+END=${2:?usage: tpu_benchmark_serve.sh START END}
+for replicas in $(seq "$START" "$END"); do
+    for batch in 1 5 10; do
+        echo "=== replicas=$replicas max_batch_size=$batch ==="
+        python benchmarks/serve_explanations.py -r "$replicas" -b "$batch" -n 5
+    done
+done

@@ -43,6 +43,7 @@ from distributedkernelshap_tpu.ops.explain import (
 )
 from distributedkernelshap_tpu.ops.links import convert_to_link
 from distributedkernelshap_tpu.ops.summarise import kmeans_summary, subsample
+from distributedkernelshap_tpu.profiling import profiler
 from distributedkernelshap_tpu.utils import methdispatch
 
 logger = logging.getLogger(__name__)
@@ -355,11 +356,13 @@ class KernelExplainerEngine:
         # recompiles across varying (coalesced-request) batch sizes
         pad = (self._bucket(B) - B) if self.config.bucket_batches else 0
         Xp = np.concatenate([X, np.tile(X[-1:], (pad, 1))], 0) if pad else X
-        ey_adj, fx, e_val = self._hosteval_stats(Xp, plan)
+        with profiler().phase('host_eval'):
+            ey_adj, fx, e_val = self._hosteval_stats(Xp, plan)
         fx_minus_e = fx - e_val[None, :]
-        phi = np.asarray(self._solve_fn()(
-            jnp.asarray(plan.mask), jnp.asarray(plan.weights),
-            jnp.asarray(ey_adj), jnp.asarray(fx_minus_e)))
+        with profiler().phase('device_solve'):
+            phi = np.asarray(self._solve_fn()(
+                jnp.asarray(plan.mask), jnp.asarray(plan.weights),
+                jnp.asarray(ey_adj), jnp.asarray(fx_minus_e)))
         return {
             'shap_values': phi[:B],
             'expected_value': e_val,
@@ -369,16 +372,21 @@ class KernelExplainerEngine:
     def _explain_array(self, X: np.ndarray, nsamples) -> Dict[str, np.ndarray]:
         if self.config.host_eval:
             return self._explain_array_hosteval(X, nsamples)
-        plan = self._plan(nsamples)
+        with profiler().phase('coalition_plan'):
+            plan = self._plan(nsamples)
         B = X.shape[0]
         pad = (self._bucket(B) - B) if self.config.bucket_batches else 0
         Xp = np.concatenate([X, np.tile(X[-1:], (pad, 1))], 0) if pad else X
-        out = self._fn()(jnp.asarray(Xp, jnp.float32),
-                         jnp.asarray(self.background),
-                         jnp.asarray(self.bg_weights),
-                         jnp.asarray(plan.mask),
-                         jnp.asarray(plan.weights),
-                         jnp.asarray(self.G))
+        with profiler().phase('device_explain'):
+            out = self._fn()(jnp.asarray(Xp, jnp.float32),
+                             jnp.asarray(self.background),
+                             jnp.asarray(self.bg_weights),
+                             jnp.asarray(plan.mask),
+                             jnp.asarray(plan.weights),
+                             jnp.asarray(self.G))
+            # dispatch is async: block inside the phase so the device time is
+            # attributed here, not to whichever np.asarray first touches it
+            out = jax.block_until_ready(out)
         phi = np.asarray(out['shap_values'])[:B]
         return {
             'shap_values': phi,
@@ -896,7 +904,8 @@ class KernelShap(Explainer, FitMixin):
         if self.use_groups and sparse.issparse(X):
             X = X.toarray()
 
-        shap_values = self._explainer.get_explanation(X, **kwargs)
+        with profiler().phase('explain'):
+            shap_values = self._explainer.get_explanation(X, **kwargs)
         self.expected_value = self._explainer.expected_value
         expected_value = self.expected_value
         if isinstance(shap_values, np.ndarray):
@@ -978,6 +987,84 @@ class KernelShap(Explainer, FitMixin):
             return engine.predict(X_arr, link=True)
         link_fn = convert_to_link(self.link)
         return np.asarray(link_fn(jnp.asarray(self.predictor(X_arr))))
+
+    def save(self, path: str) -> None:
+        """Checkpoint the fitted explainer.
+
+        The reference has no explainer checkpointing (SURVEY.md §5.4 — only
+        data caches and incremental result pickles); here the fitted state
+        (constructor args, background container, meta) round-trips through a
+        single pickle and the engine/mesh is rebuilt on load, so a serving
+        replica can come up without refitting.
+        """
+
+        import pickle
+
+        from distributedkernelshap_tpu.utils import ensure_dir
+
+        if not self._fitted:
+            raise ValueError("Cannot save an unfitted explainer")
+        state = {
+            'predictor': self.predictor,
+            'link': self.link,
+            'feature_names': self.feature_names,
+            'categorical_names': self.categorical_names,
+            'task': self.task,
+            'seed': self.seed,
+            'distributed_opts': {k: v for k, v in self.distributed_opts.items()},
+            'background_data': self.background_data,
+            'meta': self.meta,
+            'use_groups': self.use_groups,
+            'summarise_background': self.summarise_background,
+        }
+        ensure_dir(path)
+        with open(path, 'wb') as f:
+            pickle.dump(state, f)
+
+    @classmethod
+    def load(cls, path: str) -> "KernelShap":
+        """Rebuild a fitted explainer from :meth:`save` output."""
+
+        import pickle
+
+        with open(path, 'rb') as f:
+            state = pickle.load(f)
+        opts = state['distributed_opts']
+        opts.pop('algorithm', None)
+        explainer = cls(
+            state['predictor'],
+            link=state['link'],
+            feature_names=state['feature_names'],
+            categorical_names=state['categorical_names'],
+            task=state['task'],
+            seed=state['seed'],
+            distributed_opts=opts or None,
+        )
+        explainer.use_groups = state['use_groups']
+        explainer.summarise_background = state['summarise_background']
+        bg = state['background_data']
+        if isinstance(bg, Data):
+            if state['use_groups']:
+                explainer.feature_names = bg.group_names
+            explainer._fitted = True
+            explainer.background_data = bg
+            if explainer.distribute:
+                from distributedkernelshap_tpu.parallel.distributed import DistributedExplainer
+
+                explainer._explainer = DistributedExplainer(
+                    explainer.distributed_opts, KernelExplainerEngine,
+                    (explainer.predictor, bg),
+                    {'link': explainer.link, 'seed': explainer.seed})
+            else:
+                explainer._explainer = KernelExplainerEngine(
+                    explainer.predictor, bg, link=explainer.link, seed=explainer.seed)
+            explainer.expected_value = explainer._explainer.expected_value
+            explainer.meta = state['meta']
+        else:
+            # ungrouped background: refit cheaply through the normal path
+            explainer.fit(bg)
+            explainer.meta = state['meta']
+        return explainer
 
     def _check_result_summarisation(self,
                                     summarise_result: bool,

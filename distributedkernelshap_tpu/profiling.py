@@ -1,0 +1,112 @@
+"""Tracing / profiling.
+
+The reference measures wall-clock only, via ``timeit.default_timer`` around
+whole ``explain`` calls (``benchmarks/ray_pool.py:72-75``; SURVEY.md §5.1
+notes "no per-phase, per-actor, or flamegraph profiling").  This module goes
+further, as the TPU build plan requires: named per-phase timers (plan
+construction / device explain / host eval / solve / build-explanation) and a
+``jax.profiler`` trace hook producing TensorBoard-compatible device
+flamegraphs.
+
+Enable with ``DKS_PROFILE=1`` (or ``profiler().enable()``); phase summaries
+accumulate in-process and are cheap enough to leave on in benchmarks.
+"""
+
+import contextlib
+import logging
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class Profiler:
+    """Per-phase wall-clock accumulator + device trace hook."""
+
+    def __init__(self, enabled: Optional[bool] = None):
+        if enabled is None:
+            enabled = os.environ.get("DKS_PROFILE", "0") not in ("", "0", "false")
+        self.enabled = enabled
+        self._times: Dict[str, List[float]] = defaultdict(list)
+        self._lock = threading.Lock()
+
+    def enable(self):
+        self.enabled = True
+        return self
+
+    def disable(self):
+        self.enabled = False
+        return self
+
+    @contextlib.contextmanager
+    def phase(self, name: str, sync: bool = False):
+        """Time a named phase.  ``sync=True`` blocks on outstanding device
+        work before reading the clock (JAX dispatch is async; without a sync
+        the time lands in whichever phase first blocks)."""
+
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if sync:
+                try:
+                    import jax
+
+                    jax.effects_barrier()
+                except Exception:
+                    pass
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._times[name].append(dt)
+
+    @contextlib.contextmanager
+    def trace(self, logdir: str = "/tmp/dks_trace"):
+        """Capture a jax.profiler device trace (TensorBoard format)."""
+
+        import jax
+
+        jax.profiler.start_trace(logdir)
+        try:
+            yield logdir
+        finally:
+            jax.profiler.stop_trace()
+            logger.info("device trace written to %s", logdir)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-phase {count, total_s, mean_s, last_s}."""
+
+        with self._lock:
+            return {
+                name: {
+                    "count": len(v),
+                    "total_s": sum(v),
+                    "mean_s": sum(v) / len(v),
+                    "last_s": v[-1],
+                }
+                for name, v in self._times.items() if v
+            }
+
+    def reset(self):
+        with self._lock:
+            self._times.clear()
+
+    def report(self) -> str:
+        lines = [f"{'phase':<24}{'count':>7}{'total_s':>10}{'mean_s':>10}"]
+        for name, s in sorted(self.summary().items(), key=lambda kv: -kv[1]["total_s"]):
+            lines.append(f"{name:<24}{s['count']:>7}{s['total_s']:>10.3f}{s['mean_s']:>10.4f}")
+        return "\n".join(lines)
+
+
+_default = Profiler()
+
+
+def profiler() -> Profiler:
+    """The process-wide default profiler."""
+
+    return _default

@@ -1,0 +1,60 @@
+"""Serving entry point: fit the default Adult explainer and serve it.
+
+``python -m distributedkernelshap_tpu.serving.main`` is what the k8s serving
+deployment runs per pod (cluster/tpu_serve_cluster.yaml) — the analog of the
+reference's in-cluster backend setup (``benchmarks/serve_explanations.py:42-67``)
+minus the Serve controller.
+"""
+
+import argparse
+import logging
+import signal
+import threading
+
+from distributedkernelshap_tpu.serving.server import serve_explainer
+from distributedkernelshap_tpu.utils import load_data, load_model
+
+logging.basicConfig(level=logging.INFO)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", default=8000, type=int)
+    parser.add_argument("--max_batch_size", default=32, type=int)
+    parser.add_argument("--checkpoint", default=None, type=str,
+                        help="Serve a saved explainer (KernelShap.save) "
+                             "instead of fitting the default Adult one.")
+    args = parser.parse_args()
+
+    if args.checkpoint:
+        from distributedkernelshap_tpu.kernel_shap import KernelShap
+        from distributedkernelshap_tpu.serving.server import ExplainerServer
+        from distributedkernelshap_tpu.serving.wrappers import BatchKernelShapModel
+
+        explainer = KernelShap.load(args.checkpoint)
+        model = BatchKernelShapModel.from_explainer(explainer)
+        server = ExplainerServer(model, host=args.host, port=args.port,
+                                 max_batch_size=args.max_batch_size).start()
+    else:
+        data = load_data()
+        predictor = load_model()
+        group_names, groups = data["all"]["group_names"], data["all"]["groups"]
+        server = serve_explainer(
+            predictor,
+            data["background"]["X"]["preprocessed"],
+            {"link": "logit", "feature_names": group_names, "seed": 0},
+            {"group_names": group_names, "groups": groups},
+            host=args.host, port=args.port, max_batch_size=args.max_batch_size,
+        )
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    logging.info("serving on %s:%d — Ctrl-C to stop", server.host, server.port)
+    stop.wait()
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -123,7 +123,11 @@ class ExplainerServer:
                 self.wfile.write(data)
 
             def _handle(self):
-                if self.path.rstrip("/") != "/explain":
+                route = self.path.rstrip("/")
+                if route == "/healthz":
+                    self._reply(200, json.dumps({"status": "ok"}))
+                    return
+                if route != "/explain":
                     self._reply(404, json.dumps({"error": "unknown route"}))
                     return
                 try:

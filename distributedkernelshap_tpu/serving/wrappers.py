@@ -53,6 +53,15 @@ class KernelShapModel:
         self.explainer = KernelShap(predict_fcn, **constructor_kwargs)
         self.explainer.fit(background_data, **fit_kwargs)
 
+    @classmethod
+    def from_explainer(cls, explainer: KernelShap) -> "KernelShapModel":
+        """Wrap an already-fitted explainer (e.g. one restored with
+        ``KernelShap.load``) without refitting."""
+
+        model = cls.__new__(cls)
+        model.explainer = explainer
+        return model
+
     def __call__(self, request) -> str:
         """Explain a single request; returns the Explanation as JSON
         (the wire schema of ``interface.Explanation.to_json``)."""

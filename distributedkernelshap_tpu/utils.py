@@ -138,6 +138,9 @@ def _load_script(name: str):
 
 
 def ensure_dir(path: str) -> None:
-    d = os.path.dirname(path) if os.path.splitext(path)[1] else path
-    if d and not os.path.exists(d):
+    """Create the parent directory of the file ``path`` (which may have no
+    extension — the argument is always interpreted as a file path)."""
+
+    d = os.path.dirname(path)
+    if d:
         os.makedirs(d, exist_ok=True)

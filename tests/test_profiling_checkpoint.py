@@ -1,0 +1,81 @@
+"""Tests for the profiling subsystem and explainer checkpointing."""
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu import KernelShap
+from distributedkernelshap_tpu.models import LinearPredictor
+from distributedkernelshap_tpu.profiling import Profiler, profiler
+
+
+@pytest.fixture()
+def fitted(tmp_path):
+    rng = np.random.default_rng(0)
+    D = 7
+    groups = [[0], [1, 2], [3, 4], [5, 6]]
+    names = ["a", "b", "c", "d"]
+    W = rng.normal(size=(D, 2)).astype(np.float32)
+    bg = rng.normal(size=(10, D)).astype(np.float32)
+    X = rng.normal(size=(4, D)).astype(np.float32)
+    pred = LinearPredictor(W, np.zeros(2, np.float32), activation="softmax")
+    ex = KernelShap(pred, link="logit", feature_names=names, seed=0)
+    ex.fit(bg, group_names=names, groups=groups)
+    return ex, X, tmp_path
+
+
+def test_profiler_phases():
+    p = Profiler(enabled=True)
+    with p.phase("solve"):
+        pass
+    with p.phase("solve"):
+        pass
+    with p.phase("eval", sync=True):
+        pass
+    s = p.summary()
+    assert s["solve"]["count"] == 2 and "mean_s" in s["solve"]
+    assert "eval" in s
+    assert "solve" in p.report()
+    p.reset()
+    assert p.summary() == {}
+
+
+def test_profiler_disabled_is_noop():
+    p = Profiler(enabled=False)
+    with p.phase("x"):
+        pass
+    assert p.summary() == {}
+
+
+def test_default_profiler_collects_engine_phases(fitted):
+    ex, X, _ = fitted
+    prof = profiler()
+    prof.enable()
+    prof.reset()
+    try:
+        ex.explain(X, nsamples=32, silent=True)
+        s = prof.summary()
+        assert "explain" in s and "device_explain" in s and "coalition_plan" in s
+    finally:
+        prof.disable()
+        prof.reset()
+
+
+def test_save_load_roundtrip(fitted):
+    ex, X, tmp_path = fitted
+    before = ex.explain(X, nsamples=32, silent=True)
+    path = str(tmp_path / "ckpt" / "explainer.pkl")
+    ex.save(path)
+
+    loaded = KernelShap.load(path)
+    after = loaded.explain(X, nsamples=32, silent=True)
+    np.testing.assert_allclose(before.shap_values[0], after.shap_values[0], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(before.expected_value),
+                               np.asarray(loaded.expected_value), atol=1e-6)
+    assert loaded.feature_names == ex.feature_names
+
+
+def test_save_unfitted_raises():
+    ex = KernelShap(LinearPredictor(np.zeros((3, 2), np.float32),
+                                    np.zeros(2, np.float32)))
+    with pytest.raises(ValueError, match="unfitted"):
+        ex.save("/tmp/nope.pkl")
