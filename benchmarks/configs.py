@@ -1,0 +1,212 @@
+"""The full benchmark-configuration suite from BASELINE.json.
+
+Each config prints one JSON line; ``bench.py`` remains the headline driver.
+
+  * ``adult``            — 2560 instances, bg=100, LR (the reference task)
+  * ``adult_stress``     — bg=1000, nsamples=2048 (stresses the WLS/eval
+                           size; uses coalition-axis sharding on >1 device)
+  * ``adult_blackbox``   — gradient-boosted predictor as an opaque host
+                           callable (XGBoost when installed, sklearn
+                           HistGradientBoosting otherwise) via the host-eval
+                           path
+  * ``mnist``            — CNN + superpixel image KernelSHAP
+  * ``covertype``        — 581k-instance dataset, instance-sharded across
+                           every visible device
+
+Run: ``python benchmarks/configs.py --config adult_stress [--smoke]``.
+``--smoke`` shrinks sizes for CI-style validation on CPU.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks._common import add_platform_flag, apply_platform  # noqa: E402
+
+
+def _timed_explain(explainer, X, nruns=3, **kwargs):
+    explainer.explain(X, silent=True, **kwargs)  # warmup/compile
+    times = []
+    for _ in range(nruns):
+        t0 = time.perf_counter()
+        explanation = explainer.explain(X, silent=True, **kwargs)
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times)), explanation
+
+
+def _additivity(explanation):
+    sv = explanation.shap_values
+    total = np.stack(sv, 1).sum(-1) + np.asarray(explanation.expected_value)[None, :]
+    return float(np.abs(total - explanation.data["raw"]["raw_prediction"]).max())
+
+
+def config_adult(smoke=False):
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.utils import load_data, load_model
+
+    data = load_data()
+    clf = load_model()
+    gn, g = data["all"]["group_names"], data["all"]["groups"]
+    X = data["all"]["X"]["processed"]["test"].toarray()
+    if smoke:
+        X = X[:64]
+    ex = KernelShap(clf.predict_proba, link="logit", feature_names=gn, seed=0)
+    ex.fit(data["background"]["X"]["preprocessed"], group_names=gn, groups=g)
+    t, explanation = _timed_explain(ex, X)
+    return {"metric": "adult_2560_bg100_wall_s", "value": round(t, 4), "unit": "s",
+            "n_instances": X.shape[0], "additivity_err": _additivity(explanation)}
+
+
+def config_adult_stress(smoke=False):
+    """bg=1000 / nsamples=2048 (SURVEY.md §5.7 stress shape)."""
+
+    import jax
+
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.utils import load_data, load_model
+
+    data = load_data()
+    clf = load_model()
+    gn, g = data["all"]["group_names"], data["all"]["groups"]
+    X = data["all"]["X"]["processed"]["test"].toarray()
+    bg = data["all"]["X"]["processed"]["train"][:1000]
+    n_x = 64 if smoke else 512
+    X = X[:n_x]
+
+    n_dev = len(jax.devices())
+    opts = None
+    if n_dev > 1:
+        # devices co-operate on the coalition axis: partial normal equations
+        # psum'd over ICI (parallel/coalition_sharding.py)
+        cp = 2 if n_dev % 2 == 0 else 1
+        opts = {"n_devices": n_dev, "coalition_parallel": cp}
+    ex = KernelShap(clf.predict_proba, link="logit", feature_names=gn, seed=0,
+                    distributed_opts=opts)
+    ex.fit(bg, group_names=gn, groups=g)
+    t, explanation = _timed_explain(ex, X, nsamples=2048)
+    return {"metric": "adult_bg1000_ns2048_wall_s", "value": round(t, 4), "unit": "s",
+            "n_instances": n_x, "additivity_err": _additivity(explanation)}
+
+
+def config_adult_blackbox(smoke=False):
+    """Opaque host predictor through the host-eval path (the reference's
+    'any pickled callable' capability, wrappers.py:33-37)."""
+
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.kernel_shap import EngineConfig  # noqa: F401
+    from distributedkernelshap_tpu.utils import load_data
+
+    data = load_data()
+    gn, g = data["all"]["group_names"], data["all"]["groups"]
+    Xtr = data["all"]["X"]["processed"]["train"].toarray()
+    ytr = data["all"]["y"]["train"]
+    try:  # xgboost when available; sklearn boosted trees otherwise
+        from xgboost import XGBClassifier
+
+        clf = XGBClassifier(n_estimators=50, max_depth=4).fit(Xtr, ytr)
+    except ImportError:
+        from sklearn.ensemble import HistGradientBoostingClassifier
+
+        clf = HistGradientBoostingClassifier(max_iter=50, random_state=0).fit(Xtr, ytr)
+
+    X = data["all"]["X"]["processed"]["test"].toarray()
+    X = X[:32] if smoke else X[:256]
+    ex = KernelShap(clf.predict_proba, link="logit", feature_names=gn, seed=0)
+    ex.fit(data["background"]["X"]["preprocessed"], group_names=gn, groups=g)
+    t, explanation = _timed_explain(ex, X, nruns=1)
+    return {"metric": "adult_blackbox_wall_s", "value": round(t, 4), "unit": "s",
+            "n_instances": X.shape[0], "additivity_err": _additivity(explanation),
+            "predictor": type(clf).__name__}
+
+
+def config_mnist(smoke=False):
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.models.cnn import train_mnist_cnn
+    from distributedkernelshap_tpu.ops.image import image_background, superpixel_groups
+    from scripts.process_mnist_data import load_mnist
+
+    data = load_mnist()
+    tr_images, tr_labels = data["train"]
+    te_images, te_labels = data["test"]
+    if smoke:
+        tr_images, tr_labels = tr_images[:4000], tr_labels[:4000]
+
+    pred = train_mnist_cnn(tr_images, tr_labels, epochs=1 if smoke else 2)
+    acc = float((np.asarray(pred(te_images[:1000].reshape(1000, -1))).argmax(1)
+                 == te_labels[:1000]).mean())
+
+    groups, names = superpixel_groups(28, 28, patch=4)  # 49 superpixels
+    bg = image_background(tr_images, mode="mean")
+    X = te_images.reshape(te_images.shape[0], -1)
+    X = X[:16] if smoke else X[:10000]
+
+    ex = KernelShap(pred, link="logit", feature_names=names, seed=0)
+    ex.fit(bg, group_names=names, groups=groups)
+    # l1_reg=False: with M=49 superpixels shap's 'auto' default would switch
+    # to host-side AIC selection (sampled fraction << 0.2); keep the bench on
+    # the fully on-device pipeline
+    t, explanation = _timed_explain(ex, X, nruns=1 if smoke else 3, l1_reg=False)
+    return {"metric": "mnist_cnn_superpixel_wall_s", "value": round(t, 4), "unit": "s",
+            "n_instances": X.shape[0], "cnn_test_acc": round(acc, 3),
+            "n_superpixels": len(groups), "additivity_err": _additivity(explanation)}
+
+
+def config_covertype(smoke=False):
+    import jax
+
+    from distributedkernelshap_tpu import KernelShap
+    from scripts.process_covertype_data import covertype_groups, load_covertype
+
+    data = load_covertype(n_rows=20000 if smoke else None or 581012)
+    X, y = data["X"], data["y"]
+    n_train = min(100000, X.shape[0] // 2)
+    from sklearn.linear_model import LogisticRegression
+
+    clf = LogisticRegression(max_iter=200).fit(X[:n_train], y[:n_train])
+    groups, names = covertype_groups()
+
+    X_explain = X[n_train:n_train + (512 if smoke else 65536)]
+    n_dev = len(jax.devices())
+    opts = {"n_devices": n_dev} if n_dev > 1 else None
+    ex = KernelShap(clf.predict_proba, link="logit", feature_names=names, seed=0,
+                    distributed_opts=opts)
+    ex.fit(X[:100], group_names=names, groups=groups)
+    t, explanation = _timed_explain(ex, X_explain, nruns=1 if smoke else 3)
+    return {"metric": "covertype_sharded_wall_s", "value": round(t, 4), "unit": "s",
+            "n_instances": X_explain.shape[0], "n_devices": n_dev,
+            "inst_per_s": round(X_explain.shape[0] / t, 1),
+            "additivity_err": _additivity(explanation)}
+
+
+CONFIGS = {
+    "adult": config_adult,
+    "adult_stress": config_adult_stress,
+    "adult_blackbox": config_adult_blackbox,
+    "mnist": config_mnist,
+    "covertype": config_covertype,
+}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", default="adult", choices=sorted(CONFIGS) + ["all"])
+    parser.add_argument("--smoke", action="store_true",
+                        help="Shrunk sizes for CI-style validation.")
+    add_platform_flag(parser)
+    args = parser.parse_args()
+    apply_platform(args)
+
+    names = sorted(CONFIGS) if args.config == "all" else [args.config]
+    for name in names:
+        result = CONFIGS[name](smoke=args.smoke)
+        print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,93 @@
+"""Flax CNN predictor for the MNIST image-explanation configuration.
+
+BASELINE.json config: "MNIST CNN, 10k instances, image KernelSHAP with
+superpixel masking".  The reference has no image models (tabular sklearn
+only); this supplies the user-model side of that configuration as a native
+JAX predictor — the explain pipeline sees a jittable ``(n, H*W) -> (n, 10)``
+function, so the synthetic-data evaluation (S coalitions x N background rows
+per instance) stays fused on the MXU.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import flax.linen as nn
+import optax
+
+from distributedkernelshap_tpu.models.predictors import JaxPredictor
+
+
+class _CNN(nn.Module):
+    """Conv(16)-Conv(32)-Dense(64)-Dense(K) classifier."""
+
+    n_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(16, (3, 3), strides=(2, 2))(x)
+        x = nn.relu(x)
+        x = nn.Conv(32, (3, 3), strides=(2, 2))(x)
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(64)(x))
+        return nn.Dense(self.n_classes)(x)
+
+
+class CNNPredictor(JaxPredictor):
+    """Image classifier predictor: flattened pixels in, class probs out."""
+
+    def __init__(self, params, image_shape: Tuple[int, int, int],
+                 n_classes: int = 10, output: str = "probs"):
+        self.params = params
+        self.image_shape = image_shape
+        self.output = output
+        module = _CNN(n_classes=n_classes)
+
+        def fn(flat):
+            imgs = flat.reshape((-1,) + image_shape)
+            logits = module.apply({"params": params}, imgs)
+            return jax.nn.softmax(logits, -1) if output == "probs" else logits
+
+        super().__init__(fn, n_outputs=n_classes, vector_out=True)
+
+
+def train_mnist_cnn(images: np.ndarray, labels: np.ndarray,
+                    image_shape: Tuple[int, int, int] = (28, 28, 1),
+                    n_classes: int = 10, epochs: int = 2,
+                    batch_size: int = 256, lr: float = 1e-3,
+                    seed: int = 0) -> CNNPredictor:
+    """Train the small CNN and wrap it as a predictor.
+
+    ``images``: ``(n, H*W)`` or ``(n, H, W[, C])`` float in [0, 1].
+    """
+
+    rng = np.random.default_rng(seed)
+    flat = images.reshape(images.shape[0], -1).astype(np.float32)
+    module = _CNN(n_classes=n_classes)
+    params = module.init(jax.random.PRNGKey(seed),
+                         jnp.zeros((1,) + image_shape, jnp.float32))["params"]
+    tx = optax.adam(lr)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, xb, yb):
+        def loss_fn(p):
+            logits = module.apply({"params": p}, xb.reshape((-1,) + image_shape))
+            return optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    n = flat.shape[0]
+    for _ in range(epochs):
+        order = rng.permutation(n)
+        for i in range(0, n - batch_size + 1, batch_size):
+            idx = order[i:i + batch_size]
+            params, opt_state, loss = step(params, opt_state,
+                                           jnp.asarray(flat[idx]),
+                                           jnp.asarray(labels[idx]))
+    return CNNPredictor(params, image_shape, n_classes=n_classes)

@@ -1,0 +1,78 @@
+"""Tests for image KernelSHAP (superpixel masking) and the CNN predictor."""
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu import KernelShap
+from distributedkernelshap_tpu.ops.image import _box_blur, image_background, superpixel_groups
+
+
+def test_superpixel_groups_partition():
+    groups, names = superpixel_groups(28, 28, patch=4)
+    assert len(groups) == 49 and len(names) == 49
+    cols = sorted(c for g in groups for c in g)
+    assert cols == list(range(28 * 28))  # exact partition
+    assert all(len(g) == 16 for g in groups)
+
+
+def test_superpixel_groups_ragged_and_channels():
+    groups, _ = superpixel_groups(5, 5, patch=2)
+    assert len(groups) == 9
+    assert sorted(c for g in groups for c in g) == list(range(25))
+    groups3, _ = superpixel_groups(4, 4, patch=2, channels=3)
+    assert sorted(c for g in groups3 for c in g) == list(range(48))
+
+
+def test_image_background_modes():
+    rng = np.random.default_rng(0)
+    imgs = rng.random((10, 8, 8)).astype(np.float32)
+    assert image_background(imgs, "mean").shape == (1, 64)
+    fill = image_background(imgs, "fill", fill_value=0.5)
+    assert np.all(fill == 0.5)
+    assert image_background(imgs, "sample", n_rows=3).shape == (3, 64)
+    blur = image_background(imgs, "blur", blur_radius=1, n_rows=2)
+    assert blur.shape == (2, 64)
+    with pytest.raises(ValueError):
+        image_background(imgs.reshape(10, -1), "blur")
+
+
+def test_box_blur_constant_invariant():
+    imgs = np.full((1, 6, 6, 1), 3.0, dtype=np.float32)
+    np.testing.assert_allclose(_box_blur(imgs, 2), imgs, atol=1e-6)
+
+
+def test_cnn_train_and_image_explain():
+    from distributedkernelshap_tpu.models.cnn import train_mnist_cnn
+    from scripts.process_mnist_data import _class_templates, _synthetic_digits
+
+    rng = np.random.default_rng(0)
+    templates = _class_templates(rng)
+    images, labels = _synthetic_digits(2000, rng, templates)
+    pred = train_mnist_cnn(images, labels, epochs=1, batch_size=128)
+
+    test_imgs, test_labels = _synthetic_digits(200, rng, templates)
+    acc = float((np.asarray(pred(test_imgs.reshape(200, -1))).argmax(1) == test_labels).mean())
+    assert acc > 0.5  # 1 epoch on 2k samples; real training does much better
+
+    groups, names = superpixel_groups(28, 28, patch=7)  # 16 superpixels
+    bg = image_background(images, mode="mean")
+    ex = KernelShap(pred, link="logit", feature_names=names, seed=0)
+    ex.fit(bg, group_names=groups and names, groups=groups)
+    explanation = ex.explain(test_imgs[:2].reshape(2, -1), nsamples=200,
+                             l1_reg=False, silent=True)
+    sv = explanation.shap_values
+    assert len(sv) == 10 and sv[0].shape == (2, 16)
+    total = np.stack(sv, 1).sum(-1) + np.asarray(explanation.expected_value)[None]
+    np.testing.assert_allclose(total, explanation.data["raw"]["raw_prediction"], atol=1e-3)
+
+
+def test_covertype_schema():
+    from scripts.process_covertype_data import covertype_groups, load_covertype
+
+    data = load_covertype(n_rows=5000)
+    # cached full file may exist from bench runs; check schema not size
+    assert data["X"].shape[1] == 54
+    assert len(data["feature_names"]) == 54
+    groups, names = covertype_groups()
+    assert len(groups) == 12
+    assert sorted(c for g in groups for c in g) == list(range(54))
