@@ -1,0 +1,131 @@
+"""Benchmark analysis & reporting.
+
+Script equivalent of the reference's ``Analysis.ipynb`` (cells 2, 25-54):
+reads the ``{'t_elapsed': [...]}`` result pickles produced by the benchmark
+drivers (same filename convention, ``utils.get_filename``), computes
+mean/std runtimes per configuration, renders the bar charts with the
+sequential-baseline overlay, and prints a comparison table against the
+reference's published numbers (BASELINE.md).
+
+Usage::
+
+    python benchmarks/analysis.py --results results/ --serve 0 --plot out.png
+"""
+
+import argparse
+import glob
+import os
+import re
+import sys
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# reference headline numbers for the comparison table (BASELINE.md)
+REFERENCE_BASELINES = {
+    "sequential_1cpu": 1736.89,
+    "ray_pool_32cpu_best": 125.05,
+    "ray_serve_32cpu_best": 115.13,
+    "ray_pool_k8s_56cpu_best": 57.0,
+    "ray_serve_k8s_56cpu_best": 60.5,
+}
+
+_POOL_RE = re.compile(r"ray_workers_(-?\d+)_bsize_(\w+)_actorfr_([\d.]+)\.pkl")
+_SERVE_RE = re.compile(r"ray_replicas_(-?\d+)_maxbatch_(\w+)_actorfr_([\d.]+)\.pkl")
+
+
+def read_runtimes(results_dir: str, serve: bool = False) -> Dict[Tuple[int, str], List[float]]:
+    """Load all result pickles into ``{(workers, batch): [t, ...]}``
+    (the reference notebook's ``read_runtimes`` helper)."""
+
+    import pickle
+
+    pattern = _SERVE_RE if serve else _POOL_RE
+    out: Dict[Tuple[int, str], List[float]] = {}
+    for path in sorted(glob.glob(os.path.join(results_dir, "*.pkl"))):
+        m = pattern.match(os.path.basename(path))
+        if not m:
+            continue
+        workers, batch = int(m.group(1)), m.group(2)
+        with open(path, "rb") as f:
+            out[(workers, batch)] = pickle.load(f)["t_elapsed"]
+    return out
+
+
+def compare_timing(runtimes: Dict[Tuple[int, str], List[float]]):
+    """Mean/std per configuration, sorted by workers then batch
+    (the notebook's ``compare_timing``)."""
+
+    rows = []
+    for (workers, batch), times in sorted(runtimes.items()):
+        rows.append({
+            "workers": workers,
+            "batch": batch,
+            "mean_s": float(np.mean(times)),
+            "std_s": float(np.std(times)),
+            "n_runs": len(times),
+            "vs_ray_pool_best": REFERENCE_BASELINES["ray_pool_32cpu_best"] / float(np.mean(times)),
+        })
+    return rows
+
+
+def print_table(rows) -> None:
+    hdr = f"{'workers':>8}{'batch':>8}{'mean_s':>10}{'std_s':>9}{'runs':>6}{'vs ref best':>13}"
+    print(hdr)
+    print("-" * len(hdr))
+    for r in rows:
+        print(f"{r['workers']:>8}{r['batch']:>8}{r['mean_s']:>10.3f}{r['std_s']:>9.3f}"
+              f"{r['n_runs']:>6}{r['vs_ray_pool_best']:>12.1f}x")
+
+
+def plot_rows(rows, out_path: str, baseline: float = None) -> None:
+    """Bar chart with the sequential baseline overlay (the notebook's red
+    dashed line, images/pool_1_node.PNG style)."""
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    labels = [f"w{r['workers']}/b{r['batch']}" for r in rows]
+    means = [r["mean_s"] for r in rows]
+    stds = [r["std_s"] for r in rows]
+
+    fig, ax = plt.subplots(figsize=(max(6, len(rows)), 4))
+    bars = ax.bar(labels, means, yerr=stds, capsize=3)
+    for bar, mean in zip(bars, means):
+        ax.annotate(f"{mean:.2f}", (bar.get_x() + bar.get_width() / 2, mean),
+                    ha="center", va="bottom", fontsize=8)
+    if baseline:
+        ax.axhline(baseline, color="red", linestyle="--",
+                   label=f"reference best ({baseline:.1f}s)")
+        ax.legend()
+    ax.set_ylabel("wall-clock (s)")
+    ax.set_title("KernelSHAP explanation runtime (2560 Adult instances)")
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=120)
+    print(f"plot written to {out_path}")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--results", default="results")
+    parser.add_argument("--serve", default=0, type=int)
+    parser.add_argument("--plot", default=None, type=str)
+    args = parser.parse_args()
+
+    runtimes = read_runtimes(args.results, serve=bool(args.serve))
+    if not runtimes:
+        print(f"no result pickles found in {args.results}")
+        return
+    rows = compare_timing(runtimes)
+    print_table(rows)
+    if args.plot:
+        plot_rows(rows, args.plot,
+                  baseline=REFERENCE_BASELINES["ray_pool_32cpu_best"])
+
+
+if __name__ == "__main__":
+    main()
