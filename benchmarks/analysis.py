@@ -16,12 +16,9 @@ import argparse
 import glob
 import os
 import re
-import sys
 from typing import Dict, List, Tuple
 
 import numpy as np
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # reference headline numbers for the comparison table (BASELINE.md)
 REFERENCE_BASELINES = {
@@ -58,8 +55,12 @@ def compare_timing(runtimes: Dict[Tuple[int, str], List[float]]):
     """Mean/std per configuration, sorted by workers then batch
     (the notebook's ``compare_timing``)."""
 
+    def batch_key(batch: str):
+        return (0, int(batch)) if batch.lstrip("-").isdigit() else (1, batch)
+
     rows = []
-    for (workers, batch), times in sorted(runtimes.items()):
+    for (workers, batch), times in sorted(
+            runtimes.items(), key=lambda kv: (kv[0][0], batch_key(kv[0][1]))):
         rows.append({
             "workers": workers,
             "batch": batch,

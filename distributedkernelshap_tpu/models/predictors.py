@@ -191,7 +191,10 @@ def _lift_is_faithful(lifted: LinearPredictor, method, example_dim: int,
         expected = np.asarray(method(probe), dtype=np.float32)
     except Exception:
         return False
-    got = np.asarray(lifted(jnp.asarray(probe)))
+    # full f32 matmul for the probe: TPU defaults to bfloat16 passes, whose
+    # ~1e-3 error would falsely reject an exact lift
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(lifted(jnp.asarray(probe)))
     if expected.ndim == 1:
         expected = expected[:, None]
     return expected.shape == got.shape and bool(np.abs(expected - got).max() < tol)
