@@ -50,6 +50,11 @@ class ShapConfig:
     # 1<<25 elements ≈ 128 MB keeps well under one chip's HBM alongside weights
     target_chunk_elems: int = 1 << 25
     coalition_chunk: Optional[int] = None  # override auto chunking
+    # Fused Pallas kernel for the linear-predictor masked eval (None = auto:
+    # on for TPU backends, off elsewhere; the XLA chunked path is the
+    # fallback everywhere).  GSPMD-sharded callers must disable it — a
+    # pallas_call has no SPMD partitioning rule; shard_map callers are fine.
+    use_pallas: Optional[bool] = None
 
 
 def groups_to_matrix(groups: Optional[Sequence[Sequence[int]]], n_columns: int) -> np.ndarray:
@@ -107,26 +112,53 @@ def _ey_generic(predictor: BasePredictor, X, bg, bgw_n, zc, chunk):
     return ey[:, :S]
 
 
-def _ey_linear(W, b, activation: str, X, bg, bgw_n, zc, chunk):
-    """MXU fast path for logits-linear predictors.
+def _ey_linear(W, b, activation: str, X, bg, bgw_n, mask, G, chunk,
+               use_pallas: bool = False):
+    """MXU fast path for logits-linear predictors, in **group space**.
 
-    For masked input ``m = x⊙z + bg⊙(1-z)`` the logits decompose as
-    ``m @ W = (z⊙x) @ W + bg @ W - (z⊙bg) @ W``; only the (cheap) activation
-    + background average need the full ``(B, c, N, K)`` tensor.
+    For masked input ``m = x⊙z + bg⊙(1-z)`` with ``z = mask @ G`` the logits
+    decompose as ``m @ W + b = p1[b,s] + bgW[n] - t2[s,n]`` where
+
+    * ``p1[b,s,k] = Σ_m mask[s,m] · XWg[b,m,k]``,
+      ``XWg[b,m,k] = Σ_{d∈group m} X[b,d] W[d,k]``
+    * ``t2[s,n,k] = Σ_m mask[s,m] · bgWg[n,m,k]`` (same per-group reduction
+      of the background), and ``bgW = bg @ W + b``.
+
+    Contracting over the M≲100 group axis instead of the D column axis means
+    no ``B×S×D`` intermediate ever exists; the remaining cost is the
+    elementwise ``act`` + background average over ``(B, S, N, K)``, fused by
+    the Pallas kernel (``ops/pallas_kernels.py``) or chunked through XLA.
+    For ``activation='identity'`` the whole N axis collapses analytically.
     """
 
     act = ACTIVATIONS[activation]
-    zc_chunks, S = _chunked(zc, chunk)
-    bgW = bg @ W + b  # (N, K)
+    GW = G[:, :, None] * W[None, :, :]                 # (M, D, K)
+    XWg = jnp.einsum("bd,mdk->bmk", X, GW)             # (B, M, K)
+    bgWg = jnp.einsum("nd,mdk->nmk", bg, GW)           # (N, M, K)
+    bgW = bg @ W + b                                   # (N, K)
 
-    def one_chunk(zc_c):
-        p1 = jnp.einsum("bd,cd,dk->bck", X, zc_c, W)       # (B, c, K)
-        t2 = jnp.einsum("cd,nd,dk->cnk", zc_c, bg, W)       # (c, N, K)
+    if activation == "identity":
+        # E_n[p1 + bgW - t2] = p1 + E[bgW] - E_n[t2]: no (B,S,N,K) tensor
+        p1 = jnp.einsum("sm,bmk->bsk", mask, XWg)
+        e_bgW = jnp.einsum("nk,n->k", bgW, bgw_n)
+        t2w = jnp.einsum("sm,nmk,n->sk", mask, bgWg, bgw_n)
+        return p1 + e_bgW[None, None, :] - t2w[None, :, :]
+
+    if use_pallas:
+        from distributedkernelshap_tpu.ops.pallas_kernels import fused_linear_ey
+
+        return fused_linear_ey(XWg, bgWg, bgW, bgw_n, mask, activation)
+
+    mask_chunks, S = _chunked(mask, chunk)
+
+    def one_chunk(mask_c):
+        p1 = jnp.einsum("sm,bmk->bsk", mask_c, XWg)     # (B, c, K)
+        t2 = jnp.einsum("sm,nmk->snk", mask_c, bgWg)    # (c, N, K)
         logits = p1[:, :, None, :] + bgW[None, None, :, :] - t2[None]
         out = act(logits)
         return jnp.einsum("bcnk,n->bck", out, bgw_n)
 
-    ey = jax.lax.map(one_chunk, zc_chunks)
+    ey = jax.lax.map(one_chunk, mask_chunks)
     ey = jnp.moveaxis(ey, 1, 0).reshape(X.shape[0], -1, ey.shape[-1])
     return ey[:, :S]
 
@@ -211,13 +243,17 @@ def build_explainer_fn(predictor: BasePredictor, config: ShapConfig = ShapConfig
         K = predictor.n_outputs
 
         bgw_n = bgw / jnp.sum(bgw)
-        zc = mask @ G  # (S, D) column-space masks
 
         if linear is not None:
             W, b, activation = linear
+            use_pallas = config.use_pallas
+            if use_pallas is None:
+                use_pallas = jax.default_backend() not in ("cpu", "gpu")
             chunk = config.coalition_chunk or _auto_chunk(S, B * N * K, config.target_chunk_elems)
-            ey = _ey_linear(W, b, activation, X, bg, bgw_n, zc, chunk)
+            ey = _ey_linear(W, b, activation, X, bg, bgw_n, mask, G, chunk,
+                            use_pallas=use_pallas)
         else:
+            zc = mask @ G  # (S, D) column-space masks
             chunk = config.coalition_chunk or _auto_chunk(S, B * N * D, config.target_chunk_elems)
             ey = _ey_generic(predictor, X, bg, bgw_n, zc, chunk)
 

@@ -1,0 +1,158 @@
+"""Pallas TPU kernels for the KernelSHAP hot op.
+
+The explain pipeline's dominant cost is the masked-evaluation reduction
+
+    ey[b,s,k] = Σ_n bgw[n] · act(p1[b,s,k] + bgW[n,k] - t2[s,n,k])
+
+(`ops/explain._ey_linear`; reference semantics: the `nsamples × N` synthetic
+predictor evaluations of shap 0.35's per-instance loop, SURVEY.md §2.2).  XLA
+materialises the ``(B, S, N, K)`` logits tensor in HBM chunk by chunk; this
+kernel keeps everything in VMEM: per ``(TB, TS)`` tile it runs the two tiny
+group-space matmuls on the MXU, then loops the background axis on the VPU,
+accumulating the activation-weighted average without ever leaving the chip
+registers.  HBM traffic drops from O(B·S·N·K) to O(B·S·K).
+
+Layouts: the class axis K is tiny (2-10), so it is unrolled in the kernel and
+carried as the leading (untiled) axis; S rides the 128-wide lane dimension.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# default tile sizes: (TB, TS) f32 accumulators per class; K·3·TB·TS·4 bytes
+# of VMEM at K=2 → ~800 KB, comfortably inside the ~16 MB budget
+_TB = 256
+_TS = 512
+
+
+def _ey_kernel(XWg_ref, maskT_ref, bgWg_ref, bgW_ref, bgw_ref, out_ref,
+               t2p_ref, *, N: int, K: int, activation: str):
+    """One (TB, TS) tile of ey for all K classes.
+
+    Refs: XWg (K, TB, M), maskT (M, TS), bgWg (K, N, M), bgW (K, N, 1),
+    bgw (N,) in SMEM, out (K, TB, TS); scratch t2p (K, N, TS).
+    """
+
+    maskT = maskT_ref[:]                      # (M, TS)
+    highest = jax.lax.Precision.HIGHEST       # f32 MXU passes: the ~1e-3
+                                              # bf16 default error would leak
+    if activation == "softmax" and K == 2:
+        # binary softmax == sigmoid of the logit difference: one
+        # transcendental per (n, tile) and the k=0 accumulator is the
+        # complement (Σ bgw = 1).  Only the class-difference tensors are
+        # needed; the n-loop reads rows of the staged dT2 scratch.
+        t2p_ref[0] = (jnp.dot(bgWg_ref[1] - bgWg_ref[0], maskT,
+                              precision=highest,
+                              preferred_element_type=jnp.float32)
+                      - (bgW_ref[1] - bgW_ref[0]))
+        dp = jnp.dot(XWg_ref[1] - XWg_ref[0], maskT, precision=highest,
+                     preferred_element_type=jnp.float32)
+
+        def body(n, acc):
+            d = dp - t2p_ref[0, n, :][None, :]
+            return acc + bgw_ref[n] * jax.nn.sigmoid(d)
+
+        acc1 = jax.lax.fori_loop(0, N, body, jnp.zeros(dp.shape, jnp.float32))
+        out_ref[1] = acc1
+        out_ref[0] = 1.0 - acc1
+        return
+
+    for k in range(K):
+        # t2'[k,n,s] = t2[k,n,s] - bgW[k,n]:   logits = p1 - t2'
+        t2p_ref[k] = jnp.dot(bgWg_ref[k], maskT, precision=highest,
+                             preferred_element_type=jnp.float32) - bgW_ref[k]
+    p1 = [jnp.dot(XWg_ref[k], maskT, precision=highest,
+                  preferred_element_type=jnp.float32)
+          for k in range(K)]                  # K × (TB, TS)
+
+    shape = p1[0].shape
+
+    def body(n, accs):
+        w_n = bgw_ref[n]
+        logits = [p1[k] - t2p_ref[k, n, :][None, :] for k in range(K)]
+        if activation == "softmax":
+            m = logits[0]
+            for k in range(1, K):
+                m = jnp.maximum(m, logits[k])
+            es = [jnp.exp(l - m) for l in logits]
+            denom = es[0]
+            for e in es[1:]:
+                denom = denom + e
+            inv = 1.0 / denom
+            probs = [e * inv for e in es]
+        elif activation == "sigmoid":
+            probs = [jax.nn.sigmoid(l) for l in logits]
+        else:  # identity: callers collapse this analytically, kept for safety
+            probs = logits
+        return tuple(a + w_n * p for a, p in zip(accs, probs))
+
+    accs = jax.lax.fori_loop(
+        0, N, body, tuple(jnp.zeros(shape, jnp.float32) for _ in range(K)))
+    for k in range(K):
+        out_ref[k] = accs[k]
+
+
+@functools.partial(jax.jit, static_argnames=("activation", "tb", "ts", "interpret"))
+def fused_linear_ey(XWg, bgWg, bgW, bgw, mask,
+                    activation: str = "softmax",
+                    tb: int = _TB, ts: int = _TS,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Fused ``ey`` for a logits-linear predictor.
+
+    Parameters: ``XWg (B, M, K)`` per-group instance logits, ``bgWg
+    (N, M, K)`` per-group background logits, ``bgW (N, K)`` full background
+    logits (bias included), ``bgw (N,)`` normalised background weights,
+    ``mask (S, M)`` coalition masks.  Returns ``ey (B, S, K)``.
+
+    ``interpret=None`` auto-selects interpreter mode off-TPU so the same
+    code path is testable on CPU.
+    """
+
+    B, M, K = XWg.shape
+    N = bgWg.shape[0]
+    S = mask.shape[0]
+    if interpret is None:
+        interpret = jax.default_backend() in ("cpu", "gpu")
+
+    tb = min(tb, max(8, B))
+    ts = min(ts, max(128, S))
+
+    XWg_t = jnp.transpose(XWg, (2, 0, 1)).astype(jnp.float32)    # (K, B, M)
+    bgWg_t = jnp.transpose(bgWg, (2, 0, 1)).astype(jnp.float32)  # (K, N, M)
+    bgW_t = jnp.transpose(bgW, (1, 0))[:, :, None].astype(jnp.float32)  # (K, N, 1)
+    maskT = jnp.transpose(mask, (1, 0)).astype(jnp.float32)      # (M, S)
+    # the binary-softmax path relies on Σ bgw = 1 (k=0 accumulator restored
+    # as the complement); normalise defensively
+    bgw = bgw.astype(jnp.float32)
+    bgw = bgw / jnp.sum(bgw)
+
+    grid = (pl.cdiv(B, tb), pl.cdiv(S, ts))
+    kernel = functools.partial(_ey_kernel, N=N, K=K, activation=activation)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((K, tb, M), lambda i, j: (0, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((M, ts), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, N, M), lambda i, j: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, N, 1), lambda i, j: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((K, tb, ts), lambda i, j: (0, i, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((K, B, S), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((K, N, ts), jnp.float32)],
+        interpret=interpret,
+    )(XWg_t, maskT, bgWg_t, bgW_t, bgw)
+
+    return jnp.transpose(out, (1, 2, 0))  # (B, S, K)

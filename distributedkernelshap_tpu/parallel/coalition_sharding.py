@@ -49,17 +49,22 @@ def build_coalition_sharded_fn(predictor: BasePredictor,
     linear = predictor.linear_decomposition
     n_coal = mesh.shape[COALITION_AXIS]
 
-    def local_ey(X, bg, bgw_n, zc_local):
+    def local_ey(X, bg, bgw_n, mask_local, G):
         """Expected outputs for this shard's coalition rows."""
         B, D = X.shape
         N = bg.shape[0]
         K = predictor.n_outputs
-        S_local = zc_local.shape[0]
+        S_local = mask_local.shape[0]
         if linear is not None:
             W, b, activation = linear
             chunk = config.coalition_chunk or _auto_chunk(S_local, B * N * K,
                                                           config.target_chunk_elems)
-            return _ey_linear(W, b, activation, X, bg, bgw_n, zc_local, chunk)
+            # pallas only on explicit opt-in here: the shard_map body runs
+            # per-device, which is fine on TPU meshes, but the CPU-mesh dry
+            # run would interpret the kernel 8× over
+            return _ey_linear(W, b, activation, X, bg, bgw_n, mask_local, G,
+                              chunk, use_pallas=bool(config.use_pallas))
+        zc_local = mask_local @ G
         chunk = config.coalition_chunk or _auto_chunk(S_local, B * N * D,
                                                       config.target_chunk_elems)
         return _ey_generic(predictor, X, bg, bgw_n, zc_local, chunk)
@@ -69,8 +74,7 @@ def build_coalition_sharded_fn(predictor: BasePredictor,
         mask/w are this coalition-shard's rows; bg/G replicated."""
 
         bgw_n = bgw / jnp.sum(bgw)
-        zc_local = mask_local @ G
-        ey = local_ey(X, bg, bgw_n, zc_local)            # (B_loc, S_loc, K)
+        ey = local_ey(X, bg, bgw_n, mask_local, G)       # (B_loc, S_loc, K)
 
         fx = link_fn(predictor(X))                       # (B_loc, K)
         e_out = jnp.einsum("nk,n->k", predictor(bg), bgw_n)

@@ -105,6 +105,9 @@ class DistributedExplainer:
         # the reference instead spawned n_actors replica processes
         self.engine = explainer_type(*init_args, **init_kwargs)
         self._jit_cache: Dict[Any, Any] = {}
+        self._dev_cache: Dict[Any, Any] = {}
+        self.last_raw_prediction: Optional[np.ndarray] = None
+        self.last_X_fingerprint = None
 
     def __getattr__(self, item):
         # only called when normal lookup fails: proxy to the engine
@@ -135,7 +138,12 @@ class DistributedExplainer:
                 # scales with the data-parallel width
                 fn = build_explainer_fn(
                     self.engine.predictor,
+                    # use_pallas=False: a pallas_call has no GSPMD partition
+                    # rule, so under jit-with-shardings it would force a
+                    # gather onto one device; the coalition shard_map path is
+                    # where pallas composes with meshes
                     replace(self.engine.config.shap, link=self.engine.config.link,
+                            use_pallas=False,
                             target_chunk_elems=(self.engine.config.shap.target_chunk_elems
                                                 * self.n_data)))
                 shard = NamedSharding(self.mesh, P(DATA_AXIS))
@@ -148,8 +156,21 @@ class DistributedExplainer:
                 )
         return self._jit_cache[key]
 
-    def _explain_sharded(self, X: np.ndarray, nsamples) -> np.ndarray:
-        """One sharded device call over the global batch ``X``."""
+    def _device_args(self, plan):
+        """Device-resident per-fit constants (one H2D upload, reused across
+        explain calls — same rationale as the single-device engine)."""
+
+        key = id(plan)
+        if key not in self._dev_cache:
+            engine = self.engine
+            self._dev_cache[key] = tuple(jnp.asarray(a) for a in (
+                engine.background, engine.bg_weights, plan.mask, plan.weights,
+                engine.G))
+        return self._dev_cache[key]
+
+    def _explain_sharded(self, X: np.ndarray, nsamples) -> Tuple[np.ndarray, np.ndarray]:
+        """One sharded device call over the global batch ``X``; returns
+        ``(shap_values, link-space raw predictions)``."""
 
         engine = self.engine
         plan = engine._plan(nsamples)
@@ -162,15 +183,14 @@ class DistributedExplainer:
         if padded != B:
             filler = np.tile(X[-1:], (padded - B, 1))
             X = np.concatenate([X, filler], 0)
-        out = self._sharded_fn()(
-            jnp.asarray(X, jnp.float32),
-            jnp.asarray(engine.background),
-            jnp.asarray(engine.bg_weights),
-            jnp.asarray(plan.mask),
-            jnp.asarray(plan.weights),
-            jnp.asarray(engine.G),
-        )
-        return np.asarray(out['shap_values'])[:B]
+        out = self._sharded_fn()(jnp.asarray(X, jnp.float32),
+                                 *self._device_args(plan))
+        # one packed D2H instead of two (tunnelled transfers are latency-bound)
+        packed = np.asarray(jnp.concatenate(
+            [out['shap_values'].ravel(), out['raw_prediction'].ravel()]))
+        Bp, K, M = X.shape[0], engine.predictor.n_outputs, engine.M
+        phi, fx = np.split(packed, [Bp * K * M])
+        return phi.reshape(Bp, K, M)[:B], fx.reshape(Bp, K)[:B]
 
     def get_explanation(self, X: np.ndarray, **kwargs) -> Any:
         """Explain ``X``, sharded over the mesh.
@@ -197,9 +217,12 @@ class DistributedExplainer:
             slabs = make_batches(X, batch_size=slab)
         else:
             slabs = [X]
-        phi = np.concatenate(
-            [self._explain_sharded(s, nsamples) for s in slabs], 0)[:B]
+        results = [self._explain_sharded(s, nsamples) for s in slabs]
+        phi = np.concatenate([r[0] for r in results], 0)[:B]
         X = X[:B]
+        self.last_raw_prediction = np.concatenate([r[1] for r in results], 0)[:B]
+        from distributedkernelshap_tpu.kernel_shap import _fingerprint
+        self.last_X_fingerprint = _fingerprint(X)
 
         phi = self.engine._apply_l1_reg(phi, X, l1_reg, nsamples)
         return split_shap_values(phi, self.engine.vector_out)

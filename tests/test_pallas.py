@@ -1,0 +1,87 @@
+"""Pallas fused-kernel numerics (interpret mode on CPU).
+
+The fused kernel must agree with the straightforward dense formula — the
+masked-evaluation contract of shap 0.35's synthetic-data loop (SURVEY.md
+§2.2) — for every activation and for non-aligned, multi-block shapes.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from distributedkernelshap_tpu.ops.pallas_kernels import fused_linear_ey
+
+
+def _dense_reference(X, bg, W, b, G, mask, bgw, activation):
+    zc = mask @ G
+    masked = (X[:, None, None, :] * zc[None, :, None, :]
+              + bg[None, None] * (1.0 - zc[None, :, None, :]))
+    logits = masked @ W + b
+    if activation == "softmax":
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        out = e / e.sum(-1, keepdims=True)
+    elif activation == "sigmoid":
+        out = 1.0 / (1.0 + np.exp(-logits))
+    else:
+        out = logits
+    return np.einsum("bsnk,n->bsk", out, bgw)
+
+
+def _problem(B, S, N, M, K, seed=0):
+    rng = np.random.default_rng(seed)
+    D = 2 * M
+    X = rng.normal(size=(B, D)).astype(np.float32)
+    bg = rng.normal(size=(N, D)).astype(np.float32)
+    W = rng.normal(size=(D, K)).astype(np.float32)
+    b = rng.normal(size=(K,)).astype(np.float32)
+    G = np.zeros((M, D), np.float32)
+    for m in range(M):
+        G[m, 2 * m:2 * m + 2] = 1.0
+    mask = (rng.random(size=(S, M)) < 0.5).astype(np.float32)
+    bgw = rng.random(N).astype(np.float32)
+    bgw /= bgw.sum()
+    GW = G[:, :, None] * W[None]
+    XWg = np.einsum("bd,mdk->bmk", X, GW)
+    bgWg = np.einsum("nd,mdk->nmk", bg, GW)
+    bgW = bg @ W + b
+    return X, bg, W, b, G, mask, bgw, XWg, bgWg, bgW
+
+
+@pytest.mark.parametrize("K,activation", [(2, "softmax"), (3, "softmax"),
+                                          (1, "sigmoid"), (2, "sigmoid")])
+def test_fused_linear_ey_matches_dense(K, activation):
+    B, S, N, M = 12, 150, 9, 6
+    X, bg, W, b, G, mask, bgw, XWg, bgWg, bgW = _problem(B, S, N, M, K)
+    ref = _dense_reference(X, bg, W, b, G, mask, bgw, activation)
+    got = np.asarray(fused_linear_ey(
+        jnp.asarray(XWg), jnp.asarray(bgWg), jnp.asarray(bgW),
+        jnp.asarray(bgw), jnp.asarray(mask), activation, interpret=True))
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_fused_linear_ey_multiblock_edges():
+    """Non-aligned B and S exercise edge blocks of the (tb, ts) grid."""
+
+    B, S, N, M, K = 33, 700, 9, 7, 2
+    X, bg, W, b, G, mask, bgw, XWg, bgWg, bgW = _problem(B, S, N, M, K, seed=1)
+    ref = _dense_reference(X, bg, W, b, G, mask, bgw, "softmax")
+    got = np.asarray(fused_linear_ey(
+        jnp.asarray(XWg), jnp.asarray(bgWg), jnp.asarray(bgW),
+        jnp.asarray(bgw), jnp.asarray(mask), "softmax",
+        tb=16, ts=256, interpret=True))
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_ey_linear_pallas_vs_xla_path():
+    """`_ey_linear(use_pallas=True)` must equal the chunked XLA fallback."""
+
+    from distributedkernelshap_tpu.ops.explain import _ey_linear
+
+    B, S, N, M, K = 10, 90, 8, 5, 2
+    X, bg, W, b, G, mask, bgw, *_ = _problem(B, S, N, M, K, seed=2)
+    args = (jnp.asarray(W), jnp.asarray(b), "softmax", jnp.asarray(X),
+            jnp.asarray(bg), jnp.asarray(bgw), jnp.asarray(mask),
+            jnp.asarray(G), 17)
+    xla = np.asarray(_ey_linear(*args, use_pallas=False))
+    pallas = np.asarray(_ey_linear(*args, use_pallas=True))
+    np.testing.assert_allclose(pallas, xla, atol=1e-5)
