@@ -238,15 +238,16 @@ class KernelExplainerEngine:
         self.last_raw_prediction: Optional[np.ndarray] = None
 
         # black-box predictors can't run inside jit on backends without host
-        # callbacks (axon PJRT rejects pure_callback): evaluate on the host,
-        # solve on device
+        # callbacks (tunnelled TPU PJRT rejects pure_callback while still
+        # reporting platform 'tpu'): evaluate on the host, solve on device
         if self.config.host_eval is None:
-            from distributedkernelshap_tpu.models.predictors import CallbackPredictor
+            from distributedkernelshap_tpu.models.predictors import (
+                CallbackPredictor, backend_supports_callbacks)
 
             self.config = replace(
                 self.config,
                 host_eval=(isinstance(self.predictor, CallbackPredictor)
-                           and jax.default_backend() not in ('cpu', 'gpu', 'tpu')))
+                           and not backend_supports_callbacks()))
         if self.config.host_eval:
             logger.info("Using host-side predictor evaluation (device keeps the "
                         "WLS solve); backend=%s", jax.default_backend())

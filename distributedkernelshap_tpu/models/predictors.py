@@ -25,6 +25,7 @@ everything else falls back to ``CallbackPredictor``.
 """
 
 import logging
+import os
 from typing import Callable, Optional
 
 import jax
@@ -38,6 +39,57 @@ ACTIVATIONS = {
     "softmax": lambda z: jax.nn.softmax(z, axis=-1),
     "sigmoid": jax.nn.sigmoid,
 }
+
+_CALLBACK_SUPPORTED: Optional[bool] = None
+
+# PJRT plugins that proxy a remote device over a relay; they report platform
+# 'tpu' but cannot service host send/recv callbacks
+_TUNNEL_PLUGIN_NAMES = ("axon",)
+
+
+def backend_supports_callbacks() -> bool:
+    """Whether the active backend can execute ``jax.pure_callback``.
+
+    Backend *names* alone are not reliable here: tunnelled TPU runtimes
+    (remote PJRT relays) report platform 'tpu' but cannot service host
+    send/recv callbacks — some reject them, others *hang* on the transfer,
+    and a hung callback program wedges the remote device for every later
+    session.  Executing a probe is therefore unsafe; detection is purely
+    structural: cpu/gpu and directly-attached TPU support callbacks, a
+    registered tunnel plugin means no, and unknown platforms conservatively
+    fall back to host-side evaluation
+    (``KernelExplainerEngine._explain_array_hosteval``), which is always
+    correct — only the eval location differs.
+    """
+
+    global _CALLBACK_SUPPORTED
+    if _CALLBACK_SUPPORTED is None:
+        backend = jax.default_backend()
+        try:
+            # tunnelled iff the *active* client came from a tunnel plugin
+            # (registration alone is not enough: the plugin's factory can be
+            # registered while a cpu/gpu backend is the one selected)
+            from jax._src import xla_bridge as xb
+
+            active = xb.get_backend()
+            tunnelled = any(
+                name in _TUNNEL_PLUGIN_NAMES and client is active
+                for name, client in xb.backends().items())
+        except Exception:
+            # private API moved and provenance is unknowable: 'tpu' could be
+            # a tunnel (plugins auto-discover with JAX_PLATFORMS unset), and
+            # a wrong True here can wedge the device — treat any 'tpu' as
+            # possibly tunnelled; host-eval is always correct
+            tunnelled = backend == "tpu" or any(
+                p in os.environ.get("JAX_PLATFORMS", "")
+                for p in _TUNNEL_PLUGIN_NAMES)
+        _CALLBACK_SUPPORTED = backend in ("cpu", "gpu", "tpu") and not tunnelled
+        if not _CALLBACK_SUPPORTED:
+            logger.info(
+                "backend '%s'%s cannot service host callbacks; black-box "
+                "predictors will evaluate on the host", backend,
+                " (tunnelled)" if tunnelled else "")
+    return _CALLBACK_SUPPORTED
 
 
 class BasePredictor:

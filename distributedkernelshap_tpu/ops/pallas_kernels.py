@@ -29,6 +29,40 @@ from jax.experimental.pallas import tpu as pltpu
 _TB = 256
 _TS = 512
 
+# scoped-VMEM budget for one grid step.  The hardware limit is 16 MB; leave
+# headroom for Mosaic's own staging.
+_VMEM_BUDGET = 10 << 20
+
+
+def _tile_sizes(B: int, S: int, N: int, M: int, K: int,
+                tb: int, ts: int) -> tuple:
+    """Shrink (tb, ts) until the kernel's scoped-VMEM working set fits.
+
+    The general-path peak holds ~6 (K, tb, ts) f32 tile sets live at once
+    (p1, accs, logits, es, probs, double-buffered out) plus the (K, N, ts)
+    dT2 scratch and the input tiles; at K=7 (Covertype) the defaults would
+    need >20 MB and Mosaic rejects the kernel, so tb halves (then ts) until
+    the estimate fits ``_VMEM_BUDGET``.
+    """
+
+    tb = min(tb, max(8, B))
+    ts = min(ts, max(128, S))
+
+    def footprint(tb_, ts_):
+        tiles = 6 * K * tb_ * ts_ * 4
+        scratch = 2 * K * N * ts_ * 4
+        inputs = 2 * (K * tb_ * M + M * ts_ + K * N * M + K * N) * 4
+        return tiles + scratch + inputs
+
+    while footprint(tb, ts) > _VMEM_BUDGET:
+        if tb > 8:
+            tb //= 2
+        elif ts > 128:
+            ts //= 2
+        else:
+            break
+    return tb, ts
+
 
 def _ey_kernel(XWg_ref, maskT_ref, bgWg_ref, bgW_ref, bgw_ref, out_ref,
                t2p_ref, *, N: int, K: int, activation: str):
@@ -119,8 +153,7 @@ def fused_linear_ey(XWg, bgWg, bgW, bgw, mask,
     if interpret is None:
         interpret = jax.default_backend() in ("cpu", "gpu")
 
-    tb = min(tb, max(8, B))
-    ts = min(ts, max(128, S))
+    tb, ts = _tile_sizes(B, S, N, M, K, tb, ts)
 
     XWg_t = jnp.transpose(XWg, (2, 0, 1)).astype(jnp.float32)    # (K, B, M)
     bgWg_t = jnp.transpose(bgWg, (2, 0, 1)).astype(jnp.float32)  # (K, N, M)

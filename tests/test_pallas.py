@@ -72,6 +72,36 @@ def test_fused_linear_ey_multiblock_edges():
     np.testing.assert_allclose(got, ref, atol=1e-5)
 
 
+def test_fused_linear_ey_many_classes_covertype_shape():
+    """K=7 at Covertype-like dims: the auto-picked tiles must fit the scoped
+    VMEM budget (Mosaic rejected the default 256x512 tiles at 20.5 MB) and
+    the numbers must still match."""
+
+    from distributedkernelshap_tpu.ops.pallas_kernels import (
+        _TB, _TS, _VMEM_BUDGET, _tile_sizes)
+
+    B, S, N, M, K = 40, 300, 20, 12, 7
+    tb, ts = _tile_sizes(B, S, N, M, K, _TB, _TS)
+    assert 6 * K * tb * ts * 4 + 2 * K * N * ts * 4 <= _VMEM_BUDGET
+    assert tb >= 8 and ts >= 128
+
+    X, bg, W, b, G, mask, bgw, XWg, bgWg, bgW = _problem(B, S, N, M, K, seed=3)
+    ref = _dense_reference(X, bg, W, b, G, mask, bgw, "softmax")
+    got = np.asarray(fused_linear_ey(
+        jnp.asarray(XWg), jnp.asarray(bgWg), jnp.asarray(bgW),
+        jnp.asarray(bgw), jnp.asarray(mask), "softmax", interpret=True))
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_tile_sizes_defaults_unchanged_for_small_k():
+    """The headline Adult config (K=2) must keep the full-size tiles —
+    shrinking them there would regress the benchmark for no reason."""
+
+    from distributedkernelshap_tpu.ops.pallas_kernels import _TB, _TS, _tile_sizes
+
+    assert _tile_sizes(B=2560, S=2072, N=100, M=12, K=2, tb=_TB, ts=_TS) == (_TB, _TS)
+
+
 def test_ey_linear_pallas_vs_xla_path():
     """`_ey_linear(use_pallas=True)` must equal the chunked XLA fallback."""
 

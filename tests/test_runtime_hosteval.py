@@ -45,6 +45,34 @@ def test_native_lib_loaded():
     assert native.get_lib() is not None
 
 
+def test_backend_callback_probe_and_auto_routing():
+    """`host_eval=None` must auto-route CallbackPredictors by *structurally*
+    detecting callback support (active client vs registered tunnel plugins),
+    not by backend name — tunnelled TPU backends report 'tpu' but hang on
+    callbacks, and executing a probe callback could wedge the device."""
+
+    from distributedkernelshap_tpu.models import predictors as P
+
+    supported = P.backend_supports_callbacks()
+    assert isinstance(supported, bool)
+    assert P.backend_supports_callbacks() is supported  # cached
+
+    rng = np.random.default_rng(5)
+    bg = rng.normal(size=(8, 4)).astype(np.float32)
+    W = rng.normal(size=(4, 2)).astype(np.float32)
+
+    def opaque(x):
+        z = np.asarray(x) @ W
+        e = np.exp(z - z.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    eng = KernelExplainerEngine(CallbackPredictor(opaque, example_dim=4),
+                                bg, link="logit", seed=0)
+    assert eng.config.host_eval is (not supported)
+    phi = eng.get_explanation(rng.normal(size=(3, 4)).astype(np.float32))
+    assert phi[0].shape == (3, 4)
+
+
 def test_hosteval_matches_device_path():
     """Forced host-eval (black-box route) must agree with the fully on-device
     pipeline for the same model."""
