@@ -15,6 +15,8 @@ Reference parity notes are cited per method as ``kernel_shap.py:<lines>``.
 import copy
 import logging
 import math
+import threading
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field, replace
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -407,24 +409,99 @@ class KernelExplainerEngine:
             return self._explain_array_hosteval(X, nsamples)
         with profiler().phase('coalition_plan'):
             plan = self._plan(nsamples)
+        with profiler().phase('device_explain'):
+            return self._dispatch_array(X, plan)()
+
+    def _dispatch_array(self, X: np.ndarray, plan):
+        """Launch the device computation for ``X`` and return a zero-argument
+        ``finalize`` that blocks on the D2H copy and unpacks the result.
+
+        JAX dispatch is asynchronous, so the caller can issue further device
+        work (or do host work) between dispatch and finalize; through a
+        tunnelled TPU the D2H copy costs ~70ms of RPC latency regardless of
+        payload size, and concurrent copies overlap — the serving pipeline
+        exploits both."""
+
         B = X.shape[0]
         pad = (self._bucket(B) - B) if self.config.bucket_batches else 0
         Xp = np.concatenate([X, np.tile(X[-1:], (pad, 1))], 0) if pad else X
-        with profiler().phase('device_explain'):
-            out = self._fn()(jnp.asarray(Xp, jnp.float32), *self._device_args(plan))
-            # one packed D2H instead of three: device->host syncs through a
-            # tunnelled TPU cost ~100ms each regardless of payload size
-            packed = jnp.concatenate([out['shap_values'].ravel(),
-                                      out['expected_value'].ravel(),
-                                      out['raw_prediction'].ravel()])
-            flat = np.asarray(jax.block_until_ready(packed))
-        Bp, K, M = Xp.shape[0], self.predictor.n_outputs, self.M
-        phi, e_val, fx = np.split(flat, [Bp * K * M, Bp * K * M + K])
-        return {
-            'shap_values': phi.reshape(Bp, K, M)[:B],
-            'expected_value': e_val,
-            'raw_prediction': fx.reshape(Bp, K)[:B],
-        }
+        out = self._fn()(jnp.asarray(Xp, jnp.float32), *self._device_args(plan))
+        # one packed D2H instead of three; the copy itself blocks on the
+        # value, so an explicit block_until_ready would add a second full
+        # round trip.
+        packed = jnp.concatenate([out['shap_values'].ravel(),
+                                  out['expected_value'].ravel(),
+                                  out['raw_prediction'].ravel()])
+        Bp = Xp.shape[0]
+
+        def finalize() -> Dict[str, np.ndarray]:
+            flat = np.asarray(packed)
+            K, M = self.predictor.n_outputs, self.M
+            phi, e_val, fx = np.split(flat, [Bp * K * M, Bp * K * M + K])
+            return {
+                'shap_values': phi.reshape(Bp, K, M)[:B],
+                'expected_value': e_val,
+                'raw_prediction': fx.reshape(Bp, K)[:B],
+            }
+
+        return finalize
+
+    def get_explanation_async(self,
+                              X: np.ndarray,
+                              nsamples: Union[str, int, None] = None,
+                              l1_reg: Union[str, float, int, None] = 'auto'):
+        """Asynchronous variant of :meth:`get_explanation` for the serving
+        pipeline: dispatches the device work for ``X`` immediately and
+        returns ``finalize() -> (values, info)`` where ``values`` matches
+        ``get_explanation``'s return and ``info`` carries the batch's
+        ``expected_value`` / link-space ``raw_prediction``.
+
+        Dispatch must stay on one thread (it populates the jit/plan caches);
+        ``finalize`` may run on another thread, and concurrent finalizes of
+        different batches overlap their D2H round trips."""
+
+        X = np.atleast_2d(np.asarray(X, dtype=np.float32))
+        needs_chunking = (self.config.instance_chunk
+                          and X.shape[0] > self.config.instance_chunk)
+        if self.config.host_eval or needs_chunking or self._l1_active(l1_reg, nsamples):
+            # these paths don't gain from pipelining (host-eval is
+            # host-bound; the l1 path re-dispatches device work and runs
+            # sklearn lars; over-chunk batches must honour instance_chunk's
+            # memory bound) and they touch shared engine state — so compute
+            # synchronously on the dispatcher thread and close over the
+            # results, keeping finalizer threads away from non-thread-safe
+            # state
+            values = self.get_explanation(X, nsamples=nsamples,
+                                          l1_reg=l1_reg, silent=True)
+            info = {
+                'raw_prediction': self.last_raw_prediction,
+                'expected_value': np.atleast_1d(
+                    np.asarray(self.expected_value, dtype=np.float32)),
+            }
+            return lambda: (values, info)
+
+        plan = self._plan(nsamples)
+        fin = self._dispatch_array(X, plan)
+
+        def finalize():
+            r = fin()
+            # l1 is inactive here (checked above), so this is pure numpy
+            phi = r['shap_values']
+            return split_shap_values(phi, self.vector_out), r
+
+        return finalize
+
+    def _l1_active(self, l1_reg, nsamples) -> bool:
+        """Whether ``_apply_l1_reg`` would run a host-side selection pass
+        (mirrors its 'auto' fraction rule without touching device state)."""
+
+        if l1_reg in (None, False, 0):
+            return False
+        if isinstance(l1_reg, str) and l1_reg == 'auto':
+            plan = self._plan(nsamples)
+            space = 2.0 ** self.M - 2 if self.M < 63 else np.inf
+            return plan.n_rows / space < 0.2
+        return True
 
     def get_explanation(self,
                         X: Union[Tuple[int, np.ndarray], np.ndarray],
@@ -456,7 +533,19 @@ class KernelExplainerEngine:
             c = self.config.instance_chunk
             chunks = [X[i:i + c] for i in range(0, X.shape[0], c)]
 
-        results = [self._explain_array(c, nsamples) for c in chunks]
+        if len(chunks) > 1 and not self.config.host_eval:
+            # dispatch every chunk before fetching any: device executions
+            # queue up behind each other, and the per-chunk D2H round trips
+            # (~70ms each through a tunnelled TPU) overlap across threads
+            with profiler().phase('coalition_plan'):
+                plan = self._plan(nsamples)
+            with profiler().phase('device_explain'):
+                finalizers = [self._dispatch_array(c, plan) for c in chunks]
+                with ThreadPoolExecutor(
+                        max_workers=min(8, len(finalizers))) as pool:
+                    results = list(pool.map(lambda f: f(), finalizers))
+        else:
+            results = [self._explain_array(c, nsamples) for c in chunks]
         phi = np.concatenate([r['shap_values'] for r in results], 0)
         # stash the link-space predictions so build_explanation doesn't need a
         # second predictor pass (+ D2H round trip) for the same instances
@@ -485,19 +574,16 @@ class KernelExplainerEngine:
         """
 
         plan = self._plan(nsamples)
-        if l1_reg in (None, False, 0):
+        if not self._l1_active(l1_reg, nsamples):
             return phi
         if isinstance(l1_reg, str) and l1_reg == 'auto':
             space = 2.0 ** self.M - 2 if self.M < 63 else np.inf
-            fraction = plan.n_rows / space
-            if fraction >= 0.2:
-                return phi
             l1_reg = 'aic'
             logger.warning(
                 "l1_reg='auto': sampled fraction %.2e of the coalition space is "
                 "< 0.2, so AIC feature selection runs per instance on the host "
                 "(shap 0.35 default behaviour). Pass l1_reg=False to keep the "
-                "fully on-device path.", fraction)
+                "fully on-device path.", plan.n_rows / space)
         return self._l1_solve(X, plan, l1_reg)
 
     def _l1_solve(self, X, plan, l1_reg):
@@ -600,6 +686,9 @@ class KernelShap(Explainer, FitMixin):
                  distributed_opts: Optional[Dict] = None):
         super().__init__(meta=copy.deepcopy(DEFAULT_META_KERNEL_SHAP))
 
+        # guards meta mutation + snapshot in build_explanation, which the
+        # serving pipeline calls from concurrent finalizer threads
+        self._meta_lock = threading.Lock()
         self.link = link
         self.predictor = predictor
         self.feature_names = feature_names if feature_names else []
@@ -1008,9 +1097,11 @@ class KernelShap(Explainer, FitMixin):
             instances=X_arr,
             importances=importances,
         )
-        self._update_metadata({"summarise_result": self.summarise_result}, params=True)
-
-        return Explanation(meta=copy.deepcopy(self.meta), data=data)
+        with self._meta_lock:
+            self._update_metadata({"summarise_result": self.summarise_result},
+                                  params=True)
+            meta = copy.deepcopy(self.meta)
+        return Explanation(meta=meta, data=data)
 
     def _raw_predictions(self, X_arr: np.ndarray) -> np.ndarray:
         """Link-transformed model outputs on the explained instances.

@@ -56,9 +56,9 @@ def _tile_sizes(B: int, S: int, N: int, M: int, K: int,
 
     while footprint(tb, ts) > _VMEM_BUDGET:
         if tb > 8:
-            tb //= 2
+            tb = max(8, tb // 2)  # floor at the 8-sublane minimum
         elif ts > 128:
-            ts //= 2
+            ts = max(128, ts // 2)  # floor at the 128-lane minimum
         else:
             break
     return tb, ts

@@ -5,32 +5,83 @@ The reference fans out one HTTP request per instance as Ray remote tasks
 doing ``requests.get(url, json={'array': ...})``).  Here the fan-out is a
 thread pool — requests are IO-bound HTTP calls, the server coalesces them
 into device batches.
+
+Each worker thread keeps one persistent HTTP/1.1 connection (the server
+speaks keep-alive): without reuse, every request costs a TCP handshake and
+spawns a fresh handler thread server-side, and on a single-core host that
+thread churn starves the GIL the explain pipeline needs.
 """
 
+import http.client
 import json
-import urllib.request
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence
+from urllib.parse import urlparse
 
 import numpy as np
+
+_tls = threading.local()
+
+
+def _get_connection(scheme: str, netloc: str,
+                    timeout: float) -> http.client.HTTPConnection:
+    conns = getattr(_tls, "conns", None)
+    if conns is None:
+        conns = _tls.conns = {}
+    key = (scheme, netloc)
+    conn = conns.get(key)
+    if conn is None:
+        cls = (http.client.HTTPSConnection if scheme == "https"
+               else http.client.HTTPConnection)
+        conn = conns[key] = cls(netloc, timeout=timeout)
+    elif conn.timeout != timeout:
+        conn.timeout = timeout
+        if conn.sock is not None:
+            conn.sock.settimeout(timeout)
+    return conn
+
+
+def _drop_connection(scheme: str, netloc: str) -> None:
+    conn = getattr(_tls, "conns", {}).pop((scheme, netloc), None)
+    if conn is not None:
+        conn.close()
 
 
 def explain_request(url: str, instance: np.ndarray, timeout: float = 300.0) -> str:
     """POST one instance (or minibatch) to the explanation endpoint and
-    return the JSON payload."""
+    return the JSON payload, reusing this thread's connection."""
 
+    parsed = urlparse(url)
+    path = parsed.path or "/"
     body = json.dumps({"array": np.asarray(instance).tolist()}).encode()
-    req = urllib.request.Request(url, data=body,
-                                 headers={"Content-Type": "application/json"})
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        return resp.read().decode()
+    headers = {"Content-Type": "application/json"}
+    for attempt in (0, 1):  # one retry through a fresh connection
+        conn = _get_connection(parsed.scheme or "http", parsed.netloc, timeout)
+        try:
+            conn.request("POST", path, body=body, headers=headers)
+            resp = conn.getresponse()
+            payload = resp.read().decode()
+            if resp.status != 200:
+                raise RuntimeError(f"HTTP {resp.status}: {payload}")
+            return payload
+        except TimeoutError:
+            # a timed-out request may still be queued server-side; re-sending
+            # it would duplicate work on an already-overloaded server
+            _drop_connection(parsed.scheme or "http", parsed.netloc)
+            raise
+        except (http.client.HTTPException, ConnectionError, OSError):
+            _drop_connection(parsed.scheme or "http", parsed.netloc)
+            if attempt:
+                raise
+    raise AssertionError("unreachable")
 
 
 def distribute_requests(url: str,
                         data: np.ndarray,
                         batch_mode: str = "ray",
                         minibatches: Optional[Sequence[np.ndarray]] = None,
-                        max_workers: int = 64,
+                        max_workers: int = 16,
                         timeout: float = 300.0) -> List[str]:
     """Fan requests out to the endpoint.
 
@@ -38,6 +89,10 @@ def distribute_requests(url: str,
     (one single-row request per instance, ``k8s_serve_explanations.py:181``);
     ``'default'`` sends client-side minibatches (``:184``), either supplied
     via ``minibatches`` or one row each.
+
+    ``max_workers`` bounds the in-flight requests; the default is sized for a
+    colocated single-core client, where more threads only fight the serving
+    pipeline for the GIL.
     """
 
     if batch_mode == "ray" or minibatches is None:

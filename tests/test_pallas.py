@@ -93,6 +93,18 @@ def test_fused_linear_ey_many_classes_covertype_shape():
     np.testing.assert_allclose(got, ref, atol=1e-5)
 
 
+def test_tile_sizes_respect_hardware_floors_under_pressure():
+    """Even when no tile size fits the budget (huge N·K scratch), the
+    halving must stop at the 8-sublane / 128-lane floors rather than
+    emitting shapes Mosaic rejects."""
+
+    from distributedkernelshap_tpu.ops.pallas_kernels import _TB, _TS, _tile_sizes
+
+    # B=40 starts tb at 40 (not a power of two): 40 -> 20 -> 10 -> floor 8
+    tb, ts = _tile_sizes(B=40, S=4096, N=1000, M=54, K=7, tb=_TB, ts=_TS)
+    assert tb >= 8 and ts >= 128
+
+
 def test_tile_sizes_defaults_unchanged_for_small_k():
     """The headline Adult config (K=2) must keep the full-size tiles —
     shrinking them there would regress the benchmark for no reason."""

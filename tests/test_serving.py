@@ -119,6 +119,82 @@ def test_http_minibatch_mode(server, model_setup):
     assert shapes == [(2, 4, 8), (2, 2, 8)]
 
 
+def test_explain_batch_async_matches_sync(model_setup):
+    s = model_setup
+    model = BatchKernelShapModel(s["pred"], s["bg"], s["constructor_kwargs"], s["fit_kwargs"])
+    stacked = s["X"]
+    sizes = [1, 2, 3]
+    want = model.explain_batch(stacked, split_sizes=sizes)
+    finalize = model.explain_batch_async(stacked, split_sizes=sizes)
+    got = finalize()
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(
+            np.asarray(json.loads(g)["data"]["shap_values"]),
+            np.asarray(json.loads(w)["data"]["shap_values"]), atol=1e-6)
+
+
+def test_get_explanation_async_matches_sync(model_setup):
+    from distributedkernelshap_tpu.kernel_shap import KernelShap
+
+    s = model_setup
+    ex = KernelShap(s["pred"], link="logit", seed=0)
+    ex.fit(s["bg"])
+    engine = ex._explainer
+    want = engine.get_explanation(s["X"], silent=True)
+    values, info = engine.get_explanation_async(s["X"])()
+    for g, w in zip(values, want):
+        np.testing.assert_allclose(g, w, atol=1e-6)
+    assert info["raw_prediction"].shape == (s["X"].shape[0], 2)
+    assert info["expected_value"].shape == (2,)
+
+
+def test_pipelined_singles_order_and_depth(model_setup):
+    """max_batch_size=1 exercises the dispatch/finalize pipeline: many
+    concurrent single-row requests must come back 200 and aligned with
+    their instances."""
+
+    s = model_setup
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(24, 8)).astype(np.float32)
+    srv = serve_explainer(s["pred"], s["bg"], s["constructor_kwargs"], s["fit_kwargs"],
+                          host="127.0.0.1", port=0, max_batch_size=1,
+                          pipeline_depth=4)
+    try:
+        url = f"http://127.0.0.1:{srv.port}/explain"
+        payloads = distribute_requests(url, X, max_workers=8)
+        assert len(payloads) == 24
+        single = KernelShapModel(s["pred"], s["bg"], s["constructor_kwargs"], s["fit_kwargs"])
+        for i in (0, 7, 23):
+            got = np.asarray(json.loads(payloads[i])["data"]["shap_values"])
+            want = np.asarray(json.loads(single(FakeRequest(X[i])))["data"]["shap_values"])
+            np.testing.assert_allclose(got, want, atol=1e-5)
+    finally:
+        srv.stop()
+
+
+def test_finalize_error_surfaces_500(model_setup):
+    """A failure inside the async finalize must come back as a per-request
+    HTTP 500, not a hung connection."""
+
+    s = model_setup
+
+    class BrokenAsyncModel(KernelShapModel):
+        def explain_batch_async(self, instances, split_sizes=None):
+            def finalize():
+                raise RuntimeError("boom in finalize")
+            return finalize
+
+    model = BrokenAsyncModel(s["pred"], s["bg"], s["constructor_kwargs"], s["fit_kwargs"])
+    srv = ExplainerServer(model, host="127.0.0.1", port=0, max_batch_size=1).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/explain"
+        with pytest.raises(RuntimeError, match="HTTP 500"):
+            explain_request(url, s["X"][0])
+    finally:
+        srv.stop()
+
+
 def test_http_error_paths(server):
     import urllib.error
     import urllib.request
