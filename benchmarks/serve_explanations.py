@@ -42,7 +42,7 @@ def prepare_explainer_args(data: dict):
 
 
 def run_config(predictor, data, X_explain, replicas: int, max_batch_size: int,
-               host: str, port: int, nruns: int):
+               host: str, port: int, nruns: int, batch_mode: str = "ray"):
     background, ctor_kwargs, fit_kwargs = prepare_explainer_args(data)
     # replicas → pipeline depth: the reference's N replica processes become N
     # in-flight device batches whose D2H round trips overlap
@@ -55,19 +55,40 @@ def run_config(predictor, data, X_explain, replicas: int, max_batch_size: int,
     # same queue pressure from a bounded keep-alive pool
     fanout = 32
     try:
-        # warmup: drive the real fan-out shape so the steady-state batch
-        # buckets (1..max_batch_size) are compiled before timing starts
+        # warmup: compile the device buckets the steady state will hit,
+        # deterministically (HTTP warmup alone can't guarantee which sizes
+        # the coalescer forms, and a 15-40s TPU compile inside the timed
+        # region would corrupt run 0).  Full batches dominate under a
+        # saturated queue: 'ray' coalesces up to max_batch_size rows,
+        # 'default' up to max_batch_size requests of max_batch_size rows.
+        full_rows = (max_batch_size if batch_mode == "ray"
+                     else max_batch_size * max_batch_size)
+        for rows in {1, min(full_rows, X_explain.shape[0])}:
+            server.model.explain_batch(X_explain[:rows], split_sizes=[rows])
         distribute_requests(url, X_explain[:4 * max_batch_size],
                             max_workers=fanout)
         if not os.path.exists('./results'):
             os.mkdir('./results')
+        # batch_mode mirrors the reference's k8s driver
+        # (k8s_serve_explanations.py:181-184): 'ray' = one single-row request
+        # per instance with server-side coalescing; 'default' = client-side
+        # minibatches of max_batch_size rows each
+        minibatches = None
+        if batch_mode == "default":
+            from distributedkernelshap_tpu.utils import batch as make_batches
+
+            minibatches = make_batches(X_explain, batch_size=max_batch_size)
         result = {'t_elapsed': []}
         for run in range(nruns):
             logging.info("run: %d", run)
             t_start = timer()
-            responses = distribute_requests(url, X_explain, max_workers=fanout)
+            responses = distribute_requests(url, X_explain, batch_mode=batch_mode,
+                                            minibatches=minibatches,
+                                            max_workers=fanout)
             t_elapsed = timer() - t_start
-            assert len(responses) == X_explain.shape[0]
+            expected = (len(minibatches) if minibatches is not None
+                        else X_explain.shape[0])
+            assert len(responses) == expected
             logging.info("Time elapsed: %s", t_elapsed)
             result['t_elapsed'].append(t_elapsed)
             with open(get_filename(replicas, max_batch_size, serve=True), 'wb') as f:
@@ -90,10 +111,11 @@ def main():
                       else range(args.replicas, args.replicas + 1))
     for replicas in replicas_range:
         for max_batch_size in batch_sizes:
-            logging.info("Experiment: %d client workers, max_batch_size %d",
-                         replicas, max_batch_size)
+            logging.info("Experiment: pipeline depth %d, max_batch_size %d, "
+                         "batch_mode %s", replicas, max_batch_size,
+                         args.batch_mode)
             run_config(predictor, data, X_explain, replicas, max_batch_size,
-                       args.host, args.port, nruns)
+                       args.host, args.port, nruns, batch_mode=args.batch_mode)
 
 
 if __name__ == '__main__':
@@ -109,6 +131,11 @@ if __name__ == '__main__':
     parser.add_argument("-benchmark", default=0, type=int,
                         help="Set to 1 to sweep replicas in range(1, replicas+1).")
     parser.add_argument("-n", "--nruns", default=5, type=int)
+    parser.add_argument(
+        "-batch_mode", default="ray", choices=("ray", "default"),
+        help="'ray': one single-row request per instance, server-side "
+             "coalescing; 'default': client-side minibatches (the reference "
+             "k8s driver's modes, k8s_serve_explanations.py:181-184).")
     parser.add_argument("--host", default="0.0.0.0", type=str)
     parser.add_argument("--port", default=8000, type=int)
     add_platform_flag(parser)

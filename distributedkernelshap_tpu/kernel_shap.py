@@ -534,16 +534,20 @@ class KernelExplainerEngine:
             chunks = [X[i:i + c] for i in range(0, X.shape[0], c)]
 
         if len(chunks) > 1 and not self.config.host_eval:
-            # dispatch every chunk before fetching any: device executions
-            # queue up behind each other, and the per-chunk D2H round trips
-            # (~70ms each through a tunnelled TPU) overlap across threads
+            # dispatch ahead of the fetches so the per-chunk D2H round trips
+            # (~70ms each through a tunnelled TPU) overlap across threads —
+            # but in bounded waves, so a huge X doesn't enqueue thousands of
+            # executions (and their device-resident buffers) at once
+            window = 8
             with profiler().phase('coalition_plan'):
                 plan = self._plan(nsamples)
             with profiler().phase('device_explain'):
-                finalizers = [self._dispatch_array(c, plan) for c in chunks]
-                with ThreadPoolExecutor(
-                        max_workers=min(8, len(finalizers))) as pool:
-                    results = list(pool.map(lambda f: f(), finalizers))
+                results = []
+                with ThreadPoolExecutor(max_workers=window) as pool:
+                    for w0 in range(0, len(chunks), window):
+                        finalizers = [self._dispatch_array(c, plan)
+                                      for c in chunks[w0:w0 + window]]
+                        results.extend(pool.map(lambda f: f(), finalizers))
         else:
             results = [self._explain_array(c, nsamples) for c in chunks]
         phi = np.concatenate([r['shap_values'] for r in results], 0)
