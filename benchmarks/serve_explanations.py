@@ -23,7 +23,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from distributedkernelshap_tpu.serving import distribute_requests, serve_explainer  # noqa: E402
+from distributedkernelshap_tpu.serving import distribute_requests  # noqa: E402
 from benchmarks._common import add_platform_flag, apply_platform  # noqa: E402
 from distributedkernelshap_tpu.utils import get_filename, load_data, load_model  # noqa: E402
 
@@ -41,29 +41,52 @@ def prepare_explainer_args(data: dict):
     return background, constructor_kwargs, fit_kwargs
 
 
-def run_config(predictor, data, X_explain, replicas: int, max_batch_size: int,
-               host: str, port: int, nruns: int, batch_mode: str = "ray"):
+def build_model(predictor, data):
+    """One fitted serving model for the whole sweep: re-fitting per config
+    would recreate the jitted functions and pay the 15-40s TPU bucket
+    compiles for every (replicas, batch) point."""
+
+    from distributedkernelshap_tpu.serving.wrappers import BatchKernelShapModel
+
     background, ctor_kwargs, fit_kwargs = prepare_explainer_args(data)
+    return BatchKernelShapModel(predictor, background, ctor_kwargs, fit_kwargs)
+
+
+def run_config(predictor, data, X_explain, replicas: int, max_batch_size: int,
+               host: str, port: int, nruns: int, batch_mode: str = "ray",
+               model=None):
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    if model is None:
+        model = build_model(predictor, data)
     # replicas → pipeline depth: the reference's N replica processes become N
     # in-flight device batches whose D2H round trips overlap
-    server = serve_explainer(predictor, background, ctor_kwargs, fit_kwargs,
-                             host=host, port=port, max_batch_size=max_batch_size,
-                             pipeline_depth=replicas)
+    server = ExplainerServer(model, host=host, port=port,
+                             max_batch_size=max_batch_size,
+                             pipeline_depth=replicas).start()
     url = f"http://{'127.0.0.1' if host == '0.0.0.0' else host}:{server.port}/explain"
     # the reference client fans out every instance as its own Ray task
     # (serve_explanations.py:131-134); a colocated single-core client gets the
     # same queue pressure from a bounded keep-alive pool
     fanout = 32
     try:
-        # warmup: compile the device buckets the steady state will hit,
+        # warmup: compile every device bucket the coalescer can form,
         # deterministically (HTTP warmup alone can't guarantee which sizes
-        # the coalescer forms, and a 15-40s TPU compile inside the timed
-        # region would corrupt run 0).  Full batches dominate under a
-        # saturated queue: 'ray' coalesces up to max_batch_size rows,
-        # 'default' up to max_batch_size requests of max_batch_size rows.
-        full_rows = (max_batch_size if batch_mode == "ray"
-                     else max_batch_size * max_batch_size)
-        for rows in {1, min(full_rows, X_explain.shape[0])}:
+        # arrive together, and a 15-40s TPU compile inside the timed region
+        # would corrupt run 0).  'ray' coalesces 1..max_batch_size rows,
+        # 'default' up to max_batch_size requests of max_batch_size rows —
+        # every stacked size pads onto the power-of-two bucket ladder, so
+        # warming the ladder covers partial coalesces too.  The jit cache
+        # lives on the shared model, so the sweep pays each bucket once.
+        full_rows = min(X_explain.shape[0],
+                        max_batch_size if batch_mode == "ray"
+                        else max_batch_size * max_batch_size)
+        rows, ladder = 1, []
+        while rows < full_rows:
+            ladder.append(rows)
+            rows *= 2
+        ladder.append(full_rows)
+        for rows in ladder:
             server.model.explain_batch(X_explain[:rows], split_sizes=[rows])
         distribute_requests(url, X_explain[:4 * max_batch_size],
                             max_workers=fanout)
@@ -91,7 +114,10 @@ def run_config(predictor, data, X_explain, replicas: int, max_batch_size: int,
             assert len(responses) == expected
             logging.info("Time elapsed: %s", t_elapsed)
             result['t_elapsed'].append(t_elapsed)
-            with open(get_filename(replicas, max_batch_size, serve=True), 'wb') as f:
+            fname = get_filename(replicas, max_batch_size, serve=True)
+            if batch_mode != "ray":  # keep 'ray' on the reference naming
+                fname = fname.replace(".pkl", f"_mode_{batch_mode}.pkl")
+            with open(fname, 'wb') as f:
                 pickle.dump(result, f)
     finally:
         server.stop()
@@ -107,6 +133,7 @@ def main():
     assert X_explain.shape[0] == 2560
     assert data['background']['X']['preprocessed'].shape[0] == 100
 
+    model = build_model(predictor, data)
     replicas_range = (range(1, args.replicas + 1) if args.benchmark == 1
                       else range(args.replicas, args.replicas + 1))
     for replicas in replicas_range:
@@ -115,7 +142,8 @@ def main():
                          "batch_mode %s", replicas, max_batch_size,
                          args.batch_mode)
             run_config(predictor, data, X_explain, replicas, max_batch_size,
-                       args.host, args.port, nruns, batch_mode=args.batch_mode)
+                       args.host, args.port, nruns, batch_mode=args.batch_mode,
+                       model=model)
 
 
 if __name__ == '__main__':

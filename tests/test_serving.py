@@ -195,6 +195,35 @@ def test_finalize_error_surfaces_500(model_setup):
         srv.stop()
 
 
+def test_serve_checkpointed_explainer(model_setup, tmp_path):
+    """The serving.main --checkpoint path: save a fitted explainer, rebuild
+    a serving model from it without refitting, serve, and get aligned
+    answers (the reference has no explainer checkpointing at all)."""
+
+    from distributedkernelshap_tpu.kernel_shap import KernelShap
+
+    s = model_setup
+    ex = KernelShap(s["pred"], link="logit", seed=0)
+    ex.fit(s["bg"])
+    want = ex.explain(s["X"], silent=True)
+    path = str(tmp_path / "ckpt" / "explainer.pkl")
+    ex.save(path)
+
+    restored = KernelShap.load(path)
+    model = BatchKernelShapModel.from_explainer(restored)
+    srv = ExplainerServer(model, host="127.0.0.1", port=0,
+                          max_batch_size=4, pipeline_depth=4).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/explain"
+        payloads = distribute_requests(url, s["X"])
+        for i in (0, 5):
+            got = np.asarray(json.loads(payloads[i])["data"]["shap_values"])[:, 0, :]
+            np.testing.assert_allclose(
+                got, np.stack([v[i] for v in want.shap_values]), atol=1e-5)
+    finally:
+        srv.stop()
+
+
 def test_http_error_paths(server):
     import urllib.error
     import urllib.request
