@@ -8,6 +8,11 @@ def add_platform_flag(parser) -> None:
              "where jax is pre-imported at interpreter start, the "
              "JAX_PLATFORMS env var is not a reliable override; this flag "
              "uses jax.config.update before any backend is initialised.")
+    parser.add_argument(
+        "--cpu_devices", default=None, type=int,
+        help="With --platform cpu: number of virtual CPU devices (so "
+             "--workers N actually gets an N-device mesh, mirroring the "
+             "XLA_FLAGS=--xla_force_host_platform_device_count recipe).")
 
 
 def apply_platform(args) -> None:
@@ -15,3 +20,10 @@ def apply_platform(args) -> None:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+        if getattr(args, "cpu_devices", None):
+            jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+    elif getattr(args, "cpu_devices", None):
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "--cpu_devices has no effect without --platform cpu; ignoring")

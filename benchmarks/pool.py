@@ -79,9 +79,9 @@ def main():
         logging.info("Running sequential benchmark on a single device ...")
         distributed_opts = {'batch_size': None, 'n_devices': None}
         explainer = fit_kernel_shap_explainer(predictor, data, distributed_opts)
-        # warmup compile, then timed runs (the reference's 1-worker runs pay
-        # no compile cost; keep the timing comparable)
-        explainer.explain(X_explain[:8], silent=True)
+        # warmup compile at the timed shape, then timed runs (the
+        # reference's 1-worker runs pay no compile cost; keep comparable)
+        explainer.explain(X_explain, silent=True)
         run_explainer(explainer, X_explain, distributed_opts, nruns)
         return
 
@@ -93,7 +93,12 @@ def main():
                          workers, batch_size)
             distributed_opts = {'batch_size': int(batch_size), 'n_devices': workers}
             explainer = fit_kernel_shap_explainer(predictor, data, distributed_opts)
-            explainer.explain(X_explain[:8 * workers], silent=True)  # warmup
+            # warmup at the timed shape so no 15-40s TPU compile lands inside
+            # run 0: one slab (batch_size*workers rows) hits the same
+            # compiled bucket every timed slab uses; when the whole dataset
+            # fits one slab that's the full array anyway
+            slab = int(batch_size) * workers
+            explainer.explain(X_explain[:min(len(X_explain), slab)], silent=True)
             run_explainer(explainer, X_explain, distributed_opts, nruns)
 
 

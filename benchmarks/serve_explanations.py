@@ -81,12 +81,10 @@ def run_config(predictor, data, X_explain, replicas: int, max_batch_size: int,
         full_rows = min(X_explain.shape[0],
                         max_batch_size if batch_mode == "ray"
                         else max_batch_size * max_batch_size)
-        rows, ladder = 1, []
-        while rows < full_rows:
-            ladder.append(rows)
-            rows *= 2
-        ladder.append(full_rows)
+        bucket = server.model.explainer._explainer._bucket
+        ladder = sorted({bucket(rows) for rows in range(1, full_rows + 1)})
         for rows in ladder:
+            rows = min(rows, X_explain.shape[0])
             server.model.explain_batch(X_explain[:rows], split_sizes=[rows])
         distribute_requests(url, X_explain[:4 * max_batch_size],
                             max_workers=fanout)

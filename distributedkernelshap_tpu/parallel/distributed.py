@@ -207,15 +207,18 @@ class DistributedExplainer:
 
         X = np.atleast_2d(np.asarray(X, dtype=np.float32))
         B = X.shape[0]
-        if self.batch_size:
+        slab = int(self.batch_size) * self.n_data if self.batch_size else 0
+        if slab and B > slab:
             # pad the global batch to a whole number of equal slabs so every
             # device step reuses one compiled shape
-            slab = int(self.batch_size) * self.n_data
-            padded, pad = pad_to_multiple(max(B, slab), slab)
+            padded, _ = pad_to_multiple(B, slab)
             if padded != B:
                 X = np.concatenate([X, np.tile(X[-1:], (padded - B, 1))], 0)
             slabs = make_batches(X, batch_size=slab)
         else:
+            # batch fits in one slab: a single sharded call (which buckets
+            # and pads itself) — padding B up to slab would multiply the
+            # work by up to n_data for nothing
             slabs = [X]
         results = [self._explain_sharded(s, nsamples) for s in slabs]
         phi = np.concatenate([r[0] for r in results], 0)[:B]

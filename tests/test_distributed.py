@@ -104,6 +104,25 @@ def test_distributed_batch_size_slabs(setup):
     np.testing.assert_allclose(sv[0], sv_seq[0], atol=1e-5)
 
 
+def test_distributed_batch_fits_one_slab(setup):
+    """batch_size >= B must not pad the batch up to batch_size * n_devices
+    (that multiplied the work by up to n_devices): it runs as one sharded
+    call and still matches the sequential result."""
+
+    dist = DistributedExplainer(
+        {"n_devices": 8, "batch_size": 64, "algorithm": "kernel_shap"},
+        KernelExplainerEngine,
+        (setup["pred"], setup["data"]),
+        {"link": "logit", "seed": 0},
+    )
+    # B=24 < slab=64*8: single call, no slab padding
+    sv = dist.get_explanation(setup["X"], nsamples=64)
+    seq = KernelExplainerEngine(setup["pred"], setup["data"], link="logit", seed=0)
+    sv_seq = seq.get_explanation(setup["X"], nsamples=64)
+    np.testing.assert_allclose(sv[0], sv_seq[0], atol=1e-5)
+    assert sv[0].shape == sv_seq[0].shape
+
+
 def test_distributed_ragged_batch(setup):
     dist = DistributedExplainer(
         {"n_devices": 8, "batch_size": None, "algorithm": "kernel_shap"},
