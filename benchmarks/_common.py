@@ -21,7 +21,13 @@ def apply_platform(args) -> None:
 
         jax.config.update("jax_platforms", args.platform)
         if getattr(args, "cpu_devices", None):
-            jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+            if args.platform == "cpu":
+                jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+            else:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "--cpu_devices only applies with --platform cpu; ignoring")
     elif getattr(args, "cpu_devices", None):
         import logging
 

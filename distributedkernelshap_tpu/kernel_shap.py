@@ -903,31 +903,67 @@ class KernelShap(Explainer, FitMixin):
 
     @_get_data.register(pd.DataFrame)  # type: ignore
     def _(self, background_data, *args, **kwargs):
-        _, groups, weights = args
+        group_names, groups, weights = args
         if not self.use_groups:
             return background_data
-        logger.info("Group names are specified by column headers; group_names will be ignored!")
+        if self.transposed:  # features-first frame: samples are the columns
+            values = background_data.values.T
+            headers = list(background_data.index)
+        else:
+            values = background_data.values
+            headers = list(background_data.columns)
+        names = self._frame_group_names(headers, group_names, groups)
         if kwargs.get("keep_index", False):
+            index_values = (background_data.columns.values if self.transposed
+                            else background_data.index.values)
+            index_name = (background_data.columns.name if self.transposed
+                          else background_data.index.name)
             return DenseDataWithIndex(
-                background_data.values,
-                list(background_data.columns),
-                background_data.index.values,
-                background_data.index.name,
+                values,
+                names,
+                index_values,
+                index_name,
                 groups,
                 weights,
             )
-        return DenseData(background_data.values, list(background_data.columns), groups, weights)
+        return DenseData(values, names, groups, weights)
 
     @_get_data.register(pd.Series)  # type: ignore
     def _(self, background_data, *args, **kwargs):
-        _, groups, _ = args
+        group_names, groups, _ = args
         if not self.use_groups:
             return background_data
         return DenseData(
             background_data.values.reshape(1, len(background_data)),
-            list(background_data.index),
+            self._frame_group_names(list(background_data.index), group_names, groups),
             groups,
         )
+
+    @staticmethod
+    def _frame_group_names(headers, group_names, groups):
+        """Group names for a DataFrame/Series background.
+
+        The reference always substitutes the frame's column headers
+        (kernel_shap.py:635 'group_names will be ignored!'), which only
+        makes sense for single-column groups — shap 0.35 stored the
+        mismatched names without validating.  Here headers are used when
+        they line up with the groups; otherwise the caller's group_names
+        are kept (our Data container validates name/group counts)."""
+
+        if groups is None or len(headers) == len(groups):
+            logger.info("Group names are specified by column headers; "
+                        "group_names will be ignored!")
+            return headers
+        if group_names is not None and len(group_names) == len(groups):
+            logger.warning(
+                "DataFrame has %d columns but %d groups; keeping the "
+                "provided group_names instead of the column headers.",
+                len(headers), len(groups))
+            return list(group_names)
+        logger.warning(
+            "DataFrame has %d columns but %d groups and no matching "
+            "group_names; generating names.", len(headers), len(groups))
+        return [f"group_{i}" for i in range(len(groups))]
 
     # ------------------------------------------------------------------ #
 

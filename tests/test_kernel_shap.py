@@ -216,12 +216,91 @@ def test_group_names_only_wrong_count_degrades(fitted_setup):
     assert explainer.use_groups is False
 
 
+def test_transposed_background_detected_and_corrected(fitted_setup, caplog):
+    """A background passed features-first (D, N) with grouping must be
+    detected via the group-size sum (reference transposition check,
+    kernel_shap.py:443-449), warned about, and transposed internally so the
+    results match the correctly-oriented fit."""
+
+    s = fitted_setup
+    ex_t = KernelShap(s["pred"], link="logit", feature_names=s["group_names"], seed=0)
+    with caplog.at_level(logging.WARNING):
+        ex_t.fit(s["bg"].T, group_names=s["group_names"], groups=s["groups"])
+    assert any("transposing" in r.message for r in caplog.records)
+    got = ex_t.explain(s["X"], silent=True)
+
+    ex = KernelShap(s["pred"], link="logit", feature_names=s["group_names"], seed=0)
+    ex.fit(s["bg"], group_names=s["group_names"], groups=s["groups"])
+    want = ex.explain(s["X"], silent=True)
+    for g, w in zip(got.shap_values, want.shap_values):
+        np.testing.assert_allclose(g, w, atol=1e-5)
+
+    # same flip through the DataFrame dispatch path
+    import pandas as pd
+
+    ex_df = KernelShap(s["pred"], link="logit", feature_names=s["group_names"], seed=0)
+    ex_df.fit(pd.DataFrame(s["bg"].T), group_names=s["group_names"], groups=s["groups"])
+    got_df = ex_df.explain(s["X"], silent=True)
+    for g, w in zip(got_df.shap_values, want.shap_values):
+        np.testing.assert_allclose(g, w, atol=1e-5)
+
+
 def test_weights_mismatch_ignored(fitted_setup):
     s = fitted_setup
     explainer = KernelShap(s["pred"], link="logit", seed=0)
     explainer.fit(s["bg"], group_names=s["group_names"], groups=s["groups"],
                   weights=np.ones(7))  # 30 rows, 7 weights
     assert explainer.ignore_weights is True
+
+
+def test_dataframe_and_series_background_dispatch(fitted_setup):
+    """The methdispatch background paths (reference kernel_shap.py:544-671):
+    a DataFrame background must give the same values as the equivalent
+    ndarray fit; a Series (single background row) must fit and explain."""
+
+    import pandas as pd
+
+    s = fitted_setup
+    cols = [f"f{i}" for i in range(s["bg"].shape[1])]
+
+    ex_df = KernelShap(s["pred"], link="logit", feature_names=s["group_names"], seed=0)
+    ex_df.fit(pd.DataFrame(s["bg"], columns=cols),
+              group_names=s["group_names"], groups=s["groups"])
+    got = ex_df.explain(s["X"], silent=True)
+
+    ex = KernelShap(s["pred"], link="logit", feature_names=s["group_names"], seed=0)
+    ex.fit(s["bg"], group_names=s["group_names"], groups=s["groups"])
+    want = ex.explain(s["X"], silent=True)
+    for g, w in zip(got.shap_values, want.shap_values):
+        np.testing.assert_allclose(g, w, atol=1e-5)
+
+    ex_series = KernelShap(s["pred"], link="logit", seed=0)
+    ex_series.fit(pd.Series(s["bg"][0], index=cols))
+    exp = ex_series.explain(s["X"][:4], silent=True)
+    total = (np.stack(exp.shap_values, 1).sum(-1)
+             + np.asarray(exp.expected_value)[None, :])
+    np.testing.assert_allclose(total, exp.data["raw"]["raw_prediction"], atol=1e-4)
+
+
+def test_dataframe_keep_index_background(fitted_setup):
+    """fit(..., keep_index=True) with a DataFrame background must route
+    through DenseDataWithIndex (reference kernel_shap.py:637-645) and still
+    explain correctly."""
+
+    import pandas as pd
+
+    from distributedkernelshap_tpu.data import DenseDataWithIndex
+
+    s = fitted_setup
+    df = pd.DataFrame(s["bg"], columns=[f"f{i}" for i in range(s["bg"].shape[1])],
+                      index=[f"row{i}" for i in range(s["bg"].shape[0])])
+    ex = KernelShap(s["pred"], link="logit", feature_names=s["group_names"], seed=0)
+    ex.fit(df, group_names=s["group_names"], groups=s["groups"], keep_index=True)
+    assert isinstance(ex.background_data, DenseDataWithIndex)
+    exp = ex.explain(s["X"][:4], silent=True)
+    total = (np.stack(exp.shap_values, 1).sum(-1)
+             + np.asarray(exp.expected_value)[None, :])
+    np.testing.assert_allclose(total, exp.data["raw"]["raw_prediction"], atol=1e-4)
 
 
 def test_summarise_background_kmeans(fitted_setup):
