@@ -16,6 +16,8 @@ Explanation payload.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -24,7 +26,46 @@ import numpy as np
 RAY_POOL_32VCPU_BASELINE_S = 125.05  # BASELINE.md: best single-node reference
 
 
+def _device_reachable(timeout_s: float = 120.0):
+    """Probe backend init in a subprocess; returns ``(ok, detail)``.
+
+    A killed TPU client can wedge the tunnel relay so that backend init
+    blocks forever (uninterruptibly, in C) for every later process. Probing
+    in a throwaway subprocess lets this benchmark fail fast with a
+    parseable error line instead of hanging the driver. The probe child is
+    abandoned (not waited on indefinitely) if it survives SIGKILL — a child
+    stuck in an uninterruptible syscall would otherwise re-hang us here.
+    """
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+    try:
+        _, err = proc.communicate(timeout=timeout_s)
+        if proc.returncode == 0:
+            return True, ""
+        return False, err.decode(errors="replace").strip()[-400:]
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.communicate(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass  # unkillable child: leave it behind rather than hang
+        return False, f"backend init did not complete within {timeout_s:.0f}s"
+
+
 def main() -> int:
+    if os.environ.get("DKS_BENCH_SKIP_PROBE") != "1":
+        ok, detail = _device_reachable()
+        if not ok:
+            print(json.dumps({
+                "metric": "adult_2560_bg100_wall_s",
+                "error": "device backend unreachable (tunnel relay wedged?); "
+                         "see .claude/skills/verify/SKILL.md for recovery notes",
+                "detail": detail,
+            }))
+            return 1
+
     import jax
 
     from distributedkernelshap_tpu import KernelShap

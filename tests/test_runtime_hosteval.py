@@ -116,3 +116,46 @@ def test_hosteval_l1_reg():
     sv = engine.get_explanation(X, nsamples=64, l1_reg="num_features(5)")
     nz = (np.abs(sv[0]) > 1e-9).sum(1)
     assert (nz <= 6).all()
+
+
+def test_get_explanation_async_fallback_paths():
+    """The async API's synchronous fallbacks (host_eval engines, batches
+    over instance_chunk, active l1) must return exactly what the sync call
+    returns — they run on the dispatcher thread and close over the result."""
+
+    rng = np.random.default_rng(5)
+    D, K, N, B = 6, 2, 10, 12
+    W = rng.normal(size=(D, K)).astype(np.float32)
+    b = np.zeros(K, np.float32)
+    bg = rng.normal(size=(N, D)).astype(np.float32)
+    X = rng.normal(size=(B, D)).astype(np.float32)
+
+    def host_model(x):
+        z = x @ W + b
+        e = np.exp(z - z.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    # host_eval fallback
+    eng_host = KernelExplainerEngine(
+        CallbackPredictor(host_model, example_dim=D), bg, link="logit",
+        seed=0, config=EngineConfig(host_eval=True))
+    want = eng_host.get_explanation(X, nsamples=40)
+    got, info = eng_host.get_explanation_async(X, nsamples=40)()
+    np.testing.assert_allclose(got[0], want[0], atol=1e-6)
+    assert info["raw_prediction"].shape == (B, K)
+
+    # instance_chunk fallback
+    eng_chunk = KernelExplainerEngine(
+        LinearPredictor(W, b, activation="softmax"), bg, link="logit",
+        seed=0, config=EngineConfig(instance_chunk=4))
+    want = eng_chunk.get_explanation(X, nsamples=40)
+    got, _ = eng_chunk.get_explanation_async(X, nsamples=40)()
+    np.testing.assert_allclose(got[0], want[0], atol=1e-6)
+
+    # active-l1 fallback (explicit num_features selection)
+    eng_l1 = KernelExplainerEngine(
+        LinearPredictor(W, b, activation="softmax"), bg, link="logit", seed=0)
+    want = eng_l1.get_explanation(X, nsamples=40, l1_reg="num_features(4)")
+    got, _ = eng_l1.get_explanation_async(X, nsamples=40,
+                                          l1_reg="num_features(4)")()
+    np.testing.assert_allclose(got[0], want[0], atol=1e-6)
