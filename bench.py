@@ -26,7 +26,7 @@ import numpy as np
 RAY_POOL_32VCPU_BASELINE_S = 125.05  # BASELINE.md: best single-node reference
 
 
-def _device_reachable(timeout_s: float = 120.0):
+def _device_reachable(timeout_s: float = 590.0):
     """Probe backend init in a subprocess; returns ``(ok, detail)``.
 
     A killed TPU client can wedge the tunnel relay so that backend init
@@ -35,6 +35,13 @@ def _device_reachable(timeout_s: float = 120.0):
     parseable error line instead of hanging the driver. The probe child is
     abandoned (not waited on indefinitely) if it survives SIGKILL — a child
     stuck in an uninterruptible syscall would otherwise re-hang us here.
+
+    The timeout matches SKILL.md's full-patience rule (590s): right after a
+    wedge clears, the first backend init can take minutes, and killing a
+    client mid-grant re-wedges the relay — only a full-patience hang may be
+    treated as "wedged" (at which point the child holds no grant and
+    terminating it is safe). The healthy path pays backend init twice
+    (probe + run); that cost is accepted to keep the driver hang-proof.
     """
 
     proc = subprocess.Popen(
@@ -46,11 +53,15 @@ def _device_reachable(timeout_s: float = 120.0):
             return True, ""
         return False, err.decode(errors="replace").strip()[-400:]
     except subprocess.TimeoutExpired:
-        proc.kill()
+        proc.terminate()  # SIGTERM first: mirrors how a shell timeout ends it
         try:
-            proc.communicate(timeout=5)
+            proc.communicate(timeout=10)
         except subprocess.TimeoutExpired:
-            pass  # unkillable child: leave it behind rather than hang
+            proc.kill()
+            try:
+                proc.communicate(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass  # unkillable child: leave it behind rather than hang
         return False, f"backend init did not complete within {timeout_s:.0f}s"
 
 
