@@ -1,12 +1,29 @@
 #!/usr/bin/env bash
-# Sweep the pool benchmark across device counts (reference
-# benchmarks/k8s_benchmark_pool.sh swept Ray worker counts with a full
-# cluster redeploy per configuration; a mesh needs no redeploy).
+# Sweep the pool benchmark across device counts.
+#
+# Local mode (default) runs benchmarks/pool.py on this host's devices.
+# Cluster mode (MODE=cluster) mirrors the reference's
+# benchmarks/k8s_benchmark_pool.sh: loop worker counts, driving the
+# cluster/Makefile.pool deploy / upload-script / run-experiment /
+# pull-results / destroy cycle per configuration.
+#
 # Usage: bash tpu_benchmark_pool.sh START END
+#        MODE=cluster bash tpu_benchmark_pool.sh START END
 set -euo pipefail
-START=${1:?usage: tpu_benchmark_pool.sh START END}
-END=${2:?usage: tpu_benchmark_pool.sh START END}
+START=${1:?usage: [MODE=cluster] tpu_benchmark_pool.sh START END}
+END=${2:?usage: [MODE=cluster] tpu_benchmark_pool.sh START END}
+MODE=${MODE:-local}
+MAKEFILE_DIR=$(dirname "$0")/../cluster
+
 for workers in $(seq "$START" "$END"); do
     echo "=== workers=$workers ==="
-    python benchmarks/pool.py -b 1 5 10 -w "$workers" -n 5
+    if [ "$MODE" = cluster ]; then
+        make -C "$MAKEFILE_DIR" -f Makefile.pool deploy
+        make -C "$MAKEFILE_DIR" -f Makefile.pool upload-script
+        make -C "$MAKEFILE_DIR" -f Makefile.pool run-experiment WORKERS="$workers"
+        make -C "$MAKEFILE_DIR" -f Makefile.pool pull-results
+        make -C "$MAKEFILE_DIR" -f Makefile.pool destroy
+    else
+        python benchmarks/pool.py -b 1 5 10 -w "$workers" -n 5
+    fi
 done

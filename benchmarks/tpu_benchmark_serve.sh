@@ -1,13 +1,32 @@
 #!/usr/bin/env bash
-# Sweep the serving benchmark across client widths x batch sizes (reference
-# benchmarks/k8s_benchmark_serve.sh swept replicas x {1,5,10}).
+# Sweep the serving benchmark across pipeline depths x batch sizes
+# (reference benchmarks/k8s_benchmark_serve.sh swept replicas x {1,5,10}).
+#
+# Local mode (default) serves from this host's devices. Cluster mode
+# (MODE=cluster) mirrors the reference loop against cluster/Makefile.serve:
+# deploy / upload-script / run-experiment / pull-results / destroy per
+# configuration.
+#
 # Usage: bash tpu_benchmark_serve.sh START END
+#        MODE=cluster bash tpu_benchmark_serve.sh START END
 set -euo pipefail
-START=${1:?usage: tpu_benchmark_serve.sh START END}
-END=${2:?usage: tpu_benchmark_serve.sh START END}
+START=${1:?usage: [MODE=cluster] tpu_benchmark_serve.sh START END}
+END=${2:?usage: [MODE=cluster] tpu_benchmark_serve.sh START END}
+MODE=${MODE:-local}
+MAKEFILE_DIR=$(dirname "$0")/../cluster
+
 for replicas in $(seq "$START" "$END"); do
     for batch in 1 5 10; do
         echo "=== replicas=$replicas max_batch_size=$batch ==="
-        python benchmarks/serve_explanations.py -r "$replicas" -b "$batch" -n 5
+        if [ "$MODE" = cluster ]; then
+            make -C "$MAKEFILE_DIR" -f Makefile.serve deploy
+            make -C "$MAKEFILE_DIR" -f Makefile.serve upload-script
+            make -C "$MAKEFILE_DIR" -f Makefile.serve run-experiment \
+                REPLICAS="$replicas" BATCH="$batch"
+            make -C "$MAKEFILE_DIR" -f Makefile.serve pull-results
+            make -C "$MAKEFILE_DIR" -f Makefile.serve destroy
+        else
+            python benchmarks/serve_explanations.py -r "$replicas" -b "$batch" -n 5
+        fi
     done
 done

@@ -189,3 +189,47 @@ def test_as_predictor_sklearn_lift():
     probe = np.asarray(Xtr[:10], dtype=np.float32)
     np.testing.assert_allclose(np.asarray(pred(jnp.asarray(probe))),
                                clf.predict_proba(probe), atol=1e-5)
+
+
+def test_exact_shapley_nonlinear_brute_force():
+    """Independent oracle for a NONLINEAR model: with full enumeration the
+    WLS solve must reproduce the classic Shapley formula
+    phi_i = sum_S |S|!(M-|S|-1)!/M! (v(S+i) - v(S)) with the interventional
+    value function v(S) = E_bg[f(x_S, bg_notS)] — computed here by brute
+    force over all subsets, no regression involved."""
+
+    import math as pymath
+    from itertools import combinations as combos
+
+    rng = np.random.default_rng(7)
+    D, K, N, B = 6, 2, 8, 3
+    W1 = rng.normal(size=(D, 5)).astype(np.float32)
+    W2 = rng.normal(size=(5, K)).astype(np.float32)
+
+    def f_np(x):  # tiny MLP: genuinely nonlinear
+        return np.tanh(x @ W1) @ W2
+
+    predictor = JaxPredictor(
+        lambda x: jnp.tanh(x @ jnp.asarray(W1)) @ jnp.asarray(W2), n_outputs=K)
+
+    X = rng.normal(size=(B, D)).astype(np.float32)
+    bg = rng.normal(size=(N, D)).astype(np.float32)
+
+    out = run_explain(predictor, X, bg, nsamples=2 ** D)  # exact plan
+    phi = np.asarray(out["shap_values"])  # (B, K, D)
+
+    def v(b_idx, subset):
+        rows = bg.copy()
+        rows[:, list(subset)] = X[b_idx, list(subset)]
+        return f_np(rows).mean(0)  # (K,)
+
+    M = D
+    for b_idx in range(B):
+        phi_bf = np.zeros((K, M))
+        for i in range(M):
+            others = [j for j in range(M) if j != i]
+            for r in range(M):
+                coef = pymath.factorial(r) * pymath.factorial(M - r - 1) / pymath.factorial(M)
+                for S in combos(others, r):
+                    phi_bf[:, i] += coef * (v(b_idx, S + (i,)) - v(b_idx, S))
+        np.testing.assert_allclose(phi[b_idx], phi_bf, atol=5e-4)
