@@ -29,8 +29,10 @@ REFERENCE_BASELINES = {
     "ray_serve_k8s_56cpu_best": 60.5,
 }
 
-_POOL_RE = re.compile(r"ray_workers_(-?\d+)_bsize_(\w+)_actorfr_([\d.]+)\.pkl")
-_SERVE_RE = re.compile(r"ray_replicas_(-?\d+)_maxbatch_(\w+)_actorfr_([\d.]+)\.pkl")
+_POOL_RE = re.compile(
+    r"ray_workers_(-?\d+)_bsize_(\w+?)_actorfr_([\d.]+?)(_mode_(\w+))?\.pkl")
+_SERVE_RE = re.compile(
+    r"ray_replicas_(-?\d+)_maxbatch_(\w+?)_actorfr_([\d.]+?)(_mode_(\w+))?\.pkl")
 
 
 def read_runtimes(results_dir: str, serve: bool = False) -> Dict[Tuple[int, str], List[float]]:
@@ -46,6 +48,8 @@ def read_runtimes(results_dir: str, serve: bool = False) -> Dict[Tuple[int, str]
         if not m:
             continue
         workers, batch = int(m.group(1)), m.group(2)
+        if m.group(5):  # non-default batch_mode suffix, e.g. 'default'
+            batch = f"{batch}/{m.group(5)}"
         with open(path, "rb") as f:
             out[(workers, batch)] = pickle.load(f)["t_elapsed"]
     return out
@@ -73,11 +77,11 @@ def compare_timing(runtimes: Dict[Tuple[int, str], List[float]]):
 
 
 def print_table(rows) -> None:
-    hdr = f"{'workers':>8}{'batch':>8}{'mean_s':>10}{'std_s':>9}{'runs':>6}{'vs ref best':>13}"
+    hdr = f"{'workers':>8}{'batch':>12}{'mean_s':>10}{'std_s':>9}{'runs':>6}{'vs ref best':>13}"
     print(hdr)
     print("-" * len(hdr))
     for r in rows:
-        print(f"{r['workers']:>8}{r['batch']:>8}{r['mean_s']:>10.3f}{r['std_s']:>9.3f}"
+        print(f"{r['workers']:>8}{r['batch']:>12}{r['mean_s']:>10.3f}{r['std_s']:>9.3f}"
               f"{r['n_runs']:>6}{r['vs_ray_pool_best']:>12.1f}x")
 
 

@@ -1,0 +1,107 @@
+"""One-process TPU measurement session.
+
+Relay operations rule (verify SKILL.md): once a process gets a device
+grant, do ALL pending TPU work in that process instead of reconnecting per
+task — reconnect churn after a wedge risks re-wedging the relay. This
+driver runs the headline bench, the serve sweep and the extra configs in
+one session and prints one JSON line per measurement (never killed from
+outside: budget its own time instead).
+
+Usage: python benchmarks/tpu_session.py [--serve-batches 1 5 10]
+       [--nruns 3] [--skip-configs]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def emit(obj) -> None:
+    print(json.dumps(obj), flush=True)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--serve-batches", nargs="*", type=int, default=[1, 5, 10])
+    parser.add_argument("--nruns", type=int, default=3)
+    parser.add_argument("--replicas", type=int, default=8,
+                        help="serve pipeline depth")
+    parser.add_argument("--skip-configs", action="store_true")
+    args = parser.parse_args()
+
+    t_session = time.perf_counter()
+    import jax
+
+    emit({"event": "session_start", "devices": len(jax.devices()),
+          "backend": jax.default_backend()})
+
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.utils import load_data, load_model
+
+    data = load_data()
+    clf = load_model()
+    gn, g = data["all"]["group_names"], data["all"]["groups"]
+    X = np.ascontiguousarray(
+        data["all"]["X"]["processed"]["test"].toarray(), dtype=np.float32)
+    bg = data["background"]["X"]["preprocessed"]
+
+    # ---- headline pool task ------------------------------------------- #
+    ex = KernelShap(clf.predict_proba, link="logit", feature_names=gn, seed=0)
+    ex.fit(bg, group_names=gn, groups=g)
+    ex.explain(X, silent=True)  # compile
+    times = []
+    for _ in range(args.nruns):
+        t0 = time.perf_counter()
+        explanation = ex.explain(X, silent=True)
+        times.append(time.perf_counter() - t0)
+    sv = explanation.shap_values
+    total = np.stack(sv, 1).sum(-1) + np.asarray(explanation.expected_value)[None, :]
+    err = float(np.abs(total - explanation.data["raw"]["raw_prediction"]).max())
+    emit({"metric": "adult_2560_bg100_wall_s", "value": round(float(np.median(times)), 4),
+          "unit": "s", "vs_baseline": round(125.05 / float(np.median(times)), 1),
+          "additivity_err": err})
+
+    # ---- serve sweep (shares the fitted model) ------------------------ #
+    import benchmarks.serve_explanations as se
+
+    model = se.build_model(clf, data)
+    for batch in args.serve_batches:
+        try:
+            se.run_config(clf, data, X, replicas=args.replicas,
+                          max_batch_size=batch, host="127.0.0.1", port=0,
+                          nruns=args.nruns, model=model)
+            import pickle
+
+            from distributedkernelshap_tpu.utils import get_filename
+
+            with open(get_filename(args.replicas, batch, serve=True), "rb") as f:
+                t = f and pickle.load(f)["t_elapsed"]
+            emit({"metric": f"serve_2560_batch{batch}_wall_s",
+                  "value": round(float(np.median(t)), 4), "unit": "s",
+                  "vs_serve_best": round(115.13 / float(np.median(t)), 1)})
+        except Exception as e:  # keep the session going for later configs
+            emit({"metric": f"serve_2560_batch{batch}_wall_s", "error": str(e)})
+
+    # ---- extra configs ------------------------------------------------ #
+    if not args.skip_configs:
+        import benchmarks.configs as cfgs
+
+        for name in ("adult_stress", "mnist", "covertype"):
+            try:
+                emit(cfgs.CONFIGS[name](smoke=False))
+            except Exception as e:
+                emit({"metric": name, "error": str(e)})
+
+    emit({"event": "session_done",
+          "total_s": round(time.perf_counter() - t_session, 1)})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
