@@ -191,6 +191,17 @@ class EngineConfig:
     # without host-callback support, e.g. the axon TPU tunnel); the WLS solve
     # stays on device either way
     host_eval: Optional[bool] = None
+    # host-eval chunk fan-out across host cores (None = sequential): the
+    # reference's worker-pool parallelism applied to the only part of the
+    # pipeline that still runs on the host — black-box predictor calls.
+    # Opt-in (e.g. ``os.cpu_count()``) because the user's callable is invoked
+    # from this many threads at once and arbitrary callables are not
+    # guaranteed reentrant; sklearn/XGBoost release the GIL inside their
+    # numeric cores, so threads scale for them.  Each chunk writes a disjoint
+    # slice of the output buffer.  NB: an explicit ``shap.coalition_chunk``
+    # bypasses the auto memory budget, so peak host memory is then
+    # ``workers × chunk × B × N × D`` floats.
+    host_eval_workers: Optional[int] = None
 
 
 class KernelExplainerEngine:
@@ -351,15 +362,33 @@ class KernelExplainerEngine:
         # policy as the device pipeline, ops/explain._auto_chunk)
         from distributedkernelshap_tpu.ops.explain import _auto_chunk
 
+        # parallel in-flight chunks share the memory budget: give each worker
+        # at least one coalition row's worth (B*N*D elems), dropping workers
+        # rather than degenerating to 1-row chunks when the budget is tight
+        n_workers = self.config.host_eval_workers or 1
+        per_row = B * N * D
+        n_workers = max(1, min(n_workers,
+                               self.config.shap.target_chunk_elems // max(per_row, 1)))
         chunk = (self.config.shap.coalition_chunk
-                 or _auto_chunk(S, B * N * D, self.config.shap.target_chunk_elems))
+                 or _auto_chunk(S, per_row,
+                                self.config.shap.target_chunk_elems // n_workers))
         ey = np.empty((B, S, K), dtype=np.float32)
-        for s0 in range(0, S, chunk):
+        starts = range(0, S, chunk)
+        n_workers = min(n_workers, len(starts))
+
+        def eval_chunk(s0: int) -> None:
             zc_c = zc[s0:s0 + chunk]
             rows = native.masked_fill(X, self.background, zc_c)
             pred = self.predictor.host_fn(rows)
             ey[:, s0:s0 + chunk] = native.weighted_mean(
                 pred, bgw, B * zc_c.shape[0]).reshape(B, zc_c.shape[0], K)
+
+        if n_workers > 1:
+            with ThreadPoolExecutor(max_workers=n_workers) as pool:
+                list(pool.map(eval_chunk, starts))
+        else:
+            for s0 in starts:
+                eval_chunk(s0)
 
         e_val = np.atleast_1d(np.asarray(self.expected_value, dtype=np.float32))
         fx = link_np(self.predictor.host_fn(X)).astype(np.float32)
@@ -687,8 +716,14 @@ class KernelShap(Explainer, FitMixin):
                  categorical_names: Optional[Dict[int, List[str]]] = None,
                  task: str = 'classification',
                  seed: Optional[int] = None,
-                 distributed_opts: Optional[Dict] = None):
+                 distributed_opts: Optional[Dict] = None,
+                 engine_config: Optional[EngineConfig] = None):
         super().__init__(meta=copy.deepcopy(DEFAULT_META_KERNEL_SHAP))
+
+        # extension over the reference ctor: advanced engine knobs
+        # (host_eval, host_eval_workers, chunking, bucketing) without
+        # constructing KernelExplainerEngine directly
+        self.engine_config = engine_config
 
         # guards meta mutation + snapshot in build_explanation, which the
         # serving pipeline calls from concurrent finalizer threads
@@ -1020,11 +1055,13 @@ class KernelShap(Explainer, FitMixin):
                 self.distributed_opts,
                 KernelExplainerEngine,
                 (self.predictor, self.background_data),
-                {'link': self.link, 'seed': self.seed},
+                {'link': self.link, 'seed': self.seed,
+                 'config': self.engine_config},
             )
         else:
             self._explainer = KernelExplainerEngine(
-                self.predictor, self.background_data, link=self.link, seed=self.seed)
+                self.predictor, self.background_data, link=self.link,
+                seed=self.seed, config=self.engine_config)
         self.expected_value = self._explainer.expected_value
         if not self._explainer.vector_out:
             logger.warning(
@@ -1184,6 +1221,7 @@ class KernelShap(Explainer, FitMixin):
             'task': self.task,
             'seed': self.seed,
             'distributed_opts': {k: v for k, v in self.distributed_opts.items()},
+            'engine_config': self.engine_config,
             'background_data': self.background_data,
             'meta': self.meta,
             'use_groups': self.use_groups,
@@ -1211,6 +1249,8 @@ class KernelShap(Explainer, FitMixin):
             task=state['task'],
             seed=state['seed'],
             distributed_opts=opts or None,
+            # absent in pre-engine_config checkpoints
+            engine_config=state.get('engine_config'),
         )
         explainer.use_groups = state['use_groups']
         explainer.summarise_background = state['summarise_background']
@@ -1226,10 +1266,12 @@ class KernelShap(Explainer, FitMixin):
                 explainer._explainer = DistributedExplainer(
                     explainer.distributed_opts, KernelExplainerEngine,
                     (explainer.predictor, bg),
-                    {'link': explainer.link, 'seed': explainer.seed})
+                    {'link': explainer.link, 'seed': explainer.seed,
+                     'config': explainer.engine_config})
             else:
                 explainer._explainer = KernelExplainerEngine(
-                    explainer.predictor, bg, link=explainer.link, seed=explainer.seed)
+                    explainer.predictor, bg, link=explainer.link,
+                    seed=explainer.seed, config=explainer.engine_config)
             explainer.expected_value = explainer._explainer.expected_value
             explainer.meta = state['meta']
         else:

@@ -31,9 +31,30 @@ N_CLASSES = 7
 def load_covertype(seed: int = 0, n_rows: int = N_ROWS):
     """Return ``{'X': (n, 54) float32, 'y': (n,) int64, 'feature_names': [...]}``."""
 
+    cache_writable = True
     if os.path.exists(COVERTYPE_LOCAL):
         with open(COVERTYPE_LOCAL, "rb") as f:
-            return pickle.load(f)
+            data = pickle.load(f)
+        n_cached = data["X"].shape[0]
+        if n_cached >= n_rows:
+            if n_cached > n_rows:
+                # copy: a bare view would pin the full cached array in memory
+                data = dict(data, X=data["X"][:n_rows].copy(),
+                            y=data["y"][:n_rows].copy())
+            return data
+        # cached copy is smaller than requested.  Unmarked files may be a
+        # real dataset copy (or a pre-marker synthetic one — indistinguishable):
+        # never overwrite them; generate the requested size in memory only.
+        # Marked synthetic caches (e.g. from an earlier smoke run) are ours
+        # to replace on disk.
+        cache_writable = bool(data.get("synthetic"))
+        if not cache_writable:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "data/covertype.pkl holds an unmarked %d-row copy but "
+                "n_rows=%d was requested: generating synthetic data in "
+                "memory and leaving the cached file untouched", n_cached, n_rows)
 
     rng = np.random.default_rng(seed)
     numeric = rng.normal(size=(n_rows, N_NUMERIC)).astype(np.float32)
@@ -52,10 +73,11 @@ def load_covertype(seed: int = 0, n_rows: int = N_ROWS):
         + [f"wilderness_{i}" for i in range(N_WILDERNESS)]
         + [f"soil_{i}" for i in range(N_SOIL)]
     )
-    data = {"X": X, "y": y, "feature_names": feature_names}
-    ensure_dir(COVERTYPE_LOCAL)
-    with open(COVERTYPE_LOCAL, "wb") as f:
-        pickle.dump(data, f)
+    data = {"X": X, "y": y, "feature_names": feature_names, "synthetic": True}
+    if cache_writable:
+        ensure_dir(COVERTYPE_LOCAL)
+        with open(COVERTYPE_LOCAL, "wb") as f:
+            pickle.dump(data, f)
     return data
 
 

@@ -1,0 +1,81 @@
+"""Tests for the benchmark analysis/reporting layer (``benchmarks/analysis.py``),
+the script equivalent of the reference's ``Analysis.ipynb`` helpers
+(`read_runtimes`/`compare_timing`, cells 2 and 25-54)."""
+
+import os
+import pickle
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.analysis import compare_timing, plot_rows, read_runtimes
+from distributedkernelshap_tpu.utils import get_filename
+
+
+def _write(path, times):
+    with open(path, "wb") as f:
+        pickle.dump({"t_elapsed": times}, f)
+
+
+@pytest.fixture()
+def results_dir(tmp_path):
+    d = tmp_path / "results"
+    d.mkdir()
+    _write(d / "ray_workers_1_bsize_10_actorfr_1.0.pkl", [10.0, 12.0])
+    _write(d / "ray_workers_8_bsize_10_actorfr_1.0.pkl", [2.0, 2.0, 2.0])
+    _write(d / "ray_workers_8_bsize_None_actorfr_1.0.pkl", [1.5])
+    _write(d / "ray_replicas_4_maxbatch_5_actorfr_1.0.pkl", [7.0])
+    _write(d / "ray_replicas_4_maxbatch_5_actorfr_1.0_mode_default.pkl", [8.0])
+    (d / "not_a_result.pkl").write_bytes(b"junk")
+    return str(d)
+
+
+def test_read_runtimes_pool(results_dir):
+    rt = read_runtimes(results_dir)
+    assert rt[(1, "10")] == [10.0, 12.0]
+    assert rt[(8, "10")] == [2.0, 2.0, 2.0]
+    assert rt[(8, "None")] == [1.5]
+    # serve pickles and junk are excluded from the pool view
+    assert all(k[0] in (1, 8) for k in rt)
+
+
+def test_read_runtimes_serve_and_mode_suffix(results_dir):
+    rt = read_runtimes(results_dir, serve=True)
+    assert rt[(4, "5")] == [7.0]
+    assert rt[(4, "5/default")] == [8.0]
+
+
+def test_compare_timing_aggregates_and_sorts(results_dir):
+    rows = compare_timing(read_runtimes(results_dir))
+    assert [r["workers"] for r in rows] == [1, 8, 8]
+    one = rows[0]
+    assert one["mean_s"] == pytest.approx(11.0)
+    assert one["std_s"] == pytest.approx(np.std([10.0, 12.0]))
+    assert one["n_runs"] == 2
+    assert one["vs_ray_pool_best"] == pytest.approx(125.05 / 11.0)
+    # numeric batches sort before non-numeric ('None') at equal workers
+    assert [r["batch"] for r in rows[1:]] == ["10", "None"]
+
+
+def test_filename_convention_roundtrip(tmp_path):
+    """`utils.get_filename` output must parse back through `read_runtimes`
+    for both the pool and serve conventions (reference `utils.py:67-86`)."""
+
+    d = tmp_path / "results"
+    d.mkdir()
+    pool_name = get_filename(workers=3, batch_size=7, serve=False)
+    serve_name = get_filename(workers=2, batch_size=1, serve=True)
+    _write(tmp_path / pool_name, [1.0])
+    _write(tmp_path / serve_name, [2.0])
+    assert read_runtimes(str(d))[(3, "7")] == [1.0]
+    assert read_runtimes(str(d), serve=True)[(2, "1")] == [2.0]
+
+
+def test_plot_rows_writes_png(results_dir, tmp_path):
+    rows = compare_timing(read_runtimes(results_dir))
+    out = str(tmp_path / "plot.png")
+    plot_rows(rows, out, baseline=125.05)
+    assert os.path.getsize(out) > 1000

@@ -194,3 +194,6 @@ def test_graft_entry_single_and_multichip():
     ge.dryrun_multichip(8)
     ge.dryrun_multichip(4)
     ge.dryrun_multichip(1)
+    # awkward counts: data axis 3 (coalition 2) must still divide the batch
+    ge.dryrun_multichip(6)
+    ge.dryrun_multichip(3)

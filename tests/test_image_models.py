@@ -76,3 +76,39 @@ def test_covertype_schema():
     groups, names = covertype_groups()
     assert len(groups) == 12
     assert sorted(c for g in groups for c in g) == list(range(54))
+
+
+def test_covertype_cache_guard(tmp_path, monkeypatch):
+    """Undersized caches: marked-synthetic ones are regenerated in place;
+    unmarked ones (possibly a real dataset copy) are never overwritten —
+    the requested size is generated in memory only."""
+
+    import pickle
+
+    import scripts.process_covertype_data as cov
+
+    cache = tmp_path / "covertype.pkl"
+    monkeypatch.setattr(cov, "COVERTYPE_LOCAL", str(cache))
+
+    # no cache: generates at requested size, writes marked cache
+    d = cov.load_covertype(n_rows=300)
+    assert d["X"].shape == (300, 54) and d["synthetic"]
+    assert pickle.load(open(cache, "rb"))["X"].shape[0] == 300
+
+    # marked cache smaller than requested: regenerated and rewritten
+    d = cov.load_covertype(n_rows=500)
+    assert d["X"].shape[0] == 500
+    assert pickle.load(open(cache, "rb"))["X"].shape[0] == 500
+
+    # larger cache sliced (and copied, not a view of the cached array)
+    d = cov.load_covertype(n_rows=200)
+    assert d["X"].shape[0] == 200 and d["X"].base is None
+
+    # unmarked cache (real copy): file untouched, full size served in memory
+    with open(cache, "wb") as f:
+        unmarked = {"X": d["X"][:100], "y": d["y"][:100],
+                    "feature_names": d["feature_names"]}
+        pickle.dump(unmarked, f)
+    d = cov.load_covertype(n_rows=400)
+    assert d["X"].shape[0] == 400
+    assert pickle.load(open(cache, "rb"))["X"].shape[0] == 100

@@ -74,6 +74,25 @@ def test_save_load_roundtrip(fitted):
     assert loaded.feature_names == ex.feature_names
 
 
+def test_save_load_preserves_engine_config(fitted, tmp_path):
+    """`engine_config` must survive the checkpoint round trip — a serving
+    replica restored from disk has to behave like the writer process."""
+
+    from distributedkernelshap_tpu.kernel_shap import EngineConfig
+
+    ex, X, _ = fitted
+    cfg = EngineConfig(host_eval=True, host_eval_workers=3)
+    ex2 = KernelShap(ex.predictor, link=ex.link, seed=0, engine_config=cfg)
+    ex2.fit(np.asarray(ex.background_data.data))
+    path = str(tmp_path / "cfg" / "explainer.pkl")
+    ex2.save(path)
+
+    loaded = KernelShap.load(path)
+    assert loaded.engine_config == cfg
+    assert loaded._explainer.config.host_eval is True
+    assert loaded._explainer.config.host_eval_workers == 3
+
+
 def test_save_unfitted_raises():
     ex = KernelShap(LinearPredictor(np.zeros((3, 2), np.float32),
                                     np.zeros(2, np.float32)))
