@@ -43,6 +43,8 @@ def main():
     data = load_data()
     predictor = load_model()
     X_explain = data['all']['X']['processed']['test'].toarray()
+    if args.limit:
+        X_explain = X_explain[:args.limit]
 
     from benchmarks.pool import fit_kernel_shap_explainer
 
@@ -81,6 +83,9 @@ if __name__ == '__main__':
                         help="coordinator host:port (omit on TPU pods)")
     parser.add_argument("--num_processes", default=None, type=int)
     parser.add_argument("--process_id", default=None, type=int)
+    parser.add_argument("--limit", default=0, type=int,
+                        help="Explain only the first N instances (0 = all); "
+                             "used by the multi-process smoke test.")
     add_platform_flag(parser)
     args = parser.parse_args()
     apply_platform(args)

@@ -186,8 +186,18 @@ class DistributedExplainer:
         out = self._sharded_fn()(jnp.asarray(X, jnp.float32),
                                  *self._device_args(plan))
         # one packed D2H instead of two (tunnelled transfers are latency-bound)
-        packed = np.asarray(jnp.concatenate(
-            [out['shap_values'].ravel(), out['raw_prediction'].ravel()]))
+        packed_dev = jnp.concatenate(
+            [out['shap_values'].ravel(), out['raw_prediction'].ravel()])
+        if jax.process_count() > 1:
+            # multi-host mesh: the result spans non-addressable devices, so
+            # all-gather it (over ICI/DCN) before fetching — the reference's
+            # analog is results travelling back through the plasma store
+            from jax.experimental import multihost_utils
+
+            packed = np.asarray(
+                multihost_utils.process_allgather(packed_dev, tiled=True))
+        else:
+            packed = np.asarray(packed_dev)
         Bp, K, M = X.shape[0], engine.predictor.n_outputs, engine.M
         phi, fx = np.split(packed, [Bp * K * M])
         return phi.reshape(Bp, K, M)[:B], fx.reshape(Bp, K)[:B]

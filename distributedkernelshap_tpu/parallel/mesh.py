@@ -43,7 +43,16 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     redis, no raylet — just the JAX coordination service over DCN.
     """
 
-    if jax.process_count() > 1:
+    # NB: do not probe jax.process_count() here — it initialises the local
+    # backend, after which jax.distributed.initialize refuses to run.
+    # is_initialized is absent on older jax; fall back to the private state.
+    _is_init = getattr(jax.distributed, "is_initialized", None)
+    if _is_init is None:
+        from jax._src import distributed as _dist
+
+        def _is_init():
+            return _dist.global_state.client is not None
+    if _is_init():
         logger.info("jax.distributed already initialised (%d processes)", jax.process_count())
         return
     kwargs = {}
