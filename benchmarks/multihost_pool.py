@@ -50,7 +50,8 @@ def main():
 
     workers = args.workers if args.workers > 0 else len(jax.devices())
     explainer = fit_kernel_shap_explainer(
-        predictor, data, {'batch_size': None, 'n_devices': workers})
+        predictor, data, {'batch_size': None, 'n_devices': workers,
+                          'coalition_parallel': args.coalition_parallel})
     explainer.explain(X_explain[:8 * workers], silent=True)  # warmup compile
 
     nruns = args.nruns if args.benchmark else 1
@@ -86,6 +87,10 @@ if __name__ == '__main__':
     parser.add_argument("--limit", default=0, type=int,
                         help="Explain only the first N instances (0 = all); "
                              "used by the multi-process smoke test.")
+    parser.add_argument("--coalition_parallel", default=1, type=int,
+                        help="Devices per data-parallel group co-operating "
+                             "on one batch via coalition-axis sharding "
+                             "(psum'd normal equations over ICI/DCN).")
     add_platform_flag(parser)
     args = parser.parse_args()
     apply_platform(args)

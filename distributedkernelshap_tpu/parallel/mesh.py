@@ -63,7 +63,13 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
         jax.distributed.initialize(**kwargs)
         logger.info("jax.distributed initialised: %d processes, %d devices",
                     jax.process_count(), len(jax.devices()))
-    except Exception as e:  # single-host / already-initialised environments
+    except Exception as e:
+        if coordinator_address is not None:
+            # explicit multi-host flags: degrading to N independent
+            # single-process runs would silently corrupt every result
+            # downstream — fail loudly instead
+            raise
+        # auto-discovery on a single host: expected to fail, run locally
         logger.info("multi-host init skipped: %s", e)
 
 

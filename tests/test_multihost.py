@@ -14,6 +14,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -23,7 +25,9 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_pool_benchmark(tmp_path):
+@pytest.mark.parametrize("coalition_parallel", [1, 2],
+                         ids=["data4", "data2xcoalition2"])
+def test_two_process_pool_benchmark(tmp_path, coalition_parallel):
     port = _free_port()
     env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu")
     # log to files, not pipes: the processes are collectively coupled, so one
@@ -37,6 +41,7 @@ def test_two_process_pool_benchmark(tmp_path):
                     [sys.executable,
                      os.path.join(REPO, "benchmarks", "multihost_pool.py"),
                      "-b", "8", "-w", "4", "-n", "1", "--limit", "64",
+                     "--coalition_parallel", str(coalition_parallel),
                      "--platform", "cpu", "--cpu_devices", "2",
                      "--coordinator", f"127.0.0.1:{port}",
                      "--num_processes", "2", "--process_id", str(pid)],
