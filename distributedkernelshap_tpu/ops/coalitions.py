@@ -167,17 +167,27 @@ def coalition_plan(M: int,
         probs = size_mass[np.array(sampled_sizes) - 1]
         probs = probs / probs.sum()
 
-        n_draw = remaining_budget // 2 if pair_sampling else remaining_budget
-        n_draw = max(n_draw, 1)
+        if pair_sampling:
+            # draw budget//2 complement pairs; an odd budget gets one final
+            # unpaired draw so the plan never exceeds `nsamples` rows
+            n_pairs_draw = remaining_budget // 2
+            n_single = remaining_budget % 2
+            n_draw = n_pairs_draw + n_single
+        else:
+            n_pairs_draw, n_single = 0, 0
+            n_draw = remaining_budget
         sizes = rng.choice(np.array(sampled_sizes), size=n_draw, p=probs)
         sampled = np.zeros((n_draw, M), dtype=np.float32)
         for i, s in enumerate(sizes):
             sampled[i, rng.permutation(M)[:s]] = 1.0
         if pair_sampling:
-            # complement of each draw, interleaved
-            rows = np.empty((2 * n_draw, M), dtype=np.float32)
-            rows[0::2] = sampled
-            rows[1::2] = 1.0 - sampled
+            # complement of each paired draw, interleaved; the odd draw
+            # (if any) is appended on its own
+            rows = np.empty((2 * n_pairs_draw + n_single, M), dtype=np.float32)
+            rows[0:2 * n_pairs_draw:2] = sampled[:n_pairs_draw]
+            rows[1:2 * n_pairs_draw:2] = 1.0 - sampled[:n_pairs_draw]
+            if n_single:
+                rows[-1] = sampled[-1]
         else:
             rows = sampled
 

@@ -55,6 +55,13 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     if _is_init():
         logger.info("jax.distributed already initialised (%d processes)", jax.process_count())
         return
+    explicit = (coordinator_address is not None or num_processes is not None
+                or process_id is not None)
+    if explicit and coordinator_address is None:
+        raise ValueError(
+            "num_processes/process_id were given without coordinator_address; "
+            "all three are required for an explicit multi-host launch "
+            "(omit all of them on TPU pods for auto-discovery)")
     kwargs = {}
     if coordinator_address is not None:
         kwargs.update(coordinator_address=coordinator_address,
@@ -64,7 +71,7 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
         logger.info("jax.distributed initialised: %d processes, %d devices",
                     jax.process_count(), len(jax.devices()))
     except Exception as e:
-        if coordinator_address is not None:
+        if explicit:
             # explicit multi-host flags: degrading to N independent
             # single-process runs would silently corrupt every result
             # downstream — fail loudly instead

@@ -1,0 +1,158 @@
+"""Property-based tests (hypothesis) for the pure numerical building blocks.
+
+The reference ships no tests (SURVEY.md §4); the seeded unit suite pins the
+documented cases, and these properties sweep the input space for the
+invariants the pipeline's correctness rests on: coalition-plan structure,
+the summing-matrix reduction vs a direct ``np.add.reduceat``, batching
+round-trips, and permutation inversion.
+"""
+
+import numpy as np
+import pytest
+
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from distributedkernelshap_tpu.kernel_shap import sum_categories
+from distributedkernelshap_tpu.ops.coalitions import coalition_plan
+from distributedkernelshap_tpu.parallel.distributed import invert_permutation
+from distributedkernelshap_tpu.utils import batch
+
+
+@settings(max_examples=40, deadline=None)
+@given(M=st.integers(1, 14), nsamples=st.integers(4, 600),
+       seed=st.integers(0, 2**20))
+def test_coalition_plan_invariants(M, nsamples, seed):
+    plan = coalition_plan(M, nsamples=nsamples, seed=seed)
+    mask, w = np.asarray(plan.mask), np.asarray(plan.weights)
+
+    assert mask.shape == (plan.n_rows, M)
+    assert set(np.unique(mask)) <= {0.0, 1.0}
+    assert np.all(np.isfinite(w)) and np.all(w >= 0)
+    assert w.sum() > 0
+
+    if M > 1:
+        sizes = mask.sum(1)
+        # empty and grand coalitions are excluded (handled analytically by
+        # the additivity constraint, like shap 0.35)
+        live = w > 0
+        assert np.all(sizes[live] >= 1) and np.all(sizes[live] <= M - 1)
+        # no duplicate live coalitions: duplicates must have been merged
+        live_rows = mask[live]
+        assert len({r.tobytes() for r in live_rows}) == live_rows.shape[0]
+
+    # exactness flag matches the enumerable-space condition
+    if M > 1 and 2 ** M - 2 <= nsamples:
+        assert plan.exact
+        assert plan.n_enumerated == 2 ** M - 2
+    elif M > 1:
+        assert not plan.exact
+        assert plan.n_rows <= nsamples
+
+
+@settings(max_examples=40, deadline=None)
+@given(st.data())
+def test_sum_categories_matches_reduceat(data):
+    """The summing-matrix implementation must equal the reference's
+    ``np.add.reduceat`` formulation for arbitrary block layouts."""
+
+    rng_seed = data.draw(st.integers(0, 2**20))
+    rng = np.random.default_rng(rng_seed)
+    n_blocks = data.draw(st.integers(1, 4))
+    widths = [data.draw(st.integers(2, 4)) for _ in range(n_blocks)]
+    gaps = [data.draw(st.integers(0, 2)) for _ in range(n_blocks + 1)]
+
+    start_idx, pos = [], gaps[0]
+    for wd, gap in zip(widths, gaps[1:]):
+        start_idx.append(pos)
+        pos += wd + gap
+    D = pos
+    values = rng.normal(size=(5, D))
+
+    out = sum_categories(values, start_idx, widths)
+
+    # direct reference formulation: walk columns, summing each block
+    expected_cols = []
+    col = 0
+    blocks = dict(zip(start_idx, widths))
+    while col < D:
+        if col in blocks:
+            expected_cols.append(values[:, col:col + blocks[col]].sum(1))
+            col += blocks[col]
+        else:
+            expected_cols.append(values[:, col])
+            col += 1
+    expected = np.stack(expected_cols, 1)
+    np.testing.assert_allclose(out, expected, atol=1e-12)
+    assert out.shape[1] == D - sum(widths) + n_blocks
+
+
+@settings(max_examples=40, deadline=None)
+@given(n=st.integers(1, 64),
+       d=st.integers(1, 5),
+       batch_size=st.one_of(st.none(), st.integers(1, 70)),
+       n_batches=st.integers(1, 8))
+def test_batch_partition_roundtrip(n, d, batch_size, n_batches):
+    """`utils.batch` must partition: concatenation restores the input, and
+    fixed-size mode produces ceil(n/batch_size) chunks of at most that size."""
+
+    X = np.arange(n * d, dtype=np.float32).reshape(n, d)
+    chunks = batch(X, batch_size=batch_size, n_batches=n_batches)
+    np.testing.assert_array_equal(np.concatenate(chunks, 0), X)
+    if batch_size:
+        assert len(chunks) == -(-n // batch_size)
+        assert all(c.shape[0] <= batch_size for c in chunks)
+
+
+@settings(max_examples=8, deadline=None)
+@given(seed=st.integers(0, 2**20),
+       D=st.integers(2, 10),
+       K=st.integers(1, 4),
+       link=st.sampled_from(["identity", "logit"]),
+       grouped=st.booleans())
+def test_pipeline_additivity_random_problems(seed, D, K, link, grouped):
+    """Σφ + E[f] == link(f(x)) must hold for arbitrary problem shapes
+    through the full jitted pipeline — the structural constraint of the
+    WLS solve (SURVEY.md §2.2 oracle 1)."""
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributedkernelshap_tpu.models import LinearPredictor
+    from distributedkernelshap_tpu.ops import (
+        build_explainer_fn, coalition_plan, groups_to_matrix)
+    from distributedkernelshap_tpu.ops.explain import ShapConfig
+
+    rng = np.random.default_rng(seed)
+    B, N = 3, 6
+    W = rng.normal(size=(D, K)).astype(np.float32)
+    b = rng.normal(size=(K,)).astype(np.float32)
+    X = rng.normal(size=(B, D)).astype(np.float32)
+    bg = rng.normal(size=(N, D)).astype(np.float32)
+    groups = None
+    if grouped and D >= 4:
+        half = D // 2
+        groups = [list(range(half)), list(range(half, D))]
+    activation = "softmax" if (link == "logit" and K > 1) else "identity"
+    if link == "logit" and K == 1:
+        activation = "sigmoid"
+    pred = LinearPredictor(W, b, activation=activation)
+
+    G = groups_to_matrix(groups, D)
+    plan = coalition_plan(G.shape[0], nsamples=64, seed=seed)
+    fn = jax.jit(build_explainer_fn(pred, ShapConfig(link=link)))
+    out = fn(jnp.asarray(X), jnp.asarray(bg), jnp.ones(N, jnp.float32),
+             jnp.asarray(plan.mask), jnp.asarray(plan.weights), jnp.asarray(G))
+    phi = np.asarray(out["shap_values"])
+    total = phi.sum(-1) + np.asarray(out["expected_value"])[None, :]
+    np.testing.assert_allclose(total, np.asarray(out["raw_prediction"]),
+                               atol=5e-4)
+
+
+@settings(max_examples=40, deadline=None)
+@given(n=st.integers(1, 100), seed=st.integers(0, 2**20))
+def test_invert_permutation_property(n, seed):
+    p = np.random.default_rng(seed).permutation(n)
+    s = invert_permutation(list(p))
+    np.testing.assert_array_equal(np.asarray(p)[s], np.arange(n))
+    np.testing.assert_array_equal(s[p], np.arange(n))
