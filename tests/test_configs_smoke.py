@@ -1,0 +1,32 @@
+"""CPU smoke tests for the benchmark configuration suite
+(``benchmarks/configs.py`` — the BASELINE.json config matrix).
+
+Each config is run in ``--smoke`` sizes on the virtual CPU mesh and must
+produce a finite wall-clock and a tight additivity error — the same oracle
+``bench.py`` enforces on hardware. The MNIST config is exercised separately
+by ``tests/test_image_models.py`` (CNN training is too slow for CI here).
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.configs import CONFIGS
+
+
+@pytest.mark.parametrize("name", ["adult", "adult_stress", "covertype"])
+def test_config_smoke(name):
+    result = CONFIGS[name](smoke=True)
+    assert result["value"] > 0
+    assert result["additivity_err"] < 1e-3, result
+    assert result["n_instances"] > 0
+
+
+def test_config_blackbox_smoke():
+    result = CONFIGS["adult_blackbox"](smoke=True)
+    assert result["value"] > 0
+    assert result["additivity_err"] < 1e-3, result
+    assert result["predictor"]
