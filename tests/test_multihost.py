@@ -18,6 +18,11 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# explain recipe shared by the worker template and the in-test reference run
+N_INSTANCES = 32
+NSAMPLES = 64
+N_DEVICES = 4
+
 
 def _free_port() -> int:
     with socket.socket() as s:
@@ -25,41 +30,113 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.parametrize("coalition_parallel", [1, 2],
-                         ids=["data4", "data2xcoalition2"])
-def test_two_process_pool_benchmark(tmp_path, coalition_parallel):
-    port = _free_port()
+def _run_two_procs(tmp_path, argv_for_pid, timeout=420):
+    """Launch two collectively-coupled worker processes and wait for both.
+
+    Logs go to files, not pipes: one process blocking on a full pipe buffer
+    would stall the other inside a shared collective.  Returns the per-process
+    log texts; asserts both exited 0.
+    """
+
     env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu")
-    # log to files, not pipes: the processes are collectively coupled, so one
-    # blocking on a full pipe buffer would stall the other inside a collective
     logs = [tmp_path / f"proc{pid}.log" for pid in range(2)]
     procs = []
     try:
         for pid in range(2):
             with open(logs[pid], "wb") as log:
                 procs.append(subprocess.Popen(
-                    [sys.executable,
-                     os.path.join(REPO, "benchmarks", "multihost_pool.py"),
-                     "-b", "8", "-w", "4", "-n", "1", "--limit", "64",
-                     "--coalition_parallel", str(coalition_parallel),
-                     "--platform", "cpu", "--cpu_devices", "2",
-                     "--coordinator", f"127.0.0.1:{port}",
-                     "--num_processes", "2", "--process_id", str(pid)],
-                    cwd=str(tmp_path), env=env, stdout=log,
-                    stderr=subprocess.STDOUT))
+                    argv_for_pid(pid), cwd=str(tmp_path), env=env,
+                    stdout=log, stderr=subprocess.STDOUT))
         for p in procs:
-            p.wait(timeout=420)
+            p.wait(timeout=timeout)
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
                 p.wait(timeout=30)
+    texts = [log.read_text(errors="replace") for log in logs]
     for pid, p in enumerate(procs):
-        out = logs[pid].read_text(errors="replace")
-        assert p.returncode == 0, f"proc {pid} failed:\n{out[-2000:]}"
+        assert p.returncode == 0, f"proc {pid} failed:\n{texts[pid][-2000:]}"
+    return texts
+
+
+def _explain_adult(n_devices=N_DEVICES):
+    """The shared recipe: fit + explain the Adult slice on a sharded mesh."""
+
+    import numpy as np
+
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.utils import load_data, load_model
+
+    data = load_data()
+    clf = load_model()
+    gn, g = data["all"]["group_names"], data["all"]["groups"]
+    X = data["all"]["X"]["processed"]["test"].toarray()[:N_INSTANCES]
+    bg = data["background"]["X"]["preprocessed"]
+    ex = KernelShap(clf.predict_proba, link="logit", feature_names=gn, seed=0,
+                    distributed_opts={"n_devices": n_devices})
+    ex.fit(bg, group_names=gn, groups=g)
+    sv = ex.explain(X, silent=True, nsamples=NSAMPLES, l1_reg=False).shap_values
+    return np.stack(sv, 1)
+
+
+@pytest.mark.parametrize("coalition_parallel", [1, 2],
+                         ids=["data4", "data2xcoalition2"])
+def test_two_process_pool_benchmark(tmp_path, coalition_parallel):
+    port = _free_port()
+    texts = _run_two_procs(tmp_path, lambda pid: [
+        sys.executable, os.path.join(REPO, "benchmarks", "multihost_pool.py"),
+        "-b", "8", "-w", str(N_DEVICES), "-n", "1", "--limit", "64",
+        "--coalition_parallel", str(coalition_parallel),
+        "--platform", "cpu", "--cpu_devices", "2",
+        "--coordinator", f"127.0.0.1:{port}",
+        "--num_processes", "2", "--process_id", str(pid)])
+    for out in texts:
         assert "jax.distributed initialised: 2 processes, 4 devices" in out, out[-2000:]
 
     # the lead process wrote the reference-format result pickle
     with open(tmp_path / "results" / "ray_workers_4_bsize_8_actorfr_1.0.pkl", "rb") as f:
         result = pickle.load(f)
     assert len(result["t_elapsed"]) == 1 and result["t_elapsed"][0] > 0
+
+
+_PHI_WORKER = """
+import sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+pid = int(sys.argv[1])
+from distributedkernelshap_tpu.parallel.mesh import initialize_multihost
+initialize_multihost("127.0.0.1:" + sys.argv[2], 2, pid)
+assert jax.process_count() == 2
+
+import numpy as np
+sys.path.insert(0, {tests_dir!r})
+from test_multihost import _explain_adult
+np.save(sys.argv[3] + "/phi_" + str(pid) + ".npy", _explain_adult())
+"""
+
+
+def test_two_process_phi_matches_single_process(tmp_path):
+    """Cross-process numerical equivalence: the sharded explain over a
+    2-process mesh must produce exactly the same SHAP values on every
+    process, and match a single-process run of the same plan (the
+    sequential==distributed oracle, SURVEY.md §4, across a real process
+    boundary)."""
+
+    import numpy as np
+
+    port = _free_port()
+    worker = tmp_path / "worker.py"
+    worker.write_text(_PHI_WORKER.format(
+        repo=REPO, tests_dir=os.path.dirname(os.path.abspath(__file__))))
+    _run_two_procs(tmp_path, lambda pid: [
+        sys.executable, str(worker), str(pid), str(port), str(tmp_path)])
+
+    phi0 = np.load(tmp_path / "phi_0.npy")
+    phi1 = np.load(tmp_path / "phi_1.npy")
+    np.testing.assert_array_equal(phi0, phi1)
+
+    # single-process reference: same recipe on this process's own devices
+    np.testing.assert_allclose(phi0, _explain_adult(), atol=1e-5)
