@@ -156,3 +156,34 @@ def test_invert_permutation_property(n, seed):
     s = invert_permutation(list(p))
     np.testing.assert_array_equal(np.asarray(p)[s], np.arange(n))
     np.testing.assert_array_equal(s[p], np.arange(n))
+
+
+@settings(max_examples=10, deadline=None)
+@given(seed=st.integers(0, 2**20), n=st.integers(12, 40),
+       d=st.integers(2, 6), k=st.integers(1, 5))
+def test_kmeans_summary_properties(seed, n, d, k):
+    """shap.kmeans parity invariants: every centroid coordinate is an
+    actually-observed value in its column (so integer/one-hot columns stay
+    valid), and the cluster weights partition the dataset."""
+
+    from distributedkernelshap_tpu.ops.summarise import kmeans_summary
+
+    rng = np.random.default_rng(seed)
+    # mix of continuous and integer-ish columns
+    data = rng.normal(size=(n, d))
+    data[:, 0] = rng.integers(0, 3, size=n)
+
+    summary = kmeans_summary(data, k, seed=0)
+    centers = np.asarray(summary.data)
+    weights = np.asarray(summary.weights)
+
+    assert centers.shape == (k, d)
+    for j in range(d):
+        observed = set(np.round(data[:, j], 12))
+        assert all(np.round(c, 12) in observed for c in centers[:, j])
+    # DenseData normalises weights to sum 1; occupancy counts are recovered
+    # by scaling back with n and must be whole and partition the dataset
+    np.testing.assert_allclose(weights.sum(), 1.0, atol=1e-12)
+    counts = weights * n
+    np.testing.assert_allclose(counts, np.round(counts), atol=1e-9)
+    assert np.all(counts >= 0) and counts.sum() == pytest.approx(n)
