@@ -1,0 +1,27 @@
+# Common workflows. Cluster deployment targets live in cluster/Makefile.{pool,serve};
+# docker image targets in dockerfiles/Makefile.
+
+PY ?= python
+
+.PHONY: test bench configs serve sweep-pool sweep-serve analysis
+
+test:            ## full suite on CPU with 8 virtual devices
+	env PYTHONPATH= JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q
+
+bench:           ## headline benchmark (one JSON line, runs on the attached chip)
+	$(PY) bench.py
+
+configs:         ## full BASELINE.json configuration suite
+	$(PY) benchmarks/configs.py --config all
+
+serve:           ## serve the default Adult explainer on :8000
+	$(PY) -m distributedkernelshap_tpu.serving.main
+
+sweep-pool:      ## device-sweep pool benchmark (reference ray_pool.py analog)
+	$(PY) benchmarks/pool.py -benchmark 1 -w 8 -b 320 -n 3
+
+sweep-serve:     ## serving sweep (reference serve_explanations.py analog)
+	$(PY) benchmarks/serve_explanations.py --replicas 8 -b 1 5 10 -n 1
+
+analysis:        ## aggregate result pickles and plot
+	$(PY) benchmarks/analysis.py --results results --plot results/scaling.png
