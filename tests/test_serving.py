@@ -110,6 +110,30 @@ def test_http_fanout_batched(server, model_setup):
         np.testing.assert_allclose(got, want, atol=1e-5)
 
 
+def test_http_randomized_concurrent_stress(server, model_setup):
+    """Seeded stress: many concurrent clients with mixed-size payloads must
+    each get back exactly their own explanation — the micro-batcher coalesces
+    across requests of different row counts without shuffling or mixing."""
+
+    rng = np.random.default_rng(42)
+    D = model_setup["X"].shape[1]
+    requests_ = [rng.normal(size=(int(rng.integers(1, 5)), D)).astype(np.float32)
+                 for _ in range(24)]
+    url = f"http://127.0.0.1:{server.port}/explain"
+
+    payloads = distribute_requests(url, np.zeros((0, D), np.float32),
+                                   batch_mode="default", minibatches=requests_,
+                                   max_workers=12)
+
+    single = KernelShapModel(model_setup["pred"], model_setup["bg"],
+                             model_setup["constructor_kwargs"], model_setup["fit_kwargs"])
+    for x, payload in zip(requests_, payloads):
+        got = np.asarray(json.loads(payload)["data"]["shap_values"])
+        want = np.asarray(json.loads(single(FakeRequest(x)))["data"]["shap_values"])
+        assert got.shape == (2, x.shape[0], D)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+
 def test_http_minibatch_mode(server, model_setup):
     url = f"http://127.0.0.1:{server.port}/explain"
     X = model_setup["X"]
