@@ -158,6 +158,22 @@ def test_invert_permutation_property(n, seed):
     np.testing.assert_array_equal(s[p], np.arange(n))
 
 
+@settings(max_examples=40, deadline=None)
+@given(height=st.integers(1, 12), width=st.integers(1, 12),
+       patch=st.integers(1, 6), channels=st.integers(1, 3))
+def test_superpixel_groups_partition(height, width, patch, channels):
+    """Superpixel groups must exactly partition the flattened pixel columns
+    for any image geometry, including ragged edges."""
+
+    from distributedkernelshap_tpu.ops.image import superpixel_groups
+
+    groups, names = superpixel_groups(height, width, patch, channels=channels)
+    cols = [c for g in groups for c in g]
+    assert sorted(cols) == list(range(height * width * channels))
+    assert len(names) == len(groups) == (-(-height // patch)) * (-(-width // patch))
+    assert all(len(g) <= patch * patch * channels for g in groups)
+
+
 @settings(max_examples=10, deadline=None)
 @given(seed=st.integers(0, 2**20), n=st.integers(12, 40),
        d=st.integers(2, 6), k=st.integers(1, 5))
