@@ -341,11 +341,13 @@ class KernelExplainerEngine:
             self._fn_cache['solve'] = jax.jit(solve)
         return self._fn_cache['solve']
 
-    def _hosteval_stats(self, X: np.ndarray, plan):
+    def _hosteval_stats(self, X: np.ndarray, plan, silent: bool = True):
         """Host-side ``(ey_adj, fx, e_val)`` for black-box predictors: the
         masked batches are synthesised by the native OpenMP kernels
         (``runtime/masked_eval.cc``) and fed to the host callable in
-        coalition chunks."""
+        coalition chunks.  ``silent=False`` logs chunk progress — this is the
+        one path slow enough (minutes for big tasks) that the reference's
+        progress reporting has a counterpart worth having."""
 
         from distributedkernelshap_tpu.ops.links import convert_to_link_np
         from distributedkernelshap_tpu.runtime import native
@@ -375,6 +377,9 @@ class KernelExplainerEngine:
         ey = np.empty((B, S, K), dtype=np.float32)
         starts = range(0, S, chunk)
         n_workers = min(n_workers, len(starts))
+        progress = {'done': 0}
+        progress_lock = threading.Lock()
+        log_every = max(1, len(starts) // 10)
 
         def eval_chunk(s0: int) -> None:
             zc_c = zc[s0:s0 + chunk]
@@ -382,6 +387,12 @@ class KernelExplainerEngine:
             pred = self.predictor.host_fn(rows)
             ey[:, s0:s0 + chunk] = native.weighted_mean(
                 pred, bgw, B * zc_c.shape[0]).reshape(B, zc_c.shape[0], K)
+            if not silent:
+                with progress_lock:
+                    progress['done'] += 1
+                    n_done = progress['done']
+                if n_done % log_every == 0 or n_done == len(starts):
+                    logger.info("host-eval: %d/%d coalition chunks", n_done, len(starts))
 
         if n_workers > 1:
             with ThreadPoolExecutor(max_workers=n_workers) as pool:
@@ -395,7 +406,8 @@ class KernelExplainerEngine:
         ey_adj = link_np(ey) - e_val[None, None, :]
         return ey_adj, fx, e_val
 
-    def _explain_array_hosteval(self, X: np.ndarray, nsamples) -> Dict[str, np.ndarray]:
+    def _explain_array_hosteval(self, X: np.ndarray, nsamples,
+                                silent: bool = True) -> Dict[str, np.ndarray]:
         """Black-box path for backends without host callbacks: the predictor
         runs on the host, the WLS solve runs on device.  Replaces the
         reference's in-worker ``shap.KernelExplainer`` loop for opaque
@@ -408,7 +420,7 @@ class KernelExplainerEngine:
         pad = (self._bucket(B) - B) if self.config.bucket_batches else 0
         Xp = np.concatenate([X, np.tile(X[-1:], (pad, 1))], 0) if pad else X
         with profiler().phase('host_eval'):
-            ey_adj, fx, e_val = self._hosteval_stats(Xp, plan)
+            ey_adj, fx, e_val = self._hosteval_stats(Xp, plan, silent=silent)
         fx_minus_e = fx - e_val[None, :]
         with profiler().phase('device_solve'):
             phi = np.asarray(self._solve_fn()(
@@ -433,9 +445,10 @@ class KernelExplainerEngine:
                 self.background, self.bg_weights, plan.mask, plan.weights, self.G))
         return self._dev_cache[key]
 
-    def _explain_array(self, X: np.ndarray, nsamples) -> Dict[str, np.ndarray]:
+    def _explain_array(self, X: np.ndarray, nsamples,
+                       silent: bool = True) -> Dict[str, np.ndarray]:
         if self.config.host_eval:
-            return self._explain_array_hosteval(X, nsamples)
+            return self._explain_array_hosteval(X, nsamples, silent=silent)
         with profiler().phase('coalition_plan'):
             plan = self._plan(nsamples)
         with profiler().phase('device_explain'):
@@ -546,7 +559,9 @@ class KernelExplainerEngine:
         otherwise; tuple input returns ``(batch_idx, result)``.
         """
 
-        del silent, kwargs  # progress bars don't exist here; kwargs for parity
+        # kwargs accepted for parity; silent only matters on the slow
+        # (host-eval) path — device explains finish in milliseconds
+        del kwargs
         batch_idx = None
         if isinstance(X, tuple):
             batch_idx, X = X
@@ -578,7 +593,8 @@ class KernelExplainerEngine:
                                       for c in chunks[w0:w0 + window]]
                         results.extend(pool.map(lambda f: f(), finalizers))
         else:
-            results = [self._explain_array(c, nsamples) for c in chunks]
+            results = [self._explain_array(c, nsamples, silent=silent)
+                       for c in chunks]
         phi = np.concatenate([r['shap_values'] for r in results], 0)
         # stash the link-space predictions so build_explanation doesn't need a
         # second predictor pass (+ D2H round trip) for the same instances
@@ -586,7 +602,7 @@ class KernelExplainerEngine:
             [r['raw_prediction'] for r in results], 0)
         self.last_X_fingerprint = _fingerprint(X)
 
-        phi = self._apply_l1_reg(phi, X, l1_reg, nsamples)
+        phi = self._apply_l1_reg(phi, X, l1_reg, nsamples, silent=silent)
 
         values = split_shap_values(phi, self.vector_out)
         if batch_idx is not None:
@@ -595,7 +611,7 @@ class KernelExplainerEngine:
 
     # ------------------------------------------------------------------ #
 
-    def _apply_l1_reg(self, phi, X, l1_reg, nsamples):
+    def _apply_l1_reg(self, phi, X, l1_reg, nsamples, silent: bool = True):
         """Optional host-side feature selection (reference surfaces shap's
         ``l1_reg`` knob, documented at ``kernel_shap.py:840-845``).
 
@@ -617,15 +633,15 @@ class KernelExplainerEngine:
                 "< 0.2, so AIC feature selection runs per instance on the host "
                 "(shap 0.35 default behaviour). Pass l1_reg=False to keep the "
                 "fully on-device path.", plan.n_rows / space)
-        return self._l1_solve(X, plan, l1_reg)
+        return self._l1_solve(X, plan, l1_reg, silent=silent)
 
-    def _l1_solve(self, X, plan, l1_reg):
+    def _l1_solve(self, X, plan, l1_reg, silent: bool = True):
         """Restricted WLS re-solve after lasso/top-k feature selection."""
 
         from sklearn.linear_model import Lasso, LassoLarsIC, lars_path
 
         if self.config.host_eval:
-            ey_adj, fx, e_val = self._hosteval_stats(X, plan)
+            ey_adj, fx, e_val = self._hosteval_stats(X, plan, silent=silent)
             ey_adj = ey_adj.astype(np.float64)
             fx = fx.astype(np.float64)
             e_val = e_val.astype(np.float64)
