@@ -9,6 +9,10 @@ Each config prints one JSON line; ``bench.py`` remains the headline driver.
                            callable (XGBoost when installed, sklearn
                            HistGradientBoosting otherwise) via the host-eval
                            path
+  * ``adult_trees``      — a gradient-boosted predictor lifted onto the
+                           device (``models/trees.py`` path-matmul eval);
+                           measures the native-tree path against
+                           ``adult_blackbox``'s host path
   * ``mnist``            — CNN + superpixel image KernelSHAP
   * ``covertype``        — 581k-instance dataset, instance-sharded across
                            every visible device
@@ -102,34 +106,78 @@ def config_adult_blackbox(smoke=False):
     from distributedkernelshap_tpu.kernel_shap import EngineConfig
     from distributedkernelshap_tpu.utils import load_data
 
+    from distributedkernelshap_tpu.models import CallbackPredictor
+
     data = load_data()
     gn, g = data["all"]["group_names"], data["all"]["groups"]
     Xtr = data["all"]["X"]["processed"]["train"].toarray()
     ytr = data["all"]["y"]["train"]
+    if smoke:
+        Xtr, ytr = Xtr[:4000], ytr[:4000]
     try:  # xgboost when available; sklearn boosted trees otherwise
         from xgboost import XGBClassifier
 
-        clf = XGBClassifier(n_estimators=50, max_depth=4).fit(Xtr, ytr)
+        clf = XGBClassifier(n_estimators=15 if smoke else 50, max_depth=4).fit(Xtr, ytr)
     except ImportError:
         from sklearn.ensemble import HistGradientBoostingClassifier
 
-        clf = HistGradientBoostingClassifier(max_iter=50, random_state=0).fit(Xtr, ytr)
+        clf = HistGradientBoostingClassifier(max_iter=15 if smoke else 50,
+                                             random_state=0).fit(Xtr, ytr)
 
     X = data["all"]["X"]["processed"]["test"].toarray()
-    X = X[:32] if smoke else X[:256]
+    X = X[:16] if smoke else X[:256]
     # sklearn/xgboost predict is reentrant: fan the host-eval chunks across
     # every host core (a TPU-VM host has ~100+; this mirrors the reference's
     # worker-pool parallelism for the part that stays on the host)
     # host_eval=True: force the host path even on backends that support
-    # callbacks, so this config always measures the fan-out it advertises
+    # callbacks, so this config always measures the fan-out it advertises.
+    # The explicit CallbackPredictor wrap keeps the model opaque — without it
+    # as_predictor would lift the sklearn ensemble onto the device
+    # (models/trees.py), which is what config_adult_trees measures instead
     cfg = EngineConfig(host_eval=True, host_eval_workers=os.cpu_count() or 1)
-    ex = KernelShap(clf.predict_proba, link="logit", feature_names=gn, seed=0,
+    pred = CallbackPredictor(clf.predict_proba, example_dim=Xtr.shape[1])
+    ex = KernelShap(pred, link="logit", feature_names=gn, seed=0,
                     engine_config=cfg)
     ex.fit(data["background"]["X"]["preprocessed"], group_names=gn, groups=g)
     t, explanation = _timed_explain(ex, X, nruns=1)
     return {"metric": "adult_blackbox_wall_s", "value": round(t, 4), "unit": "s",
             "n_instances": X.shape[0], "additivity_err": _additivity(explanation),
             "predictor": type(clf).__name__}
+
+
+def config_adult_trees(smoke=False):
+    """A gradient-boosted model lifted onto the device (``models/trees.py``):
+    the whole ``B×S×N`` synthetic tensor is evaluated on-chip as MXU
+    path-matmuls, no host callback.  Same task size as ``adult_blackbox``;
+    the two lines are directly comparable when xgboost is not installed
+    (both then use HistGradientBoostingClassifier(max_iter=50) — the case for
+    the numbers in RESULTS.md).  With xgboost installed, ``adult_blackbox``
+    measures XGBClassifier instead, a different per-row eval cost."""
+
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.models import TreeEnsemblePredictor
+    from distributedkernelshap_tpu.utils import load_data
+
+    data = load_data()
+    gn, g = data["all"]["group_names"], data["all"]["groups"]
+    Xtr = data["all"]["X"]["processed"]["train"].toarray()
+    ytr = data["all"]["y"]["train"]
+    if smoke:
+        Xtr, ytr = Xtr[:4000], ytr[:4000]
+    from sklearn.ensemble import HistGradientBoostingClassifier
+
+    clf = HistGradientBoostingClassifier(max_iter=10 if smoke else 50,
+                                         random_state=0).fit(Xtr, ytr)
+
+    X = data["all"]["X"]["processed"]["test"].toarray()
+    X = X[:8] if smoke else X[:256]
+    ex = KernelShap(clf.predict_proba, link="logit", feature_names=gn, seed=0)
+    ex.fit(data["background"]["X"]["preprocessed"], group_names=gn, groups=g)
+    lifted = isinstance(ex._explainer.predictor, TreeEnsemblePredictor)
+    t, explanation = _timed_explain(ex, X, nruns=1 if smoke else 3)
+    return {"metric": "adult_trees_wall_s", "value": round(t, 4), "unit": "s",
+            "n_instances": X.shape[0], "additivity_err": _additivity(explanation),
+            "predictor": type(clf).__name__, "device_lifted": lifted}
 
 
 def config_mnist(smoke=False):
@@ -195,6 +243,7 @@ CONFIGS = {
     "adult": config_adult,
     "adult_stress": config_adult_stress,
     "adult_blackbox": config_adult_blackbox,
+    "adult_trees": config_adult_trees,
     "mnist": config_mnist,
     "covertype": config_covertype,
 }

@@ -5,3 +5,7 @@ from distributedkernelshap_tpu.models.predictors import (  # noqa: F401
     LinearPredictor,
     as_predictor,
 )
+from distributedkernelshap_tpu.models.trees import (  # noqa: F401
+    TreeEnsemblePredictor,
+    lift_tree_ensemble,
+)

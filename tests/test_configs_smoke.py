@@ -30,3 +30,10 @@ def test_config_blackbox_smoke():
     assert result["value"] > 0
     assert result["additivity_err"] < 1e-3, result
     assert result["predictor"]
+
+
+def test_config_trees_smoke():
+    result = CONFIGS["adult_trees"](smoke=True)
+    assert result["value"] > 0
+    assert result["additivity_err"] < 1e-3, result
+    assert result["device_lifted"], "GBT should lift onto the device"

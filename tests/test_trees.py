@@ -1,0 +1,254 @@
+"""Device-side tree-ensemble lifting (models/trees.py).
+
+The reference runs tree models as opaque pickled callables on CPU workers
+(``explainers/wrappers.py:33-37``); here the ensemble is lifted into
+gather-traversal arrays on the device, so the tests check (a) the lifted
+predictor reproduces sklearn's own outputs, (b) the full KernelShap pipeline
+over a lifted tree model satisfies additivity, and (c) unliftable estimators
+fall back to the host path rather than silently mis-predicting.
+"""
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu.models import (
+    CallbackPredictor,
+    TreeEnsemblePredictor,
+    as_predictor,
+    lift_tree_ensemble,
+)
+
+
+@pytest.fixture(scope="module")
+def clf_data():
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(400, 6))
+    y = ((X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(int)
+         + (X[:, 3] > 1).astype(int))  # 3 classes
+    return X.astype(np.float64), y
+
+
+@pytest.fixture(scope="module")
+def reg_data():
+    rng = np.random.default_rng(8)
+    X = rng.normal(size=(400, 6))
+    y = 100.0 * X[:, 0] - 40.0 * X[:, 1] * X[:, 2] + rng.normal(size=400)
+    return X.astype(np.float64), y
+
+
+def _assert_matches(method, X, atol=2e-5):
+    lifted = lift_tree_ensemble(method)
+    assert lifted is not None, f"{method} did not lift"
+    expected = np.asarray(method(X), dtype=np.float64)
+    if expected.ndim == 1:
+        expected = expected[:, None]
+    got = np.asarray(lifted(X.astype(np.float32)), dtype=np.float64)
+    scale = max(1.0, np.abs(expected).max())
+    np.testing.assert_allclose(got, expected, atol=atol * scale)
+    return lifted
+
+
+def test_decision_tree_classifier(clf_data):
+    from sklearn.tree import DecisionTreeClassifier
+
+    X, y = clf_data
+    clf = DecisionTreeClassifier(max_depth=5, random_state=0).fit(X, y)
+    lifted = _assert_matches(clf.predict_proba, X[:64])
+    assert lifted.n_outputs == 3
+
+
+def test_random_forest_classifier(clf_data):
+    from sklearn.ensemble import RandomForestClassifier
+
+    X, y = clf_data
+    clf = RandomForestClassifier(n_estimators=20, max_depth=6, random_state=0).fit(X, y)
+    lifted = _assert_matches(clf.predict_proba, X[:64])
+    assert lifted.n_trees == 20 and lifted.aggregation == "mean"
+
+
+def test_extra_trees_regressor(reg_data):
+    from sklearn.ensemble import ExtraTreesRegressor
+
+    X, y = reg_data
+    reg = ExtraTreesRegressor(n_estimators=10, max_depth=6, random_state=0).fit(X, y)
+    lifted = _assert_matches(reg.predict, X[:64])
+    assert not lifted.vector_out
+
+
+@pytest.mark.parametrize("n_classes", [2, 3])
+def test_gradient_boosting_classifier(clf_data, n_classes):
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    X, y = clf_data
+    y = y if n_classes == 3 else (y > 0).astype(int)
+    clf = GradientBoostingClassifier(n_estimators=15, max_depth=3, random_state=0).fit(X, y)
+    lifted = _assert_matches(clf.predict_proba, X[:64])
+    assert lifted.n_outputs == n_classes
+    _assert_matches(clf.decision_function, X[:64])
+
+
+def test_gradient_boosting_regressor(reg_data):
+    from sklearn.ensemble import GradientBoostingRegressor
+
+    X, y = reg_data
+    reg = GradientBoostingRegressor(n_estimators=15, max_depth=3, random_state=0).fit(X, y)
+    _assert_matches(reg.predict, X[:64])
+
+
+@pytest.mark.parametrize("n_classes", [2, 3])
+def test_hist_gradient_boosting_classifier(clf_data, n_classes):
+    from sklearn.ensemble import HistGradientBoostingClassifier
+
+    X, y = clf_data
+    y = y if n_classes == 3 else (y > 0).astype(int)
+    clf = HistGradientBoostingClassifier(max_iter=12, max_depth=4, random_state=0).fit(X, y)
+    lifted = _assert_matches(clf.predict_proba, X[:64])
+    assert lifted.n_outputs == n_classes and lifted.missing_left is not None
+
+
+def test_hist_gradient_boosting_missing_values(clf_data):
+    """NaN routing must follow the trained missing_go_to_left flags."""
+
+    from sklearn.ensemble import HistGradientBoostingClassifier
+
+    X, y = clf_data
+    Xm = X.copy()
+    Xm[::7, 0] = np.nan
+    Xm[::11, 3] = np.nan
+    clf = HistGradientBoostingClassifier(max_iter=12, max_depth=4, random_state=0).fit(Xm, y)
+    _assert_matches(clf.predict_proba, Xm[:64])
+
+
+def test_hist_gradient_boosting_regressor(reg_data):
+    from sklearn.ensemble import HistGradientBoostingRegressor
+
+    X, y = reg_data
+    reg = HistGradientBoostingRegressor(max_iter=12, random_state=0).fit(X, y)
+    _assert_matches(reg.predict, X[:64])
+
+
+def test_classifier_label_predict_not_lifted(clf_data):
+    """Class-label ``predict`` is a discontinuous argmax — stays on the host."""
+
+    from sklearn.ensemble import RandomForestClassifier
+
+    X, y = clf_data
+    clf = RandomForestClassifier(n_estimators=5, random_state=0).fit(X, y)
+    assert lift_tree_ensemble(clf.predict) is None
+
+
+def test_as_predictor_routes_trees(clf_data):
+    from sklearn.ensemble import HistGradientBoostingClassifier
+
+    X, y = clf_data
+    clf = HistGradientBoostingClassifier(max_iter=8, random_state=0).fit(X, y)
+    pred = as_predictor(clf.predict_proba, example_dim=X.shape[1])
+    assert isinstance(pred, TreeEnsemblePredictor)
+
+
+def test_as_predictor_falls_back_when_unfaithful(clf_data):
+    """A non-tree opaque callable still lands on CallbackPredictor."""
+
+    X, y = clf_data
+
+    def opaque(A):
+        return np.stack([np.sin(A[:, 0]), np.cos(A[:, 0])], axis=1)
+
+    pred = as_predictor(opaque, example_dim=X.shape[1])
+    assert isinstance(pred, CallbackPredictor)
+
+
+def test_kernel_shap_end_to_end_tree(clf_data):
+    """Full explain over a lifted GBT: additivity in link space."""
+
+    from sklearn.ensemble import HistGradientBoostingClassifier
+
+    from distributedkernelshap_tpu import KernelShap
+
+    X, y = clf_data
+    y = (y > 0).astype(int)
+    clf = HistGradientBoostingClassifier(max_iter=10, max_depth=3, random_state=0).fit(X, y)
+    ex = KernelShap(clf.predict_proba, link="logit", seed=0)
+    ex.fit(X[:50])
+    assert isinstance(ex._explainer.predictor, TreeEnsemblePredictor)
+    Xe = X[50:66]
+    res = ex.explain(Xe, silent=True)
+    proba = np.clip(clf.predict_proba(Xe), 1e-7, 1 - 1e-7)
+    for k, phi in enumerate(res.shap_values):
+        lhs = phi.sum(axis=1) + res.expected_value[k]
+        rhs = np.log(proba[:, k] / (1 - proba[:, k]))
+        np.testing.assert_allclose(lhs, rhs, atol=5e-3)
+
+
+def test_path_and_iterative_strategies_agree(clf_data):
+    """The MXU path-matmul evaluation must match the gather traversal."""
+
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    X, y = clf_data
+    y = (y > 0).astype(int)
+    clf = GradientBoostingClassifier(n_estimators=10, max_depth=4, random_state=0).fit(X, y)
+    lifted = lift_tree_ensemble(clf.predict_proba)
+    assert lifted.path_sign is not None
+    Xf = X[:100].astype(np.float32)
+    via_paths = np.asarray(lifted(Xf))
+    via_iter = np.asarray(lifted._eval_iterative(Xf) * lifted.scale + lifted.base[None, :])
+    p = 1.0 / (1.0 + np.exp(-via_iter[:, 0]))
+    via_iter = np.stack([1.0 - p, p], axis=1)
+    np.testing.assert_allclose(via_paths, via_iter, atol=1e-5)
+
+
+def test_oversized_ensemble_declines_path_matmul(clf_data):
+    """A forest past the per-row flop budget falls back to gather traversal
+    and still predicts correctly."""
+
+    from sklearn.ensemble import RandomForestClassifier
+
+    X, y = clf_data
+    clf = RandomForestClassifier(n_estimators=4, max_depth=5, random_state=0).fit(X, y)
+    lifted = lift_tree_ensemble(clf.predict_proba)
+    assert lifted.path_sign is not None
+
+    class Tiny(TreeEnsemblePredictor):
+        max_path_flops_per_row = 1
+
+    tiny = Tiny(lifted.feature, lifted.threshold, lifted.left, lifted.right,
+                np.asarray(lifted.value), depth=lifted.depth, aggregation="mean")
+    assert tiny.path_sign is None
+    expected = clf.predict_proba(X[:50])
+    np.testing.assert_allclose(np.asarray(tiny(X[:50].astype(np.float32))),
+                               expected, atol=2e-5)
+
+
+def test_chunked_rows_match_unchunked(clf_data):
+    """Row chunking under lax.map (with padding) is transparent."""
+
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    X, y = clf_data
+    y = (y > 0).astype(int)
+    clf = GradientBoostingClassifier(n_estimators=8, max_depth=3, random_state=0).fit(X, y)
+    lifted = lift_tree_ensemble(clf.predict_proba)
+
+    class Small(TreeEnsemblePredictor):
+        target_chunk_elems = 1 << 10   # force many chunks + ragged tail
+
+    small = Small(lifted.feature, lifted.threshold, lifted.left, lifted.right,
+                  np.asarray(lifted.value), depth=lifted.depth, aggregation="sum",
+                  base=np.asarray(lifted.base), scale=lifted.scale,
+                  out_transform="binary_sigmoid")
+    Xf = X[:333].astype(np.float32)
+    np.testing.assert_allclose(np.asarray(small(Xf)), np.asarray(lifted(Xf)),
+                               atol=1e-6)
+
+
+def test_deep_tree_padding(reg_data):
+    """Trees of very different depths pad correctly (self-looping leaves)."""
+
+    from sklearn.ensemble import RandomForestRegressor
+
+    X, y = reg_data
+    reg = RandomForestRegressor(n_estimators=6, max_depth=None, random_state=0,
+                                min_samples_leaf=1).fit(X, y)
+    lifted = _assert_matches(reg.predict, X[:64], atol=1e-4)
+    assert lifted.depth >= 5
