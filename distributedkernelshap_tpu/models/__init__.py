@@ -3,6 +3,7 @@ from distributedkernelshap_tpu.models.predictors import (  # noqa: F401
     CallbackPredictor,
     JaxPredictor,
     LinearPredictor,
+    MLPPredictor,
     as_predictor,
 )
 from distributedkernelshap_tpu.models.trees import (  # noqa: F401

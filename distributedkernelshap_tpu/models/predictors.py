@@ -166,6 +166,91 @@ class JaxPredictor(BasePredictor):
         return out
 
 
+_MLP_HIDDEN_ACTIVATIONS = {
+    "identity": lambda z: z,
+    "relu": jax.nn.relu,
+    "tanh": jnp.tanh,
+    "logistic": jax.nn.sigmoid,
+}
+
+
+class MLPPredictor(BasePredictor):
+    """A feed-forward network evaluated natively in JAX — dense matmuls all
+    the way down, so the whole KernelSHAP synthetic tensor stays on the MXU.
+
+    ``layers`` is a list of ``(W, b)`` with ``W: (D_in, D_out)``;
+    ``hidden_activation`` applies between layers, ``out_activation`` to the
+    final logits ('identity' | 'softmax' | 'binary_sigmoid' — a single logit
+    mapped to ``[1-p, p]`` — | 'sigmoid', elementwise per-label probabilities
+    for multilabel classifiers).
+    """
+
+    def __init__(self, layers, hidden_activation: str = "relu",
+                 out_activation: str = "identity", vector_out: bool = True):
+        if hidden_activation not in _MLP_HIDDEN_ACTIVATIONS:
+            raise ValueError(
+                f"hidden_activation must be one of {sorted(_MLP_HIDDEN_ACTIVATIONS)}")
+        if out_activation not in ("identity", "softmax", "binary_sigmoid", "sigmoid"):
+            raise ValueError(
+                "out_activation must be identity|softmax|binary_sigmoid|sigmoid")
+        self.layers = [(jnp.asarray(W, jnp.float32), jnp.asarray(b, jnp.float32))
+                       for W, b in layers]
+        self.hidden_activation = hidden_activation
+        self.out_activation = out_activation
+        k_raw = int(self.layers[-1][0].shape[1])
+        self.n_outputs = 2 if out_activation == "binary_sigmoid" else k_raw
+        self.vector_out = vector_out
+
+    def __call__(self, X):
+        act = _MLP_HIDDEN_ACTIVATIONS[self.hidden_activation]
+        h = X
+        for W, b in self.layers[:-1]:
+            h = act(h @ W + b)
+        W, b = self.layers[-1]
+        z = h @ W + b
+        if self.out_activation == "binary_sigmoid":
+            p = jax.nn.sigmoid(z[:, 0])
+            return jnp.stack([1.0 - p, p], axis=1)
+        if self.out_activation == "sigmoid":
+            return jax.nn.sigmoid(z)
+        if self.out_activation == "softmax":
+            return jax.nn.softmax(z, axis=-1)
+        return z
+
+
+def _lift_sklearn_mlp(method) -> Optional[MLPPredictor]:
+    """Lift ``MLPClassifier.predict_proba`` / ``MLPRegressor.predict`` into a
+    native :class:`MLPPredictor` (sklearn stores per-layer ``coefs_`` /
+    ``intercepts_`` and names its output activation in ``out_activation_``)."""
+
+    owner = getattr(method, "__self__", None)
+    name = getattr(method, "__name__", "")
+    if owner is None or type(owner).__name__ not in ("MLPClassifier", "MLPRegressor"):
+        return None
+    coefs = getattr(owner, "coefs_", None)
+    intercepts = getattr(owner, "intercepts_", None)
+    hidden = getattr(owner, "activation", None)
+    out_act = getattr(owner, "out_activation_", None)
+    if coefs is None or intercepts is None or hidden not in _MLP_HIDDEN_ACTIVATIONS:
+        return None
+    layers = list(zip(coefs, intercepts))
+    is_classifier = hasattr(owner, "classes_")
+    if is_classifier and name == "predict_proba":
+        if out_act == "logistic":
+            # one logit = binary ([1-p, p]); several = multilabel per-label
+            # sigmoids (sklearn returns the elementwise probabilities)
+            if np.asarray(coefs[-1]).shape[1] == 1:
+                return MLPPredictor(layers, hidden, "binary_sigmoid")
+            return MLPPredictor(layers, hidden, "sigmoid")
+        if out_act == "softmax":
+            return MLPPredictor(layers, hidden, "softmax")
+        return None
+    if not is_classifier and name == "predict":
+        return MLPPredictor(layers, hidden, "identity",
+                            vector_out=np.asarray(coefs[-1]).shape[1] > 1)
+    return None  # class-label predict is a discontinuous argmax; host path
+
+
 class CallbackPredictor(BasePredictor):
     """Host-side black-box predictor bridged via ``jax.pure_callback``.
 
@@ -276,9 +361,9 @@ def as_predictor(predictor, example_dim: Optional[int] = None,
         )
         lifted = None
 
-    # tree lifts are only trusted when the numerical probe can run: structural
-    # extraction cannot see e.g. a data-dependent GradientBoosting init
-    # estimator, whose lifted constant base would be silently wrong
+    # tree/MLP lifts are only trusted when the numerical probe can run:
+    # structural extraction cannot see e.g. a data-dependent GradientBoosting
+    # init estimator, whose lifted constant base would be silently wrong
     if example_dim is not None:
         from distributedkernelshap_tpu.models.trees import lift_tree_ensemble
 
@@ -291,6 +376,18 @@ def as_predictor(predictor, example_dim: Optional[int] = None,
                 return tree
             logger.warning(
                 "Tree ensemble lift did not reproduce the original callable; "
+                "falling back to the host-callback path."
+            )
+
+        mlp = _lift_sklearn_mlp(predictor)
+        if mlp is not None:
+            if _lift_is_faithful(mlp, predictor, example_dim):
+                logger.info("Lifted sklearn MLP into a native JAX MLPPredictor "
+                            "(%d layers, hidden=%s, K=%d)", len(mlp.layers),
+                            mlp.hidden_activation, mlp.n_outputs)
+                return mlp
+            logger.warning(
+                "MLP lift did not reproduce the original callable; "
                 "falling back to the host-callback path."
             )
 

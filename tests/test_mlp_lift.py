@@ -1,0 +1,113 @@
+"""Native lifting of sklearn MLPs (models/predictors.py:MLPPredictor).
+
+Same contract as the linear/tree lifts: the lifted network must reproduce
+sklearn's own outputs (probe-gated in ``as_predictor``), and the full
+KernelShap pipeline over it must satisfy additivity.
+"""
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu.models import MLPPredictor, as_predictor
+from distributedkernelshap_tpu.models.predictors import _lift_sklearn_mlp
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(300, 5))
+    y3 = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)
+    yr = np.tanh(X[:, 0]) * 50.0 + X[:, 1]
+    return X, y3, yr
+
+
+def _check(method, X, atol=2e-5):
+    lifted = _lift_sklearn_mlp(method)
+    assert lifted is not None
+    expected = np.asarray(method(X), dtype=np.float64)
+    if expected.ndim == 1:
+        expected = expected[:, None]
+    got = np.asarray(lifted(X.astype(np.float32)), dtype=np.float64)
+    scale = max(1.0, np.abs(expected).max())
+    np.testing.assert_allclose(got, expected, atol=atol * scale)
+    return lifted
+
+
+@pytest.mark.parametrize("activation", ["relu", "tanh", "logistic"])
+def test_mlp_classifier_binary(data, activation):
+    from sklearn.neural_network import MLPClassifier
+
+    X, y3, _ = data
+    clf = MLPClassifier((8,), activation=activation, max_iter=80,
+                        random_state=0).fit(X, (y3 > 0).astype(int))
+    lifted = _check(clf.predict_proba, X[:64])
+    assert lifted.n_outputs == 2 and lifted.out_activation == "binary_sigmoid"
+
+
+def test_mlp_classifier_multiclass(data):
+    from sklearn.neural_network import MLPClassifier
+
+    X, y3, _ = data
+    clf = MLPClassifier((8, 6), max_iter=80, random_state=0).fit(X, y3)
+    lifted = _check(clf.predict_proba, X[:64])
+    assert lifted.n_outputs == 3 and lifted.out_activation == "softmax"
+
+
+def test_mlp_classifier_multilabel(data):
+    """out_activation_='logistic' with several output logits (multilabel):
+    lifted as elementwise sigmoids, matching sklearn's per-label proba."""
+
+    from sklearn.neural_network import MLPClassifier
+
+    X, y3, _ = data
+    Y = np.stack([(y3 > 0).astype(int), (y3 > 1).astype(int)], axis=1)
+    clf = MLPClassifier((8,), max_iter=80, random_state=0).fit(X, Y)
+    assert clf.out_activation_ == "logistic"
+    lifted = _check(clf.predict_proba, X[:64])
+    assert lifted.out_activation == "sigmoid" and lifted.n_outputs == 2
+
+
+def test_mlp_regressor(data):
+    from sklearn.neural_network import MLPRegressor
+
+    X, _, yr = data
+    reg = MLPRegressor(hidden_layer_sizes=(10,), max_iter=150, random_state=0).fit(X, yr)
+    lifted = _check(reg.predict, X[:64])
+    assert not lifted.vector_out
+
+
+def test_mlp_label_predict_not_lifted(data):
+    from sklearn.neural_network import MLPClassifier
+
+    X, y3, _ = data
+    clf = MLPClassifier((4,), max_iter=30, random_state=0).fit(X, y3)
+    assert _lift_sklearn_mlp(clf.predict) is None
+
+
+def test_as_predictor_routes_mlp(data):
+    from sklearn.neural_network import MLPClassifier
+
+    X, y3, _ = data
+    clf = MLPClassifier((6,), max_iter=60, random_state=0).fit(X, y3)
+    pred = as_predictor(clf.predict_proba, example_dim=X.shape[1])
+    assert isinstance(pred, MLPPredictor)
+
+
+def test_kernel_shap_end_to_end_mlp(data):
+    from sklearn.neural_network import MLPClassifier
+
+    from distributedkernelshap_tpu import KernelShap
+
+    X, y3, _ = data
+    y = (y3 > 0).astype(int)
+    clf = MLPClassifier((8,), max_iter=120, random_state=0).fit(X, y)
+    ex = KernelShap(clf.predict_proba, link="logit", seed=0)
+    ex.fit(X[:40])
+    assert isinstance(ex._explainer.predictor, MLPPredictor)
+    Xe = X[40:56]
+    res = ex.explain(Xe, silent=True)
+    proba = np.clip(clf.predict_proba(Xe), 1e-7, 1 - 1e-7)
+    for k, phi in enumerate(res.shap_values):
+        lhs = phi.sum(axis=1) + res.expected_value[k]
+        rhs = np.log(proba[:, k] / (1 - proba[:, k]))
+        np.testing.assert_allclose(lhs, rhs, atol=5e-3)
