@@ -361,35 +361,26 @@ def as_predictor(predictor, example_dim: Optional[int] = None,
         )
         lifted = None
 
-    # tree/MLP lifts are only trusted when the numerical probe can run:
+    # tree/SVM/MLP lifts are only trusted when the numerical probe can run:
     # structural extraction cannot see e.g. a data-dependent GradientBoosting
     # init estimator, whose lifted constant base would be silently wrong
     if example_dim is not None:
+        from distributedkernelshap_tpu.models.svm import lift_svm
         from distributedkernelshap_tpu.models.trees import lift_tree_ensemble
 
-        tree = lift_tree_ensemble(predictor)
-        if tree is not None:
-            if _lift_is_faithful(tree, predictor, example_dim):
-                logger.info("Lifted sklearn tree ensemble onto the device "
-                            "(T=%d trees, depth=%d, K=%d)",
-                            tree.n_trees, tree.depth, tree.n_outputs)
-                return tree
+        for family, lifter in (("tree ensemble", lift_tree_ensemble),
+                               ("SVM", lift_svm),
+                               ("MLP", _lift_sklearn_mlp)):
+            candidate = lifter(predictor)
+            if candidate is None:
+                continue
+            if _lift_is_faithful(candidate, predictor, example_dim):
+                logger.info("Lifted sklearn %s onto the device (%s)",
+                            family, type(candidate).__name__)
+                return candidate
             logger.warning(
-                "Tree ensemble lift did not reproduce the original callable; "
-                "falling back to the host-callback path."
-            )
-
-        mlp = _lift_sklearn_mlp(predictor)
-        if mlp is not None:
-            if _lift_is_faithful(mlp, predictor, example_dim):
-                logger.info("Lifted sklearn MLP into a native JAX MLPPredictor "
-                            "(%d layers, hidden=%s, K=%d)", len(mlp.layers),
-                            mlp.hidden_activation, mlp.n_outputs)
-                return mlp
-            logger.warning(
-                "MLP lift did not reproduce the original callable; "
-                "falling back to the host-callback path."
-            )
+                "%s lift did not reproduce the original callable; "
+                "falling back to the host-callback path.", family)
 
     if example_dim is not None:
         # is it jit-traceable?

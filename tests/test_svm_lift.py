@@ -1,0 +1,162 @@
+"""Native lifting of sklearn SVMs (models/svm.py).
+
+Binary SVC decision_function and SVR predict are exact kernel expansions
+over the support vectors — lifted as one Gram matmul + elementwise kernel
+map.  Platt-scaled predict_proba and multiclass one-vs-one aggregation are
+NOT deterministic functions of the lifted surface and must fall back.
+"""
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu.models import (
+    CallbackPredictor,
+    LinearPredictor,
+    SVMPredictor,
+    as_predictor,
+)
+from distributedkernelshap_tpu.models.svm import lift_svm
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(250, 5))
+    y = (X[:, 0] + 0.4 * X[:, 1] ** 2 > 0.2).astype(int)
+    yr = np.sin(X[:, 0]) + 0.1 * X[:, 1]
+    return X, y, yr
+
+
+def _check(method, X, atol=2e-5):
+    lifted = lift_svm(method)
+    assert lifted is not None
+    expected = np.asarray(method(X), dtype=np.float64)
+    if expected.ndim == 1:
+        expected = expected[:, None]
+    got = np.asarray(lifted(X.astype(np.float32)), dtype=np.float64)
+    scale = max(1.0, np.abs(expected).max())
+    np.testing.assert_allclose(got, expected, atol=atol * scale)
+    return lifted
+
+
+@pytest.mark.parametrize("kernel", ["rbf", "poly", "sigmoid"])
+def test_svc_decision_function(data, kernel):
+    from sklearn.svm import SVC
+
+    X, y, _ = data
+    clf = SVC(kernel=kernel, random_state=0).fit(X, y)
+    lifted = _check(clf.decision_function, X[:64])
+    assert lifted.kernel == kernel and not lifted.vector_out
+
+
+@pytest.mark.parametrize("kernel", ["rbf", "poly", "sigmoid"])
+def test_svr_predict(data, kernel):
+    from sklearn.svm import SVR
+
+    X, _, yr = data
+    reg = SVR(kernel=kernel).fit(X, yr)
+    _check(reg.predict, X[:64])
+
+
+def test_nusvr_predict(data):
+    from sklearn.svm import NuSVR
+
+    X, _, yr = data
+    reg = NuSVR(kernel="rbf").fit(X, yr)
+    _check(reg.predict, X[:64])
+
+
+def test_linear_kernel_svc_uses_linear_lift(data):
+    """Linear-kernel SVC exposes coef_ and hits the (exact, simpler)
+    LinearPredictor lift before the SVM lift."""
+
+    from sklearn.svm import SVC
+
+    X, y, _ = data
+    clf = SVC(kernel="linear", random_state=0).fit(X, y)
+    pred = as_predictor(clf.decision_function, example_dim=X.shape[1])
+    assert isinstance(pred, LinearPredictor)
+
+
+def test_multiclass_svc_not_lifted(data):
+    from sklearn.svm import SVC
+
+    X, y, _ = data
+    y3 = y + (X[:, 2] > 1).astype(int)
+    clf = SVC(kernel="rbf", random_state=0).fit(X, y3)
+    assert lift_svm(clf.decision_function) is None
+
+
+def test_svc_label_predict_not_lifted(data):
+    from sklearn.svm import SVC
+
+    X, y, _ = data
+    clf = SVC(kernel="rbf", random_state=0).fit(X, y)
+    assert lift_svm(clf.predict) is None
+
+
+def test_platt_proba_falls_back_to_host(data):
+    """predict_proba (libsvm internal-CV Platt scaling) is not liftable; it
+    must land on the host-callback path, not a wrong device lift."""
+
+    import warnings
+
+    from sklearn.svm import SVC
+
+    X, y, _ = data
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", FutureWarning)
+        clf = SVC(kernel="rbf", probability=True, random_state=0).fit(X, y)
+        pred = as_predictor(clf.predict_proba, example_dim=X.shape[1])
+    assert isinstance(pred, CallbackPredictor)
+
+
+def test_sparse_fitted_svm_falls_back(data):
+    """SVMs fit on sparse input store sparse internals; the lift must fall
+    back (or densify), not crash as_predictor."""
+
+    import scipy.sparse as sp
+    from sklearn.svm import SVC
+
+    X, y, _ = data
+    clf = SVC(kernel="rbf", random_state=0).fit(sp.csr_matrix(X), y)
+    pred = as_predictor(clf.decision_function, example_dim=X.shape[1])
+    expected = clf.decision_function(X[:16])
+    got = np.asarray(pred(X[:16].astype(np.float32))).ravel()
+    np.testing.assert_allclose(got, expected, atol=1e-4)
+
+
+def test_unfitted_svm_returns_none(data):
+    from sklearn.svm import SVC
+
+    assert lift_svm(SVC(kernel="rbf").decision_function) is None
+
+
+def test_as_predictor_routes_svm(data):
+    from sklearn.svm import SVC
+
+    X, y, _ = data
+    clf = SVC(kernel="rbf", random_state=0).fit(X, y)
+    pred = as_predictor(clf.decision_function, example_dim=X.shape[1])
+    assert isinstance(pred, SVMPredictor)
+
+
+def test_kernel_shap_end_to_end_svm(data):
+    """Full explain over a lifted RBF SVM: additivity in identity link
+    (decision_function is a margin, not a probability)."""
+
+    from sklearn.svm import SVC
+
+    from distributedkernelshap_tpu import KernelShap
+
+    X, y, _ = data
+    clf = SVC(kernel="rbf", random_state=0).fit(X, y)
+    ex = KernelShap(clf.decision_function, seed=0)
+    ex.fit(X[:40])
+    assert isinstance(ex._explainer.predictor, SVMPredictor)
+    Xe = X[40:56]
+    res = ex.explain(Xe, silent=True)
+    phi = np.asarray(res.shap_values[0] if isinstance(res.shap_values, list)
+                     else res.shap_values)
+    lhs = phi.sum(axis=1) + np.ravel(res.expected_value)[0]
+    np.testing.assert_allclose(lhs, clf.decision_function(Xe), atol=5e-3)
