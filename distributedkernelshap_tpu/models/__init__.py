@@ -14,3 +14,7 @@ from distributedkernelshap_tpu.models.trees import (  # noqa: F401
     TreeEnsemblePredictor,
     lift_tree_ensemble,
 )
+from distributedkernelshap_tpu.models.xgb import (  # noqa: F401
+    lift_xgboost,
+    predictor_from_xgboost_json,
+)

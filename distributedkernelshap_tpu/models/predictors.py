@@ -367,8 +367,10 @@ def as_predictor(predictor, example_dim: Optional[int] = None,
     if example_dim is not None:
         from distributedkernelshap_tpu.models.svm import lift_svm
         from distributedkernelshap_tpu.models.trees import lift_tree_ensemble
+        from distributedkernelshap_tpu.models.xgb import lift_xgboost
 
         for family, lifter in (("tree ensemble", lift_tree_ensemble),
+                               ("XGBoost ensemble", lift_xgboost),
                                ("SVM", lift_svm),
                                ("MLP", _lift_sklearn_mlp)):
             candidate = lifter(predictor)

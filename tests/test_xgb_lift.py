@@ -1,0 +1,227 @@
+"""XGBoost JSON-model lifting (models/xgb.py).
+
+xgboost is not installed in CI, so these tests validate the parser against
+hand-constructed ``save_model`` JSON (per the documented schema) and an
+*independent* pure-Python tree walker written here — not against the parser
+itself.  On user machines with xgboost installed, every lift is additionally
+probe-verified against the real ``predict_proba`` in ``as_predictor``.
+"""
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu.models import predictor_from_xgboost_json
+
+
+def _tree(split_indices, split_conditions, left, right, default_left):
+    return {
+        "split_indices": split_indices,
+        "split_conditions": split_conditions,
+        "left_children": left,
+        "right_children": right,
+        "default_left": default_left,
+        "split_type": [0] * len(split_indices),
+        "categories": [],
+    }
+
+
+def _model(trees, objective, base_score, num_class=0, tree_info=None):
+    return {"learner": {
+        "objective": {"name": objective},
+        "learner_model_param": {"base_score": str(base_score),
+                                "num_class": str(num_class)},
+        "gradient_booster": {"model": {
+            "trees": trees,
+            "tree_info": tree_info or [0] * len(trees),
+        }},
+    }}
+
+
+def _walk(tree, x):
+    """Independent reference evaluator: xgboost semantics (strict x < t,
+    default_left for NaN)."""
+
+    j = 0
+    while tree["left_children"][j] != -1:
+        v = x[tree["split_indices"][j]]
+        if np.isnan(v):
+            go_left = bool(tree["default_left"][j])
+        else:
+            go_left = v < tree["split_conditions"][j]
+        j = tree["left_children"][j] if go_left else tree["right_children"][j]
+    return tree["split_conditions"][j]
+
+
+@pytest.fixture
+def binary_model():
+    # two depth-2 trees over 3 features
+    t0 = _tree([0, 1, 2, 0, 0, 0, 0],
+               [0.5, -1.0, 2.0, 0.3, -0.7, 1.1, -0.2],
+               [1, 3, 5, -1, -1, -1, -1],
+               [2, 4, 6, -1, -1, -1, -1],
+               [1, 0, 1, 0, 0, 0, 0])
+    t1 = _tree([2, 0, 0],
+               [1.5, 0.25, -0.4],
+               [1, -1, -1],
+               [2, -1, -1],
+               [0, 0, 0])
+    return _model([t0, t1], "binary:logistic", 0.5), [t0, t1]
+
+
+def test_binary_logistic(binary_model):
+    model, trees = binary_model
+    pred = predictor_from_xgboost_json(model)
+    assert pred is not None and pred.n_outputs == 2
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(64, 3)).astype(np.float32)
+    margin = np.array([sum(_walk(t, x) for t in trees) for x in X])
+    expected = 1.0 / (1.0 + np.exp(-margin))        # base_score 0.5 -> bias 0
+    got = np.asarray(pred(X))
+    np.testing.assert_allclose(got[:, 1], expected, atol=1e-5)
+    np.testing.assert_allclose(got.sum(1), 1.0, atol=1e-6)
+
+
+def test_base_score_bias():
+    t = _tree([0], [0.0], [-1], [-1], [0])          # single leaf, value 0
+    pred = predictor_from_xgboost_json(_model([t], "binary:logistic", 0.8))
+    p = np.asarray(pred(np.zeros((1, 1), np.float32)))
+    np.testing.assert_allclose(p[0, 1], 0.8, atol=1e-5)  # sigmoid(logit(0.8))
+
+
+def test_strict_less_than_boundary(binary_model):
+    """xgboost routes x < t left; a probe exactly AT a threshold must go
+    right (the one-ulp threshold shift)."""
+
+    model, trees = binary_model
+    pred = predictor_from_xgboost_json(model)
+    x = np.array([[0.5, 0.0, 0.0]], np.float32)     # x[0] == t0 root threshold
+    margin = sum(_walk(t, x[0]) for t in trees)
+    got = np.asarray(pred(x))
+    np.testing.assert_allclose(got[0, 1], 1 / (1 + np.exp(-margin)), atol=1e-5)
+
+
+def test_missing_value_routing(binary_model):
+    model, trees = binary_model
+    pred = predictor_from_xgboost_json(model)
+    X = np.array([[np.nan, 2.0, 0.0],
+                  [0.1, np.nan, 5.0],
+                  [np.nan, np.nan, np.nan]], np.float32)
+    margin = np.array([sum(_walk(t, x) for t in trees) for x in X])
+    got = np.asarray(pred(X))
+    np.testing.assert_allclose(got[:, 1], 1 / (1 + np.exp(-margin)), atol=1e-5)
+
+
+def test_multiclass_softprob():
+    # 3 classes, one round: tree i contributes to class i (tree_info)
+    trees = [_tree([0, 0, 0], [0.5, 0.3 * (k + 1), -0.1 * (k + 1)],
+                   [1, -1, -1], [2, -1, -1], [0, 0, 0]) for k in range(3)]
+    model = _model(trees, "multi:softprob", 0.5, num_class=3, tree_info=[0, 1, 2])
+    pred = predictor_from_xgboost_json(model)
+    assert pred.n_outputs == 3
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(32, 1)).astype(np.float32)
+    margins = np.stack([[ _walk(t, x) for t in trees] for x in X])  # (n, 3)
+    got = np.asarray(pred(X))
+    np.testing.assert_allclose(got.sum(1), 1.0, atol=1e-6)
+    # softmax over per-class margins + shared bias (cancels in softmax)
+    e = np.exp(margins - margins.max(1, keepdims=True))
+    np.testing.assert_allclose(got, e / e.sum(1, keepdims=True), atol=1e-5)
+
+
+def test_regression_identity():
+    t = _tree([0, 0, 0], [1.0, 2.5, -3.5], [1, -1, -1], [2, -1, -1], [0, 0, 0])
+    model = _model([t], "reg:squarederror", 0.7)
+    pred = predictor_from_xgboost_json(model)
+    assert not pred.vector_out
+    got = np.asarray(pred(np.array([[0.0], [2.0]], np.float32)))
+    np.testing.assert_allclose(got[:, 0], [2.5 + 0.7, -3.5 + 0.7], atol=1e-5)
+
+
+def test_categorical_split_declines():
+    t = _tree([0, 0, 0], [0.5, 1.0, -1.0], [1, -1, -1], [2, -1, -1], [0, 0, 0])
+    t["split_type"] = [1, 0, 0]                      # categorical root
+    assert predictor_from_xgboost_json(_model([t], "binary:logistic", 0.5)) is None
+
+
+def test_malformed_json_declines():
+    assert predictor_from_xgboost_json({"learner": {}}) is None
+    assert predictor_from_xgboost_json({}) is None
+
+
+def test_malformed_tree_declines(binary_model):
+    """A schema-drifted tree dict (missing fields) must decline, not raise."""
+
+    model, _ = binary_model
+    del model["learner"]["gradient_booster"]["model"]["trees"][0]["default_left"]
+    assert predictor_from_xgboost_json(model) is None
+
+
+def test_short_tree_info_declines():
+    trees = [_tree([0, 0, 0], [0.5, 1.0, -1.0], [1, -1, -1], [2, -1, -1],
+                   [0, 0, 0]) for _ in range(3)]
+    model = _model(trees, "multi:softprob", 0.5, num_class=3, tree_info=[0])
+    assert predictor_from_xgboost_json(model) is None
+
+
+def test_unreproducible_objective_declines():
+    t = _tree([0], [1.5], [-1], [-1], [0])
+    for obj in ("reg:logistic", "count:poisson", "reg:gamma", "reg:tweedie"):
+        assert predictor_from_xgboost_json(_model([t], obj, 0.5)) is None
+
+
+def test_logitraw_base_score_is_logit_transformed():
+    t = _tree([0], [0.0], [-1], [-1], [0])          # single leaf, value 0
+    pred = predictor_from_xgboost_json(_model([t], "binary:logitraw", 0.8))
+    got = np.asarray(pred(np.zeros((1, 1), np.float32)))
+    np.testing.assert_allclose(got[0, 0], np.log(0.8 / 0.2), atol=1e-5)
+
+
+def test_early_stopping_slices_trees(binary_model):
+    """With best_iteration recorded, only the first best_iteration+1 rounds
+    contribute — matching what booster.predict() does after early stopping."""
+
+    model, trees = binary_model
+    bm = model["learner"]["gradient_booster"]["model"]
+    model["learner"]["attributes"] = {"best_iteration": "0"}
+    bm["iteration_indptr"] = [0, 1, 2]               # one tree per round
+    pred = predictor_from_xgboost_json(model)
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(32, 3)).astype(np.float32)
+    margin = np.array([_walk(trees[0], x) for x in X])  # tree 1 dropped
+    np.testing.assert_allclose(np.asarray(pred(X))[:, 1],
+                               1 / (1 + np.exp(-margin)), atol=1e-5)
+
+
+def test_early_stopping_without_indptr(binary_model):
+    """Older JSON without iteration_indptr: rounds estimated from num_class
+    and num_parallel_tree."""
+
+    model, trees = binary_model
+    model["learner"]["attributes"] = {"best_iteration": "0"}
+    bm = model["learner"]["gradient_booster"]["model"]
+    bm["gbtree_model_param"] = {"num_parallel_tree": "1"}
+    pred = predictor_from_xgboost_json(model)
+    X = np.zeros((4, 3), np.float32)
+    margin = np.array([_walk(trees[0], x) for x in X])
+    np.testing.assert_allclose(np.asarray(pred(X))[:, 1],
+                               1 / (1 + np.exp(-margin)), atol=1e-5)
+
+
+def test_explain_end_to_end_from_json(binary_model):
+    """The parsed predictor drives the full KernelShap pipeline."""
+
+    from distributedkernelshap_tpu import KernelShap
+
+    model, _ = binary_model
+    pred = predictor_from_xgboost_json(model)
+    rng = np.random.default_rng(2)
+    bg = rng.normal(size=(30, 3)).astype(np.float32)
+    Xe = rng.normal(size=(12, 3)).astype(np.float32)
+    ex = KernelShap(pred, link="logit", seed=0)
+    ex.fit(bg)
+    res = ex.explain(Xe, silent=True)
+    proba = np.clip(np.asarray(pred(Xe)), 1e-7, 1 - 1e-7)
+    for k, phi in enumerate(res.shap_values):
+        lhs = phi.sum(axis=1) + res.expected_value[k]
+        rhs = np.log(proba[:, k] / (1 - proba[:, k]))
+        np.testing.assert_allclose(lhs, rhs, atol=5e-3)
