@@ -14,6 +14,10 @@ from distributedkernelshap_tpu.models.trees import (  # noqa: F401
     TreeEnsemblePredictor,
     lift_tree_ensemble,
 )
+from distributedkernelshap_tpu.models.lgbm import (  # noqa: F401
+    lift_lightgbm,
+    predictor_from_lightgbm_dump,
+)
 from distributedkernelshap_tpu.models.xgb import (  # noqa: F401
     lift_xgboost,
     predictor_from_xgboost_json,

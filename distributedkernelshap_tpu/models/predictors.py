@@ -365,12 +365,14 @@ def as_predictor(predictor, example_dim: Optional[int] = None,
     # structural extraction cannot see e.g. a data-dependent GradientBoosting
     # init estimator, whose lifted constant base would be silently wrong
     if example_dim is not None:
+        from distributedkernelshap_tpu.models.lgbm import lift_lightgbm
         from distributedkernelshap_tpu.models.svm import lift_svm
         from distributedkernelshap_tpu.models.trees import lift_tree_ensemble
         from distributedkernelshap_tpu.models.xgb import lift_xgboost
 
         for family, lifter in (("tree ensemble", lift_tree_ensemble),
                                ("XGBoost ensemble", lift_xgboost),
+                               ("LightGBM ensemble", lift_lightgbm),
                                ("SVM", lift_svm),
                                ("MLP", _lift_sklearn_mlp)):
             candidate = lifter(predictor)

@@ -36,7 +36,35 @@ from distributedkernelshap_tpu.models.predictors import BasePredictor
 
 logger = logging.getLogger(__name__)
 
-OUT_TRANSFORMS = ("identity", "binary_sigmoid", "softmax")
+OUT_TRANSFORMS = ("identity", "binary_sigmoid", "sigmoid", "softmax")
+
+
+def f32_le_threshold(t) -> np.ndarray:
+    """Largest float32 ``<=`` each (double) threshold.
+
+    Libraries compare float32 feature values against *double* thresholds;
+    the device compares against float32.  A nearest-cast can round a
+    threshold UP onto a representable data value ``w``, flipping
+    ``w <= t`` (false in double) into ``w <= float32(t)`` (true).  For f32
+    data, ``x <= t  <=>  x <= largest-f32-<=-t``, so round the cast down
+    whenever it overshot.  ``inf`` (leaf padding) is preserved.
+    """
+
+    t64 = np.asarray(t, np.float64)
+    t32 = t64.astype(np.float32)
+    over = t32.astype(np.float64) > t64
+    return np.where(over, np.nextafter(t32, np.float32(-np.inf)), t32).astype(np.float32)
+
+
+def f32_lt_threshold(t) -> np.ndarray:
+    """Largest float32 strictly ``<`` each (double) threshold — the
+    ``x < t  <=>  x <= thr`` conversion for strict-comparison libraries
+    (xgboost)."""
+
+    t64 = np.asarray(t, np.float64)
+    t32 = t64.astype(np.float32)
+    ge = t32.astype(np.float64) >= t64
+    return np.where(ge, np.nextafter(t32, np.float32(-np.inf)), t32).astype(np.float32)
 
 
 class TreeEnsemblePredictor(BasePredictor):
@@ -237,6 +265,8 @@ class TreeEnsemblePredictor(BasePredictor):
         if self.out_transform == "binary_sigmoid":
             p = jax.nn.sigmoid(out[:, 0])
             return jnp.stack([1.0 - p, p], axis=1)
+        if self.out_transform == "sigmoid":
+            return jax.nn.sigmoid(out)
         if self.out_transform == "softmax":
             return jax.nn.softmax(out, axis=-1)
         return out
@@ -296,7 +326,7 @@ def _sklearn_tree_table(tree, k_slot: Optional[int] = None, k_total: int = 1,
     feature = np.where(is_leaf, 0, np.maximum(feature, 0))
     left = np.where(is_leaf, idx, left)
     right = np.where(is_leaf, idx, right)
-    threshold = np.where(is_leaf, np.inf, tree.threshold).astype(np.float32)
+    threshold = f32_le_threshold(np.where(is_leaf, np.inf, tree.threshold))
     raw = tree.value[:, 0, :].astype(np.float64)           # (n_nodes, C)
     if normalise:
         raw = raw / np.clip(raw.sum(axis=1, keepdims=True), 1e-12, None)
@@ -321,7 +351,7 @@ def _hist_tree_table(predictor, k_slot: int, k_total: int) -> Optional[dict]:
     idx = np.arange(n, dtype=np.int32)
     is_leaf = nodes["is_leaf"].astype(bool)
     feature = np.where(is_leaf, 0, nodes["feature_idx"]).astype(np.int32)
-    threshold = np.where(is_leaf, np.inf, nodes["num_threshold"]).astype(np.float32)
+    threshold = f32_le_threshold(np.where(is_leaf, np.inf, nodes["num_threshold"]))
     left = np.where(is_leaf, idx, nodes["left"].astype(np.int32))
     right = np.where(is_leaf, idx, nodes["right"].astype(np.int32))
     value = np.zeros((n, k_total), np.float32)

@@ -43,7 +43,11 @@ from typing import Optional
 
 import numpy as np
 
-from distributedkernelshap_tpu.models.trees import TreeEnsemblePredictor, _finalise
+from distributedkernelshap_tpu.models.trees import (
+    TreeEnsemblePredictor,
+    _finalise,
+    f32_lt_threshold,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -79,15 +83,15 @@ def _xgb_tree_table(tree: dict, k_slot: int, k_total: int) -> Optional[dict]:
     """Node table from one tree of the xgboost JSON model.
 
     xgboost routes left when ``x < t`` (strict) while the shared traversal /
-    path-matmul compares ``x <= t``.  For float32 data and thresholds,
-    ``x < t  <=>  x <= nextafter(t, -inf)``, so thresholds are stepped one
-    ulp down instead of changing the comparator.
+    path-matmul compares ``x <= t``; thresholds are therefore converted to
+    the largest float32 strictly below ``t`` (``f32_lt_threshold``) instead
+    of changing the comparator.
     """
 
     if tree.get("categories") or any(int(s) != 0 for s in tree.get("split_type", [])):
         return None  # categorical splits are not lifted
     feat = np.asarray(tree["split_indices"], dtype=np.int64)
-    cond = np.asarray(tree["split_conditions"], dtype=np.float32)
+    cond = np.asarray(tree["split_conditions"], dtype=np.float64)
     left = np.asarray(tree["left_children"], dtype=np.int64)
     right = np.asarray(tree["right_children"], dtype=np.int64)
     default_left = np.asarray(tree["default_left"], dtype=np.int64).astype(bool)
@@ -95,9 +99,8 @@ def _xgb_tree_table(tree: dict, k_slot: int, k_total: int) -> Optional[dict]:
     idx = np.arange(n, dtype=np.int32)
     is_leaf = left < 0
 
-    threshold = np.where(
-        is_leaf, np.inf,
-        np.nextafter(cond, np.float32(-np.inf), dtype=np.float32)).astype(np.float32)
+    threshold = f32_lt_threshold(np.where(is_leaf, np.inf, cond))
+    threshold = np.where(is_leaf, np.float32(np.inf), threshold)
     value = np.zeros((n, k_total), np.float32)
     value[is_leaf, k_slot] = cond[is_leaf]   # leaf payout lives in split_conditions
     return {

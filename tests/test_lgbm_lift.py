@@ -1,0 +1,187 @@
+"""LightGBM dump_model lifting (models/lgbm.py).
+
+lightgbm is not installed in CI, so the parser is validated against
+hand-constructed ``dump_model()`` dicts (per the documented nested-tree
+structure) and an independent pure-Python walker — mirroring
+``tests/test_xgb_lift.py``.  On machines with lightgbm installed, lifts are
+additionally probe-verified in ``as_predictor``.
+"""
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu.models import predictor_from_lightgbm_dump
+
+
+def _leaf(v):
+    return {"leaf_value": v}
+
+
+def _split(feat, thr, left, right, default_left=True, decision_type="<="):
+    return {"split_feature": feat, "threshold": thr, "decision_type": decision_type,
+            "default_left": default_left, "left_child": left, "right_child": right}
+
+
+def _dump(roots, objective, num_class=1, average_output=False):
+    return {"objective": objective, "num_class": num_class,
+            "average_output": average_output,
+            "tree_info": [{"tree_structure": r} for r in roots]}
+
+
+def _walk(node, x):
+    while "leaf_value" not in node:
+        v = x[node["split_feature"]]
+        if np.isnan(v):
+            go_left = node["default_left"]
+        else:
+            go_left = v <= node["threshold"]
+        node = node["left_child"] if go_left else node["right_child"]
+    return node["leaf_value"]
+
+
+@pytest.fixture
+def binary_roots():
+    r0 = _split(0, 0.5,
+                _split(1, -1.0, _leaf(0.3), _leaf(-0.7), default_left=False),
+                _split(2, 2.0, _leaf(1.1), _leaf(-0.2)))
+    r1 = _split(2, 1.5, _leaf(0.25), _leaf(-0.4))
+    return [r0, r1]
+
+
+def test_binary(binary_roots):
+    pred = predictor_from_lightgbm_dump(_dump(binary_roots, "binary sigmoid:1"))
+    assert pred is not None and pred.n_outputs == 2
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(64, 3)).astype(np.float32)
+    margin = np.array([sum(_walk(r, x) for r in binary_roots) for x in X])
+    np.testing.assert_allclose(np.asarray(pred(X))[:, 1],
+                               1 / (1 + np.exp(-margin)), atol=1e-5)
+
+
+def test_boundary_goes_left(binary_roots):
+    """LightGBM routes x <= t left (inclusive) — exactly our comparator."""
+
+    pred = predictor_from_lightgbm_dump(_dump(binary_roots, "binary"))
+    x = np.array([[0.5, -1.0, 1.5]], np.float32)    # every value AT a threshold
+    margin = sum(_walk(r, x[0]) for r in binary_roots)
+    np.testing.assert_allclose(np.asarray(pred(x))[0, 1],
+                               1 / (1 + np.exp(-margin)), atol=1e-5)
+
+
+def test_missing_routing(binary_roots):
+    pred = predictor_from_lightgbm_dump(_dump(binary_roots, "binary"))
+    X = np.array([[np.nan, 0.0, 0.0], [1.0, np.nan, np.nan]], np.float32)
+    margin = np.array([sum(_walk(r, x) for r in binary_roots) for x in X])
+    np.testing.assert_allclose(np.asarray(pred(X))[:, 1],
+                               1 / (1 + np.exp(-margin)), atol=1e-5)
+
+
+def test_multiclass_iteration_major():
+    """num_class=3: tree i feeds class i % 3 (iteration-major dump order)."""
+
+    roots = [_split(0, 0.0, _leaf(0.1 * (i + 1)), _leaf(-0.2 * (i + 1)))
+             for i in range(6)]                      # 2 rounds x 3 classes
+    pred = predictor_from_lightgbm_dump(_dump(roots, "multiclass num_class:3",
+                                              num_class=3))
+    assert pred.n_outputs == 3
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(32, 1)).astype(np.float32)
+    margins = np.stack([[sum(_walk(roots[r * 3 + k], x) for r in range(2))
+                         for k in range(3)] for x in X])
+    e = np.exp(margins - margins.max(1, keepdims=True))
+    np.testing.assert_allclose(np.asarray(pred(X)), e / e.sum(1, keepdims=True),
+                               atol=1e-5)
+
+
+def test_regression_identity_and_rf_average():
+    roots = [_split(0, 0.0, _leaf(2.0), _leaf(4.0)),
+             _split(0, 1.0, _leaf(-1.0), _leaf(3.0))]
+    summed = predictor_from_lightgbm_dump(_dump(roots, "regression"))
+    averaged = predictor_from_lightgbm_dump(_dump(roots, "regression",
+                                                  average_output=True))
+    x = np.array([[0.5]], np.float32)
+    np.testing.assert_allclose(np.asarray(summed(x))[0, 0], 4.0 - 1.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(averaged(x))[0, 0], (4.0 - 1.0) / 2,
+                               atol=1e-6)
+    assert not summed.vector_out
+
+
+def test_threshold_rounds_down_not_nearest():
+    """A double threshold half-an-ulp below an f32 value must not round up
+    onto it: x == 1.0 with t = 1 - 1e-12 goes RIGHT in LightGBM's double
+    compare and must go right on the device too."""
+
+    t = 1.0 - 1e-12
+    assert np.float32(t) == np.float32(1.0)          # nearest-cast overshoots
+    roots = [_split(0, t, _leaf(10.0), _leaf(-10.0))]
+    pred = predictor_from_lightgbm_dump(_dump(roots, "regression"))
+    got = np.asarray(pred(np.array([[1.0], [0.999999]], np.float32)))
+    np.testing.assert_allclose(got[:, 0], [-10.0, 10.0], atol=1e-6)
+
+
+def test_linear_tree_declines():
+    leaf = {"leaf_value": 0.5, "leaf_coeff": [0.1], "leaf_const": 0.2,
+            "leaf_features": [0]}
+    roots = [_split(0, 0.0, leaf, _leaf(-0.5))]
+    assert predictor_from_lightgbm_dump(_dump(roots, "regression")) is None
+
+
+def test_multiclass_rf_average_declines():
+    roots = [_split(0, 0.0, _leaf(0.1), _leaf(-0.1)) for _ in range(6)]
+    assert predictor_from_lightgbm_dump(
+        _dump(roots, "multiclass", num_class=3, average_output=True)) is None
+
+
+def test_binary_as_scalar_matches_raw_booster_layout(binary_roots):
+    """Raw Booster.predict returns one probability column for binary
+    objectives; binary_as_scalar reproduces that layout."""
+
+    pred = predictor_from_lightgbm_dump(_dump(binary_roots, "binary"),
+                                        binary_as_scalar=True)
+    assert pred.n_outputs == 1 and not pred.vector_out
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(16, 3)).astype(np.float32)
+    margin = np.array([sum(_walk(r, x) for r in binary_roots) for x in X])
+    np.testing.assert_allclose(np.asarray(pred(X))[:, 0],
+                               1 / (1 + np.exp(-margin)), atol=1e-5)
+
+
+def test_categorical_split_declines(binary_roots):
+    roots = [_split(0, 0.5, _leaf(1.0), _leaf(-1.0), decision_type="==")]
+    assert predictor_from_lightgbm_dump(_dump(roots, "binary")) is None
+
+
+def test_link_objectives_decline():
+    roots = [_leaf(0.5)]
+    for obj in ("poisson", "gamma", "tweedie", "cross_entropy", "multiclassova"):
+        assert predictor_from_lightgbm_dump(_dump(roots, obj)) is None
+
+
+def test_single_leaf_tree():
+    pred = predictor_from_lightgbm_dump(_dump([_leaf(1.25)], "regression"))
+    np.testing.assert_allclose(np.asarray(pred(np.zeros((2, 1), np.float32)))[:, 0],
+                               [1.25, 1.25], atol=1e-6)
+
+
+def test_malformed_dump_declines():
+    assert predictor_from_lightgbm_dump({}) is None
+    assert predictor_from_lightgbm_dump({"objective": "binary"}) is None
+    assert predictor_from_lightgbm_dump(
+        {"objective": "binary", "tree_info": [{"tree_structure": {"bogus": 1}}]}) is None
+
+
+def test_explain_end_to_end_from_dump(binary_roots):
+    from distributedkernelshap_tpu import KernelShap
+
+    pred = predictor_from_lightgbm_dump(_dump(binary_roots, "binary"))
+    rng = np.random.default_rng(2)
+    bg = rng.normal(size=(30, 3)).astype(np.float32)
+    Xe = rng.normal(size=(12, 3)).astype(np.float32)
+    ex = KernelShap(pred, link="logit", seed=0)
+    ex.fit(bg)
+    res = ex.explain(Xe, silent=True)
+    proba = np.clip(np.asarray(pred(Xe)), 1e-7, 1 - 1e-7)
+    for k, phi in enumerate(res.shap_values):
+        lhs = phi.sum(axis=1) + res.expected_value[k]
+        rhs = np.log(proba[:, k] / (1 - proba[:, k]))
+        np.testing.assert_allclose(lhs, rhs, atol=5e-3)

@@ -37,12 +37,20 @@ def reg_data():
 
 
 def _assert_matches(method, X, atol=2e-5):
+    """The lift contract: on f32-representable inputs (all the device ever
+    sees — the explain pipeline synthesises masked data in f32), the lifted
+    predictor reproduces the library's own outputs.  Unquantised f64 rows
+    falling inside the half-ulp between an f32 value and a double threshold
+    are inherent input-quantisation error, not lift error, so the comparison
+    quantises first."""
+
     lifted = lift_tree_ensemble(method)
     assert lifted is not None, f"{method} did not lift"
-    expected = np.asarray(method(X), dtype=np.float64)
+    Xq = X.astype(np.float32)
+    expected = np.asarray(method(Xq.astype(np.float64)), dtype=np.float64)
     if expected.ndim == 1:
         expected = expected[:, None]
-    got = np.asarray(lifted(X.astype(np.float32)), dtype=np.float64)
+    got = np.asarray(lifted(Xq), dtype=np.float64)
     scale = max(1.0, np.abs(expected).max())
     np.testing.assert_allclose(got, expected, atol=atol * scale)
     return lifted
@@ -298,6 +306,39 @@ def test_tree_predictor_coalition_parallel(clf_data):
     sv = dist.get_explanation(Xe, nsamples=64)
     np.testing.assert_allclose(sv[0], sv_seq[0], atol=1e-4)
     np.testing.assert_allclose(sv[1], sv_seq[1], atol=1e-4)
+
+
+def test_f32_threshold_casts():
+    """f32_le_threshold: largest f32 <= t. f32_lt_threshold: largest f32 < t.
+    Nearest-casting can overshoot a double threshold onto a representable
+    data value and flip the comparison — these must never."""
+
+    from distributedkernelshap_tpu.models.trees import (
+        f32_le_threshold,
+        f32_lt_threshold,
+    )
+
+    one_minus = np.nextafter(np.float32(1.0), np.float32(-np.inf))
+    cases_le = [
+        (1.0, np.float32(1.0)),            # exactly representable: keep
+        (1.0 - 1e-12, one_minus),          # nearest rounds up: step down
+        (1.0 + 1e-12, np.float32(1.0)),    # nearest rounds down: keep
+        (np.inf, np.float32(np.inf)),      # leaf padding survives
+    ]
+    for t, want in cases_le:
+        got = f32_le_threshold(np.asarray([t]))[0]
+        assert got == want, (t, got, want)
+        if np.isfinite(t):
+            assert np.float64(got) <= t < np.float64(np.nextafter(got, np.float32(np.inf)))
+    cases_lt = [
+        (1.0, one_minus),                  # strict: 1.0 itself must fail x < 1
+        (1.0 - 1e-12, one_minus),
+        (1.0 + 1e-12, np.float32(1.0)),    # 1.0 < t holds
+    ]
+    for t, want in cases_lt:
+        got = f32_lt_threshold(np.asarray([t]))[0]
+        assert got == want, (t, got, want)
+        assert np.float64(got) < t <= np.float64(np.nextafter(got, np.float32(np.inf)))
 
 
 def test_deep_tree_padding(reg_data):

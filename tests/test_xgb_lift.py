@@ -100,6 +100,24 @@ def test_strict_less_than_boundary(binary_model):
     np.testing.assert_allclose(got[0, 1], 1 / (1 + np.exp(-margin)), atol=1e-5)
 
 
+def test_threshold_cast_rounds_strictly_below():
+    """The x < t conversion must yield the largest f32 strictly below t even
+    when the nearest f32 cast lands BELOW t already (no double-step) or
+    ABOVE t (step down)."""
+
+    for t, probe, expect_left in [
+        (1.0 - 1e-12, 1.0, False),        # cast overshoots up; 1.0 !< t
+        (1.0 + 1e-12, 1.0, True),         # cast undershoots; 1.0 < t
+        (1.0, np.float32(np.nextafter(np.float32(1.0), np.float32(-np.inf))), True),
+        (1.0, 1.0, False),                # boundary: 1.0 !< 1.0
+    ]:
+        tree = _tree([0, 0, 0], [t, 10.0, -10.0], [1, -1, -1], [2, -1, -1],
+                     [0, 0, 0])
+        pred = predictor_from_xgboost_json(_model([tree], "reg:squarederror", 0.0))
+        got = float(np.asarray(pred(np.array([[probe]], np.float32)))[0, 0])
+        assert got == (10.0 if expect_left else -10.0), (t, probe, got)
+
+
 def test_missing_value_routing(binary_model):
     model, trees = binary_model
     pred = predictor_from_xgboost_json(model)
