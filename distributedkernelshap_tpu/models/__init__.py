@@ -14,6 +14,11 @@ from distributedkernelshap_tpu.models.trees import (  # noqa: F401
     TreeEnsemblePredictor,
     lift_tree_ensemble,
 )
+from distributedkernelshap_tpu.models.compose import (  # noqa: F401
+    CalibratedBinaryPredictor,
+    MeanEnsemblePredictor,
+    PipelinePredictor,
+)
 from distributedkernelshap_tpu.models.lgbm import (  # noqa: F401
     lift_lightgbm,
     predictor_from_lightgbm_dump,

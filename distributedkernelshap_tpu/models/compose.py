@@ -1,0 +1,321 @@
+"""Composite sklearn estimators lifted onto the device.
+
+The family lifts (linear / trees / XGBoost / LightGBM / SVM / MLP) cover
+single estimators; real sklearn models are usually *compositions* of those —
+a ``Pipeline`` with scaling in front, a soft ``VotingClassifier``, or a
+``CalibratedClassifierCV`` (the recommended replacement for the deprecated
+``SVC(probability=True)``).  This module lifts the composition itself by
+recursively lifting the members through
+``predictors.structural_lift`` and stitching them together with device ops:
+
+* ``PipelinePredictor`` — a chain of picklable transform stages
+  (elementwise-affine scalers, NaN imputation, linear projections like PCA)
+  applied before an inner predictor;
+* ``MeanEnsemblePredictor`` — weighted mean of member outputs
+  (soft voting, cv-ensembled calibration);
+* ``CalibratedBinaryPredictor`` — a margin model followed by sigmoid
+  (``1/(1+exp(a·f+b))``) or isotonic (``jnp.interp`` over the fitted
+  thresholds — sklearn's own interpolation) calibration.
+
+Everything lifted here is still numerically probe-gated as one composite in
+``as_predictor`` before being trusted; any unrecognised step declines the
+whole composition to the host paths.
+"""
+
+import logging
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributedkernelshap_tpu.models.predictors import BasePredictor
+
+logger = logging.getLogger(__name__)
+
+# transform stages are (kind, *param-arrays) tuples — picklable, no closures
+Stage = Tuple
+
+
+def _apply_stage(stage: Stage, X):
+    kind = stage[0]
+    if kind == "affine":                  # x * a + b (elementwise per column)
+        return X * stage[1][None, :] + stage[2][None, :]
+    if kind == "linear":                  # x @ W + b (PCA / TruncatedSVD)
+        return X @ stage[1] + stage[2][None, :]
+    if kind == "impute":                  # NaN -> fitted statistics
+        return jnp.where(jnp.isnan(X), stage[1][None, :], X)
+    if kind == "clip":                    # MinMaxScaler(clip=True)
+        return jnp.clip(X, stage[1], stage[2])
+    raise ValueError(f"unknown stage kind {kind!r}")
+
+
+def _lift_transformer(tf) -> Optional[Stage]:
+    """One fitted preprocessing step -> a device stage, or None."""
+
+    name = type(tf).__name__
+    try:
+        if name == "StandardScaler":
+            d = tf.n_features_in_
+            mean = np.asarray(tf.mean_) if tf.with_mean else np.zeros(d)
+            scale = np.asarray(tf.scale_) if tf.with_std else np.ones(d)
+            return ("affine", jnp.asarray(1.0 / scale, jnp.float32),
+                    jnp.asarray(-mean / scale, jnp.float32))
+        if name == "MinMaxScaler":
+            stage = ("affine", jnp.asarray(tf.scale_, jnp.float32),
+                     jnp.asarray(tf.min_, jnp.float32))
+            if getattr(tf, "clip", False):
+                lo, hi = tf.feature_range
+                return [stage, ("clip", jnp.float32(lo), jnp.float32(hi))]
+            return stage
+        if name == "MaxAbsScaler":
+            return ("affine", jnp.asarray(1.0 / np.asarray(tf.scale_), jnp.float32),
+                    jnp.zeros(tf.n_features_in_, jnp.float32))
+        if name == "RobustScaler":
+            d = tf.n_features_in_
+            center = np.asarray(tf.center_) if tf.with_centering else np.zeros(d)
+            scale = np.asarray(tf.scale_) if tf.with_scaling else np.ones(d)
+            return ("affine", jnp.asarray(1.0 / scale, jnp.float32),
+                    jnp.asarray(-center / scale, jnp.float32))
+        if name == "SimpleImputer":
+            mv = getattr(tf, "missing_values", np.nan)
+            if not (isinstance(mv, float) and np.isnan(mv)):
+                return None           # only NaN-as-missing is reproduced
+            if getattr(tf, "add_indicator", False):
+                return None           # appends indicator columns
+            return ("impute", jnp.asarray(tf.statistics_, jnp.float32))
+        if name == "PCA":
+            W = np.asarray(tf.components_).T            # (D, C)
+            if getattr(tf, "whiten", False):
+                W = W / np.sqrt(np.asarray(tf.explained_variance_))[None, :]
+            b = -np.asarray(tf.mean_) @ W
+            return ("linear", jnp.asarray(W, jnp.float32), jnp.asarray(b, jnp.float32))
+        if name == "TruncatedSVD":
+            W = np.asarray(tf.components_).T
+            return ("linear", jnp.asarray(W, jnp.float32),
+                    jnp.zeros(W.shape[1], jnp.float32))
+    except Exception as exc:
+        logger.info("transformer %s lift failed (%s)", name, exc)
+    return None
+
+
+def _compose_linear(stages: Sequence[Stage], inner: BasePredictor):
+    """Fold all-affine/linear stages into an inner ``LinearPredictor``.
+
+    ``Pipeline(StandardScaler, LogisticRegression)`` is algebraically one
+    generalised linear model; folding it recovers the explain kernel's
+    ``linear_decomposition`` MXU fast path (the three-einsum collapse of the
+    ``B×S×N×D`` synthetic tensor), which a generic ``PipelinePredictor``
+    wrapper would forfeit.  Returns None when any stage is non-affine
+    (impute/clip) or the inner model is not linear.
+    """
+
+    from distributedkernelshap_tpu.models.predictors import LinearPredictor
+
+    decomp = inner.linear_decomposition
+    if decomp is None or any(s[0] not in ("affine", "linear") for s in stages):
+        return None
+    W_in, b_in, activation = decomp
+    D = None
+    for s in stages:                       # input dim of the first stage
+        D = s[1].shape[0]
+        break
+    if D is None:
+        D = W_in.shape[0]
+    M = np.eye(D, dtype=np.float64)        # cumulative x -> x@M + v
+    v = np.zeros(D, dtype=np.float64)
+    for s in stages:
+        if s[0] == "affine":
+            a, b = np.asarray(s[1], np.float64), np.asarray(s[2], np.float64)
+            M = M * a[None, :]
+            v = v * a + b
+        else:                              # linear
+            W, b = np.asarray(s[1], np.float64), np.asarray(s[2], np.float64)
+            M = M @ W
+            v = v @ W + b
+    W64 = np.asarray(W_in, np.float64)
+    b64 = np.asarray(b_in, np.float64)
+    return LinearPredictor(M @ W64, v @ W64 + b64, activation=activation,
+                           vector_out=inner.vector_out)
+
+
+class PipelinePredictor(BasePredictor):
+    """Device transform stages applied before an inner predictor."""
+
+    def __init__(self, stages: Sequence[Stage], inner: BasePredictor):
+        self.stages = list(stages)
+        self.inner = inner
+        self.n_outputs = inner.n_outputs
+        self.vector_out = inner.vector_out
+
+    def __call__(self, X):
+        X = jnp.asarray(X, jnp.float32)
+        for stage in self.stages:
+            X = _apply_stage(stage, X)
+        return self.inner(X)
+
+
+class MeanEnsemblePredictor(BasePredictor):
+    """Weighted mean of member predictor outputs (soft voting)."""
+
+    def __init__(self, members: Sequence[BasePredictor], weights=None):
+        if not members:
+            raise ValueError("MeanEnsemblePredictor needs at least one member")
+        self.members = list(members)
+        k = members[0].n_outputs
+        if any(m.n_outputs != k for m in members):
+            raise ValueError("members disagree on n_outputs")
+        w = np.ones(len(members)) if weights is None else np.asarray(weights, np.float64)
+        self.weights = jnp.asarray(w / w.sum(), jnp.float32)
+        self.n_outputs = k
+        self.vector_out = members[0].vector_out
+
+    def __call__(self, X):
+        X = jnp.asarray(X, jnp.float32)
+        outs = jnp.stack([m(X) for m in self.members])      # (M, n, K)
+        return jnp.einsum("mnk,m->nk", outs, self.weights)
+
+
+class CalibratedBinaryPredictor(BasePredictor):
+    """Binary probability calibration over a lifted margin model.
+
+    ``inner`` produces either a margin column (``decision_function`` lifts)
+    or a 2-class proba (``predict_proba`` lifts — the positive column feeds
+    the calibrator, sklearn's ``_get_response_values`` convention).
+    """
+
+    n_outputs = 2
+    vector_out = True
+
+    def __init__(self, inner: BasePredictor, kind: str, params):
+        self.inner = inner
+        if kind == "sigmoid":
+            self.kind = "sigmoid"
+            self.a = float(params[0])
+            self.b = float(params[1])
+        elif kind == "isotonic":
+            self.kind = "isotonic"
+            self.xs = jnp.asarray(params[0], jnp.float32)
+            self.ys = jnp.asarray(params[1], jnp.float32)
+        else:
+            raise ValueError(f"unknown calibration kind {kind!r}")
+
+    def __call__(self, X):
+        f = self.inner(jnp.asarray(X, jnp.float32))
+        f = f[:, -1] if self.inner.n_outputs > 1 else f[:, 0]
+        if self.kind == "sigmoid":
+            p1 = jax.nn.sigmoid(-(self.a * f + self.b))
+        else:
+            p1 = jnp.interp(f, self.xs, self.ys)
+        return jnp.stack([1.0 - p1, p1], axis=1)
+
+
+def _inner_lift(estimator, method_names) -> Optional[BasePredictor]:
+    """Recursively lift a member estimator through the first of its
+    ``method_names`` that exists and lifts."""
+
+    from distributedkernelshap_tpu.models.predictors import structural_lift
+
+    for mname in method_names:
+        method = getattr(estimator, mname, None)
+        if method is None:
+            continue
+        inner = structural_lift(method)
+        if inner is not None:
+            return inner
+    return None
+
+
+def lift_pipeline(method) -> Optional[BasePredictor]:
+    """Lift ``Pipeline.predict/predict_proba/decision_function`` when every
+    preprocessing step and the final estimator lift."""
+
+    owner = getattr(method, "__self__", None)
+    name = getattr(method, "__name__", "")
+    if owner is None or type(owner).__name__ != "Pipeline" \
+            or name not in ("predict", "predict_proba", "decision_function"):
+        return None
+    try:
+        steps = list(owner.steps)
+    except Exception:
+        return None
+    stages: List[Stage] = []
+    for _, tf in steps[:-1]:
+        if tf is None or tf == "passthrough":
+            continue
+        stage = _lift_transformer(tf)
+        if stage is None:
+            logger.info("pipeline step %s is not lifted; using host path",
+                        type(tf).__name__)
+            return None
+        stages.extend(stage if isinstance(stage, list) else [stage])
+    inner = _inner_lift(steps[-1][1], (name,))
+    if inner is None:
+        return None
+    composed = _compose_linear(stages, inner)
+    return composed if composed is not None else PipelinePredictor(stages, inner)
+
+
+def lift_voting(method) -> Optional[BasePredictor]:
+    """Lift soft ``VotingClassifier.predict_proba`` /
+    ``VotingRegressor.predict`` when every member lifts."""
+
+    owner = getattr(method, "__self__", None)
+    name = getattr(method, "__name__", "")
+    if owner is None:
+        return None
+    cls = type(owner).__name__
+    try:
+        if cls == "VotingClassifier" and name == "predict_proba":
+            if owner.voting != "soft":
+                return None   # hard voting is a discontinuous argmax-of-modes
+            members = [_inner_lift(e, ("predict_proba",)) for e in owner.estimators_]
+        elif cls == "VotingRegressor" and name == "predict":
+            members = [_inner_lift(e, ("predict",)) for e in owner.estimators_]
+        else:
+            return None
+        if any(m is None for m in members):
+            return None
+        # sklearn pairs weights with NON-dropped estimators only
+        # (_weights_not_none); estimators_ already excludes 'drop' members
+        weights = owner._weights_not_none
+        return MeanEnsemblePredictor(members, weights=weights)
+    except Exception as exc:
+        logger.info("voting lift failed structurally (%s); using host path", exc)
+        return None
+
+
+def lift_calibrated(method) -> Optional[BasePredictor]:
+    """Lift binary ``CalibratedClassifierCV.predict_proba``: per-fold base
+    model + sigmoid/isotonic calibrator, averaged over folds."""
+
+    owner = getattr(method, "__self__", None)
+    name = getattr(method, "__name__", "")
+    if owner is None or type(owner).__name__ != "CalibratedClassifierCV" \
+            or name != "predict_proba":
+        return None
+    try:
+        if len(owner.classes_) != 2:
+            return None   # multiclass OvR normalisation not reproduced
+        folds = []
+        for cc in owner.calibrated_classifiers_:
+            base = getattr(cc, "estimator", None) or getattr(cc, "base_estimator", None)
+            inner = _inner_lift(base, ("decision_function", "predict_proba"))
+            if inner is None or len(cc.calibrators) != 1:
+                return None
+            cal = cc.calibrators[0]
+            cname = type(cal).__name__
+            if cname == "_SigmoidCalibration":
+                folds.append(CalibratedBinaryPredictor(inner, "sigmoid",
+                                                       (cal.a_, cal.b_)))
+            elif cname == "IsotonicRegression":
+                folds.append(CalibratedBinaryPredictor(
+                    inner, "isotonic", (cal.X_thresholds_, cal.y_thresholds_)))
+            else:
+                return None
+        if not folds:
+            return None
+        return folds[0] if len(folds) == 1 else MeanEnsemblePredictor(folds)
+    except Exception as exc:
+        logger.info("calibration lift failed structurally (%s); using host path", exc)
+        return None

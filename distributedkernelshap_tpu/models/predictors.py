@@ -330,8 +330,12 @@ def _lift_is_faithful(lifted: LinearPredictor, method, example_dim: int,
         return False
     # full f32 matmul for the probe: TPU defaults to bfloat16 passes, whose
     # ~1e-3 error would falsely reject an exact lift
-    with jax.default_matmul_precision("highest"):
-        got = np.asarray(lifted(jnp.asarray(probe)))
+    try:
+        with jax.default_matmul_precision("highest"):
+            got = np.asarray(lifted(jnp.asarray(probe)))
+    except Exception:
+        # structurally mismatched lift (shape errors etc.): reject, fall back
+        return False
     if expected.ndim == 1:
         expected = expected[:, None]
     if expected.shape != got.shape:
@@ -340,6 +344,47 @@ def _lift_is_faithful(lifted: LinearPredictor, method, example_dim: int,
     # legitimately deviates by more than an absolute 1e-4
     scale = max(1.0, float(np.abs(expected).max()))
     return bool(np.abs(expected - got).max() < tol * scale)
+
+
+def _nonlinear_lifters():
+    """(family name, lifter) pairs for every structural lift beyond the
+    plain linear one — single estimators first, then compositions (which
+    recurse through :func:`structural_lift` for their members)."""
+
+    from distributedkernelshap_tpu.models.compose import (
+        lift_calibrated,
+        lift_pipeline,
+        lift_voting,
+    )
+    from distributedkernelshap_tpu.models.lgbm import lift_lightgbm
+    from distributedkernelshap_tpu.models.svm import lift_svm
+    from distributedkernelshap_tpu.models.trees import lift_tree_ensemble
+    from distributedkernelshap_tpu.models.xgb import lift_xgboost
+
+    return (("tree ensemble", lift_tree_ensemble),
+            ("XGBoost ensemble", lift_xgboost),
+            ("LightGBM ensemble", lift_lightgbm),
+            ("SVM", lift_svm),
+            ("MLP", _lift_sklearn_mlp),
+            ("pipeline", lift_pipeline),
+            ("voting ensemble", lift_voting),
+            ("calibrated classifier", lift_calibrated))
+
+
+def structural_lift(method) -> Optional[BasePredictor]:
+    """Structure-only lift of a bound estimator method across every family,
+    with NO numerical verification — used by composite lifts
+    (``models/compose.py``) to lift member estimators; the composite as a
+    whole is probe-gated in :func:`as_predictor`."""
+
+    lifted = _lift_sklearn(method)
+    if lifted is not None:
+        return lifted
+    for _, lifter in _nonlinear_lifters():
+        candidate = lifter(method)
+        if candidate is not None:
+            return candidate
+    return None
 
 
 def as_predictor(predictor, example_dim: Optional[int] = None,
@@ -361,20 +406,12 @@ def as_predictor(predictor, example_dim: Optional[int] = None,
         )
         lifted = None
 
-    # tree/SVM/MLP lifts are only trusted when the numerical probe can run:
-    # structural extraction cannot see e.g. a data-dependent GradientBoosting
-    # init estimator, whose lifted constant base would be silently wrong
+    # non-linear / composite lifts are only trusted when the numerical probe
+    # can run: structural extraction cannot see e.g. a data-dependent
+    # GradientBoosting init estimator, whose lifted constant base would be
+    # silently wrong
     if example_dim is not None:
-        from distributedkernelshap_tpu.models.lgbm import lift_lightgbm
-        from distributedkernelshap_tpu.models.svm import lift_svm
-        from distributedkernelshap_tpu.models.trees import lift_tree_ensemble
-        from distributedkernelshap_tpu.models.xgb import lift_xgboost
-
-        for family, lifter in (("tree ensemble", lift_tree_ensemble),
-                               ("XGBoost ensemble", lift_xgboost),
-                               ("LightGBM ensemble", lift_lightgbm),
-                               ("SVM", lift_svm),
-                               ("MLP", _lift_sklearn_mlp)):
+        for family, lifter in _nonlinear_lifters():
             candidate = lifter(predictor)
             if candidate is None:
                 continue

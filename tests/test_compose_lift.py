@@ -1,0 +1,252 @@
+"""Composite-estimator lifting (models/compose.py): Pipelines, soft voting,
+and CalibratedClassifierCV, each verified against sklearn's own outputs on
+f32-representable inputs and through the full explain pipeline."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu.models import (
+    CalibratedBinaryPredictor,
+    CallbackPredictor,
+    MeanEnsemblePredictor,
+    PipelinePredictor,
+    as_predictor,
+)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(21)
+    X = (rng.normal(size=(300, 6)) * np.array([1, 5, 0.2, 3, 1, 10])
+         + np.array([0, 2, -1, 0, 4, -3]))
+    y = (X[:, 0] + 0.3 * X[:, 1] - 0.05 * X[:, 5] > 1).astype(int)
+    yr = X[:, 0] * 2.0 - X[:, 3] + rng.normal(size=300)
+    return X, y, yr
+
+
+def _quant(X):
+    return X.astype(np.float32).astype(np.float64)
+
+
+def _check(pred, method, X, atol=5e-5):
+    Xq = _quant(X)
+    expected = np.asarray(method(Xq), dtype=np.float64)
+    if expected.ndim == 1:
+        expected = expected[:, None]
+    got = np.asarray(pred(Xq.astype(np.float32)), dtype=np.float64)
+    scale = max(1.0, np.abs(expected).max())
+    np.testing.assert_allclose(got, expected, atol=atol * scale)
+
+
+@pytest.mark.parametrize("scaler_name", ["standard", "minmax", "maxabs", "robust"])
+def test_pipeline_scaler_plus_lr(data, scaler_name):
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.pipeline import Pipeline
+    from sklearn.preprocessing import (
+        MaxAbsScaler,
+        MinMaxScaler,
+        RobustScaler,
+        StandardScaler,
+    )
+
+    from distributedkernelshap_tpu.models import LinearPredictor
+
+    scaler = {"standard": StandardScaler(), "minmax": MinMaxScaler(),
+              "maxabs": MaxAbsScaler(), "robust": RobustScaler()}[scaler_name]
+    X, y, _ = data
+    pipe = Pipeline([("sc", scaler), ("lr", LogisticRegression())]).fit(X, y)
+    pred = as_predictor(pipe.predict_proba, example_dim=X.shape[1])
+    # affine + linear folds into ONE LinearPredictor -> MXU einsum fast path
+    assert isinstance(pred, LinearPredictor)
+    _check(pred, pipe.predict_proba, X[:64])
+
+
+def test_pipeline_pca_then_svm(data):
+    from sklearn.decomposition import PCA
+    from sklearn.pipeline import Pipeline
+    from sklearn.svm import SVC
+
+    X, y, _ = data
+    pipe = Pipeline([("sc", __import__("sklearn.preprocessing", fromlist=["StandardScaler"]).StandardScaler()),
+                     ("pca", PCA(n_components=4)),
+                     ("svc", SVC(kernel="rbf"))]).fit(X, y)
+    pred = as_predictor(pipe.decision_function, example_dim=X.shape[1])
+    assert isinstance(pred, PipelinePredictor)
+    _check(pred, pipe.decision_function, X[:64])
+
+
+def test_pipeline_imputer(data):
+    from sklearn.impute import SimpleImputer
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.pipeline import Pipeline
+
+    X, y, _ = data
+    Xm = X.copy()
+    Xm[::5, 1] = np.nan
+    pipe = Pipeline([("imp", SimpleImputer(strategy="median")),
+                     ("lr", LogisticRegression())]).fit(Xm, y)
+    pred = as_predictor(pipe.predict_proba, example_dim=X.shape[1])
+    assert isinstance(pred, PipelinePredictor)
+    _check(pred, pipe.predict_proba, Xm[:64])
+
+
+def test_pipeline_whitened_pca_regressor(data):
+    from sklearn.decomposition import PCA
+    from sklearn.linear_model import LinearRegression
+    from sklearn.pipeline import Pipeline
+
+    X, _, yr = data
+    from distributedkernelshap_tpu.models import LinearPredictor
+
+    pipe = Pipeline([("pca", PCA(n_components=5, whiten=True)),
+                     ("lin", LinearRegression())]).fit(X, yr)
+    pred = as_predictor(pipe.predict, example_dim=X.shape[1])
+    assert isinstance(pred, LinearPredictor)   # linear ∘ linear folds
+    _check(pred, pipe.predict, X[:64])
+
+
+def test_minmax_clip_is_reproduced(data):
+    """MinMaxScaler(clip=True) must clip out-of-range inputs like sklearn —
+    including values beyond the fitted range, which the probe never sees."""
+
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.pipeline import Pipeline
+    from sklearn.preprocessing import MinMaxScaler
+
+    X, y, _ = data
+    pipe = Pipeline([("sc", MinMaxScaler(clip=True)),
+                     ("lr", LogisticRegression())]).fit(X, y)
+    pred = as_predictor(pipe.predict_proba, example_dim=X.shape[1])
+    assert isinstance(pred, PipelinePredictor)  # clip stage blocks folding
+    X_ood = X[:16] * 25.0 + 40.0                 # far outside the fitted range
+    _check(pred, pipe.predict_proba, X_ood)
+
+
+def test_voting_with_dropped_member(data):
+    """weights pair with NON-dropped members (sklearn _weights_not_none)."""
+
+    from sklearn.ensemble import VotingClassifier
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.tree import DecisionTreeClassifier
+
+    X, y, _ = data
+    clf = VotingClassifier(
+        [("lr", LogisticRegression()), ("drop_me", "drop"),
+         ("dt", DecisionTreeClassifier(max_depth=3, random_state=0))],
+        voting="soft", weights=[2.0, 5.0, 1.0]).fit(X, y)
+    pred = as_predictor(clf.predict_proba, example_dim=X.shape[1])
+    assert isinstance(pred, MeanEnsemblePredictor)
+    assert len(pred.members) == 2
+    _check(pred, clf.predict_proba, X[:64])
+
+
+def test_pipeline_unknown_step_falls_back(data):
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.pipeline import Pipeline
+    from sklearn.preprocessing import Normalizer
+
+    X, y, _ = data
+    pipe = Pipeline([("norm", Normalizer()),        # row-dependent: not lifted
+                     ("lr", LogisticRegression())]).fit(X, y)
+    pred = as_predictor(pipe.predict_proba, example_dim=X.shape[1])
+    assert isinstance(pred, CallbackPredictor)
+
+
+def test_voting_soft(data):
+    from sklearn.ensemble import GradientBoostingClassifier, VotingClassifier
+    from sklearn.linear_model import LogisticRegression
+
+    X, y, _ = data
+    clf = VotingClassifier(
+        [("lr", LogisticRegression()),
+         ("gb", GradientBoostingClassifier(n_estimators=10, random_state=0))],
+        voting="soft", weights=[2.0, 1.0]).fit(X, y)
+    pred = as_predictor(clf.predict_proba, example_dim=X.shape[1])
+    assert isinstance(pred, MeanEnsemblePredictor)
+    _check(pred, clf.predict_proba, X[:64])
+
+
+def test_voting_hard_falls_back(data):
+    from sklearn.ensemble import VotingClassifier
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.tree import DecisionTreeClassifier
+
+    X, y, _ = data
+    clf = VotingClassifier([("lr", LogisticRegression()),
+                            ("dt", DecisionTreeClassifier(max_depth=3))],
+                           voting="hard").fit(X, y)
+    pred = as_predictor(clf.predict, example_dim=X.shape[1])
+    assert isinstance(pred, CallbackPredictor)
+
+
+def test_voting_regressor(data):
+    from sklearn.ensemble import VotingRegressor
+    from sklearn.linear_model import LinearRegression
+    from sklearn.tree import DecisionTreeRegressor
+
+    X, _, yr = data
+    reg = VotingRegressor([("lin", LinearRegression()),
+                           ("dt", DecisionTreeRegressor(max_depth=4))]).fit(X, yr)
+    pred = as_predictor(reg.predict, example_dim=X.shape[1])
+    assert isinstance(pred, MeanEnsemblePredictor)
+    _check(pred, reg.predict, X[:64])
+
+
+@pytest.mark.parametrize("method", ["sigmoid", "isotonic"])
+def test_calibrated_svc(data, method):
+    """CalibratedClassifierCV(SVC) — the recommended replacement for the
+    deprecated SVC(probability=True) — lifts end to end."""
+
+    from sklearn.calibration import CalibratedClassifierCV
+    from sklearn.svm import SVC
+
+    X, y, _ = data
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        clf = CalibratedClassifierCV(SVC(kernel="rbf"), method=method,
+                                     cv=3).fit(X, y)
+    pred = as_predictor(clf.predict_proba, example_dim=X.shape[1])
+    assert isinstance(pred, (CalibratedBinaryPredictor, MeanEnsemblePredictor))
+    _check(pred, clf.predict_proba, X[:64], atol=1e-4)
+
+
+def test_calibrated_ensemble_false(data):
+    from sklearn.calibration import CalibratedClassifierCV
+    from sklearn.svm import SVC
+
+    X, y, _ = data
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        clf = CalibratedClassifierCV(SVC(kernel="rbf"), method="sigmoid",
+                                     ensemble=False, cv=3).fit(X, y)
+    pred = as_predictor(clf.predict_proba, example_dim=X.shape[1])
+    assert isinstance(pred, CalibratedBinaryPredictor)
+    _check(pred, clf.predict_proba, X[:64], atol=1e-4)
+
+
+def test_explain_end_to_end_pipeline(data):
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.pipeline import Pipeline
+    from sklearn.preprocessing import StandardScaler
+
+    from distributedkernelshap_tpu import KernelShap
+
+    X, y, _ = data
+    from distributedkernelshap_tpu.models import LinearPredictor
+
+    pipe = Pipeline([("sc", StandardScaler()),
+                     ("lr", LogisticRegression())]).fit(X, y)
+    ex = KernelShap(pipe.predict_proba, link="logit", seed=0)
+    ex.fit(X[:40])
+    assert isinstance(ex._explainer.predictor, LinearPredictor)
+    Xe = _quant(X[40:56])
+    res = ex.explain(Xe, silent=True)
+    proba = np.clip(pipe.predict_proba(Xe), 1e-7, 1 - 1e-7)
+    for k, phi in enumerate(res.shap_values):
+        lhs = phi.sum(axis=1) + res.expected_value[k]
+        rhs = np.log(proba[:, k] / (1 - proba[:, k]))
+        # rtol absorbs the f32 blow-up of near-saturated probabilities
+        # (|logit| ~ 12 means p within 1e-5 of 1)
+        np.testing.assert_allclose(lhs, rhs, rtol=1e-3, atol=5e-3)
