@@ -23,6 +23,10 @@ from distributedkernelshap_tpu.models.lgbm import (  # noqa: F401
     lift_lightgbm,
     predictor_from_lightgbm_dump,
 )
+from distributedkernelshap_tpu.models.torch_lift import (  # noqa: F401
+    TorchMLPPredictor,
+    lift_torch,
+)
 from distributedkernelshap_tpu.models.xgb import (  # noqa: F401
     lift_xgboost,
     predictor_from_xgboost_json,

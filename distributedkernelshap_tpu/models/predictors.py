@@ -327,7 +327,20 @@ def _lift_is_faithful(lifted: LinearPredictor, method, example_dim: int,
     try:
         expected = np.asarray(method(probe), dtype=np.float32)
     except Exception:
-        return False
+        # torch modules want tensors, not numpy — retry through the converter
+        # (only the module itself / its bound forward, never a custom method)
+        try:
+            from distributedkernelshap_tpu.models.torch_lift import (
+                module_of,
+                torch_callback,
+            )
+
+            target = module_of(method)
+            if target is None:
+                return False
+            expected = np.asarray(torch_callback(target)(probe), dtype=np.float32)
+        except Exception:
+            return False
     # full f32 matmul for the probe: TPU defaults to bfloat16 passes, whose
     # ~1e-3 error would falsely reject an exact lift
     try:
@@ -358,6 +371,7 @@ def _nonlinear_lifters():
     )
     from distributedkernelshap_tpu.models.lgbm import lift_lightgbm
     from distributedkernelshap_tpu.models.svm import lift_svm
+    from distributedkernelshap_tpu.models.torch_lift import lift_torch
     from distributedkernelshap_tpu.models.trees import lift_tree_ensemble
     from distributedkernelshap_tpu.models.xgb import lift_xgboost
 
@@ -366,6 +380,7 @@ def _nonlinear_lifters():
             ("LightGBM ensemble", lift_lightgbm),
             ("SVM", lift_svm),
             ("MLP", _lift_sklearn_mlp),
+            ("torch feed-forward", lift_torch),
             ("pipeline", lift_pipeline),
             ("voting ensemble", lift_voting),
             ("calibrated classifier", lift_calibrated))
@@ -416,12 +431,21 @@ def as_predictor(predictor, example_dim: Optional[int] = None,
             if candidate is None:
                 continue
             if _lift_is_faithful(candidate, predictor, example_dim):
-                logger.info("Lifted sklearn %s onto the device (%s)",
+                logger.info("Lifted %s onto the device (%s)",
                             family, type(candidate).__name__)
                 return candidate
             logger.warning(
                 "%s lift did not reproduce the original callable; "
                 "falling back to the host-callback path.", family)
+
+    # unlifted torch modules need tensor conversion on the host path —
+    # only the module itself or its bound forward; a custom bound method
+    # (e.g. model.predict) is the user's chosen callable and stays as-is
+    from distributedkernelshap_tpu.models.torch_lift import module_of, torch_callback
+
+    torch_target = module_of(predictor)
+    if torch_target is not None:
+        predictor = torch_callback(torch_target)
 
     if example_dim is not None:
         # is it jit-traceable?

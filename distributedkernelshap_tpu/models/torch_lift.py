@@ -1,0 +1,240 @@
+"""PyTorch feed-forward modules lifted onto the device.
+
+A ``torch.nn.Sequential`` of standard layers is a chain of matmuls and
+elementwise maps — exactly what the explain kernel wants on the MXU.
+``lift_torch`` walks the module, hoists the weights out of torch once, and
+returns a pure-JAX predictor; torch is never called again after the lift.
+
+Supported layers: ``Linear``, ``ReLU``/``LeakyReLU``/``ELU``/``GELU``/
+``SiLU``/``Tanh``/``Sigmoid``/``Softmax``/``LogSoftmax`` (last-dim),
+``BatchNorm1d`` (folded to its eval-mode affine using running statistics),
+``LayerNorm`` (last-dim), ``Dropout``/``Identity``/1-dim ``Flatten``
+(no-ops at inference), and nested ``Sequential``.  Anything else declines,
+and the model still runs through a tensor-converting host callback
+(``torch_callback``) so arbitrary torch models work unlifted.
+
+The lift reproduces **eval-mode** semantics (dropout off, batch-norm running
+stats); the numerical probe in ``as_predictor`` compares against the module
+as given, so a module left in training mode simply fails the probe and falls
+back to the host path.
+"""
+
+import logging
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributedkernelshap_tpu.models.predictors import BasePredictor
+
+logger = logging.getLogger(__name__)
+
+Stage = Tuple
+
+
+def is_torch_module(obj) -> bool:
+    try:
+        import torch
+
+        return isinstance(obj, torch.nn.Module)
+    except ImportError:
+        return False
+
+
+def module_of(predictor):
+    """The torch module behind ``predictor`` — itself, or the owner of its
+    bound ``forward``/``__call__`` — else None.  A bound method with any
+    OTHER name (e.g. a custom ``model.predict``) is the user's chosen
+    callable and must NOT be replaced by the raw forward."""
+
+    if is_torch_module(predictor):
+        return predictor
+    owner = getattr(predictor, "__self__", None)
+    if owner is not None and is_torch_module(owner) \
+            and getattr(predictor, "__name__", "") in ("forward", "__call__"):
+        return owner
+    return None
+
+
+def torch_callback(module):
+    """Host-callable wrapper: numpy in, numpy out, no grad, eval semantics
+    preserved as-is.  The input is moved to the module's own parameter
+    dtype/device (double or CUDA-resident modules included)."""
+
+    import torch
+
+    try:
+        p = next(module.parameters())
+        dtype, device = p.dtype, p.device
+    except StopIteration:
+        dtype, device = torch.float32, torch.device("cpu")
+
+    def fn(a: np.ndarray) -> np.ndarray:
+        with torch.no_grad():
+            t = torch.from_numpy(np.ascontiguousarray(a, dtype=np.float32))
+            out = module(t.to(device=device, dtype=dtype))
+        return out.detach().cpu().numpy()
+
+    return fn
+
+
+_ACT_STAGES = {
+    "ReLU": lambda layer: ("act_relu",),
+    "Tanh": lambda layer: ("act_tanh",),
+    "Sigmoid": lambda layer: ("act_sigmoid",),
+    "SiLU": lambda layer: ("act_silu",),
+    "Softmax": lambda layer: ("softmax",) if layer.dim in (-1, 1) else None,
+    "LogSoftmax": lambda layer: ("log_softmax",) if layer.dim in (-1, 1) else None,
+    "LeakyReLU": lambda layer: ("act_leaky_relu", float(layer.negative_slope)),
+    "ELU": lambda layer: ("act_elu", float(layer.alpha)),
+    "GELU": lambda layer: ("act_gelu", getattr(layer, "approximate", "none") == "tanh"),
+}
+
+
+def _apply_stage(stage: Stage, X):
+    kind = stage[0]
+    if kind == "linear":
+        return X @ stage[1] + stage[2][None, :]
+    if kind == "affine":
+        return X * stage[1][None, :] + stage[2][None, :]
+    if kind == "layernorm":
+        mu = X.mean(axis=-1, keepdims=True)
+        var = ((X - mu) ** 2).mean(axis=-1, keepdims=True)
+        return (X - mu) / jnp.sqrt(var + stage[3]) * stage[1][None, :] + stage[2][None, :]
+    if kind == "act_relu":
+        return jax.nn.relu(X)
+    if kind == "act_tanh":
+        return jnp.tanh(X)
+    if kind == "act_sigmoid":
+        return jax.nn.sigmoid(X)
+    if kind == "act_silu":
+        return jax.nn.silu(X)
+    if kind == "act_leaky_relu":
+        return jax.nn.leaky_relu(X, negative_slope=stage[1])
+    if kind == "act_elu":
+        return jax.nn.elu(X, alpha=stage[1])
+    if kind == "act_gelu":
+        return jax.nn.gelu(X, approximate=stage[1])
+    if kind == "softmax":
+        return jax.nn.softmax(X, axis=-1)
+    if kind == "log_softmax":
+        return jax.nn.log_softmax(X, axis=-1)
+    raise ValueError(f"unknown stage kind {stage[0]!r}")
+
+
+class TorchMLPPredictor(BasePredictor):
+    """A lifted feed-forward torch network: picklable stages, pure JAX."""
+
+    def __init__(self, stages: List[Stage], n_outputs: int, vector_out: bool = True):
+        self.stages = list(stages)
+        self.n_outputs = int(n_outputs)
+        self.vector_out = vector_out
+
+    def __call__(self, X):
+        X = jnp.asarray(X, jnp.float32)
+        for stage in self.stages:
+            X = _apply_stage(stage, X)
+        return X
+
+
+def _stages_from_module(module) -> Optional[List[Stage]]:
+    import torch.nn as nn
+
+    if isinstance(module, nn.Linear):
+        children = [module]
+    elif isinstance(module, nn.Sequential):
+        children = list(module)
+    else:
+        return None
+
+    stages: List[Stage] = []
+    for layer in children:
+        name = type(layer).__name__
+        if isinstance(layer, nn.Sequential):
+            sub = _stages_from_module(layer)
+            if sub is None:
+                return None
+            stages.extend(sub)
+        elif isinstance(layer, nn.Linear):
+            W = jnp.asarray(layer.weight.detach().cpu().numpy().T, jnp.float32)
+            b = (jnp.asarray(layer.bias.detach().cpu().numpy(), jnp.float32)
+                 if layer.bias is not None else jnp.zeros(W.shape[1], jnp.float32))
+            stages.append(("linear", W, b))
+        elif isinstance(layer, nn.BatchNorm1d):
+            if layer.running_mean is None:
+                return None          # track_running_stats=False: batch-dependent
+            mean = layer.running_mean.detach().cpu().numpy()
+            var = layer.running_var.detach().cpu().numpy()
+            scale = 1.0 / np.sqrt(var + layer.eps)
+            shift = -mean * scale
+            if layer.affine:
+                g = layer.weight.detach().cpu().numpy()
+                be = layer.bias.detach().cpu().numpy()
+                shift = shift * g + be
+                scale = scale * g
+            stages.append(("affine", jnp.asarray(scale, jnp.float32),
+                           jnp.asarray(shift, jnp.float32)))
+        elif isinstance(layer, nn.LayerNorm):
+            if len(layer.normalized_shape) != 1:
+                return None
+            d = layer.normalized_shape[0]
+            g = (layer.weight.detach().cpu().numpy() if layer.elementwise_affine
+                 else np.ones(d))
+            be = (layer.bias.detach().cpu().numpy()
+                  if layer.elementwise_affine and layer.bias is not None
+                  else np.zeros(d))
+            stages.append(("layernorm", jnp.asarray(g, jnp.float32),
+                           jnp.asarray(be, jnp.float32), float(layer.eps)))
+        elif isinstance(layer, (nn.Dropout, nn.Identity)):
+            continue                 # inference no-ops
+        elif isinstance(layer, nn.Flatten):
+            if layer.start_dim != 1:
+                return None          # 2-D inputs are already flat
+            continue
+        elif name in _ACT_STAGES:
+            stage = _ACT_STAGES[name](layer)
+            if stage is None:
+                return None
+            stages.append(stage)
+        else:
+            return None              # conv/recurrent/attention/custom: host path
+    return stages
+
+
+def lift_torch(predictor) -> Optional[TorchMLPPredictor]:
+    """Lift a ``torch.nn.Module`` (or its bound ``forward``/``__call__``)
+    into a pure-JAX predictor, or None when the architecture is out of the
+    feed-forward surface.  Numerically probe-gated by the caller."""
+
+    module = module_of(predictor)
+    if module is None:
+        return None
+    try:
+        stages = _stages_from_module(module)
+        if not stages:
+            return None
+        last_linear = next((s for s in reversed(stages) if s[0] == "linear"), None)
+        if last_linear is None:
+            return None
+        k = int(last_linear[1].shape[1])
+        # a logits-linear network (one Linear, optionally under softmax /
+        # sigmoid) gets the LinearPredictor decomposition, which the explain
+        # kernel turns into the three-einsum MXU fast path
+        if len(stages) == 1 and stages[0][0] == "linear":
+            return _as_linear(stages[0], "identity")
+        if (len(stages) == 2 and stages[0][0] == "linear"
+                and stages[1][0] in ("softmax", "act_sigmoid")):
+            act = "softmax" if stages[1][0] == "softmax" else "sigmoid"
+            return _as_linear(stages[0], act)
+        return TorchMLPPredictor(stages, n_outputs=k, vector_out=True)
+    except Exception as exc:  # unexpected layer internals: fall back
+        logger.info("torch lift failed structurally (%s); using host path", exc)
+        return None
+
+
+def _as_linear(stage: Stage, activation: str):
+    from distributedkernelshap_tpu.models.predictors import LinearPredictor
+
+    return LinearPredictor(np.asarray(stage[1]), np.asarray(stage[2]),
+                           activation=activation)

@@ -1,0 +1,195 @@
+"""PyTorch feed-forward lifting (models/torch_lift.py): lifted stages must
+reproduce the module's own (eval-mode) outputs, unsupported architectures
+must still work through the tensor-converting host callback, and the full
+explain pipeline must run over a lifted torch network."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+from torch import nn  # noqa: E402
+
+from distributedkernelshap_tpu.models import (  # noqa: E402
+    CallbackPredictor,
+    TorchMLPPredictor,
+    as_predictor,
+    lift_torch,
+)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(31)
+    return rng.normal(size=(200, 5)).astype(np.float32)
+
+
+def _check(module, X, atol=2e-5):
+    module.eval()
+    lifted = lift_torch(module)
+    assert lifted is not None, f"{module} did not lift"
+    with torch.no_grad():
+        expected = module(torch.from_numpy(X)).numpy()
+    got = np.asarray(lifted(X))
+    scale = max(1.0, float(np.abs(expected).max()))
+    np.testing.assert_allclose(got, expected, atol=atol * scale)
+    return lifted
+
+
+def test_linear_single_layer(data):
+    torch.manual_seed(0)
+    _check(nn.Linear(5, 3), data)
+
+
+@pytest.mark.parametrize("act", [nn.ReLU(), nn.Tanh(), nn.Sigmoid(), nn.SiLU(),
+                                 nn.LeakyReLU(0.2), nn.ELU(alpha=0.7),
+                                 nn.GELU(), nn.GELU(approximate="tanh")])
+def test_mlp_activations(data, act):
+    torch.manual_seed(1)
+    net = nn.Sequential(nn.Linear(5, 8), act, nn.Linear(8, 2))
+    _check(net, data)
+
+
+def test_softmax_head(data):
+    torch.manual_seed(2)
+    net = nn.Sequential(nn.Linear(5, 8), nn.ReLU(), nn.Linear(8, 3),
+                        nn.Softmax(dim=-1))
+    lifted = _check(net, data)
+    assert lifted.n_outputs == 3
+    np.testing.assert_allclose(np.asarray(lifted(data[:8])).sum(1), 1.0, atol=1e-5)
+
+
+def test_batchnorm_folds_to_eval_affine(data):
+    torch.manual_seed(3)
+    net = nn.Sequential(nn.Linear(5, 8), nn.BatchNorm1d(8), nn.ReLU(),
+                        nn.Linear(8, 2))
+    net.train()
+    # accumulate non-trivial running stats
+    for _ in range(3):
+        net(torch.from_numpy(data))
+    net.eval()
+    _check(net, data)
+
+
+def test_layernorm_and_dropout_and_nesting(data):
+    torch.manual_seed(4)
+    net = nn.Sequential(
+        nn.Flatten(),
+        nn.Sequential(nn.Linear(5, 16), nn.LayerNorm(16), nn.GELU()),
+        nn.Dropout(0.5), nn.Identity(), nn.Linear(16, 2))
+    _check(net, data)
+
+
+def test_unsupported_architecture_uses_host_callback(data):
+    torch.manual_seed(5)
+
+    class WithConv(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(5, 4)
+
+        def forward(self, x):
+            return torch.cummax(self.lin(x), dim=1)[0]   # not liftable
+
+    net = WithConv().eval()
+    pred = as_predictor(net, example_dim=5)
+    assert isinstance(pred, CallbackPredictor)
+    with torch.no_grad():
+        expected = net(torch.from_numpy(data[:16])).numpy()
+    np.testing.assert_allclose(np.asarray(pred.host_fn(data[:16])), expected,
+                               atol=1e-5)
+
+
+def test_as_predictor_routes_torch(data):
+    torch.manual_seed(6)
+    net = nn.Sequential(nn.Linear(5, 6), nn.ReLU(), nn.Linear(6, 2),
+                        nn.Softmax(dim=-1)).eval()
+    pred = as_predictor(net, example_dim=5)
+    assert isinstance(pred, TorchMLPPredictor)
+
+
+def test_training_mode_dropout_module_still_works(data):
+    """A module left in train mode (active dropout) fails the probe
+    determinism and must land on the host path, not a wrong lift."""
+
+    torch.manual_seed(7)
+    net = nn.Sequential(nn.Linear(5, 64), nn.Dropout(0.9), nn.Linear(64, 2))
+    net.train()
+    pred = as_predictor(net, example_dim=5)
+    # dropout is stochastic in train mode: either the probe rejected the
+    # lift (CallbackPredictor) or torch's eval-mode==train-mode linear chain
+    # happened to match — both are sound; a silently WRONG lift is not
+    assert isinstance(pred, (CallbackPredictor, TorchMLPPredictor))
+
+
+def test_bare_linear_gets_fast_path(data):
+    """Logits-linear torch models lift to LinearPredictor so the explain
+    kernel's three-einsum decomposition engages."""
+
+    from distributedkernelshap_tpu.models import LinearPredictor
+
+    torch.manual_seed(9)
+    assert isinstance(lift_torch(nn.Linear(5, 3).eval()), LinearPredictor)
+    net = nn.Sequential(nn.Linear(5, 3), nn.Softmax(dim=-1)).eval()
+    lifted = lift_torch(net)
+    assert isinstance(lifted, LinearPredictor) and lifted.activation == "softmax"
+    X = data[:32]
+    with torch.no_grad():
+        expected = net(torch.from_numpy(X)).numpy()
+    np.testing.assert_allclose(np.asarray(lifted(X)), expected, atol=2e-5)
+
+
+def test_custom_bound_method_is_not_hijacked(data):
+    """A custom bound method (model.predict) is the user's chosen callable;
+    as_predictor must wrap IT, not the module's raw forward."""
+
+    class WithPredict(nn.Module):
+        def __init__(self):
+            super().__init__()
+            torch.manual_seed(10)
+            self.lin = nn.Linear(5, 3)
+
+        def forward(self, x):
+            return self.lin(x)
+
+        def predict(self, a):             # numpy in, softmax probs out
+            with torch.no_grad():
+                return torch.softmax(self.lin(torch.from_numpy(
+                    np.ascontiguousarray(a, np.float32))), dim=-1).numpy()
+
+    m = WithPredict().eval()
+    pred = as_predictor(m.predict, example_dim=5)
+    got = np.asarray(pred.host_fn(data[:8]))
+    np.testing.assert_allclose(got, m.predict(data[:8]), atol=1e-6)
+    np.testing.assert_allclose(got.sum(1), 1.0, atol=1e-5)  # probs, not logits
+
+
+def test_double_precision_module(data):
+    """A float64 module must work: the callback converts to the module's own
+    dtype, and the lift (weights cast to f32) passes the probe."""
+
+    torch.manual_seed(11)
+    net = nn.Sequential(nn.Linear(5, 4), nn.ReLU(), nn.Linear(4, 2)).double().eval()
+    pred = as_predictor(net, example_dim=5)
+    assert isinstance(pred, TorchMLPPredictor)
+    with torch.no_grad():
+        expected = net(torch.from_numpy(data[:16].astype(np.float64))).numpy()
+    np.testing.assert_allclose(np.asarray(pred(data[:16])), expected, atol=1e-4)
+
+
+def test_explain_end_to_end_torch(data):
+    from distributedkernelshap_tpu import KernelShap
+
+    torch.manual_seed(8)
+    net = nn.Sequential(nn.Linear(5, 12), nn.Tanh(), nn.Linear(12, 2),
+                        nn.Softmax(dim=-1)).eval()
+    ex = KernelShap(net, link="logit", seed=0)
+    ex.fit(data[:40])
+    assert isinstance(ex._explainer.predictor, TorchMLPPredictor)
+    Xe = data[40:56]
+    res = ex.explain(Xe, silent=True)
+    with torch.no_grad():
+        proba = np.clip(net(torch.from_numpy(Xe)).numpy(), 1e-7, 1 - 1e-7)
+    for k, phi in enumerate(res.shap_values):
+        lhs = phi.sum(axis=1) + res.expected_value[k]
+        rhs = np.log(proba[:, k] / (1 - proba[:, k]))
+        np.testing.assert_allclose(lhs, rhs, rtol=1e-3, atol=5e-3)
