@@ -13,6 +13,9 @@ Each config prints one JSON line; ``bench.py`` remains the headline driver.
                            device (``models/trees.py`` path-matmul eval);
                            measures the native-tree path against
                            ``adult_blackbox``'s host path
+  * ``model_zoo``        — one timing per lifted model family (linear, GBT,
+                           RBF SVM, sklearn MLP, torch net, pipeline) on the
+                           same Adult batch
   * ``mnist``            — CNN + superpixel image KernelSHAP
   * ``covertype``        — 581k-instance dataset, instance-sharded across
                            every visible device
@@ -180,6 +183,87 @@ def config_adult_trees(smoke=False):
             "predictor": type(clf).__name__, "device_lifted": lifted}
 
 
+def config_model_zoo(smoke=False):
+    """One line per lifted model family on the Adult task: every predictor
+    class the lift matrix covers (linear, GBT path-matmul, RBF SVM Gram
+    matmul, sklearn MLP, torch net, scaler pipeline) explained on-device
+    with the same 256-instance batch.  Evidence that 'switch your model,
+    keep your speed' holds across the families the reference could only run
+    as opaque CPU callables."""
+
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.models import (
+        LinearPredictor,
+        MLPPredictor,
+        PipelinePredictor,
+        SVMPredictor,
+        TorchMLPPredictor,
+        TreeEnsemblePredictor,
+    )
+    from distributedkernelshap_tpu.utils import load_data, load_model
+
+    data = load_data()
+    gn, g = data["all"]["group_names"], data["all"]["groups"]
+    Xtr = data["all"]["X"]["processed"]["train"].toarray()
+    ytr = data["all"]["y"]["train"]
+    if smoke:
+        Xtr, ytr = Xtr[:3000], ytr[:3000]
+    X = data["all"]["X"]["processed"]["test"].toarray()
+    X = X[:16] if smoke else X[:256]
+    bg = data["background"]["X"]["preprocessed"]
+
+    def zoo():
+        from sklearn.ensemble import HistGradientBoostingClassifier
+        from sklearn.neural_network import MLPClassifier
+        from sklearn.pipeline import Pipeline
+        from sklearn.preprocessing import StandardScaler
+        from sklearn.svm import SVC
+
+        yield "linear_lr", load_model().predict_proba, LinearPredictor
+        yield ("hist_gbt",
+               HistGradientBoostingClassifier(
+                   max_iter=10 if smoke else 50, random_state=0)
+               .fit(Xtr, ytr).predict_proba, TreeEnsemblePredictor)
+        svc_n = 2000 if smoke else 5000   # SVC fit is quadratic-ish in rows
+        yield ("svc_rbf",
+               SVC(kernel="rbf", random_state=0)
+               .fit(Xtr[:svc_n], ytr[:svc_n]).decision_function, SVMPredictor)
+        yield ("sklearn_mlp",
+               MLPClassifier((32,), max_iter=30 if smoke else 120,
+                             random_state=0).fit(Xtr, ytr).predict_proba,
+               MLPPredictor)
+        try:
+            import torch
+            from torch import nn
+
+            torch.manual_seed(0)
+            D = Xtr.shape[1]
+            net = nn.Sequential(nn.Linear(D, 32), nn.ReLU(), nn.Linear(32, 2),
+                                nn.Softmax(dim=-1)).eval()
+            yield "torch_mlp", net, TorchMLPPredictor
+        except ImportError:
+            pass
+        yield ("scaler_pipeline",
+               Pipeline([("sc", StandardScaler()),
+                         ("gb", HistGradientBoostingClassifier(
+                             max_iter=10 if smoke else 50, random_state=0))])
+               .fit(Xtr, ytr).predict_proba, PipelinePredictor)
+
+    families = {}
+    for fam_name, predictor, expected_cls in zoo():
+        ex = KernelShap(predictor, link="logit" if fam_name != "svc_rbf" else "identity",
+                        feature_names=gn, seed=0)
+        ex.fit(bg, group_names=gn, groups=g)
+        lifted = isinstance(ex._explainer.predictor, expected_cls)
+        t, explanation = _timed_explain(ex, X, nruns=1 if smoke else 3)
+        families[fam_name] = {"wall_s": round(t, 4), "device_lifted": lifted,
+                              "additivity_err": _additivity(explanation)}
+    worst = max(v["wall_s"] for v in families.values())
+    return {"metric": "model_zoo_worst_wall_s", "value": worst, "unit": "s",
+            "n_instances": X.shape[0], "families": families,
+            "additivity_err": max(v["additivity_err"] for v in families.values())}
+
+
 def config_mnist(smoke=False):
     from distributedkernelshap_tpu import KernelShap
     from distributedkernelshap_tpu.models.cnn import train_mnist_cnn
@@ -244,6 +328,7 @@ CONFIGS = {
     "adult_stress": config_adult_stress,
     "adult_blackbox": config_adult_blackbox,
     "adult_trees": config_adult_trees,
+    "model_zoo": config_model_zoo,
     "mnist": config_mnist,
     "covertype": config_covertype,
 }

@@ -51,8 +51,11 @@ def module_of(predictor):
     if is_torch_module(predictor):
         return predictor
     owner = getattr(predictor, "__self__", None)
+    # nn.Module.__call__ is bound through torch's dispatch wrappers, whose
+    # __name__ is _wrapped_call_impl / _call_impl rather than "__call__"
     if owner is not None and is_torch_module(owner) \
-            and getattr(predictor, "__name__", "") in ("forward", "__call__"):
+            and getattr(predictor, "__name__", "") in (
+                "forward", "__call__", "_wrapped_call_impl", "_call_impl"):
         return owner
     return None
 

@@ -176,6 +176,19 @@ def test_double_precision_module(data):
     np.testing.assert_allclose(np.asarray(pred(data[:16])), expected, atol=1e-4)
 
 
+def test_bound_dunder_call_lifts(data):
+    """net.__call__ binds through torch's _wrapped_call_impl; it must still
+    resolve to the module and lift."""
+
+    torch.manual_seed(12)
+    net = nn.Sequential(nn.Linear(5, 6), nn.ReLU(), nn.Linear(6, 2)).eval()
+    pred = as_predictor(net.__call__, example_dim=5)
+    assert isinstance(pred, TorchMLPPredictor)
+    with torch.no_grad():
+        expected = net(torch.from_numpy(data[:8])).numpy()
+    np.testing.assert_allclose(np.asarray(pred(data[:8])), expected, atol=2e-5)
+
+
 def test_explain_end_to_end_torch(data):
     from distributedkernelshap_tpu import KernelShap
 
