@@ -308,6 +308,46 @@ def test_tree_predictor_coalition_parallel(clf_data):
     np.testing.assert_allclose(sv[1], sv_seq[1], atol=1e-4)
 
 
+def test_property_random_forests_match_sklearn():
+    """Property sweep: random forest/GBT shapes (stumps, deep trees, tiny
+    leaf counts, class imbalance) all lift faithfully on f32-representable
+    inputs."""
+
+    hypothesis = pytest.importorskip("hypothesis")
+    from hypothesis import given, settings, strategies as st
+
+    from sklearn.ensemble import GradientBoostingClassifier, RandomForestClassifier
+
+    @settings(max_examples=25, deadline=None)
+    @given(st.data())
+    def run(data_st):
+        seed = data_st.draw(st.integers(0, 2 ** 16), label="seed")
+        n_est = data_st.draw(st.integers(1, 12), label="n_estimators")
+        max_depth = data_st.draw(st.one_of(st.none(), st.integers(1, 8)),
+                                 label="max_depth")
+        family = data_st.draw(st.sampled_from(["rf", "gbt"]), label="family")
+        imbalance = data_st.draw(st.floats(0.05, 0.5), label="imbalance")
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(120, 4))
+        y = (rng.random(120) < imbalance).astype(int)
+        if y.min() == y.max():
+            y[0] = 1 - y[0]
+        if family == "rf":
+            clf = RandomForestClassifier(n_estimators=n_est, max_depth=max_depth,
+                                         random_state=seed % 100).fit(X, y)
+        else:
+            clf = GradientBoostingClassifier(n_estimators=n_est,
+                                             max_depth=max_depth or 3,
+                                             random_state=seed % 100).fit(X, y)
+        lifted = lift_tree_ensemble(clf.predict_proba)
+        assert lifted is not None
+        Xq = X[:40].astype(np.float32)
+        expected = clf.predict_proba(Xq.astype(np.float64))
+        np.testing.assert_allclose(np.asarray(lifted(Xq)), expected, atol=3e-5)
+
+    run()
+
+
 def test_f32_threshold_casts():
     """f32_le_threshold: largest f32 <= t. f32_lt_threshold: largest f32 < t.
     Nearest-casting can overshoot a double threshold onto a representable
