@@ -1,0 +1,28 @@
+"""Shared padded-chunk mapping for the structure-aware masked evaluations.
+
+One helper so the tree / SVM ``masked_ey`` implementations are only the
+per-model math: pad the leading axis to a multiple of ``chunk``, run ``fn``
+per chunk under ``lax.map`` (bounded memory, one compiled body), and return
+the concatenated result sliced back to the original length.
+
+``fn`` must map ``(chunk, *in_tail) -> (chunk, *out_tail)`` — the leading
+axis of its output must correspond elementwise to its input chunk.  Padding
+rows are zeros; callers are responsible for pad rows being harmless (zero
+masks evaluate the pure background, zero instances produce rows that are
+sliced away).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def padded_chunk_map(fn, arr, chunk: int):
+    n = arr.shape[0]
+    chunk = max(1, min(n, int(chunk)))
+    n_chunks = -(-n // chunk)
+    pad = n_chunks * chunk - n
+    if pad:
+        arr = jnp.concatenate(
+            [arr, jnp.zeros((pad,) + arr.shape[1:], arr.dtype)], axis=0)
+    out = jax.lax.map(fn, arr.reshape((n_chunks, chunk) + arr.shape[1:]))
+    return out.reshape((n_chunks * chunk,) + out.shape[2:])[:n]

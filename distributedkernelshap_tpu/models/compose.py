@@ -154,6 +154,32 @@ class PipelinePredictor(BasePredictor):
             X = _apply_stage(stage, X)
         return self.inner(X)
 
+    @property
+    def supports_masked_ey(self) -> bool:
+        """Columnwise stages (affine / NaN-impute / clip) commute with the
+        KernelSHAP column mask — ``t(x·z + bg·(1-z)) = t(x)·z + t(bg)·(1-z)``
+        per column — so the inner predictor's structure-aware masked
+        evaluation (e.g. the separable-hits tree path) forwards exactly with
+        pre-transformed sources.  Column-mixing stages ('linear': PCA/SVD)
+        break the two-source structure and fall back to row evaluation."""
+
+        return (all(s[0] in ("affine", "impute", "clip") for s in self.stages)
+                and getattr(self.inner, "supports_masked_ey", False))
+
+    def masked_ey_fits(self, **kwargs) -> bool:
+        fits = getattr(self.inner, "masked_ey_fits", None)
+        return fits(**kwargs) if fits is not None else True
+
+    def masked_ey(self, X, bg, bgw_n, mask, G, target_chunk_elems=None,
+                  coalition_chunk=None):
+        X = jnp.asarray(X, jnp.float32)
+        bg = jnp.asarray(bg, jnp.float32)
+        for stage in self.stages:
+            X = _apply_stage(stage, X)
+            bg = _apply_stage(stage, bg)
+        return self.inner.masked_ey(X, bg, bgw_n, mask, G, target_chunk_elems,
+                                    coalition_chunk=coalition_chunk)
+
 
 class MeanEnsemblePredictor(BasePredictor):
     """Weighted mean of member predictor outputs (soft voting)."""

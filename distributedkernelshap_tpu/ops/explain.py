@@ -74,6 +74,19 @@ def groups_to_matrix(groups: Optional[Sequence[Sequence[int]]], n_columns: int) 
     return G
 
 
+def _use_masked_ey(predictor, B: int, N: int, S: int, M: int,
+                   config: "ShapConfig") -> bool:
+    """Dispatch to the structure-aware masked evaluation when the predictor
+    offers it AND its persistent tensors fit the budget at these shapes
+    (otherwise the row-materialising paths are the better choice)."""
+
+    if not getattr(predictor, "supports_masked_ey", False):
+        return False
+    fits = getattr(predictor, "masked_ey_fits", None)
+    return fits is None or fits(B=B, N=N, S=S, M=M,
+                                budget=config.target_chunk_elems)
+
+
 def _auto_chunk(S: int, per_row_elems: int, target: int) -> int:
     chunk = max(1, min(S, target // max(per_row_elems, 1)))
     return chunk
@@ -252,6 +265,12 @@ def build_explainer_fn(predictor: BasePredictor, config: ShapConfig = ShapConfig
             chunk = config.coalition_chunk or _auto_chunk(S, B * N * K, config.target_chunk_elems)
             ey = _ey_linear(W, b, activation, X, bg, bgw_n, mask, G, chunk,
                             use_pallas=use_pallas)
+        elif _use_masked_ey(predictor, B, N, S, mask.shape[1], config):
+            # structure-aware path: split-condition / kernel sums separate
+            # into instance and background halves (models/{trees,svm}.py)
+            ey = predictor.masked_ey(X, bg, bgw_n, mask, G,
+                                     config.target_chunk_elems,
+                                     coalition_chunk=config.coalition_chunk)
         else:
             zc = mask @ G  # (S, D) column-space masks
             chunk = config.coalition_chunk or _auto_chunk(S, B * N * D, config.target_chunk_elems)

@@ -64,6 +64,13 @@ def build_coalition_sharded_fn(predictor: BasePredictor,
             # run would interpret the kernel 8× over
             return _ey_linear(W, b, activation, X, bg, bgw_n, mask_local, G,
                               chunk, use_pallas=bool(config.use_pallas))
+        from distributedkernelshap_tpu.ops.explain import _use_masked_ey
+
+        if _use_masked_ey(predictor, B, N, S_local, mask_local.shape[1], config):
+            # per-shard coalition rows through the structure-aware fast path
+            return predictor.masked_ey(X, bg, bgw_n, mask_local, G,
+                                       config.target_chunk_elems,
+                                       coalition_chunk=config.coalition_chunk)
         zc_local = mask_local @ G
         chunk = config.coalition_chunk or _auto_chunk(S_local, B * N * D,
                                                       config.target_chunk_elems)

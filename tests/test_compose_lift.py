@@ -226,6 +226,55 @@ def test_calibrated_ensemble_false(data):
     _check(pred, clf.predict_proba, X[:64], atol=1e-4)
 
 
+def test_pipeline_forwards_masked_ey(data):
+    """Columnwise-stage pipelines forward the tree masked-ey fast path with
+    transformed sources; phi matches the row-evaluating path."""
+
+    from sklearn.ensemble import GradientBoostingClassifier
+    from sklearn.pipeline import Pipeline
+    from sklearn.preprocessing import StandardScaler
+
+    from distributedkernelshap_tpu import KernelShap
+
+    X, y, _ = data
+    pipe = Pipeline([("sc", StandardScaler()),
+                     ("gb", GradientBoostingClassifier(n_estimators=8,
+                                                       max_depth=3,
+                                                       random_state=0))]).fit(X, y)
+    pred = as_predictor(pipe.predict_proba, example_dim=X.shape[1])
+    assert isinstance(pred, PipelinePredictor) and pred.supports_masked_ey
+
+    Xq = _quant(X)
+    ex_fast = KernelShap(pipe.predict_proba, link="logit", seed=0)
+    ex_fast.fit(Xq[:30])
+    phi_fast = ex_fast.explain(Xq[200:212], silent=True).shap_values
+
+    slow = as_predictor(pipe.predict_proba, example_dim=X.shape[1])
+    slow.inner.path_sign = None          # force row evaluation
+    ex_slow = KernelShap(slow, link="logit", seed=0)
+    ex_slow.fit(Xq[:30])
+    phi_slow = ex_slow.explain(Xq[200:212], silent=True).shap_values
+    for a, b in zip(phi_fast, phi_slow):
+        np.testing.assert_allclose(a, b, atol=5e-4)
+
+
+def test_pca_pipeline_does_not_forward_masked_ey(data):
+    """Column-mixing stages must NOT forward (masking in original space is
+    not masking in projected space)."""
+
+    from sklearn.decomposition import PCA
+    from sklearn.ensemble import GradientBoostingClassifier
+    from sklearn.pipeline import Pipeline
+
+    X, y, _ = data
+    pipe = Pipeline([("pca", PCA(n_components=4)),
+                     ("gb", GradientBoostingClassifier(n_estimators=5,
+                                                       random_state=0))]).fit(X, y)
+    pred = as_predictor(pipe.predict_proba, example_dim=X.shape[1])
+    assert isinstance(pred, PipelinePredictor)
+    assert not pred.supports_masked_ey
+
+
 def test_explain_end_to_end_pipeline(data):
     from sklearn.linear_model import LogisticRegression
     from sklearn.pipeline import Pipeline

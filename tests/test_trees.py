@@ -308,6 +308,126 @@ def test_tree_predictor_coalition_parallel(clf_data):
     np.testing.assert_allclose(sv[1], sv_seq[1], atol=1e-4)
 
 
+def _masked_ey_case(clf_data, n_classes=2, groups=None):
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    from distributedkernelshap_tpu.ops.coalitions import coalition_plan
+    from distributedkernelshap_tpu.ops.explain import _ey_generic, groups_to_matrix
+
+    X, y = clf_data
+    y = y if n_classes == 3 else (y > 0).astype(int)
+    clf = GradientBoostingClassifier(n_estimators=8, max_depth=3,
+                                     random_state=0).fit(X, y)
+    pred = lift_tree_ensemble(clf.predict_proba)
+    assert pred.supports_masked_ey
+    G = groups_to_matrix(groups, X.shape[1])
+    plan = coalition_plan(G.shape[0], nsamples=64, seed=0)
+    Xe = X[:12].astype(np.float32)
+    bg = X[50:70].astype(np.float32)
+    bgw = np.full(bg.shape[0], 1.0 / bg.shape[0], np.float32)
+    mask = np.asarray(plan.mask, np.float32)
+    zc = mask @ G
+    ey_rows = np.asarray(_ey_generic(pred, Xe, bg, bgw, zc, chunk=16))
+    ey_fast = np.asarray(pred.masked_ey(Xe, bg, bgw, mask, G))
+    return ey_rows, ey_fast
+
+
+def test_masked_ey_matches_row_eval(clf_data):
+    """The separable-hits masked evaluation must agree with materialising
+    every synthetic row and calling the predictor."""
+
+    ey_rows, ey_fast = _masked_ey_case(clf_data)
+    np.testing.assert_allclose(ey_fast, ey_rows, atol=2e-6)
+
+
+def test_masked_ey_matches_row_eval_grouped(clf_data):
+    ey_rows, ey_fast = _masked_ey_case(
+        clf_data, groups=[[0, 1], [2], [3, 4], [5]])
+    np.testing.assert_allclose(ey_fast, ey_rows, atol=2e-6)
+
+
+def test_masked_ey_matches_row_eval_multiclass(clf_data):
+    ey_rows, ey_fast = _masked_ey_case(clf_data, n_classes=3)
+    np.testing.assert_allclose(ey_fast, ey_rows, atol=2e-6)
+
+
+def test_masked_ey_tiny_chunks_match(clf_data):
+    """Forced instance- and coalition-chunking (padding both axes) is
+    transparent."""
+
+    from distributedkernelshap_tpu.ops.coalitions import coalition_plan
+    from distributedkernelshap_tpu.ops.explain import groups_to_matrix
+
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    X, y = clf_data
+    clf = GradientBoostingClassifier(n_estimators=5, max_depth=3,
+                                     random_state=0).fit(X, (y > 0).astype(int))
+    pred = lift_tree_ensemble(clf.predict_proba)
+    G = groups_to_matrix(None, X.shape[1])
+    plan = coalition_plan(G.shape[0], nsamples=50, seed=0)  # odd sizes
+    Xe = X[:7].astype(np.float32)
+    bg = X[50:63].astype(np.float32)
+    bgw = np.full(bg.shape[0], 1.0 / bg.shape[0], np.float32)
+    mask = np.asarray(plan.mask, np.float32)
+    big = np.asarray(pred.masked_ey(Xe, bg, bgw, mask, G))
+    tiny = np.asarray(pred.masked_ey(Xe, bg, bgw, mask, G,
+                                     target_chunk_elems=1 << 9))
+    np.testing.assert_allclose(tiny, big, atol=1e-6)
+
+
+def test_masked_ey_guards(clf_data):
+    """Depth > 256 (bf16 exactness limit) and oversized persistent tensors
+    both decline the fast path; explain then routes through row evaluation
+    and still produces the same result."""
+
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    from distributedkernelshap_tpu.ops.explain import ShapConfig, _use_masked_ey
+
+    X, y = clf_data
+    clf = GradientBoostingClassifier(n_estimators=5, max_depth=3,
+                                     random_state=0).fit(X, (y > 0).astype(int))
+    pred = lift_tree_ensemble(clf.predict_proba)
+    assert pred.supports_masked_ey
+    pred.depth = 300                      # exceeds bf16-exact integer range
+    assert not pred.supports_masked_ey
+    pred.depth = 3
+    cfg = ShapConfig()
+    assert _use_masked_ey(pred, B=8, N=20, S=64, M=6, config=cfg)
+    # huge background x huge ensemble: persistent R would dwarf the budget
+    assert not pred.masked_ey_fits(B=8, N=10 ** 7, S=64, M=6,
+                                   budget=cfg.target_chunk_elems)
+
+
+def test_explain_uses_masked_ey_and_matches_generic(clf_data):
+    """Full KernelShap phi through the masked-ey fast path equals the
+    row-materialising generic path."""
+
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    from distributedkernelshap_tpu import KernelShap
+
+    X, y = clf_data
+    y = (y > 0).astype(int)
+    clf = GradientBoostingClassifier(n_estimators=8, max_depth=3,
+                                     random_state=0).fit(X, y)
+    Xe = X[:10].astype(np.float32)
+
+    ex_fast = KernelShap(clf.predict_proba, link="logit", seed=0)
+    ex_fast.fit(X[:30])
+    assert ex_fast._explainer.predictor.supports_masked_ey
+    phi_fast = ex_fast.explain(Xe, silent=True).shap_values
+
+    slow_pred = lift_tree_ensemble(clf.predict_proba)
+    slow_pred.path_sign = None          # force iterative row eval everywhere
+    ex_slow = KernelShap(slow_pred, link="logit", seed=0)
+    ex_slow.fit(X[:30])
+    phi_slow = ex_slow.explain(Xe, silent=True).shap_values
+    for a, b in zip(phi_fast, phi_slow):
+        np.testing.assert_allclose(a, b, atol=5e-4)
+
+
 def test_property_random_forests_match_sklearn():
     """Property sweep: random forest/GBT shapes (stumps, deep trees, tiny
     leaf counts, class imbalance) all lift faithfully on f32-representable
