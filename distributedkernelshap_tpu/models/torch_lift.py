@@ -7,10 +7,13 @@ returns a pure-JAX predictor; torch is never called again after the lift.
 
 Supported layers: ``Linear``, ``ReLU``/``LeakyReLU``/``ELU``/``GELU``/
 ``SiLU``/``Tanh``/``Sigmoid``/``Softmax``/``LogSoftmax`` (last-dim),
-``BatchNorm1d`` (folded to its eval-mode affine using running statistics),
-``LayerNorm`` (last-dim), ``Dropout``/``Identity``/1-dim ``Flatten``
-(no-ops at inference), and nested ``Sequential``.  Anything else declines,
-and the model still runs through a tensor-converting host callback
+``BatchNorm1d``/``BatchNorm2d`` (folded to their eval-mode affines using
+running statistics), ``LayerNorm`` (last-dim), ``Dropout``/``Identity``
+(no-ops at inference), nested ``Sequential``, and the feed-forward CNN
+surface — ``Unflatten(1, (C,H,W))`` (how a flat ``(n, D)`` KernelSHAP row
+enters a conv stack), ``Conv2d`` (zero padding; strides/dilation/groups),
+``MaxPool2d``/``AvgPool2d``, ``Flatten``.  Anything else declines, and the
+model still runs through a tensor-converting host callback
 (``torch_callback``) so arbitrary torch models work unlifted.
 
 The lift reproduces **eval-mode** semantics (dropout off, batch-norm running
@@ -99,6 +102,30 @@ def _apply_stage(stage: Stage, X):
     kind = stage[0]
     if kind == "linear":
         return X @ stage[1] + stage[2][None, :]
+    if kind == "unflatten":                      # (n, D) -> (n, C, H, W)
+        return X.reshape((X.shape[0],) + stage[1])
+    if kind == "conv2d":                         # NCHW, torch semantics
+        W, b, stride, padding, dilation, groups = stage[1:]
+        out = jax.lax.conv_general_dilated(
+            X, W, window_strides=stride,
+            padding=[(padding[0], padding[0]), (padding[1], padding[1])],
+            rhs_dilation=dilation, feature_group_count=groups,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return out + b[None, :, None, None]
+    if kind == "maxpool2d":
+        k, stride, padding = stage[1:]
+        return jax.lax.reduce_window(
+            X, -jnp.inf, jax.lax.max, (1, 1) + k, (1, 1) + stride,
+            [(0, 0), (0, 0), (padding[0], padding[0]), (padding[1], padding[1])])
+    if kind == "avgpool2d":
+        k, stride = stage[1:]
+        summed = jax.lax.reduce_window(
+            X, 0.0, jax.lax.add, (1, 1) + k, (1, 1) + stride, "VALID")
+        return summed / (k[0] * k[1])
+    if kind == "affine_chan":                    # BatchNorm2d eval affine
+        return X * stage[1][None, :, None, None] + stage[2][None, :, None, None]
+    if kind == "flatten":                        # back to (n, D')
+        return X.reshape(X.shape[0], -1)
     if kind == "affine":
         return X * stage[1][None, :] + stage[2][None, :]
     if kind == "layernorm":
@@ -164,7 +191,7 @@ def _stages_from_module(module) -> Optional[List[Stage]]:
             b = (jnp.asarray(layer.bias.detach().cpu().numpy(), jnp.float32)
                  if layer.bias is not None else jnp.zeros(W.shape[1], jnp.float32))
             stages.append(("linear", W, b))
-        elif isinstance(layer, nn.BatchNorm1d):
+        elif isinstance(layer, (nn.BatchNorm1d, nn.BatchNorm2d)):
             if layer.running_mean is None:
                 return None          # track_running_stats=False: batch-dependent
             mean = layer.running_mean.detach().cpu().numpy()
@@ -176,7 +203,8 @@ def _stages_from_module(module) -> Optional[List[Stage]]:
                 be = layer.bias.detach().cpu().numpy()
                 shift = shift * g + be
                 scale = scale * g
-            stages.append(("affine", jnp.asarray(scale, jnp.float32),
+            kind = "affine_chan" if isinstance(layer, nn.BatchNorm2d) else "affine"
+            stages.append((kind, jnp.asarray(scale, jnp.float32),
                            jnp.asarray(shift, jnp.float32)))
         elif isinstance(layer, nn.LayerNorm):
             if len(layer.normalized_shape) != 1:
@@ -189,12 +217,48 @@ def _stages_from_module(module) -> Optional[List[Stage]]:
                   else np.zeros(d))
             stages.append(("layernorm", jnp.asarray(g, jnp.float32),
                            jnp.asarray(be, jnp.float32), float(layer.eps)))
-        elif isinstance(layer, (nn.Dropout, nn.Identity)):
+        elif isinstance(layer, (nn.Dropout, nn.Dropout2d, nn.Identity)):
             continue                 # inference no-ops
+        elif isinstance(layer, nn.Unflatten):
+            # only flat-row -> (C, H, W) image entry; other ranks would hit
+            # the 2-D stages (BatchNorm1d affine etc.) on the wrong axis
+            if layer.dim != 1 or len(layer.unflattened_size) != 3:
+                return None
+            stages.append(("unflatten", tuple(int(d) for d in layer.unflattened_size)))
+        elif isinstance(layer, nn.Conv2d):
+            if layer.padding_mode != "zeros" or isinstance(layer.padding, str):
+                return None
+            W = jnp.asarray(layer.weight.detach().cpu().numpy(), jnp.float32)
+            b = (jnp.asarray(layer.bias.detach().cpu().numpy(), jnp.float32)
+                 if layer.bias is not None
+                 else jnp.zeros(layer.out_channels, jnp.float32))
+            stages.append(("conv2d", W, b, tuple(layer.stride),
+                           tuple(layer.padding), tuple(layer.dilation),
+                           int(layer.groups)))
+        elif isinstance(layer, nn.MaxPool2d):
+            k = layer.kernel_size if isinstance(layer.kernel_size, tuple) \
+                else (layer.kernel_size,) * 2
+            st = layer.stride if isinstance(layer.stride, tuple) \
+                else (layer.stride or layer.kernel_size,) * 2
+            pad = layer.padding if isinstance(layer.padding, tuple) \
+                else (layer.padding,) * 2
+            if layer.dilation not in (1, (1, 1)) or layer.ceil_mode:
+                return None
+            stages.append(("maxpool2d", k, st, pad))
+        elif isinstance(layer, nn.AvgPool2d):
+            k = layer.kernel_size if isinstance(layer.kernel_size, tuple) \
+                else (layer.kernel_size,) * 2
+            st = layer.stride if isinstance(layer.stride, tuple) \
+                else (layer.stride or layer.kernel_size,) * 2
+            if layer.padding not in (0, (0, 0)) or layer.ceil_mode \
+                    or not layer.count_include_pad \
+                    or layer.divisor_override is not None:
+                return None
+            stages.append(("avgpool2d", k, st))
         elif isinstance(layer, nn.Flatten):
             if layer.start_dim != 1:
-                return None          # 2-D inputs are already flat
-            continue
+                return None
+            stages.append(("flatten",))
         elif name in _ACT_STAGES:
             stage = _ACT_STAGES[name](layer)
             if stage is None:

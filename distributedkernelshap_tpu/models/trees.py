@@ -261,19 +261,15 @@ class TreeEnsemblePredictor(BasePredictor):
         if self.path_sign is None:
             raw = self._eval_iterative(X)
         else:
+            from distributedkernelshap_tpu.models._chunking import padded_chunk_map
+
             T, Nn = self.feature.shape
             per_row = T * max(Nn, self.n_leaves)
             chunk = max(1, min(X.shape[0], self.target_chunk_elems // per_row))
             if X.shape[0] <= chunk:
                 raw = self._eval_paths(X)
             else:
-                n = X.shape[0]
-                n_chunks = -(-n // chunk)
-                pad = n_chunks * chunk - n
-                Xp = jnp.concatenate([X, jnp.zeros((pad, X.shape[1]), X.dtype)], 0) if pad else X
-                raw = jax.lax.map(self._eval_paths,
-                                  Xp.reshape(n_chunks, chunk, X.shape[1]))
-                raw = raw.reshape(n_chunks * chunk, -1)[:n]
+                raw = padded_chunk_map(self._eval_paths, X, chunk)
         return self._finish(raw)
 
     # ------------------------------------------------------------------

@@ -189,6 +189,80 @@ def test_bound_dunder_call_lifts(data):
     np.testing.assert_allclose(np.asarray(pred(data[:8])), expected, atol=2e-5)
 
 
+def test_cnn_lifts(data):
+    """A feed-forward torch CNN (Unflatten -> Conv2d -> pool -> Flatten ->
+    Linear) lifts and matches torch's own outputs."""
+
+    torch.manual_seed(13)
+    net = nn.Sequential(
+        nn.Unflatten(1, (1, 8, 8)),
+        nn.Conv2d(1, 4, 3, padding=1), nn.ReLU(), nn.MaxPool2d(2),
+        nn.Conv2d(4, 8, 3, padding=1), nn.BatchNorm2d(8), nn.ReLU(),
+        nn.AvgPool2d(2),
+        nn.Flatten(), nn.Linear(8 * 2 * 2, 3), nn.Softmax(dim=-1)).eval()
+    rng = np.random.default_rng(40)
+    X = rng.normal(size=(32, 64)).astype(np.float32)
+    lifted = lift_torch(net)
+    assert lifted is not None and lifted.n_outputs == 3
+    with torch.no_grad():
+        expected = net(torch.from_numpy(X)).numpy()
+    np.testing.assert_allclose(np.asarray(lifted(X)), expected, atol=3e-5)
+
+
+def test_cnn_strided_grouped_conv(data):
+    torch.manual_seed(14)
+    net = nn.Sequential(
+        nn.Unflatten(1, (2, 8, 8)),
+        nn.Conv2d(2, 6, 3, stride=2, padding=1, groups=2), nn.SiLU(),
+        nn.Flatten(), nn.Linear(6 * 4 * 4, 2)).eval()
+    rng = np.random.default_rng(41)
+    X = rng.normal(size=(16, 128)).astype(np.float32)
+    lifted = lift_torch(net)
+    assert lifted is not None
+    with torch.no_grad():
+        expected = net(torch.from_numpy(X)).numpy()
+    np.testing.assert_allclose(np.asarray(lifted(X)), expected, atol=3e-5)
+
+
+def test_cnn_guards_decline(data):
+    """divisor_override AvgPool and non-image Unflatten are structurally
+    unreproduced and must decline, not mis-lift."""
+
+    net1 = nn.Sequential(nn.Unflatten(1, (1, 4, 4)),
+                         nn.AvgPool2d(2, divisor_override=1),
+                         nn.Flatten(), nn.Linear(4, 2)).eval()
+    assert lift_torch(net1) is None
+    net2 = nn.Sequential(nn.Unflatten(1, (3, 3)), nn.BatchNorm1d(3),
+                         nn.Flatten(), nn.Linear(9, 2)).eval()
+    assert lift_torch(net2) is None
+
+
+def test_cnn_explain_end_to_end(data):
+    """Image KernelSHAP over a lifted torch CNN with superpixel groups."""
+
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.ops.image import superpixel_groups
+
+    torch.manual_seed(15)
+    net = nn.Sequential(
+        nn.Unflatten(1, (1, 8, 8)),
+        nn.Conv2d(1, 4, 3, padding=1), nn.ReLU(), nn.MaxPool2d(2),
+        nn.Flatten(), nn.Linear(4 * 4 * 4, 2), nn.Softmax(dim=-1)).eval()
+    rng = np.random.default_rng(42)
+    X = rng.normal(size=(60, 64)).astype(np.float32)
+    groups, names = superpixel_groups(8, 8, patch=4)
+    ex = KernelShap(net, link="logit", seed=0, feature_names=names)
+    ex.fit(X[:10], group_names=names, groups=groups)
+    assert isinstance(ex._explainer.predictor, TorchMLPPredictor)
+    res = ex.explain(X[10:18], silent=True)
+    with torch.no_grad():
+        proba = np.clip(net(torch.from_numpy(X[10:18])).numpy(), 1e-7, 1 - 1e-7)
+    for k, phi in enumerate(res.shap_values):
+        lhs = phi.sum(axis=1) + res.expected_value[k]
+        rhs = np.log(proba[:, k] / (1 - proba[:, k]))
+        np.testing.assert_allclose(lhs, rhs, rtol=1e-3, atol=5e-3)
+
+
 def test_explain_end_to_end_torch(data):
     from distributedkernelshap_tpu import KernelShap
 
