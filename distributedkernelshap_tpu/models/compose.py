@@ -201,6 +201,25 @@ class MeanEnsemblePredictor(BasePredictor):
         outs = jnp.stack([m(X) for m in self.members])      # (M, n, K)
         return jnp.einsum("mnk,m->nk", outs, self.weights)
 
+    @property
+    def supports_masked_ey(self) -> bool:
+        """Expectation is linear, so the ensemble's masked evaluation is the
+        weighted mean of member masked evaluations — available whenever every
+        member has a fast path."""
+
+        return all(getattr(m, "supports_masked_ey", False) for m in self.members)
+
+    def masked_ey_fits(self, **kwargs) -> bool:
+        return all(getattr(m, "masked_ey_fits", lambda **kw: True)(**kwargs)
+                   for m in self.members)
+
+    def masked_ey(self, X, bg, bgw_n, mask, G, target_chunk_elems=None,
+                  coalition_chunk=None):
+        parts = [m.masked_ey(X, bg, bgw_n, mask, G, target_chunk_elems,
+                             coalition_chunk=coalition_chunk)
+                 for m in self.members]
+        return jnp.einsum("mbsk,m->bsk", jnp.stack(parts), self.weights)
+
 
 class CalibratedBinaryPredictor(BasePredictor):
     """Binary probability calibration over a lifted margin model.

@@ -150,6 +150,36 @@ class LinearPredictor(BasePredictor):
     def linear_decomposition(self):
         return self.W, self.b, self.activation
 
+    # the explain builder prefers the decomposition branch directly; this
+    # uniform masked_ey exists so composite predictors (soft-voting means)
+    # can forward their members through one protocol
+    supports_masked_ey = True
+    #: default chunk budget, matching the sibling masked_ey implementations
+    target_chunk_elems: int = 1 << 25
+
+    def masked_ey_fits(self, **kwargs) -> bool:
+        return True
+
+    def masked_ey(self, X, bg, bgw_n, mask, G, target_chunk_elems=None,
+                  coalition_chunk=None):
+        from distributedkernelshap_tpu.ops.explain import _auto_chunk, _ey_linear
+
+        budget = target_chunk_elems or self.target_chunk_elems
+        S = mask.shape[0]
+        chunk = coalition_chunk or _auto_chunk(
+            S, X.shape[0] * bg.shape[0] * self.n_outputs, budget)
+        # use_pallas stays off here: this path has no ShapConfig to carry the
+        # caller's sharding context, and a pallas_call under a GSPMD-sharded
+        # jit has no partitioning rule (ops/explain.py:54-57).  The cost is
+        # the chunked-XLA eval for linear members inside ensembles — small
+        # next to their tree/SVM co-members
+        return _ey_linear(self.W, self.b, self.activation,
+                          jnp.asarray(X, jnp.float32),
+                          jnp.asarray(bg, jnp.float32), bgw_n,
+                          jnp.asarray(mask, jnp.float32),
+                          jnp.asarray(G, jnp.float32), chunk,
+                          use_pallas=False)
+
 
 class JaxPredictor(BasePredictor):
     """Wraps a user-supplied jittable function ``(n, D) -> (n, K)``."""

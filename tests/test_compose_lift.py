@@ -275,6 +275,39 @@ def test_pca_pipeline_does_not_forward_masked_ey(data):
     assert not pred.supports_masked_ey
 
 
+def test_voting_forwards_masked_ey(data):
+    """A soft-voting LR+GBT ensemble rides the masked fast path (expectation
+    is linear over members) and matches the row-evaluating path."""
+
+    from sklearn.ensemble import GradientBoostingClassifier, VotingClassifier
+    from sklearn.linear_model import LogisticRegression
+
+    from distributedkernelshap_tpu import KernelShap
+
+    X, y, _ = data
+    clf = VotingClassifier(
+        [("lr", LogisticRegression()),
+         ("gb", GradientBoostingClassifier(n_estimators=8, max_depth=3,
+                                           random_state=0))],
+        voting="soft", weights=[2.0, 1.0]).fit(X, y)
+    pred = as_predictor(clf.predict_proba, example_dim=X.shape[1])
+    assert isinstance(pred, MeanEnsemblePredictor) and pred.supports_masked_ey
+
+    Xq = _quant(X)
+    ex_fast = KernelShap(clf.predict_proba, link="logit", seed=0)
+    ex_fast.fit(Xq[:30])
+    phi_fast = ex_fast.explain(Xq[200:212], silent=True).shap_values
+
+    slow = as_predictor(clf.predict_proba, example_dim=X.shape[1])
+    slow.members[1].path_sign = None     # tree member loses its fast path
+    assert not slow.supports_masked_ey
+    ex_slow = KernelShap(slow, link="logit", seed=0)
+    ex_slow.fit(Xq[:30])
+    phi_slow = ex_slow.explain(Xq[200:212], silent=True).shap_values
+    for a, b in zip(phi_fast, phi_slow):
+        np.testing.assert_allclose(a, b, atol=5e-4)
+
+
 def test_explain_end_to_end_pipeline(data):
     from sklearn.linear_model import LogisticRegression
     from sklearn.pipeline import Pipeline
