@@ -428,6 +428,34 @@ def test_explain_uses_masked_ey_and_matches_generic(clf_data):
         np.testing.assert_allclose(a, b, atol=5e-4)
 
 
+def test_l1_reg_over_masked_path(clf_data):
+    """l1 feature selection consumes per-coalition ey stats computed through
+    the masked fast path; the selected-features result keeps additivity."""
+
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    from distributedkernelshap_tpu import KernelShap
+
+    X, y = clf_data
+    y = (y > 0).astype(int)
+    clf = GradientBoostingClassifier(n_estimators=8, max_depth=3,
+                                     random_state=0).fit(X, y)
+    ex = KernelShap(clf.predict_proba, link="logit", seed=0)
+    ex.fit(X[:30])
+    assert ex._explainer.predictor.supports_masked_ey
+    Xe = X[:8].astype(np.float32)
+    res = ex.explain(Xe, silent=True, nsamples=48, l1_reg="num_features(4)")
+    phi = res.shap_values[1]
+    assert phi.shape == (8, 6)
+    # at most 4 features carry weight per instance (plus the constrained last)
+    nonzero = (np.abs(phi) > 1e-8).sum(axis=1)
+    assert nonzero.max() <= 5
+    proba = np.clip(clf.predict_proba(Xe.astype(np.float64)), 1e-7, 1 - 1e-7)
+    lhs = phi.sum(axis=1) + res.expected_value[1]
+    rhs = np.log(proba[:, 1] / (1 - proba[:, 1]))
+    np.testing.assert_allclose(lhs, rhs, atol=5e-3)
+
+
 def test_property_random_forests_match_sklearn():
     """Property sweep: random forest/GBT shapes (stumps, deep trees, tiny
     leaf counts, class imbalance) all lift faithfully on f32-representable
