@@ -16,6 +16,51 @@ import jax
 import jax.numpy as jnp
 
 
+def first_layer_separated_ey(W1, b1, tail_fn, X, bg, bgw_n, mask, G,
+                             budget: int, coalition_chunk=None,
+                             h_max: int = None):
+    """Masked expected outputs for networks whose FIRST layer is dense.
+
+    The first layer is linear in the synthetic row, so its pre-activations
+    separate into instance + background group-space terms (the ``_ey_linear``
+    decomposition); ``tail_fn`` applies everything after the first layer's
+    pre-activations to the assembled ``(chunk, B, N, H)`` tensor and must
+    return ``(chunk, B, N, K)``.  Shared by the sklearn and torch MLP
+    ``masked_ey`` implementations so the chunk-budget and einsum logic exists
+    once.  Only per-chunk tensors scale with ``B``; the persistent
+    background-side terms are ``N·M·H``.
+    """
+
+    X = jnp.asarray(X, jnp.float32)
+    bg = jnp.asarray(bg, jnp.float32)
+    mask = jnp.asarray(mask, jnp.float32)
+    Gm = jnp.asarray(G, jnp.float32)
+    B, N, S = X.shape[0], bg.shape[0], mask.shape[0]
+    M = mask.shape[1]
+    H = W1.shape[1]
+    h_max = max(H, h_max or 0)
+
+    bgW = bg @ W1 + b1[None, :]                          # (N, H)
+    bgWg = jnp.einsum("nd,md,dh->nmh", bg, Gm, W1)       # (N, M, H)
+    bc = max(1, min(B, budget // max(1, N * h_max, M * H)))
+    sc = coalition_chunk or max(
+        1, min(S, budget // max(1, bc * N * h_max)))
+
+    def b_chunk(Xc):
+        XWg = jnp.einsum("bd,md,dh->bmh", Xc, Gm, W1)    # (bc, M, H)
+
+        def s_chunk(mask_c):
+            p1 = jnp.einsum("cm,bmh->cbh", mask_c, XWg)
+            t2 = jnp.einsum("cm,nmh->cnh", mask_c, bgWg)
+            z1 = p1[:, :, None, :] + bgW[None, None] - t2[:, None]
+            return jnp.einsum("cbnk,n->cbk", tail_fn(z1), bgw_n)
+
+        ey_c = padded_chunk_map(s_chunk, mask, sc)       # (S, bc, K)
+        return jnp.moveaxis(ey_c, 0, 1)                  # (bc, S, K)
+
+    return padded_chunk_map(b_chunk, X, bc)              # (B, S, K)
+
+
 def padded_chunk_map(fn, arr, chunk: int):
     n = arr.shape[0]
     chunk = max(1, min(n, int(chunk)))

@@ -47,6 +47,8 @@ def _apply_stage(stage: Stage, X):
         return jnp.where(jnp.isnan(X), stage[1][None, :], X)
     if kind == "clip":                    # MinMaxScaler(clip=True)
         return jnp.clip(X, stage[1], stage[2])
+    if kind == "select":                  # static column subset (bagging)
+        return X[:, stage[1]]
     raise ValueError(f"unknown stage kind {kind!r}")
 
 
@@ -156,14 +158,17 @@ class PipelinePredictor(BasePredictor):
 
     @property
     def supports_masked_ey(self) -> bool:
-        """Columnwise stages (affine / NaN-impute / clip) commute with the
-        KernelSHAP column mask — ``t(x·z + bg·(1-z)) = t(x)·z + t(bg)·(1-z)``
-        per column — so the inner predictor's structure-aware masked
-        evaluation (e.g. the separable-hits tree path) forwards exactly with
-        pre-transformed sources.  Column-mixing stages ('linear': PCA/SVD)
-        break the two-source structure and fall back to row evaluation."""
+        """Columnwise stages (affine / NaN-impute / clip / column select)
+        commute with the KernelSHAP column mask —
+        ``t(x·z + bg·(1-z)) = t(x)·z + t(bg)·(1-z)`` per column — so the
+        inner predictor's structure-aware masked evaluation (e.g. the
+        separable-hits tree path) forwards exactly with pre-transformed
+        sources (a select additionally re-indexes the group matrix).
+        Column-mixing stages ('linear': PCA/SVD) break the two-source
+        structure and fall back to row evaluation."""
 
-        return (all(s[0] in ("affine", "impute", "clip") for s in self.stages)
+        return (all(s[0] in ("affine", "impute", "clip", "select")
+                    for s in self.stages)
                 and getattr(self.inner, "supports_masked_ey", False))
 
     def masked_ey_fits(self, **kwargs) -> bool:
@@ -174,9 +179,12 @@ class PipelinePredictor(BasePredictor):
                   coalition_chunk=None):
         X = jnp.asarray(X, jnp.float32)
         bg = jnp.asarray(bg, jnp.float32)
+        G = jnp.asarray(G, jnp.float32)
         for stage in self.stages:
             X = _apply_stage(stage, X)
             bg = _apply_stage(stage, bg)
+            if stage[0] == "select":      # groups follow the column subset
+                G = G[:, stage[1]]
         return self.inner.masked_ey(X, bg, bgw_n, mask, G, target_chunk_elems,
                                     coalition_chunk=coalition_chunk)
 
@@ -327,6 +335,47 @@ def lift_voting(method) -> Optional[BasePredictor]:
         return MeanEnsemblePredictor(members, weights=weights)
     except Exception as exc:
         logger.info("voting lift failed structurally (%s); using host path", exc)
+        return None
+
+
+def lift_bagging(method) -> Optional[BasePredictor]:
+    """Lift ``BaggingClassifier.predict_proba`` / ``BaggingRegressor.predict``
+    when every member lifts: the mean of member predictions, each member
+    seeing its own bootstrap feature subset (a 'select' stage that commutes
+    with the KernelSHAP column mask)."""
+
+    owner = getattr(method, "__self__", None)
+    name = getattr(method, "__name__", "")
+    if owner is None:
+        return None
+    cls = type(owner).__name__
+    try:
+        if cls == "BaggingClassifier" and name == "predict_proba":
+            method_names = ("predict_proba",)
+        elif cls == "BaggingRegressor" and name == "predict":
+            method_names = ("predict",)
+        else:
+            return None
+        n_features = owner.n_features_in_
+        members = []
+        for est, feats in zip(owner.estimators_, owner.estimators_features_):
+            if not all(hasattr(est, m) for m in method_names):
+                return None  # sklearn would fall back to a different method
+            inner = _inner_lift(est, method_names)
+            if inner is None:
+                return None
+            feats = np.asarray(feats)
+            if feats.shape[0] == n_features and np.array_equal(
+                    feats, np.arange(n_features)):
+                members.append(inner)
+            else:
+                members.append(PipelinePredictor(
+                    [("select", jnp.asarray(feats, jnp.int32))], inner))
+        if not members:
+            return None
+        return MeanEnsemblePredictor(members)
+    except Exception as exc:
+        logger.info("bagging lift failed structurally (%s); using host path", exc)
         return None
 
 

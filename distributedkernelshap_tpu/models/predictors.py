@@ -231,21 +231,80 @@ class MLPPredictor(BasePredictor):
         self.n_outputs = 2 if out_activation == "binary_sigmoid" else k_raw
         self.vector_out = vector_out
 
-    def __call__(self, X):
-        act = _MLP_HIDDEN_ACTIVATIONS[self.hidden_activation]
-        h = X
-        for W, b in self.layers[:-1]:
-            h = act(h @ W + b)
-        W, b = self.layers[-1]
-        z = h @ W + b
+    def _head(self, z):
+        """Output transform for any leading dims (``z[..., K_raw]``)."""
+
         if self.out_activation == "binary_sigmoid":
-            p = jax.nn.sigmoid(z[:, 0])
-            return jnp.stack([1.0 - p, p], axis=1)
+            p = jax.nn.sigmoid(z[..., 0])
+            return jnp.stack([1.0 - p, p], axis=-1)
         if self.out_activation == "sigmoid":
             return jax.nn.sigmoid(z)
         if self.out_activation == "softmax":
             return jax.nn.softmax(z, axis=-1)
         return z
+
+    def _tail(self, h):
+        """Hidden layers 2..n and the final linear, for any leading dims
+        (``h`` already holds the FIRST layer's activations)."""
+
+        act = _MLP_HIDDEN_ACTIVATIONS[self.hidden_activation]
+        for W, b in self.layers[1:-1]:
+            h = act(h @ W + b)
+        W, b = self.layers[-1]
+        return h @ W + b
+
+    def __call__(self, X):
+        act = _MLP_HIDDEN_ACTIVATIONS[self.hidden_activation]
+        W, b = self.layers[0]
+        if len(self.layers) == 1:
+            return self._head(X @ W + b)
+        return self._head(self._tail(act(X @ W + b)))
+
+    # ------------------------------------------------------------------
+    # structure-aware masked evaluation for the KernelSHAP pipeline
+    # ------------------------------------------------------------------
+
+    #: default chunk budget, matching the sibling masked_ey implementations
+    target_chunk_elems: int = 1 << 25
+
+    @property
+    def supports_masked_ey(self) -> bool:
+        return True
+
+    def masked_ey_fits(self, B: int, N: int, S: int, M: int,
+                       budget: int) -> bool:
+        # only per-chunk tensors scale with B; the persistent background
+        # terms are N·M·H
+        H = int(self.layers[0][0].shape[1])
+        return N * M * H <= 4 * budget
+
+    def masked_ey(self, X, bg, bgw_n, mask, G, target_chunk_elems=None,
+                  coalition_chunk=None):
+        """Expected outputs over the KernelSHAP synthetic tensor: the first
+        dense layer is linear in the row, so its pre-activations separate
+        into instance + background group-space terms (exactly the
+        ``_ey_linear`` decomposition); the remaining layers run on the
+        assembled ``(chunk, B, N, H)`` hidden tensor.  Per synthetic row this
+        replaces the ``D×H`` input matmul with one add — and, unlike the row
+        path, never materialises the ``(rows, D)`` synthetic matrix."""
+
+        from distributedkernelshap_tpu.models._chunking import (
+            first_layer_separated_ey,
+        )
+
+        act = _MLP_HIDDEN_ACTIVATIONS[self.hidden_activation]
+        W1, b1 = self.layers[0]
+
+        def tail(z1):
+            if len(self.layers) == 1:
+                return self._head(z1)
+            return self._head(self._tail(act(z1)))
+
+        return first_layer_separated_ey(
+            W1, b1, tail, X, bg, bgw_n, mask, G,
+            budget=target_chunk_elems or self.target_chunk_elems,
+            coalition_chunk=coalition_chunk,
+            h_max=max(int(Wl.shape[1]) for Wl, _ in self.layers))
 
 
 def _lift_sklearn_mlp(method) -> Optional[MLPPredictor]:
@@ -395,6 +454,7 @@ def _nonlinear_lifters():
     recurse through :func:`structural_lift` for their members)."""
 
     from distributedkernelshap_tpu.models.compose import (
+        lift_bagging,
         lift_calibrated,
         lift_pipeline,
         lift_voting,
@@ -413,6 +473,7 @@ def _nonlinear_lifters():
             ("torch feed-forward", lift_torch),
             ("pipeline", lift_pipeline),
             ("voting ensemble", lift_voting),
+            ("bagging ensemble", lift_bagging),
             ("calibrated classifier", lift_calibrated))
 
 

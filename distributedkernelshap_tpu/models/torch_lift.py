@@ -153,8 +153,19 @@ def _apply_stage(stage: Stage, X):
     raise ValueError(f"unknown stage kind {stage[0]!r}")
 
 
+#: stage kinds operating on the last axis only — safe to run on the 4-D
+#: masked hidden tensor (image stages reshape and are excluded)
+_DENSE_STAGE_KINDS = frozenset(
+    {"linear", "affine", "layernorm", "softmax", "log_softmax"}
+    | {f"act_{a}" for a in ("relu", "tanh", "sigmoid", "silu", "leaky_relu",
+                            "elu", "gelu")})
+
+
 class TorchMLPPredictor(BasePredictor):
     """A lifted feed-forward torch network: picklable stages, pure JAX."""
+
+    #: default chunk budget, matching the sibling masked_ey implementations
+    target_chunk_elems: int = 1 << 25
 
     def __init__(self, stages: List[Stage], n_outputs: int, vector_out: bool = True):
         self.stages = list(stages)
@@ -166,6 +177,48 @@ class TorchMLPPredictor(BasePredictor):
         for stage in self.stages:
             X = _apply_stage(stage, X)
         return X
+
+    # ------------------------------------------------------------------
+    # structure-aware masked evaluation for the KernelSHAP pipeline
+    # ------------------------------------------------------------------
+
+    @property
+    def supports_masked_ey(self) -> bool:
+        """Dense-only chains starting with a Linear layer: the first layer's
+        pre-activations separate into instance + background group-space
+        terms; the remaining last-axis stages run on the assembled hidden
+        tensor.  CNN chains (unflatten/conv/pool) mix columns and keep the
+        row paths."""
+
+        return (bool(self.stages) and self.stages[0][0] == "linear"
+                and all(s[0] in _DENSE_STAGE_KINDS for s in self.stages))
+
+    def masked_ey_fits(self, B: int, N: int, S: int, M: int,
+                       budget: int) -> bool:
+        # only per-chunk tensors scale with B; the persistent background
+        # terms are N·M·H
+        H = int(self.stages[0][1].shape[1])
+        return N * M * H <= 4 * budget
+
+    def masked_ey(self, X, bg, bgw_n, mask, G, target_chunk_elems=None,
+                  coalition_chunk=None):
+        from distributedkernelshap_tpu.models._chunking import (
+            first_layer_separated_ey,
+        )
+
+        rest = self.stages[1:]
+
+        def tail(z1):
+            for stage in rest:
+                z1 = _apply_stage(stage, z1)
+            return z1
+
+        return first_layer_separated_ey(
+            self.stages[0][1], self.stages[0][2], tail, X, bg, bgw_n, mask, G,
+            budget=target_chunk_elems or self.target_chunk_elems,
+            coalition_chunk=coalition_chunk,
+            h_max=max([int(self.stages[0][1].shape[1])]
+                      + [int(s[1].shape[1]) for s in rest if s[0] == "linear"]))
 
 
 def _stages_from_module(module) -> Optional[List[Stage]]:

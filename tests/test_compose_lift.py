@@ -194,6 +194,63 @@ def test_voting_regressor(data):
     _check(pred, reg.predict, X[:64])
 
 
+def test_bagging_classifier_with_feature_subsets(data):
+    """Bagged trees on bootstrap feature subsets lift: each member gets a
+    'select' stage; the mean matches sklearn."""
+
+    from sklearn.ensemble import BaggingClassifier
+
+    X, y, _ = data
+    clf = BaggingClassifier(n_estimators=7, max_features=0.5,
+                            bootstrap_features=True, random_state=0).fit(X, y)
+    pred = as_predictor(clf.predict_proba, example_dim=X.shape[1])
+    assert isinstance(pred, MeanEnsemblePredictor)
+    assert any(isinstance(m, PipelinePredictor) for m in pred.members)
+    _check(pred, clf.predict_proba, X[:64])
+
+
+def test_bagging_regressor(data):
+    from sklearn.ensemble import BaggingRegressor
+
+    X, _, yr = data
+    reg = BaggingRegressor(n_estimators=5, max_features=4,
+                           random_state=0).fit(X, yr)
+    pred = as_predictor(reg.predict, example_dim=X.shape[1])
+    assert isinstance(pred, MeanEnsemblePredictor)
+    _check(pred, reg.predict, X[:64])
+
+
+def test_bagging_forwards_masked_ey(data):
+    """Feature-subset members still ride the masked fast path (the select
+    stage re-indexes the group matrix); phi matches row evaluation."""
+
+    from sklearn.ensemble import BaggingClassifier
+
+    from distributedkernelshap_tpu import KernelShap
+
+    X, y, _ = data
+    clf = BaggingClassifier(n_estimators=5, max_features=0.7,
+                            bootstrap_features=True, random_state=0).fit(X, y)
+    pred = as_predictor(clf.predict_proba, example_dim=X.shape[1])
+    assert pred.supports_masked_ey
+
+    Xq = _quant(X)
+    ex_fast = KernelShap(clf.predict_proba, link="logit", seed=0)
+    ex_fast.fit(Xq[:30])
+    phi_fast = ex_fast.explain(Xq[200:210], silent=True).shap_values
+
+    slow = as_predictor(clf.predict_proba, example_dim=X.shape[1])
+    for m in slow.members:
+        inner = m.inner if isinstance(m, PipelinePredictor) else m
+        inner.path_sign = None
+    assert not slow.supports_masked_ey
+    ex_slow = KernelShap(slow, link="logit", seed=0)
+    ex_slow.fit(Xq[:30])
+    phi_slow = ex_slow.explain(Xq[200:210], silent=True).shap_values
+    for a, b in zip(phi_fast, phi_slow):
+        np.testing.assert_allclose(a, b, atol=5e-4)
+
+
 @pytest.mark.parametrize("method", ["sigmoid", "isotonic"])
 def test_calibrated_svc(data, method):
     """CalibratedClassifierCV(SVC) — the recommended replacement for the

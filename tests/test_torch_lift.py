@@ -263,6 +263,34 @@ def test_cnn_explain_end_to_end(data):
         np.testing.assert_allclose(lhs, rhs, rtol=1e-3, atol=5e-3)
 
 
+def test_masked_ey_matches_row_eval(data):
+    """Dense torch chains ride the first-layer-separated masked evaluation;
+    CNN chains decline it."""
+
+    from distributedkernelshap_tpu.ops.coalitions import coalition_plan
+    from distributedkernelshap_tpu.ops.explain import _ey_generic, groups_to_matrix
+
+    torch.manual_seed(16)
+    net = nn.Sequential(nn.Linear(5, 9), nn.GELU(), nn.LayerNorm(9),
+                        nn.Linear(9, 3), nn.Softmax(dim=-1)).eval()
+    pred = lift_torch(net)
+    assert pred.supports_masked_ey
+    for groups in (None, [[0, 1], [2], [3, 4]]):
+        G = groups_to_matrix(groups, 5)
+        plan = coalition_plan(G.shape[0], nsamples=30, seed=0)
+        Xe = data[:9]
+        bg = data[100:117]
+        bgw = np.full(bg.shape[0], 1.0 / bg.shape[0], np.float32)
+        mask = np.asarray(plan.mask, np.float32)
+        ey_rows = np.asarray(_ey_generic(pred, Xe, bg, bgw, mask @ G, chunk=8))
+        ey_fast = np.asarray(pred.masked_ey(Xe, bg, bgw, mask, G))
+        np.testing.assert_allclose(ey_fast, ey_rows, atol=2e-5)
+
+    cnn = nn.Sequential(nn.Unflatten(1, (1, 8, 8)), nn.Conv2d(1, 2, 3),
+                        nn.Flatten(), nn.Linear(2 * 36, 2)).eval()
+    assert not lift_torch(cnn).supports_masked_ey
+
+
 def test_explain_end_to_end_torch(data):
     from distributedkernelshap_tpu import KernelShap
 
