@@ -18,6 +18,7 @@ from distributedkernelshap_tpu.models.compose import (  # noqa: F401
     CalibratedBinaryPredictor,
     MeanEnsemblePredictor,
     PipelinePredictor,
+    StackingPredictor,
 )
 from distributedkernelshap_tpu.models.lgbm import (  # noqa: F401
     lift_lightgbm,

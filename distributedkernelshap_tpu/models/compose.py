@@ -338,6 +338,73 @@ def lift_voting(method) -> Optional[BasePredictor]:
         return None
 
 
+class StackingPredictor(BasePredictor):
+    """Lifted stacking: member predictions (column-sliced the way sklearn's
+    ``_concatenate_predictions`` does, plus the raw features when
+    ``passthrough``) feed a lifted final estimator."""
+
+    def __init__(self, members: Sequence[BasePredictor],
+                 slices: Sequence[Optional[Tuple[int, int]]],
+                 final: BasePredictor, passthrough: bool = False):
+        self.members = list(members)
+        self.slices = list(slices)
+        self.final = final
+        self.passthrough = passthrough
+        self.n_outputs = final.n_outputs
+        self.vector_out = final.vector_out
+
+    def __call__(self, X):
+        X = jnp.asarray(X, jnp.float32)
+        cols = []
+        for m, sl in zip(self.members, self.slices):
+            out = m(X)
+            cols.append(out if sl is None else out[:, sl[0]:sl[1]])
+        if self.passthrough:
+            cols.append(X)
+        return self.final(jnp.concatenate(cols, axis=1))
+
+
+def lift_stacking(method) -> Optional[BasePredictor]:
+    """Lift ``StackingClassifier.predict_proba`` /
+    ``StackingRegressor.predict`` when every member (via its fitted
+    ``stack_method_``) and the final estimator lift.  Class-label ``predict``
+    stack methods are discontinuous and decline."""
+
+    owner = getattr(method, "__self__", None)
+    name = getattr(method, "__name__", "")
+    if owner is None:
+        return None
+    cls = type(owner).__name__
+    try:
+        if cls == "StackingClassifier" and name == "predict_proba":
+            final_method = ("predict_proba",)
+            binary = len(owner.classes_) == 2
+        elif cls == "StackingRegressor" and name == "predict":
+            final_method = ("predict",)
+            binary = False
+        else:
+            return None
+        members, slices = [], []
+        for est, mname in zip(owner.estimators_, owner.stack_method_):
+            if cls == "StackingClassifier" and mname == "predict":
+                return None  # hard-label stacking feature: argmax
+            inner = _inner_lift(est, (mname,))
+            if inner is None:
+                return None
+            members.append(inner)
+            # sklearn drops the redundant first proba column for binary
+            slices.append((1, 2) if (mname == "predict_proba" and binary)
+                          else None)
+        final = _inner_lift(owner.final_estimator_, final_method)
+        if final is None:
+            return None
+        return StackingPredictor(members, slices, final,
+                                 passthrough=bool(owner.passthrough))
+    except Exception as exc:
+        logger.info("stacking lift failed structurally (%s); using host path", exc)
+        return None
+
+
 def lift_bagging(method) -> Optional[BasePredictor]:
     """Lift ``BaggingClassifier.predict_proba`` / ``BaggingRegressor.predict``
     when every member lifts: the mean of member predictions, each member

@@ -457,6 +457,7 @@ def _nonlinear_lifters():
         lift_bagging,
         lift_calibrated,
         lift_pipeline,
+        lift_stacking,
         lift_voting,
     )
     from distributedkernelshap_tpu.models.lgbm import lift_lightgbm
@@ -474,6 +475,7 @@ def _nonlinear_lifters():
             ("pipeline", lift_pipeline),
             ("voting ensemble", lift_voting),
             ("bagging ensemble", lift_bagging),
+            ("stacking ensemble", lift_stacking),
             ("calibrated classifier", lift_calibrated))
 
 

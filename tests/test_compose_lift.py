@@ -251,6 +251,81 @@ def test_bagging_forwards_masked_ey(data):
         np.testing.assert_allclose(a, b, atol=5e-4)
 
 
+@pytest.mark.parametrize("passthrough", [False, True])
+def test_stacking_classifier(data, passthrough):
+    from sklearn.ensemble import GradientBoostingClassifier, StackingClassifier
+    from sklearn.linear_model import LogisticRegression
+
+    from distributedkernelshap_tpu.models import StackingPredictor
+
+    X, y, _ = data
+    clf = StackingClassifier(
+        [("lr", LogisticRegression()),
+         ("gb", GradientBoostingClassifier(n_estimators=8, random_state=0))],
+        final_estimator=LogisticRegression(), cv=3,
+        passthrough=passthrough).fit(X, y)
+    pred = as_predictor(clf.predict_proba, example_dim=X.shape[1])
+    assert isinstance(pred, StackingPredictor)
+    _check(pred, clf.predict_proba, X[:64], atol=1e-4)
+
+
+def test_stacking_multiclass(data):
+    from sklearn.ensemble import StackingClassifier
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.tree import DecisionTreeClassifier
+
+    from distributedkernelshap_tpu.models import StackingPredictor
+
+    X, y, _ = data
+    y3 = y + (X[:, 3] > 2).astype(int)
+    clf = StackingClassifier(
+        [("lr", LogisticRegression()),
+         ("dt", DecisionTreeClassifier(max_depth=4, random_state=0))],
+        final_estimator=LogisticRegression(), cv=3).fit(X, y3)
+    pred = as_predictor(clf.predict_proba, example_dim=X.shape[1])
+    assert isinstance(pred, StackingPredictor) and pred.n_outputs == 3
+    _check(pred, clf.predict_proba, X[:64], atol=1e-4)
+
+
+def test_stacking_regressor(data):
+    from sklearn.ensemble import StackingRegressor
+    from sklearn.linear_model import LinearRegression
+    from sklearn.tree import DecisionTreeRegressor
+
+    from distributedkernelshap_tpu.models import StackingPredictor
+
+    X, _, yr = data
+    reg = StackingRegressor(
+        [("lin", LinearRegression()),
+         ("dt", DecisionTreeRegressor(max_depth=4, random_state=0))],
+        final_estimator=LinearRegression(), cv=3).fit(X, yr)
+    pred = as_predictor(reg.predict, example_dim=X.shape[1])
+    assert isinstance(pred, StackingPredictor)
+    _check(pred, reg.predict, X[:64], atol=1e-4)
+
+
+def test_stacking_explain_additivity(data):
+    from sklearn.ensemble import GradientBoostingClassifier, StackingClassifier
+    from sklearn.linear_model import LogisticRegression
+
+    from distributedkernelshap_tpu import KernelShap
+
+    X, y, _ = data
+    clf = StackingClassifier(
+        [("lr", LogisticRegression()),
+         ("gb", GradientBoostingClassifier(n_estimators=6, random_state=0))],
+        final_estimator=LogisticRegression(), cv=3).fit(X, y)
+    Xq = _quant(X)
+    ex = KernelShap(clf.predict_proba, link="logit", seed=0)
+    ex.fit(Xq[:30])
+    res = ex.explain(Xq[200:210], silent=True)
+    proba = np.clip(clf.predict_proba(Xq[200:210]), 1e-7, 1 - 1e-7)
+    for k, phi in enumerate(res.shap_values):
+        lhs = phi.sum(axis=1) + res.expected_value[k]
+        rhs = np.log(proba[:, k] / (1 - proba[:, k]))
+        np.testing.assert_allclose(lhs, rhs, rtol=1e-3, atol=5e-3)
+
+
 @pytest.mark.parametrize("method", ["sigmoid", "isotonic"])
 def test_calibrated_svc(data, method):
     """CalibratedClassifierCV(SVC) — the recommended replacement for the
