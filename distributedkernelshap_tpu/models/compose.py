@@ -9,10 +9,15 @@ recursively lifting the members through
 ``predictors.structural_lift`` and stitching them together with device ops:
 
 * ``PipelinePredictor`` — a chain of picklable transform stages
-  (elementwise-affine scalers, NaN imputation, linear projections like PCA)
-  applied before an inner predictor;
-* ``MeanEnsemblePredictor`` — weighted mean of member outputs
-  (soft voting, cv-ensembled calibration);
+  (elementwise-affine scalers, NaN imputation, clipping, static column
+  selects, linear projections like PCA) applied before an inner predictor;
+  columnwise stages forward the inner model's structure-aware masked
+  evaluation with pre-transformed sources;
+* ``MeanEnsemblePredictor`` — weighted mean of member outputs (soft voting,
+  bagging, cv-ensembled calibration); forwards the masked fast path
+  memberwise, since expectation is linear;
+* ``StackingPredictor`` — member predictions (sklearn's column-slicing
+  rules, optional feature passthrough) feeding a lifted final estimator;
 * ``CalibratedBinaryPredictor`` — a margin model followed by sigmoid
   (``1/(1+exp(a·f+b))``) or isotonic (``jnp.interp`` over the fitted
   thresholds — sklearn's own interpolation) calibration.

@@ -3,12 +3,15 @@
 The reference treats tree models (the XGBoost-class black box of
 BASELINE.json's stress configs) as opaque pickled callables evaluated on CPU
 workers (``explainers/wrappers.py:33-37``).  Here the ensemble itself is
-*lifted onto the device*: every tree becomes five padded arrays (feature,
-threshold, left, right, leaf value) and prediction is ``max_depth`` rounds of
-vectorised gathers over a ``(rows, trees)`` frontier — data-oblivious,
-shape-static, jit/vmap/shard_map-safe, so the KernelSHAP synthetic-data
-evaluation (``ops/explain.py:_ey_generic``) keeps the whole ``B×S×N`` tensor
-on-chip instead of round-tripping ~1e8 rows through a host callback.
+*lifted onto the device*: every tree becomes five padded node arrays
+(feature, threshold, left, right, leaf value), prediction runs as MXU
+path-matmuls over static leaf-path tensors (see
+:class:`TreeEnsemblePredictor`), and inside the KernelSHAP pipeline the
+synthetic ``B×S×N`` tensor is never even materialised — split-condition
+sums separate into instance and background halves (``masked_ey``).
+Everything is data-oblivious, shape-static, and jit/vmap/shard_map-safe,
+vs. round-tripping ~1e8 rows through a host callback in the reference's
+model.
 
 Supported sklearn families (``lift_tree_ensemble``):
 
