@@ -6,6 +6,10 @@ from distributedkernelshap_tpu.models.predictors import (  # noqa: F401
     MLPPredictor,
     as_predictor,
 )
+from distributedkernelshap_tpu.models.quadratic import (  # noqa: F401
+    QuadraticDiscriminantPredictor,
+    lift_gaussian_quadratic,
+)
 from distributedkernelshap_tpu.models.svm import (  # noqa: F401
     SVMPredictor,
     lift_svm,

@@ -461,12 +461,14 @@ def _nonlinear_lifters():
         lift_voting,
     )
     from distributedkernelshap_tpu.models.lgbm import lift_lightgbm
+    from distributedkernelshap_tpu.models.quadratic import lift_gaussian_quadratic
     from distributedkernelshap_tpu.models.svm import lift_svm
     from distributedkernelshap_tpu.models.torch_lift import lift_torch
     from distributedkernelshap_tpu.models.trees import lift_tree_ensemble
     from distributedkernelshap_tpu.models.xgb import lift_xgboost
 
     return (("tree ensemble", lift_tree_ensemble),
+            ("Gaussian quadratic classifier", lift_gaussian_quadratic),
             ("XGBoost ensemble", lift_xgboost),
             ("LightGBM ensemble", lift_lightgbm),
             ("SVM", lift_svm),

@@ -1,0 +1,86 @@
+"""Gaussian generative classifier lifting (models/quadratic.py): GaussianNB
+and QDA as softmax-of-quadratic device predictors."""
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu.models import (
+    QuadraticDiscriminantPredictor,
+    as_predictor,
+)
+from distributedkernelshap_tpu.models.quadratic import lift_gaussian_quadratic
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(51)
+    X = rng.normal(size=(400, 5)) * np.array([1, 2, 0.5, 1, 3])
+    y = (X[:, 0] + 0.4 * X[:, 1] > 0).astype(int) + (X[:, 4] > 3).astype(int)
+    return X, y
+
+
+def _check(method, X, atol=5e-5):
+    lifted = lift_gaussian_quadratic(method)
+    assert lifted is not None
+    Xq = X.astype(np.float32).astype(np.float64)
+    expected = np.asarray(method(Xq))
+    got = np.asarray(lifted(Xq.astype(np.float32)))
+    np.testing.assert_allclose(got, expected, atol=atol)
+    return lifted
+
+
+@pytest.mark.parametrize("n_classes", [2, 3])
+def test_gaussian_nb(data, n_classes):
+    from sklearn.naive_bayes import GaussianNB
+
+    X, y = data
+    yy = y if n_classes == 3 else (y > 0).astype(int)
+    clf = GaussianNB().fit(X, yy)
+    lifted = _check(clf.predict_proba, X[:64])
+    assert lifted.n_outputs == n_classes
+
+
+def test_gaussian_nb_with_priors(data):
+    from sklearn.naive_bayes import GaussianNB
+
+    X, y = data
+    clf = GaussianNB(priors=[0.7, 0.2, 0.1]).fit(X, y)
+    _check(clf.predict_proba, X[:64])
+
+
+@pytest.mark.parametrize("reg", [0.0, 0.1])
+def test_qda(data, reg):
+    from sklearn.discriminant_analysis import QuadraticDiscriminantAnalysis
+
+    X, y = data
+    clf = QuadraticDiscriminantAnalysis(reg_param=reg).fit(X, y)
+    _check(clf.predict_proba, X[:64])
+
+
+def test_as_predictor_routes(data):
+    from sklearn.naive_bayes import GaussianNB
+
+    X, y = data
+    clf = GaussianNB().fit(X, y)
+    pred = as_predictor(clf.predict_proba, example_dim=X.shape[1])
+    assert isinstance(pred, QuadraticDiscriminantPredictor)
+
+
+def test_explain_end_to_end(data):
+    from sklearn.naive_bayes import GaussianNB
+
+    from distributedkernelshap_tpu import KernelShap
+
+    X, y = data
+    yb = (y > 0).astype(int)
+    clf = GaussianNB().fit(X, yb)
+    ex = KernelShap(clf.predict_proba, link="logit", seed=0)
+    ex.fit(X[:40])
+    assert isinstance(ex._explainer.predictor, QuadraticDiscriminantPredictor)
+    Xe = X[40:56].astype(np.float32).astype(np.float64)
+    res = ex.explain(Xe, silent=True)
+    proba = np.clip(clf.predict_proba(Xe), 1e-7, 1 - 1e-7)
+    for k, phi in enumerate(res.shap_values):
+        lhs = phi.sum(axis=1) + res.expected_value[k]
+        rhs = np.log(proba[:, k] / (1 - proba[:, k]))
+        np.testing.assert_allclose(lhs, rhs, rtol=1e-3, atol=5e-3)
