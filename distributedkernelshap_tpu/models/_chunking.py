@@ -15,6 +15,10 @@ sliced away).
 import jax
 import jax.numpy as jnp
 
+#: default per-chunk element budget shared by every masked_ey implementation
+#: (f32: 4 bytes/element; 1<<25 elements ≈ 128 MB)
+DEFAULT_CHUNK_ELEMS: int = 1 << 25
+
 
 def first_layer_separated_ey(W1, b1, tail_fn, X, bg, bgw_n, mask, G,
                              budget: int, coalition_chunk=None,

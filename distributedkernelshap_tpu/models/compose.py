@@ -177,8 +177,7 @@ class PipelinePredictor(BasePredictor):
                 and getattr(self.inner, "supports_masked_ey", False))
 
     def masked_ey_fits(self, **kwargs) -> bool:
-        fits = getattr(self.inner, "masked_ey_fits", None)
-        return fits(**kwargs) if fits is not None else True
+        return self.inner.masked_ey_fits(**kwargs)
 
     def masked_ey(self, X, bg, bgw_n, mask, G, target_chunk_elems=None,
                   coalition_chunk=None):
@@ -223,8 +222,7 @@ class MeanEnsemblePredictor(BasePredictor):
         return all(getattr(m, "supports_masked_ey", False) for m in self.members)
 
     def masked_ey_fits(self, **kwargs) -> bool:
-        return all(getattr(m, "masked_ey_fits", lambda **kw: True)(**kwargs)
-                   for m in self.members)
+        return all(m.masked_ey_fits(**kwargs) for m in self.members)
 
     def masked_ey(self, X, bg, bgw_n, mask, G, target_chunk_elems=None,
                   coalition_chunk=None):

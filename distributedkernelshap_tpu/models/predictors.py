@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributedkernelshap_tpu.models._chunking import DEFAULT_CHUNK_ELEMS
+
 logger = logging.getLogger(__name__)
 
 ACTIVATIONS = {
@@ -102,10 +104,23 @@ class BasePredictor:
     vector_out
         False when the underlying user callable returned a scalar per row
         (reference reads ``vector_out`` at ``kernel_shap.py:790``).
+    supports_masked_ey
+        Whether the predictor implements the structure-aware ``masked_ey``
+        protocol — expected outputs over the KernelSHAP synthetic tensor
+        without materialising it (``ops/explain.py`` dispatches on this,
+        gated by :meth:`masked_ey_fits`).
     """
 
     n_outputs: int = 1
     vector_out: bool = True
+    supports_masked_ey: bool = False
+
+    def masked_ey_fits(self, **kwargs) -> bool:
+        """Whether ``masked_ey``'s persistent tensors fit the chunk budget at
+        the given ``B/N/S/M`` shapes; only consulted when
+        ``supports_masked_ey`` is True."""
+
+        return True
 
     def __call__(self, X: jnp.ndarray) -> jnp.ndarray:
         raise NotImplementedError
@@ -154,11 +169,7 @@ class LinearPredictor(BasePredictor):
     # uniform masked_ey exists so composite predictors (soft-voting means)
     # can forward their members through one protocol
     supports_masked_ey = True
-    #: default chunk budget, matching the sibling masked_ey implementations
-    target_chunk_elems: int = 1 << 25
-
-    def masked_ey_fits(self, **kwargs) -> bool:
-        return True
+    target_chunk_elems: int = DEFAULT_CHUNK_ELEMS
 
     def masked_ey(self, X, bg, bgw_n, mask, G, target_chunk_elems=None,
                   coalition_chunk=None):
@@ -264,12 +275,8 @@ class MLPPredictor(BasePredictor):
     # structure-aware masked evaluation for the KernelSHAP pipeline
     # ------------------------------------------------------------------
 
-    #: default chunk budget, matching the sibling masked_ey implementations
-    target_chunk_elems: int = 1 << 25
-
-    @property
-    def supports_masked_ey(self) -> bool:
-        return True
+    target_chunk_elems: int = DEFAULT_CHUNK_ELEMS
+    supports_masked_ey = True
 
     def masked_ey_fits(self, B: int, N: int, S: int, M: int,
                        budget: int) -> bool:
@@ -404,7 +411,7 @@ def _lift_sklearn(method) -> Optional[LinearPredictor]:
     return None
 
 
-def _lift_is_faithful(lifted: LinearPredictor, method, example_dim: int,
+def _lift_is_faithful(lifted: BasePredictor, method, example_dim: int,
                       tol: float = 1e-4) -> bool:
     """Numerically check that the lifted JAX predictor reproduces the original
     callable.  Guards against estimators that expose ``coef_`` but whose

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributedkernelshap_tpu.models._chunking import DEFAULT_CHUNK_ELEMS
 from distributedkernelshap_tpu.models.predictors import BasePredictor
 
 logger = logging.getLogger(__name__)
@@ -89,12 +90,8 @@ class SVMPredictor(BasePredictor):
     # structure-aware masked evaluation for the KernelSHAP pipeline
     # ------------------------------------------------------------------
 
-    #: target element count of per-chunk intermediates
-    target_chunk_elems: int = 1 << 25
-
-    @property
-    def supports_masked_ey(self) -> bool:
-        return True
+    target_chunk_elems: int = DEFAULT_CHUNK_ELEMS
+    supports_masked_ey = True
 
     def masked_ey_fits(self, B: int, N: int, S: int, M: int,
                        budget: int) -> bool:

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributedkernelshap_tpu.models._chunking import DEFAULT_CHUNK_ELEMS
 from distributedkernelshap_tpu.models.predictors import BasePredictor
 
 logger = logging.getLogger(__name__)
@@ -164,8 +165,7 @@ _DENSE_STAGE_KINDS = frozenset(
 class TorchMLPPredictor(BasePredictor):
     """A lifted feed-forward torch network: picklable stages, pure JAX."""
 
-    #: default chunk budget, matching the sibling masked_ey implementations
-    target_chunk_elems: int = 1 << 25
+    target_chunk_elems: int = DEFAULT_CHUNK_ELEMS
 
     def __init__(self, stages: List[Stage], n_outputs: int, vector_out: bool = True):
         self.stages = list(stages)

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributedkernelshap_tpu.models._chunking import DEFAULT_CHUNK_ELEMS
 from distributedkernelshap_tpu.models.predictors import BasePredictor
 
 logger = logging.getLogger(__name__)
@@ -122,8 +123,7 @@ class TreeEnsemblePredictor(BasePredictor):
 
     #: per-row MAC budget above which the path-matmul strategy is declined
     max_path_flops_per_row: int = 1 << 22
-    #: target element count of per-chunk intermediates (f32)
-    target_chunk_elems: int = 1 << 25
+    target_chunk_elems: int = DEFAULT_CHUNK_ELEMS
 
     def __init__(self, feature, threshold, left, right, value, depth: int,
                  aggregation: str = "sum", base=None, scale: float = 1.0,

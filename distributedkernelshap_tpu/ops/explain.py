@@ -80,11 +80,9 @@ def _use_masked_ey(predictor, B: int, N: int, S: int, M: int,
     offers it AND its persistent tensors fit the budget at these shapes
     (otherwise the row-materialising paths are the better choice)."""
 
-    if not getattr(predictor, "supports_masked_ey", False):
-        return False
-    fits = getattr(predictor, "masked_ey_fits", None)
-    return fits is None or fits(B=B, N=N, S=S, M=M,
-                                budget=config.target_chunk_elems)
+    return getattr(predictor, "supports_masked_ey", False) and \
+        predictor.masked_ey_fits(B=B, N=N, S=S, M=M,
+                                 budget=config.target_chunk_elems)
 
 
 def _auto_chunk(S: int, per_row_elems: int, target: int) -> int:
