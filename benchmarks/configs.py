@@ -47,10 +47,38 @@ def _timed_explain(explainer, X, nruns=3, **kwargs):
     return float(np.median(times)), explanation
 
 
-def _additivity(explanation):
+def _phi_total(explanation):
+    """``Σφ + E`` as an ``(n, K)`` array for list (multi-output) or plain
+    (scalar-output) shap_values layouts."""
+
     sv = explanation.shap_values
-    total = np.stack(sv, 1).sum(-1) + np.asarray(explanation.expected_value)[None, :]
-    return float(np.abs(total - explanation.data["raw"]["raw_prediction"]).max())
+    if isinstance(sv, list):
+        return np.stack(sv, 1).sum(-1) + np.asarray(explanation.expected_value)[None, :]
+    total = np.asarray(sv).sum(-1) + np.ravel(explanation.expected_value)[0]
+    return total[:, None]
+
+
+def _additivity(explanation):
+    total = _phi_total(explanation)
+    raw = np.asarray(explanation.data["raw"]["raw_prediction"]).reshape(total.shape)
+    return float(np.abs(total - raw).max())
+
+
+def _model_err(explanation, model_out, link="logit"):
+    """Additivity against the ORIGINAL model's outputs — the external
+    faithfulness oracle.  The internal `_additivity` holds by WLS
+    construction even if the lifted predictor mis-evaluates (observed once
+    on the TPU backend via a miscompiling fusion, models/trees.py
+    `_split_conditions`), so lifted-model configs must also check this."""
+
+    total = _phi_total(explanation)
+    out = np.asarray(model_out, np.float64)
+    if out.ndim == 1:
+        out = out[:, None]
+    if link == "logit":
+        p = np.clip(out, 1e-7, 1 - 1e-7)
+        out = np.log(p / (1.0 - p))
+    return float(np.abs(total - out.reshape(total.shape)).max())
 
 
 def config_adult(smoke=False):
@@ -180,6 +208,7 @@ def config_adult_trees(smoke=False):
     t, explanation = _timed_explain(ex, X, nruns=1 if smoke else 3)
     return {"metric": "adult_trees_wall_s", "value": round(t, 4), "unit": "s",
             "n_instances": X.shape[0], "additivity_err": _additivity(explanation),
+            "model_err": _model_err(explanation, clf.predict_proba(X)),
             "predictor": type(clf).__name__, "device_lifted": lifted}
 
 
@@ -249,19 +278,24 @@ def config_model_zoo(smoke=False):
                              max_iter=10 if smoke else 50, random_state=0))])
                .fit(Xtr, ytr).predict_proba, PipelinePredictor)
 
+    from distributedkernelshap_tpu.models.torch_lift import is_torch_module, torch_callback
+
     families = {}
     for fam_name, predictor, expected_cls in zoo():
-        ex = KernelShap(predictor, link="logit" if fam_name != "svc_rbf" else "identity",
-                        feature_names=gn, seed=0)
+        link = "logit" if fam_name != "svc_rbf" else "identity"
+        ex = KernelShap(predictor, link=link, feature_names=gn, seed=0)
         ex.fit(bg, group_names=gn, groups=g)
         lifted = isinstance(ex._explainer.predictor, expected_cls)
         t, explanation = _timed_explain(ex, X, nruns=1 if smoke else 3)
+        host = torch_callback(predictor) if is_torch_module(predictor) else predictor
         families[fam_name] = {"wall_s": round(t, 4), "device_lifted": lifted,
-                              "additivity_err": _additivity(explanation)}
+                              "additivity_err": _additivity(explanation),
+                              "model_err": _model_err(explanation, host(X), link)}
     worst = max(v["wall_s"] for v in families.values())
     return {"metric": "model_zoo_worst_wall_s", "value": worst, "unit": "s",
             "n_instances": X.shape[0], "families": families,
-            "additivity_err": max(v["additivity_err"] for v in families.values())}
+            "additivity_err": max(v["additivity_err"] for v in families.values()),
+            "model_err": max(v["model_err"] for v in families.values())}
 
 
 def config_mnist(smoke=False):

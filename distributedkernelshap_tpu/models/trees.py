@@ -208,8 +208,19 @@ class TreeEnsemblePredictor(BasePredictor):
         self.n_leaves = L
 
     def _split_conditions(self, X):
-        """``gl[n,t,j]``: does row ``n`` go left at node ``(t,j)``?  (f32)"""
+        """``gl[n,t,j]``: does row ``n`` go left at node ``(t,j)``?  (f32)
 
+        The input is materialised behind an optimization barrier before the
+        node-column gather: on the TPU backend, letting XLA fuse this gather
+        with an upstream producer (e.g. the synthetic-row synthesis of
+        ``ops/explain._ey_generic``) was observed to corrupt the comparisons
+        at specific shapes (B=8/S=64/N=100 Adult: whole coalitions got wrong
+        leaf memberships, ~0.9 absolute output error), while every
+        constituent op is exact in isolation.  The barrier costs one
+        materialisation of ``X`` and removes the miscompiling fusion.
+        """
+
+        X = jax.lax.optimization_barrier(X)
         T, Nn = self.feature.shape
         xv = X[:, self.feature.reshape(-1)].reshape(X.shape[0], T, Nn)
         gl = xv <= self.threshold[None]
@@ -229,6 +240,7 @@ class TreeEnsemblePredictor(BasePredictor):
         return out / self.n_trees if self.aggregation == "mean" else out
 
     def _eval_iterative(self, X):
+        X = jax.lax.optimization_barrier(X)   # see _split_conditions
         T = self.feature.shape[0]
         t_idx = jnp.arange(T)[None, :]                        # (1, T)
         node0 = jnp.zeros((X.shape[0], T), jnp.int32)
