@@ -1,0 +1,76 @@
+"""TPU regression check for the fused tree-eval miscompilation.
+
+Reproduces the exact failing configuration of 2026-07-30 (B=8 instances,
+nsamples=64, the 100-row Adult background, HistGradientBoosting max_iter=50)
+and asserts the three invariants the bug violated:
+
+1. the masked fast path equals the row-materialising generic path;
+2. the device predictor equals sklearn on the synthetic rows;
+3. full-engine phi satisfies additivity against the ORIGINAL sklearn model
+   (not just the engine's internal raw predictions, which hold by WLS
+   construction regardless).
+
+Run on a real TPU after any change to the tree evaluation, XLA version, or
+jax upgrade:  ``python benchmarks/tpu_regression_check.py``.  All-clear
+prints one OK line per invariant; any violation raises.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from sklearn.ensemble import HistGradientBoostingClassifier
+
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.models import TreeEnsemblePredictor, as_predictor
+    from distributedkernelshap_tpu.ops.coalitions import coalition_plan
+    from distributedkernelshap_tpu.ops.explain import _ey_generic, groups_to_matrix
+    from distributedkernelshap_tpu.utils import load_data
+
+    data = load_data()
+    gn, g = data["all"]["group_names"], data["all"]["groups"]
+    Xtr = data["all"]["X"]["processed"]["train"].toarray()
+    ytr = data["all"]["y"]["train"]
+    clf = HistGradientBoostingClassifier(max_iter=50, random_state=0).fit(Xtr, ytr)
+    pred = as_predictor(clf.predict_proba, example_dim=Xtr.shape[1])
+    assert isinstance(pred, TreeEnsemblePredictor)
+
+    Xall = data["all"]["X"]["processed"]["test"].toarray().astype(np.float32)
+    bgd = data["background"]["X"]["preprocessed"]
+    bg = np.asarray(bgd.todense() if hasattr(bgd, "todense") else bgd,
+                    dtype=np.float32)
+    G = groups_to_matrix(g, Xall.shape[1])
+    plan = coalition_plan(G.shape[0], nsamples=64, seed=0)
+    mask = np.asarray(plan.mask, np.float32)
+    bgw = np.full(bg.shape[0], 1.0 / bg.shape[0], np.float32)
+
+    for B in (4, 8, 16, 256):
+        X = Xall[:B]
+        ey_rows = np.asarray(_ey_generic(pred, X, bg, bgw, mask @ G, chunk=8))
+        ey_fast = np.asarray(pred.masked_ey(X, bg, bgw, mask, G))
+        err = np.abs(ey_fast - ey_rows).max()
+        assert err < 1e-4, f"masked vs generic diverge at B={B}: {err}"
+        print(f"OK masked==generic at B={B} (err {err:.2e})")
+
+    # full engine against the original model
+    ex = KernelShap(clf.predict_proba, link="logit", feature_names=gn, seed=0)
+    ex.fit(data["background"]["X"]["preprocessed"], group_names=gn, groups=g)
+    for B in (256, 2560):
+        X = Xall[:B]
+        res = ex.explain(X, silent=True)
+        proba = np.clip(clf.predict_proba(X.astype(np.float64)), 1e-7, 1 - 1e-7)
+        err = max(abs(res.shap_values[k].sum(1) + res.expected_value[k]
+                      - np.log(proba[:, k] / (1 - proba[:, k]))).max()
+                  for k in range(2))
+        assert err < 1e-2, f"engine phi vs sklearn diverge at B={B}: {err}"
+        print(f"OK engine additivity vs sklearn at B={B} (err {err:.2e})")
+    print("ALL CLEAR")
+
+
+if __name__ == "__main__":
+    main()
