@@ -1,4 +1,5 @@
-"""Property-based tests (hypothesis) for the pure numerical building blocks.
+"""Property-based tests (hypothesis) for the pure numerical building blocks
+and the masked-evaluation equivalence invariant.
 
 The reference ships no tests (SURVEY.md §4); the seeded unit suite pins the
 documented cases, and these properties sweep the input space for the
@@ -203,3 +204,60 @@ def test_kmeans_summary_properties(seed, n, d, k):
     counts = weights * n
     np.testing.assert_allclose(counts, np.round(counts), atol=1e-9)
     assert np.all(counts >= 0) and counts.sum() == pytest.approx(n)
+
+
+@settings(max_examples=20, deadline=None)
+@given(st.data())
+def test_masked_ey_equivalence_random_shapes(data_st):
+    """The structure-aware masked evaluation must equal the row-materialising
+    generic path for every fast-path family across random shapes — the
+    invariant whose violation exposed the TPU fused tree-eval
+    miscompilation (benchmarks/tpu_regression_check.py)."""
+
+    from sklearn.ensemble import GradientBoostingClassifier
+    from sklearn.neural_network import MLPClassifier
+    from sklearn.svm import SVC
+
+    from distributedkernelshap_tpu.models import as_predictor
+    from distributedkernelshap_tpu.ops.coalitions import coalition_plan
+    from distributedkernelshap_tpu.ops.explain import _ey_generic, groups_to_matrix
+
+    seed = data_st.draw(st.integers(0, 2 ** 16), label="seed")
+    B = data_st.draw(st.integers(1, 12), label="B")
+    N = data_st.draw(st.integers(1, 24), label="N")
+    S = data_st.draw(st.integers(4, 48), label="nsamples")
+    D = data_st.draw(st.integers(3, 8), label="D")
+    family = data_st.draw(st.sampled_from(["tree", "svm", "mlp"]), label="family")
+    grouped = data_st.draw(st.booleans(), label="grouped")
+
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(150, D))
+    y = (X[:, 0] + 0.5 * X[:, 1 % D] > 0).astype(int)
+    if y.min() == y.max():
+        y[0] = 1 - y[0]
+    if family == "tree":
+        method = GradientBoostingClassifier(
+            n_estimators=4, max_depth=3, random_state=0).fit(X, y).predict_proba
+    elif family == "svm":
+        method = SVC(kernel="rbf", random_state=0).fit(X, y).decision_function
+    else:
+        method = MLPClassifier((6,), max_iter=40,
+                               random_state=0).fit(X, y).predict_proba
+    pred = as_predictor(method, example_dim=D)
+    if not getattr(pred, "supports_masked_ey", False):
+        return  # probe rejected the lift for this draw; nothing to compare
+
+    groups = None
+    if grouped and D >= 4:
+        cols = list(range(D))
+        groups = [cols[:2], cols[2:3], cols[3:]]
+    G = groups_to_matrix(groups, D)
+    plan = coalition_plan(G.shape[0], nsamples=S, seed=0)
+    mask = np.asarray(plan.mask, np.float32)
+    Xe = X[:B].astype(np.float32)
+    bg = X[50:50 + N].astype(np.float32)
+    bgw = np.full(N, 1.0 / N, np.float32)
+    ey_rows = np.asarray(_ey_generic(pred, Xe, bg, bgw, mask @ G, chunk=7))
+    ey_fast = np.asarray(pred.masked_ey(Xe, bg, bgw, mask, G))
+    scale = max(1.0, np.abs(ey_rows).max())
+    np.testing.assert_allclose(ey_fast, ey_rows, atol=3e-4 * scale)
