@@ -21,6 +21,7 @@ from distributedkernelshap_tpu.models.trees import (  # noqa: F401
 from distributedkernelshap_tpu.models.compose import (  # noqa: F401
     CalibratedBinaryPredictor,
     MeanEnsemblePredictor,
+    OneVsRestPredictor,
     PipelinePredictor,
     StackingPredictor,
 )

@@ -341,6 +341,69 @@ def lift_voting(method) -> Optional[BasePredictor]:
         return None
 
 
+class OneVsRestPredictor(BasePredictor):
+    """Per-class binary members' positive probabilities, row-normalised
+    (sklearn's multiclass one-vs-rest composition)."""
+
+    vector_out = True
+
+    def __init__(self, members: Sequence[BasePredictor], normalise: bool = True):
+        if not members:
+            raise ValueError("OneVsRestPredictor needs at least one member")
+        self.members = list(members)
+        self.normalise = normalise
+        self.n_outputs = len(members)
+
+    def __call__(self, X):
+        X = jnp.asarray(X, jnp.float32)
+        P = jnp.stack([m(X)[:, -1] for m in self.members], axis=1)
+        if self.normalise:
+            P = P / jnp.sum(P, axis=1, keepdims=True)
+        return P
+
+    @property
+    def supports_masked_ey(self) -> bool:
+        """Unnormalised (multilabel) composition is memberwise-linear, so
+        member masked evaluations stack directly; the multiclass row
+        normalisation is nonlinear per synthetic row and cannot forward."""
+
+        return (not self.normalise
+                and all(getattr(m, "supports_masked_ey", False)
+                        for m in self.members))
+
+    def masked_ey_fits(self, **kwargs) -> bool:
+        return all(m.masked_ey_fits(**kwargs) for m in self.members)
+
+    def masked_ey(self, X, bg, bgw_n, mask, G, target_chunk_elems=None,
+                  coalition_chunk=None):
+        parts = [m.masked_ey(X, bg, bgw_n, mask, G, target_chunk_elems,
+                             coalition_chunk=coalition_chunk)[:, :, -1]
+                 for m in self.members]
+        return jnp.stack(parts, axis=-1)
+
+
+def lift_ovr(method) -> Optional[BasePredictor]:
+    """Lift multiclass ``OneVsRestClassifier.predict_proba`` when every
+    per-class binary member lifts.  Multilabel mode (unnormalised,
+    independent labels) also lifts; the single-estimator binary special case
+    declines (sklearn reshapes it differently — host path)."""
+
+    owner = getattr(method, "__self__", None)
+    if owner is None or type(owner).__name__ != "OneVsRestClassifier" \
+            or getattr(method, "__name__", "") != "predict_proba":
+        return None
+    try:
+        if len(owner.estimators_) < 2:
+            return None
+        members = [_inner_lift(e, ("predict_proba",)) for e in owner.estimators_]
+        if any(m is None for m in members):
+            return None
+        return OneVsRestPredictor(members, normalise=not owner.multilabel_)
+    except Exception as exc:
+        logger.info("one-vs-rest lift failed structurally (%s); using host path", exc)
+        return None
+
+
 class StackingPredictor(BasePredictor):
     """Lifted stacking: member predictions (column-sliced the way sklearn's
     ``_concatenate_predictions`` does, plus the raw features when

@@ -463,6 +463,7 @@ def _nonlinear_lifters():
     from distributedkernelshap_tpu.models.compose import (
         lift_bagging,
         lift_calibrated,
+        lift_ovr,
         lift_pipeline,
         lift_stacking,
         lift_voting,
@@ -485,6 +486,7 @@ def _nonlinear_lifters():
             ("voting ensemble", lift_voting),
             ("bagging ensemble", lift_bagging),
             ("stacking ensemble", lift_stacking),
+            ("one-vs-rest classifier", lift_ovr),
             ("calibrated classifier", lift_calibrated))
 
 

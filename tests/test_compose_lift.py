@@ -251,6 +251,94 @@ def test_bagging_forwards_masked_ey(data):
         np.testing.assert_allclose(a, b, atol=5e-4)
 
 
+def test_ovr_multiclass(data):
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.multiclass import OneVsRestClassifier
+
+    from distributedkernelshap_tpu.models import OneVsRestPredictor
+
+    X, y, _ = data
+    y3 = y + (X[:, 3] > 2).astype(int)
+    clf = OneVsRestClassifier(LogisticRegression()).fit(X, y3)
+    pred = as_predictor(clf.predict_proba, example_dim=X.shape[1])
+    assert isinstance(pred, OneVsRestPredictor) and pred.n_outputs == 3
+    _check(pred, clf.predict_proba, X[:64], atol=1e-4)
+
+
+def test_ovr_multilabel_unnormalised(data):
+    """Multilabel OvR: per-label sigmoids, no row normalisation — and the
+    memberwise-linear composition forwards the masked fast path."""
+
+    from sklearn.ensemble import GradientBoostingClassifier
+    from sklearn.multiclass import OneVsRestClassifier
+
+    from distributedkernelshap_tpu.models import OneVsRestPredictor
+    from distributedkernelshap_tpu.ops.coalitions import coalition_plan
+    from distributedkernelshap_tpu.ops.explain import _ey_generic, groups_to_matrix
+
+    X, y, _ = data
+    Y = np.stack([(y > 0).astype(int), (X[:, 3] > 2).astype(int)], axis=1)
+    clf = OneVsRestClassifier(GradientBoostingClassifier(
+        n_estimators=5, random_state=0)).fit(X, Y)
+    assert clf.multilabel_
+    pred = as_predictor(clf.predict_proba, example_dim=X.shape[1])
+    assert isinstance(pred, OneVsRestPredictor) and not pred.normalise
+    _check(pred, clf.predict_proba, X[:64], atol=1e-4)
+
+    assert pred.supports_masked_ey
+    G = groups_to_matrix(None, X.shape[1])
+    plan = coalition_plan(G.shape[0], nsamples=24, seed=0)
+    Xe = _quant(X[:6]).astype(np.float32)
+    bgm = _quant(X[100:112]).astype(np.float32)
+    bgw = np.full(12, 1.0 / 12, np.float32)
+    mask = np.asarray(plan.mask, np.float32)
+    ey_rows = np.asarray(_ey_generic(pred, Xe, bgm, bgw, mask @ G, chunk=8))
+    ey_fast = np.asarray(pred.masked_ey(Xe, bgm, bgw, mask, G))
+    np.testing.assert_allclose(ey_fast, ey_rows, atol=1e-5)
+
+
+def test_ovr_with_unliftable_members_falls_back(data):
+    """OvR whose members expose predict_proba but cannot lift (Platt-scaled
+    SVCs) declines to the host path."""
+
+    import warnings as _w
+
+    from sklearn.multiclass import OneVsRestClassifier
+    from sklearn.svm import SVC
+
+    X, y, _ = data
+    y3 = y + (X[:, 3] > 2).astype(int)
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        clf = OneVsRestClassifier(SVC(kernel="rbf", probability=True,
+                                      random_state=0)).fit(X, y3)
+        pred = as_predictor(clf.predict_proba, example_dim=X.shape[1])
+    assert isinstance(pred, CallbackPredictor)
+
+
+def test_ovr_explain_additivity(data):
+    from sklearn.ensemble import GradientBoostingClassifier
+    from sklearn.multiclass import OneVsRestClassifier
+
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.models import OneVsRestPredictor
+
+    X, y, _ = data
+    y3 = y + (X[:, 3] > 2).astype(int)
+    clf = OneVsRestClassifier(GradientBoostingClassifier(
+        n_estimators=6, random_state=0)).fit(X, y3)
+    Xq = _quant(X)
+    ex = KernelShap(clf.predict_proba, link="logit", seed=0)
+    ex.fit(Xq[:30])
+    assert isinstance(ex._explainer.predictor, OneVsRestPredictor)
+    res = ex.explain(Xq[200:210], silent=True)
+    proba = np.clip(clf.predict_proba(Xq[200:210]), 1e-7, 1 - 1e-7)
+    for k, phi in enumerate(res.shap_values):
+        lhs = phi.sum(axis=1) + res.expected_value[k]
+        rhs = np.log(proba[:, k] / (1 - proba[:, k]))
+        np.testing.assert_allclose(lhs, rhs, rtol=1e-3, atol=5e-3)
+
+
 @pytest.mark.parametrize("passthrough", [False, True])
 def test_stacking_classifier(data, passthrough):
     from sklearn.ensemble import GradientBoostingClassifier, StackingClassifier
