@@ -18,6 +18,9 @@ recursively lifting the members through
   memberwise, since expectation is linear;
 * ``StackingPredictor`` — member predictions (sklearn's column-slicing
   rules, optional feature passthrough) feeding a lifted final estimator;
+* ``OneVsRestPredictor`` — per-class binary members' positive
+  probabilities, row-normalised for multiclass (multilabel stays
+  unnormalised and forwards the masked fast path memberwise);
 * ``CalibratedBinaryPredictor`` — a margin model followed by sigmoid
   (``1/(1+exp(a·f+b))``) or isotonic (``jnp.interp`` over the fitted
   thresholds — sklearn's own interpolation) calibration.
