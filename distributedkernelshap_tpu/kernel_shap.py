@@ -240,7 +240,8 @@ class KernelExplainerEngine:
                            if weights is None else np.asarray(weights, dtype=np.float32))
 
         self.n_columns = self.background.shape[1]
-        self.predictor = as_predictor(predictor, example_dim=self.n_columns)
+        self.predictor = as_predictor(predictor, example_dim=self.n_columns,
+                                      probe_data=self.background)
         self.vector_out = self.predictor.vector_out
         self.G = groups_to_matrix(groups, self.n_columns)
         self.M = self.G.shape[0]

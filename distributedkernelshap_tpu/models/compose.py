@@ -529,7 +529,9 @@ def lift_calibrated(method) -> Optional[BasePredictor]:
             return None   # multiclass OvR normalisation not reproduced
         folds = []
         for cc in owner.calibrated_classifiers_:
-            base = getattr(cc, "estimator", None) or getattr(cc, "base_estimator", None)
+            base = getattr(cc, "estimator", None)
+            if base is None:  # pre-1.2 sklearn attribute; `or` would also
+                base = getattr(cc, "base_estimator", None)  # skip falsy bases
             inner = _inner_lift(base, ("decision_function", "predict_proba"))
             if inner is None or len(cc.calibrators) != 1:
                 return None

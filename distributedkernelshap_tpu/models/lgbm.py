@@ -106,8 +106,21 @@ def _flatten_tree(root: dict) -> Optional[dict]:
 
 
 def _objective_transform(objective: str, num_class: int):
-    obj = objective.split(" ")[0]                    # e.g. "binary sigmoid:1"
+    parts = objective.split(" ")                     # e.g. "binary sigmoid:2"
+    obj = parts[0]
     if obj == "binary":
+        # the binary objective carries a sigmoid scale (p = 1/(1+e^{-s*f}));
+        # only s == 1 is reproduced by the lifted sigmoid head — decline the
+        # rest on BOTH paths (xgb.py policy), not just via the as_predictor
+        # probe, so predictor_from_lightgbm_dump never returns a wrong model
+        for tok in parts[1:]:
+            if tok.startswith("sigmoid:"):
+                try:
+                    scale = float(tok.split(":", 1)[1])
+                except ValueError:
+                    return None
+                if scale != 1.0:
+                    return None
         return "binary_sigmoid", True
     if obj == "multiclass":
         return "softmax", True

@@ -412,14 +412,26 @@ def _lift_sklearn(method) -> Optional[LinearPredictor]:
 
 
 def _lift_is_faithful(lifted: BasePredictor, method, example_dim: int,
-                      tol: float = 1e-4) -> bool:
+                      tol: float = 1e-4,
+                      probe_data: Optional[np.ndarray] = None) -> bool:
     """Numerically check that the lifted JAX predictor reproduces the original
     callable.  Guards against estimators that expose ``coef_`` but whose
     ``predict_proba`` is NOT softmax-of-margin (Platt-scaled SVC, one-vs-rest
-    logistic regression, ...)."""
+    logistic regression, ...).
+
+    ``probe_data`` rows (the caller's background set, when available) join the
+    synthetic Gaussian probe so the check exercises the real input
+    distribution: a model trained on unscaled / one-hot features can agree
+    with its lift on N(0, 0.5) draws — where e.g. every tree threshold sits on
+    one side of the probe's support — while diverging on actual data."""
 
     rng = np.random.default_rng(0)
     probe = rng.normal(scale=0.5, size=(16, example_dim)).astype(np.float32)
+    if probe_data is not None:
+        rows = np.asarray(probe_data, dtype=np.float32)
+        if rows.ndim == 2 and rows.shape[1] == example_dim and rows.shape[0]:
+            take = rows[:: -(-rows.shape[0] // 32)][:32]  # spread, cap 32
+            probe = np.concatenate([probe, take], axis=0)
     try:
         expected = np.asarray(method(probe), dtype=np.float32)
     except Exception:
@@ -507,15 +519,21 @@ def structural_lift(method) -> Optional[BasePredictor]:
 
 
 def as_predictor(predictor, example_dim: Optional[int] = None,
-                 n_outputs: Optional[int] = None) -> BasePredictor:
-    """Normalise whatever the user passed into a :class:`BasePredictor`."""
+                 n_outputs: Optional[int] = None,
+                 probe_data: Optional[np.ndarray] = None) -> BasePredictor:
+    """Normalise whatever the user passed into a :class:`BasePredictor`.
+
+    ``probe_data`` (typically the explainer's background set) augments the
+    faithfulness probe so lifts are validated on the real data distribution,
+    not just synthetic Gaussian draws."""
 
     if isinstance(predictor, BasePredictor):
         return predictor
 
     lifted = _lift_sklearn(predictor)
     if lifted is not None:
-        if example_dim is None or _lift_is_faithful(lifted, predictor, example_dim):
+        if example_dim is None or _lift_is_faithful(lifted, predictor, example_dim,
+                                                    probe_data=probe_data):
             logger.info("Lifted sklearn linear model into a native JAX LinearPredictor "
                         "(K=%d, activation=%s)", lifted.n_outputs, lifted.activation)
             return lifted
@@ -534,7 +552,8 @@ def as_predictor(predictor, example_dim: Optional[int] = None,
             candidate = lifter(predictor)
             if candidate is None:
                 continue
-            if _lift_is_faithful(candidate, predictor, example_dim):
+            if _lift_is_faithful(candidate, predictor, example_dim,
+                                 probe_data=probe_data):
                 logger.info("Lifted %s onto the device (%s)",
                             family, type(candidate).__name__)
                 return candidate

@@ -58,6 +58,23 @@ def test_binary(binary_roots):
                                1 / (1 + np.exp(-margin)), atol=1e-5)
 
 
+def test_nonunit_sigmoid_scale_declines(binary_roots):
+    """``binary sigmoid:2`` means p = 1/(1+exp(-2f)); the lift only reproduces
+    scale 1, so any other scale must decline on the dump path too (ADVICE r1:
+    previously only the as_predictor probe caught it)."""
+
+    assert predictor_from_lightgbm_dump(
+        _dump(binary_roots, "binary sigmoid:2")) is None
+    assert predictor_from_lightgbm_dump(
+        _dump(binary_roots, "binary sigmoid:0.5")) is None
+    assert predictor_from_lightgbm_dump(
+        _dump(binary_roots, "binary sigmoid:bogus")) is None
+    assert predictor_from_lightgbm_dump(
+        _dump(binary_roots, "binary sigmoid:1")) is not None
+    # bare "binary" (no scale token) keeps the default scale of 1
+    assert predictor_from_lightgbm_dump(_dump(binary_roots, "binary")) is not None
+
+
 def test_boundary_goes_left(binary_roots):
     """LightGBM routes x <= t left (inclusive) — exactly our comparator."""
 

@@ -166,6 +166,36 @@ def test_as_predictor_falls_back_when_unfaithful(clf_data):
     assert isinstance(pred, CallbackPredictor)
 
 
+def test_probe_data_catches_distribution_dependent_unfaithfulness():
+    """A lift that agrees with the original callable on the synthetic N(0, .5)
+    probe but diverges on the real data distribution must be rejected once
+    background rows join the probe (ADVICE r1: the probe alone can bless
+    unfaithful lifts for models trained far from the Gaussian support)."""
+
+    from distributedkernelshap_tpu.models import LinearPredictor
+
+    class Shifty:
+        # exposes linear coefficients, but predict_proba deviates from
+        # softmax-of-margin outside the Gaussian probe's support
+        coef_ = np.array([[1.0, -1.0, 0.5]], np.float32)
+        intercept_ = np.array([0.0], np.float32)
+        classes_ = np.array([0, 1])
+
+        def predict_proba(self, A):
+            z = A @ self.coef_[0] + self.intercept_[0]
+            z = np.where(np.abs(A).max(axis=1) > 3.0, z + 1.0, z)
+            p = 1.0 / (1.0 + np.exp(-z))
+            return np.stack([1.0 - p, p], axis=1)
+
+    m = Shifty()
+    # without probe_data the Gaussian draws never leave |x| < 3: wrong accept
+    assert isinstance(as_predictor(m.predict_proba, example_dim=3),
+                      LinearPredictor)
+    bg = np.full((8, 3), 5.0, np.float32)
+    pred = as_predictor(m.predict_proba, example_dim=3, probe_data=bg)
+    assert not isinstance(pred, LinearPredictor)
+
+
 def test_kernel_shap_end_to_end_tree(clf_data):
     """Full explain over a lifted GBT: additivity in link space."""
 
