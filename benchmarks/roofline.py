@@ -1,0 +1,151 @@
+"""Analytic roofline model for the explain pipeline (VERDICT r1 #5).
+
+Computes, per benchmark configuration, the work the jitted pipeline performs
+— MXU einsum FLOPs, VPU elementwise ops, transcendentals, and minimum HBM
+traffic — from the *actual* coalition-plan shapes, then reports the floor
+wall-clock implied by each hardware bound next to the measured number from
+RESULTS.md.  Pure host arithmetic: no device needed, reproducible anywhere.
+
+Cost model (linear fast path, ``ops/explain._ey_linear`` / the fused Pallas
+kernel ``ops/pallas_kernels.fused_linear_ey``):
+
+* MXU: the group-space contractions ``XWg``/``bgWg`` (once per call), the
+  per-tile ``p1``/``t2`` mask matmuls, and the WLS normal equations;
+* VPU: assembling ``logits = p1 + bgW - t2`` over the ``(B, S, N, K)``
+  synthetic tensor, the softmax/sigmoid, and the background-weighted
+  average — ~8 arithmetic ops per element plus one transcendental per
+  ``(B, S, N)`` (binary sigmoid path) or per element (general softmax);
+* HBM: inputs/outputs plus the Pallas grid's block reloads; the logits
+  tensor itself never leaves VMEM (that is the kernel's point — the XLA
+  fallback keeps it fused too, spilling only the chunked ``ey``).
+
+Peaks are explicit, overridable constants (public TPU v5e-1 specs where
+published; the VPU/transcendental rates are stated order-of-magnitude
+assumptions since Google does not publish them — conclusions below are
+robust to 2x error in them).
+"""
+
+import argparse
+import json
+import math
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# ---------------------------------------------------------------------------
+# hardware peaks (TPU v5e, one chip)
+PEAK = {
+    "mxu_bf16_flops": 197e12,   # published v5e peak (bf16)
+    "mxu_f32_flops": 49e12,     # f32 passes run ~1/4 of bf16 on the MXU
+    "vpu_f32_ops": 4e12,        # assumption: order of magnitude for 8x128-lane VPU
+    "transcendental_ops": 1e12,  # assumption: exp/sigmoid ~1/4 of VPU rate
+    "hbm_bytes": 819e9,         # published v5e HBM bandwidth (819 GB/s)
+    "tunnel_rpc_s": 0.07,       # measured: every device sync through the axon
+                                # tunnel costs ~70 ms regardless of payload
+}
+
+
+def linear_path_cost(B, S, N, K, D, M, tb=256, ts=512):
+    """Work/traffic of one explain call on the linear fast path."""
+
+    f32 = 4
+    mxu = (2 * B * M * D * K        # XWg
+           + 2 * N * M * D * K      # bgWg
+           + 2 * N * D * K          # bgW
+           + 2 * B * S * M * K      # p1 (per tile, total over grid)
+           + 2 * S * N * M * K      # t2
+           + 2 * S * (M - 1) ** 2   # normal-equation Gram
+           + 2 * B * S * (M - 1) * K  # normal-equation rhs
+           + 2 * B * D * K)         # fx
+    E = B * S * N * K
+    binary = K == 2
+    vpu = 8 * (B * S * N if binary else E)
+    transcendental = B * S * N if binary else E
+    grid_b, grid_s = math.ceil(B / tb), math.ceil(S / ts)
+    hbm = f32 * (
+        B * D + N * D + S * M + S + M * D          # inputs
+        + B * M * K + N * M * K                    # staged XWg / bgWg
+        + K * N * M * grid_b                       # bgWg reloaded per B-tile row
+        + K * B * M * grid_s                       # XWg reloaded per S-tile col
+        + 2 * B * S * K                            # ey written + read by solve
+        + B * K * M                                # phi out
+    )
+    return {"mxu_flops": mxu, "vpu_ops": vpu,
+            "transcendentals": transcendental, "hbm_bytes": hbm}
+
+
+def floors(cost):
+    return {
+        "mxu_s": cost["mxu_flops"] / PEAK["mxu_f32_flops"],
+        "vpu_s": cost["vpu_ops"] / PEAK["vpu_f32_ops"],
+        "transcendental_s": cost["transcendentals"] / PEAK["transcendental_ops"],
+        "hbm_s": cost["hbm_bytes"] / PEAK["hbm_bytes"],
+    }
+
+
+# measured single-chip wall-clocks (RESULTS.md, axon tunnel; each includes at
+# least one ~70 ms tunnel round trip that is NOT device work)
+MEASURED = {
+    "adult": 0.086,         # 2026-07-29 bench.py
+    "adult_stress": 0.073,  # 2026-07-30
+    "covertype_65536": 2.13,  # 2026-07-30, 65,536-row sub-run
+}
+
+CONFIGS = {
+    # B, S, N, K, D, M  (S from coalition_plan: 2M + 2^11 capped by 2^M - 2)
+    "adult": dict(B=2560, S=2072, N=100, K=2, D=48, M=12),
+    "adult_stress": dict(B=512, S=2048, N=1000, K=2, D=48, M=12),
+    "covertype_65536": dict(B=65536, S=2072, N=100, K=7, D=54, M=12),
+    "covertype_full": dict(B=581012, S=2072, N=100, K=7, D=54, M=12),
+}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args()
+
+    rows = []
+    for name, dims in CONFIGS.items():
+        cost = linear_path_cost(**dims)
+        fl = floors(cost)
+        floor = max(fl.values())
+        bound = max(fl, key=fl.get)
+        measured = MEASURED.get(name)
+        rows.append({
+            "config": name, **dims, **cost, **fl,
+            "roofline_floor_s": floor, "bound": bound,
+            "measured_s": measured,
+            "roofline_frac": (floor / measured) if measured else None,
+            "device_frac_excl_rpc": (
+                floor / max(measured - PEAK["tunnel_rpc_s"], 1e-9)
+                if measured else None),
+        })
+
+    if args.json:
+        for r in rows:
+            print(json.dumps(r))
+        return
+
+    hdr = (f"{'config':<18} {'MXU GF':>8} {'VPU Gop':>8} {'exp Gop':>8} "
+           f"{'HBM MB':>8} {'floor ms':>9} {'bound':>16} {'meas ms':>8} "
+           f"{'% roofline':>10} {'% excl RPC':>10}")
+    print(hdr)
+    print("-" * len(hdr))
+    for r in rows:
+        meas = f"{1e3 * r['measured_s']:8.1f}" if r["measured_s"] else "       -"
+        frac = (f"{100 * r['roofline_frac']:9.1f}%" if r["roofline_frac"]
+                else "         -")
+        fracx = (f"{100 * r['device_frac_excl_rpc']:9.1f}%"
+                 if r["device_frac_excl_rpc"] else "         -")
+        print(f"{r['config']:<18} {r['mxu_flops'] / 1e9:8.1f} "
+              f"{r['vpu_ops'] / 1e9:8.1f} {r['transcendentals'] / 1e9:8.1f} "
+              f"{r['hbm_bytes'] / 1e6:8.1f} {1e3 * r['roofline_floor_s']:9.2f} "
+              f"{r['bound']:>16} {meas} {frac} {fracx}")
+    print()
+    print("Peaks assumed:", json.dumps(PEAK))
+
+
+if __name__ == "__main__":
+    main()

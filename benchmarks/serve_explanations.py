@@ -60,10 +60,13 @@ def run_config(predictor, data, X_explain, replicas: int, max_batch_size: int,
     if model is None:
         model = build_model(predictor, data)
     # replicas → pipeline depth: the reference's N replica processes become N
-    # in-flight device batches whose D2H round trips overlap
+    # in-flight device batches whose D2H round trips overlap; 0 = let the
+    # server self-calibrate the depth at startup
     server = ExplainerServer(model, host=host, port=port,
                              max_batch_size=max_batch_size,
-                             pipeline_depth=replicas).start()
+                             pipeline_depth=replicas or None).start()
+    if not replicas:
+        logging.info("auto-calibrated pipeline_depth=%d", server.pipeline_depth)
     url = f"http://{'127.0.0.1' if host == '0.0.0.0' else host}:{server.port}/explain"
     # the reference client fans out every instance as its own Ray task
     # (serve_explanations.py:131-134); a colocated single-core client gets the
@@ -150,7 +153,8 @@ if __name__ == '__main__':
         "-r", "--replicas", default=1, type=int,
         help="Server pipeline depth (the reference's replica count: N "
              "in-flight device batches with overlapped D2H, instead of N "
-             "model-copy processes). Client fan-out is fixed at 32.")
+             "model-copy processes). 0 = self-calibrate at server startup. "
+             "Client fan-out is fixed at 32.")
     parser.add_argument(
         "-b", "--batch", nargs='+', required=True,
         help="max_batch_size values to sweep for server-side request coalescing.")

@@ -74,6 +74,17 @@ def groups_to_matrix(groups: Optional[Sequence[Sequence[int]]], n_columns: int) 
     return G
 
 
+def resolve_use_pallas(use_pallas: Optional[bool]) -> bool:
+    """Resolve ``ShapConfig.use_pallas``: ``None`` = auto (on for TPU
+    backends, off for cpu/gpu where the kernel would only interpret).
+    Shared by the single-device and shard_map builders so both paths always
+    agree on which kernel they run."""
+
+    if use_pallas is None:
+        return jax.default_backend() not in ("cpu", "gpu")
+    return bool(use_pallas)
+
+
 def _use_masked_ey(predictor, B: int, N: int, S: int, M: int,
                    config: "ShapConfig") -> bool:
     """Dispatch to the structure-aware masked evaluation when the predictor
@@ -257,9 +268,7 @@ def build_explainer_fn(predictor: BasePredictor, config: ShapConfig = ShapConfig
 
         if linear is not None:
             W, b, activation = linear
-            use_pallas = config.use_pallas
-            if use_pallas is None:
-                use_pallas = jax.default_backend() not in ("cpu", "gpu")
+            use_pallas = resolve_use_pallas(config.use_pallas)
             chunk = config.coalition_chunk or _auto_chunk(S, B * N * K, config.target_chunk_elems)
             ey = _ey_linear(W, b, activation, X, bg, bgw_n, mask, G, chunk,
                             use_pallas=use_pallas)

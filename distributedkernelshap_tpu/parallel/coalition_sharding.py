@@ -48,6 +48,15 @@ def build_coalition_sharded_fn(predictor: BasePredictor,
     link_fn = convert_to_link(config.link)
     linear = predictor.linear_decomposition
     n_coal = mesh.shape[COALITION_AXIS]
+    # shared auto rule with build_explainer_fn: pallas on for TPU backends,
+    # off elsewhere.  A pallas_call composes with shard_map (each device runs
+    # the kernel on its local block), so the multi-chip path executes the
+    # same fused kernel the single-chip benchmark measured; on CPU meshes the
+    # interpreter would run it n_devices times over, so it stays off unless
+    # explicitly opted in (the equivalence tests do).
+    from distributedkernelshap_tpu.ops.explain import resolve_use_pallas
+
+    use_pallas = resolve_use_pallas(config.use_pallas)
 
     def local_ey(X, bg, bgw_n, mask_local, G):
         """Expected outputs for this shard's coalition rows."""
@@ -59,11 +68,8 @@ def build_coalition_sharded_fn(predictor: BasePredictor,
             W, b, activation = linear
             chunk = config.coalition_chunk or _auto_chunk(S_local, B * N * K,
                                                           config.target_chunk_elems)
-            # pallas only on explicit opt-in here: the shard_map body runs
-            # per-device, which is fine on TPU meshes, but the CPU-mesh dry
-            # run would interpret the kernel 8× over
             return _ey_linear(W, b, activation, X, bg, bgw_n, mask_local, G,
-                              chunk, use_pallas=bool(config.use_pallas))
+                              chunk, use_pallas=use_pallas)
         from distributedkernelshap_tpu.ops.explain import _use_masked_ey
 
         if _use_masked_ey(predictor, B, N, S_local, mask_local.shape[1], config):

@@ -93,10 +93,55 @@ class DistributedExplainer:
         opts = dict(distributed_opts)
         n_devices = opts.get('n_devices') or opts.get('n_cpus')
         self.batch_size = opts.get('batch_size')
-        self.coalition_parallel = int(opts.get('coalition_parallel', 1) or 1)
+        cp = opts.get('coalition_parallel')
+        frac = opts.get('actor_cpu_fraction')
+        cp_from_fraction = False
+        if cp is None and frac is not None and float(frac) != 1.0:
+            # reference semantics: one actor spans `actor_cpu_fraction` CPUs
+            # (n_actors = n_cpus // frac, reference distributed.py:93).  The
+            # device analog of an actor spanning f units is f devices
+            # co-operating on one explanation batch — coalition-axis sharding.
+            # Fractions < 1 packed several actors onto one CPU; a device has
+            # no sub-unit to pack onto, so those are ignored loudly rather
+            # than silently (the knob must never be dead).
+            if float(frac) > 1 and float(frac).is_integer():
+                cp = int(frac)
+                cp_from_fraction = True
+                logger.info(
+                    "actor_cpu_fraction=%s mapped to coalition_parallel=%d "
+                    "(devices co-operating per batch)", frac, cp)
+            else:
+                logger.warning(
+                    "actor_cpu_fraction=%s has no device analog (devices are "
+                    "not subdividable; only whole fractions > 1 map to "
+                    "coalition parallelism). Ignoring it — set "
+                    "coalition_parallel explicitly to shard the coalition "
+                    "axis across devices.", frac)
+        self.coalition_parallel = int(cp or 1)
+        # 'shard_map' (default) runs the SAME kernel stack as the
+        # single-device engine — pallas fast path included — inside a
+        # shard_map over the mesh; 'gspmd' is the jit-with-shardings path
+        # kept for A/B comparison (it must disable pallas: a pallas_call has
+        # no GSPMD partitioning rule).
+        self.partitioning = opts.get('partitioning', 'shard_map')
+        if self.partitioning not in ('shard_map', 'gspmd'):
+            raise ValueError(
+                f"partitioning must be 'shard_map' or 'gspmd', got "
+                f"{self.partitioning!r}")
         self.algorithm = opts.get('algorithm', 'kernel_shap')
 
-        self.mesh = device_mesh(n_devices, coalition_parallel=self.coalition_parallel)
+        try:
+            self.mesh = device_mesh(n_devices, coalition_parallel=self.coalition_parallel)
+        except ValueError:
+            if not cp_from_fraction:
+                raise  # an explicit coalition_parallel request must not degrade
+            # alias semantics stay warn-and-degrade like the reference's knob
+            # (n_actors = n_cpus // frac floors; it never hard-fails)
+            logger.warning(
+                "actor_cpu_fraction=%s does not divide the device count; "
+                "running without coalition parallelism.", frac)
+            self.coalition_parallel = 1
+            self.mesh = device_mesh(n_devices, coalition_parallel=1)
         self.n_data = self.mesh.shape[DATA_AXIS]
         logger.info("Mesh: %d data-parallel x %d coalition-parallel devices",
                     self.n_data, self.mesh.shape[COALITION_AXIS])
@@ -121,27 +166,15 @@ class DistributedExplainer:
     def _sharded_fn(self):
         key = 'fn'
         if key not in self._jit_cache:
-            if self.coalition_parallel > 1:
-                # shard_map body sees *local* shapes: the per-chunk memory
-                # budget needs no adjustment
-                from distributedkernelshap_tpu.parallel.coalition_sharding import (
-                    build_coalition_sharded_fn,
-                )
-                self._jit_cache[key] = build_coalition_sharded_fn(
-                    self.engine.predictor,
-                    replace(self.engine.config.shap, link=self.engine.config.link),
-                    self.mesh,
-                )
-            else:
-                # GSPMD traces *global* shapes while each device materialises
-                # only its 1/n_data slice of a chunk, so the chunk budget
-                # scales with the data-parallel width
+            if self.partitioning == 'gspmd' and self.coalition_parallel == 1:
+                # A/B reference path.  GSPMD traces *global* shapes while
+                # each device materialises only its 1/n_data slice of a
+                # chunk, so the chunk budget scales with the data-parallel
+                # width.  use_pallas=False: a pallas_call has no GSPMD
+                # partition rule, so under jit-with-shardings it would force
+                # a gather onto one device.
                 fn = build_explainer_fn(
                     self.engine.predictor,
-                    # use_pallas=False: a pallas_call has no GSPMD partition
-                    # rule, so under jit-with-shardings it would force a
-                    # gather onto one device; the coalition shard_map path is
-                    # where pallas composes with meshes
                     replace(self.engine.config.shap, link=self.engine.config.link,
                             use_pallas=False,
                             target_chunk_elems=(self.engine.config.shap.target_chunk_elems
@@ -153,6 +186,26 @@ class DistributedExplainer:
                     in_shardings=(shard, repl, repl, repl, repl, repl),
                     out_shardings={'shap_values': shard, 'expected_value': repl,
                                    'raw_prediction': shard},
+                )
+            else:
+                # default: shard_map over the (data, coalition) mesh.  The
+                # body is the single-device kernel stack (pallas fast path,
+                # masked_ey, chunked XLA fallback) applied to *local* shapes,
+                # so the per-chunk memory budget needs no adjustment and the
+                # multi-chip path executes exactly what the single-chip
+                # benchmark measured.  With coalition size 1 the psum is a
+                # no-op.
+                if self.partitioning == 'gspmd':
+                    logger.warning(
+                        "partitioning='gspmd' does not support "
+                        "coalition_parallel>1; using shard_map.")
+                from distributedkernelshap_tpu.parallel.coalition_sharding import (
+                    build_coalition_sharded_fn,
+                )
+                self._jit_cache[key] = build_coalition_sharded_fn(
+                    self.engine.predictor,
+                    replace(self.engine.config.shap, link=self.engine.config.link),
+                    self.mesh,
                 )
         return self._jit_cache[key]
 

@@ -22,9 +22,10 @@ def main():
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", default=8000, type=int)
     parser.add_argument("--max_batch_size", default=32, type=int)
-    parser.add_argument("--pipeline_depth", default=8, type=int,
+    parser.add_argument("--pipeline_depth", default=0, type=int,
                         help="In-flight device batches (overlapped D2H); the "
-                             "reference's num_replicas analog.")
+                             "reference's num_replicas analog. 0 (default) "
+                             "self-calibrates at startup.")
     parser.add_argument("--checkpoint", default=None, type=str,
                         help="Serve a saved explainer (KernelShap.save) "
                              "instead of fitting the default Adult one.")
@@ -39,7 +40,7 @@ def main():
         model = BatchKernelShapModel.from_explainer(explainer)
         server = ExplainerServer(model, host=args.host, port=args.port,
                                  max_batch_size=args.max_batch_size,
-                                 pipeline_depth=args.pipeline_depth).start()
+                                 pipeline_depth=args.pipeline_depth or None).start()
     else:
         data = load_data()
         predictor = load_model()
@@ -50,7 +51,7 @@ def main():
             {"link": "logit", "feature_names": group_names, "seed": 0},
             {"group_names": group_names, "groups": groups},
             host=args.host, port=args.port, max_batch_size=args.max_batch_size,
-            pipeline_depth=args.pipeline_depth,
+            pipeline_depth=args.pipeline_depth or None,
         )
 
     stop = threading.Event()

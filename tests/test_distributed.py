@@ -152,6 +152,104 @@ def test_coalition_parallel_matches(setup):
     np.testing.assert_allclose(sv[1], sv_seq[1], atol=1e-5)
 
 
+def test_shardmap_pallas_matches_gspmd(setup):
+    """The default multi-chip path (shard_map carrying the pallas fast path,
+    interpret mode on this CPU mesh) must agree with the GSPMD
+    jit-with-shardings path — i.e. the sharded production path runs the same
+    kernel the single-chip benchmark measured (VERDICT r1 #3)."""
+
+    from distributedkernelshap_tpu.kernel_shap import EngineConfig
+    from distributedkernelshap_tpu.ops.explain import ShapConfig
+
+    pallas_cfg = EngineConfig(link="logit",
+                              shap=ShapConfig(link="logit", use_pallas=True))
+    dist = DistributedExplainer(
+        {"n_devices": 8, "batch_size": None, "algorithm": "kernel_shap"},
+        KernelExplainerEngine,
+        (setup["pred"], setup["data"]),
+        {"seed": 0, "config": pallas_cfg},
+    )
+    assert dist.partitioning == "shard_map"
+    sv = dist.get_explanation(setup["X"], nsamples=64)
+
+    gspmd = DistributedExplainer(
+        {"n_devices": 8, "batch_size": None, "algorithm": "kernel_shap",
+         "partitioning": "gspmd"},
+        KernelExplainerEngine,
+        (setup["pred"], setup["data"]),
+        {"link": "logit", "seed": 0},
+    )
+    sv_g = gspmd.get_explanation(setup["X"], nsamples=64)
+    np.testing.assert_allclose(sv[0], sv_g[0], atol=1e-5)
+    np.testing.assert_allclose(sv[1], sv_g[1], atol=1e-5)
+
+
+def test_actor_cpu_fraction_maps_to_coalition_parallel(setup):
+    """The reference's packing knob (one actor spanning f CPUs) maps onto f
+    devices co-operating per batch; results still match sequential."""
+
+    dist = DistributedExplainer(
+        {"n_devices": 8, "batch_size": None, "actor_cpu_fraction": 2.0,
+         "algorithm": "kernel_shap"},
+        KernelExplainerEngine,
+        (setup["pred"], setup["data"]),
+        {"link": "logit", "seed": 0},
+    )
+    assert dist.coalition_parallel == 2
+    assert dist.mesh.shape == {"data": 4, "coalition": 2}
+    sv = dist.get_explanation(setup["X"], nsamples=64)
+    seq = KernelExplainerEngine(setup["pred"], setup["data"], link="logit", seed=0)
+    sv_seq = seq.get_explanation(setup["X"], nsamples=64)
+    np.testing.assert_allclose(sv[0], sv_seq[0], atol=1e-5)
+
+
+def test_actor_cpu_fraction_subunit_warns_and_ignores(setup, caplog):
+    import logging
+
+    with caplog.at_level(logging.WARNING,
+                         logger="distributedkernelshap_tpu.parallel.distributed"):
+        dist = DistributedExplainer(
+            {"n_devices": 8, "batch_size": None, "actor_cpu_fraction": 0.25,
+             "algorithm": "kernel_shap"},
+            KernelExplainerEngine,
+            (setup["pred"], setup["data"]),
+            {"link": "logit", "seed": 0},
+        )
+    assert dist.coalition_parallel == 1
+    assert any("actor_cpu_fraction" in rec.message for rec in caplog.records)
+    # a whole fraction that does not divide the device count degrades with a
+    # warning (the reference's knob floors n_actors = n_cpus // frac — it
+    # never hard-fails); an explicit coalition_parallel still raises
+    with caplog.at_level(logging.WARNING,
+                         logger="distributedkernelshap_tpu.parallel.distributed"):
+        d3 = DistributedExplainer(
+            {"n_devices": 8, "actor_cpu_fraction": 3.0, "algorithm": "kernel_shap"},
+            KernelExplainerEngine,
+            (setup["pred"], setup["data"]),
+            {"link": "logit", "seed": 0},
+        )
+    assert d3.coalition_parallel == 1
+    with pytest.raises(ValueError):
+        DistributedExplainer(
+            {"n_devices": 8, "coalition_parallel": 3, "algorithm": "kernel_shap"},
+            KernelExplainerEngine, (setup["pred"], setup["data"]),
+            {"link": "logit", "seed": 0})
+    with pytest.raises(ValueError):
+        DistributedExplainer(
+            {"n_devices": 8, "partitioning": "gpsmd", "algorithm": "kernel_shap"},
+            KernelExplainerEngine, (setup["pred"], setup["data"]),
+            {"link": "logit", "seed": 0})
+    # an explicit coalition_parallel always wins over the alias
+    dist2 = DistributedExplainer(
+        {"n_devices": 8, "coalition_parallel": 4, "actor_cpu_fraction": 2.0,
+         "algorithm": "kernel_shap"},
+        KernelExplainerEngine,
+        (setup["pred"], setup["data"]),
+        {"link": "logit", "seed": 0},
+    )
+    assert dist2.coalition_parallel == 4
+
+
 def test_attribute_proxy(setup):
     dist = DistributedExplainer(
         {"n_devices": 4, "batch_size": None, "algorithm": "kernel_shap"},
