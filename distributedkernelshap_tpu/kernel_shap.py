@@ -100,7 +100,9 @@ def _l1_select_batch(Xw, Yw, l1_reg) -> List[np.ndarray]:
     S, p = Xw.shape
     T = Yw.shape[1]
 
-    if isinstance(l1_reg, (int, float)) and not isinstance(l1_reg, bool):
+    if isinstance(l1_reg, (int, float)):
+        # NB: includes bools — `_l1_active` classifies True as active and the
+        # pre-batching implementation ran Lasso(alpha=1.0) for it
         coef = np.atleast_2d(Lasso(alpha=float(l1_reg)).fit(Xw, Yw).coef_)
         return [np.nonzero(coef[t])[0] for t in range(T)]
 

@@ -128,6 +128,12 @@ class DistributedExplainer:
             raise ValueError(
                 f"partitioning must be 'shard_map' or 'gspmd', got "
                 f"{self.partitioning!r}")
+        if self.partitioning == 'gspmd' and self.coalition_parallel > 1:
+            # normalise at the point of misconfiguration so the attribute
+            # always reports the path that actually runs
+            logger.warning("partitioning='gspmd' does not support "
+                           "coalition_parallel>1; using shard_map.")
+            self.partitioning = 'shard_map'
         self.algorithm = opts.get('algorithm', 'kernel_shap')
 
         try:
@@ -166,7 +172,7 @@ class DistributedExplainer:
     def _sharded_fn(self):
         key = 'fn'
         if key not in self._jit_cache:
-            if self.partitioning == 'gspmd' and self.coalition_parallel == 1:
+            if self.partitioning == 'gspmd':  # init guarantees cp == 1 here
                 # A/B reference path.  GSPMD traces *global* shapes while
                 # each device materialises only its 1/n_data slice of a
                 # chunk, so the chunk budget scales with the data-parallel
@@ -195,10 +201,6 @@ class DistributedExplainer:
                 # multi-chip path executes exactly what the single-chip
                 # benchmark measured.  With coalition size 1 the psum is a
                 # no-op.
-                if self.partitioning == 'gspmd':
-                    logger.warning(
-                        "partitioning='gspmd' does not support "
-                        "coalition_parallel>1; using shard_map.")
                 from distributedkernelshap_tpu.parallel.coalition_sharding import (
                     build_coalition_sharded_fn,
                 )

@@ -49,7 +49,8 @@ class _Pending:
 
 def calibrate_pipeline_depth(model, example_array: Optional[np.ndarray] = None,
                              candidates=(2, 4, 8, 16, 24),
-                             probes: int = 32) -> int:
+                             probes: int = 32, budget_s: float = 60.0,
+                             fallback: int = 8) -> int:
     """Measure pipelined throughput at a few depths and return the best one.
 
     The optimal number of in-flight device batches is environment-dependent:
@@ -59,10 +60,15 @@ def calibrate_pipeline_depth(model, example_array: Optional[np.ndarray] = None,
     round 1's hand-set depths spanned a 3.7x wall-clock spread.  This short
     self-calibration replaces the hand tuning: for each candidate depth it
     pushes ``probes`` batches through the same bounded dispatch/finalize
-    pipeline the server runs (finalize on a thread pool capped like the
-    server's finalizer count) and keeps the depth with the best measured
-    throughput; a larger depth must win by >5% so ties resolve to fewer
-    in-flight buffers.
+    pipeline the server runs (finalize threads capped like the server's
+    finalizer count) and keeps the depth with the best measured throughput;
+    a larger depth must win by >5% so ties resolve to fewer in-flight
+    buffers.
+
+    The whole measurement is bounded by ``budget_s``: a wedged/hung device
+    (or a model whose finalize raises) must not block server startup, so
+    calibration runs on daemon threads and ``fallback`` is returned — with
+    a warning — if it has not completed in time.
     """
 
     if not hasattr(model, "explain_batch_async"):
@@ -71,35 +77,55 @@ def calibrate_pipeline_depth(model, example_array: Optional[np.ndarray] = None,
         example_array = model.explainer._explainer.background[:1]
     row = np.atleast_2d(np.asarray(example_array, dtype=np.float32))[:1]
 
-    import concurrent.futures as cf
+    out = {}
+    done = threading.Event()
 
-    # warmup: compile + first transfer out of the timed region
-    model.explain_batch_async(row, split_sizes=[1])()
+    def _finish(fin, sem, fetch_gate):
+        try:
+            with fetch_gate:  # the server caps concurrent fetch threads at 8
+                fin()
+        except Exception:
+            logger.debug("calibration probe failed", exc_info=True)
+        finally:
+            sem.release()
 
-    best_depth, best_tp = 1, -1.0
-    for depth in candidates:
-        sem = threading.BoundedSemaphore(depth)
-        futs = []
-        t0 = time.perf_counter()
-        with cf.ThreadPoolExecutor(max_workers=min(depth, 8)) as pool:
-            for _ in range(probes):
-                sem.acquire()
-                fin = model.explain_batch_async(row, split_sizes=[1])
+    def _calibrate():
+        try:
+            # warmup: compile + first transfer out of the timed region
+            model.explain_batch_async(row, split_sizes=[1])()
+            best_depth, best_tp = 1, -1.0
+            for depth in candidates:
+                sem = threading.BoundedSemaphore(depth)  # in-flight bound
+                fetch_gate = threading.BoundedSemaphore(min(depth, 8))
+                threads = []
+                t0 = time.perf_counter()
+                for _ in range(probes):
+                    sem.acquire()
+                    fin = model.explain_batch_async(row, split_sizes=[1])
+                    t = threading.Thread(target=_finish,
+                                         args=(fin, sem, fetch_gate),
+                                         daemon=True)
+                    t.start()
+                    threads.append(t)
+                for t in threads:
+                    t.join()
+                tp = probes / (time.perf_counter() - t0)
+                if tp > best_tp * 1.05:
+                    best_depth, best_tp = depth, tp
+            out["depth"], out["tp"] = best_depth, best_tp
+        except Exception:
+            logger.exception("depth calibration failed")
+        finally:
+            done.set()
 
-                def _finish(f=fin, s=sem):
-                    try:
-                        return f()
-                    finally:
-                        s.release()
-
-                futs.append(pool.submit(_finish))
-            for f in futs:
-                f.result()
-        tp = probes / (time.perf_counter() - t0)
-        if tp > best_tp * 1.05:
-            best_depth, best_tp = depth, tp
-    logger.info("calibrated pipeline_depth=%d (%.1f req/s)", best_depth, best_tp)
-    return best_depth
+    threading.Thread(target=_calibrate, daemon=True).start()
+    if not done.wait(budget_s) or "depth" not in out:
+        logger.warning("depth calibration did not complete within %.0fs; "
+                       "using pipeline_depth=%d", budget_s, fallback)
+        return fallback
+    logger.info("calibrated pipeline_depth=%d (%.1f req/s)",
+                out["depth"], out["tp"])
+    return out["depth"]
 
 
 class ExplainerServer:

@@ -404,6 +404,13 @@ def test_l1_select_batch_matches_sklearn_per_fit():
         want = np.nonzero(Lasso(alpha=0.01).fit(Xw, Yw[:, t]).coef_)[0]
         np.testing.assert_array_equal(got[t], want)
 
+    # l1_reg=True is classified active by _l1_active and historically ran
+    # Lasso(alpha=1.0); it must keep selecting, not raise
+    got_true = _l1_select_batch(Xw, Yw, True)
+    for t in range(T):
+        want = np.nonzero(Lasso(alpha=1.0).fit(Xw, Yw[:, t]).coef_)[0]
+        np.testing.assert_array_equal(got_true[t], want)
+
     with pytest.raises(ValueError):
         _l1_select_batch(Xw, Yw, "bogus")
 
