@@ -3,7 +3,10 @@
 
 PY ?= python
 
-.PHONY: test bench configs serve sweep-pool sweep-serve analysis
+.PHONY: test bench configs serve sweep-pool sweep-serve analysis multihost-ci
+
+multihost-ci:    ## 2-process multi-host validation (one JSON line, rc 0/1)
+	$(PY) benchmarks/multihost_ci.py
 
 test:            ## full suite on CPU with 8 virtual devices
 	env PYTHONPATH= JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q

@@ -175,7 +175,21 @@ def main() -> int:
                             stdout=subprocess.PIPE)
     try:
         out, _ = proc.communicate(timeout=remaining)
-        sys.stdout.write(out.decode())
+        text = out.decode()
+        # the contract is ONE parseable JSON line, even when the child dies
+        # without printing (uncaught exception, OOM kill, signal)
+        last = text.strip().splitlines()[-1] if text.strip() else ""
+        try:
+            json.loads(last)
+        except ValueError:
+            print(json.dumps({
+                "metric": _METRIC,
+                "error": f"benchmark child exited rc={proc.returncode} "
+                         f"without a JSON result",
+                "detail": last[-400:],
+            }))
+            return 1
+        sys.stdout.write(text)
         return proc.returncode
     except subprocess.TimeoutExpired:
         proc.terminate()

@@ -1,0 +1,171 @@
+"""CI-style multi-host validation driver (standalone, exits nonzero on fail).
+
+Drives ``benchmarks/multihost_pool.py`` with two real OS processes joining
+one ``jax.distributed`` runtime (2 virtual CPU devices each -> a global
+4-device mesh, collectives crossing the process boundary over gloo — the
+DCN stand-in), the way the reference's k8s Makefiles drove
+``k8s_ray_pool.py`` against a live cluster (``cluster/Makefile.pool``,
+``k8s_ray_pool.py:90``).  Checks:
+
+1. both processes exit 0 and report a 2-process / 4-device runtime;
+2. the lead process wrote the reference-format result pickle;
+3. the multi-process SHAP values byte-match across processes and agree with
+   a single-process run of the same plan (the sequential == distributed
+   oracle of SURVEY.md §4, across a real process boundary).
+
+Prints ONE JSON line and exits 0/1 — suitable for cron/CI.
+
+    python benchmarks/multihost_ci.py [--timeout 420]
+"""
+
+import argparse
+import json
+import os
+import pickle
+import socket
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_INSTANCES = 64
+NSAMPLES = 64
+N_DEVICES = 4
+
+_PHI_WORKER = """
+import sys
+sys.path.insert(0, sys.argv[4])
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+pid = int(sys.argv[1])
+from distributedkernelshap_tpu.parallel.mesh import initialize_multihost
+initialize_multihost("127.0.0.1:" + sys.argv[2], 2, pid)
+assert jax.process_count() == 2
+import numpy as np
+from benchmarks.multihost_ci import explain_adult_slice
+np.save(sys.argv[3] + "/phi_" + str(pid) + ".npy", explain_adult_slice())
+"""
+
+
+def explain_adult_slice(n_devices: int = N_DEVICES) -> np.ndarray:
+    """Shared recipe: fit + explain the Adult slice on an n-device mesh."""
+
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.utils import load_data, load_model
+
+    data = load_data()
+    clf = load_model()
+    gn, g = data["all"]["group_names"], data["all"]["groups"]
+    X = data["all"]["X"]["processed"]["test"].toarray()[:N_INSTANCES]
+    bg = data["background"]["X"]["preprocessed"]
+    ex = KernelShap(clf.predict_proba, link="logit", feature_names=gn, seed=0,
+                    distributed_opts={"n_devices": n_devices})
+    ex.fit(bg, group_names=gn, groups=g)
+    sv = ex.explain(X, silent=True, nsamples=NSAMPLES, l1_reg=False).shap_values
+    return np.stack(sv, 1)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_two(argv_for_pid, workdir: str, timeout: float):
+    """Two collectively-coupled processes; logs to files (a process blocking
+    on a full pipe would stall its peer inside a shared collective)."""
+
+    env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu")
+    logs = [os.path.join(workdir, f"proc{pid}.log") for pid in range(2)]
+    procs = []
+    try:
+        for pid in range(2):
+            with open(logs[pid], "wb") as log:
+                procs.append(subprocess.Popen(
+                    argv_for_pid(pid), cwd=workdir, env=env,
+                    stdout=log, stderr=subprocess.STDOUT))
+        for p in procs:
+            p.wait(timeout=timeout)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    # unreapable (uninterruptible syscall): keep cleaning up
+                    # the peer rather than masking the original failure
+                    pass
+    texts = [open(log, errors="replace").read() for log in logs]
+    for pid, p in enumerate(procs):
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"process {pid} exited {p.returncode}:\n{texts[pid][-2000:]}")
+    return texts
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--timeout", default=420.0, type=float)
+    args = parser.parse_args()
+
+    checks = {}
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            # --- leg 1: the pool benchmark across two processes ----------
+            port = _free_port()
+            texts = _run_two(lambda pid: [
+                sys.executable, os.path.join(REPO, "benchmarks", "multihost_pool.py"),
+                "-b", "8", "-w", str(N_DEVICES), "-n", "1", "--limit", "64",
+                "--platform", "cpu", "--cpu_devices", "2",
+                "--coordinator", f"127.0.0.1:{port}",
+                "--num_processes", "2", "--process_id", str(pid)],
+                tmp, args.timeout)
+            for out in texts:
+                if "jax.distributed initialised: 2 processes, 4 devices" not in out:
+                    raise RuntimeError("runtime did not span 2 processes:\n"
+                                       + out[-1500:])
+            pkl = os.path.join(tmp, "results",
+                               "ray_workers_4_bsize_8_actorfr_1.0.pkl")
+            with open(pkl, "rb") as f:
+                result = pickle.load(f)
+            assert result["t_elapsed"] and result["t_elapsed"][0] > 0
+            checks["pool_benchmark_2proc"] = "ok"
+
+            # --- leg 2: cross-process phi equivalence --------------------
+            port = _free_port()
+            worker = os.path.join(tmp, "worker.py")
+            with open(worker, "w") as f:
+                f.write(_PHI_WORKER)
+            _run_two(lambda pid: [
+                sys.executable, worker, str(pid), str(port), tmp, REPO],
+                tmp, args.timeout)
+            phi0 = np.load(os.path.join(tmp, "phi_0.npy"))
+            phi1 = np.load(os.path.join(tmp, "phi_1.npy"))
+            np.testing.assert_array_equal(phi0, phi1)
+            checks["phi_identical_across_processes"] = "ok"
+
+            # single-process reference on this process's own devices
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", N_DEVICES)
+            np.testing.assert_allclose(phi0, explain_adult_slice(), atol=1e-5)
+            checks["phi_matches_single_process"] = "ok"
+    except Exception as e:  # noqa: BLE001 - CI driver reports, never raises
+        checks["error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps({"multihost_ci": "fail", **checks}))
+        return 1
+
+    print(json.dumps({"multihost_ci": "ok", **checks}))
+    return 0
+
+
+if __name__ == "__main__":
+    main_rc = main()
+    sys.exit(main_rc)

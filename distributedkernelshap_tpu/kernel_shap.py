@@ -988,7 +988,7 @@ class KernelShap(Explainer, FitMixin):
 
         self.summarise_background = True
         if self.use_groups or self.categorical_names or sparse.issparse(background_data):
-            return subsample(background_data, n_background_samples)
+            return subsample(background_data, n_background_samples, seed=self.seed)
         logger.info(
             "Summarising with k-means; samples are weighted by cluster occupancy. "
             "Pass explicit weights of len=n_background_samples to override."
@@ -1124,9 +1124,13 @@ class KernelShap(Explainer, FitMixin):
             weights: Union[List[float], Tuple[float], np.ndarray, None] = None,
             **kwargs) -> "KernelShap":
         """Initialise the explainer with background data and grouping options
-        (reference kernel_shap.py:697-808; same flow and flags)."""
+        (reference kernel_shap.py:697-808; same flow and flags).
 
-        np.random.seed(self.seed)
+        Unlike the reference (``kernel_shap.py:744``) fit does NOT mutate the
+        global numpy RNG: coalition plans are deterministic from the
+        configured seed and background summarisation receives the seed
+        explicitly, so a library user's own ``np.random`` state is left
+        alone."""
 
         self._fitted = True
         self.use_groups = groups is not None or group_names is not None

@@ -128,12 +128,6 @@ class DistributedExplainer:
             raise ValueError(
                 f"partitioning must be 'shard_map' or 'gspmd', got "
                 f"{self.partitioning!r}")
-        if self.partitioning == 'gspmd' and self.coalition_parallel > 1:
-            # normalise at the point of misconfiguration so the attribute
-            # always reports the path that actually runs
-            logger.warning("partitioning='gspmd' does not support "
-                           "coalition_parallel>1; using shard_map.")
-            self.partitioning = 'shard_map'
         self.algorithm = opts.get('algorithm', 'kernel_shap')
 
         try:
@@ -148,6 +142,13 @@ class DistributedExplainer:
                 "running without coalition parallelism.", frac)
             self.coalition_parallel = 1
             self.mesh = device_mesh(n_devices, coalition_parallel=1)
+        if self.partitioning == 'gspmd' and self.coalition_parallel > 1:
+            # normalise AFTER the mesh settles (a fraction-derived cp may have
+            # degraded to 1 above, which keeps gspmd viable) so the attribute
+            # always reports the path that actually runs
+            logger.warning("partitioning='gspmd' does not support "
+                           "coalition_parallel>1; using shard_map.")
+            self.partitioning = 'shard_map'
         self.n_data = self.mesh.shape[DATA_AXIS]
         logger.info("Mesh: %d data-parallel x %d coalition-parallel devices",
                     self.n_data, self.mesh.shape[COALITION_AXIS])

@@ -79,6 +79,7 @@ def calibrate_pipeline_depth(model, example_array: Optional[np.ndarray] = None,
 
     out = {}
     done = threading.Event()
+    cancelled = threading.Event()  # budget expiry: stop issuing new probes
 
     def _finish(fin, sem, fetch_gate):
         try:
@@ -100,6 +101,8 @@ def calibrate_pipeline_depth(model, example_array: Optional[np.ndarray] = None,
                 threads = []
                 t0 = time.perf_counter()
                 for _ in range(probes):
+                    if cancelled.is_set():
+                        return  # abandoned: don't contend with live traffic
                     sem.acquire()
                     fin = model.explain_batch_async(row, split_sizes=[1])
                     t = threading.Thread(target=_finish,
@@ -120,6 +123,7 @@ def calibrate_pipeline_depth(model, example_array: Optional[np.ndarray] = None,
 
     threading.Thread(target=_calibrate, daemon=True).start()
     if not done.wait(budget_s) or "depth" not in out:
+        cancelled.set()
         logger.warning("depth calibration did not complete within %.0fs; "
                        "using pipeline_depth=%d", budget_s, fallback)
         return fallback

@@ -238,6 +238,21 @@ def test_pipeline_depth_self_calibration(model_setup):
 
     assert calibrate_pipeline_depth(SyncOnly()) == 1
 
+    # a hung device must not block startup: the budget expires and the
+    # fallback depth is returned (the calibration thread is a daemon)
+    class HungModel(KernelShapModel):
+        def explain_batch_async(self, instances, split_sizes=None):
+            import threading as _t
+
+            def finalize():
+                _t.Event().wait()  # never returns
+
+            return finalize
+
+    hung = HungModel(s["pred"], s["bg"], s["constructor_kwargs"], s["fit_kwargs"])
+    assert calibrate_pipeline_depth(hung, example_array=s["bg"][:1],
+                                    budget_s=1.0) == 8
+
     srv = ExplainerServer(model, host="127.0.0.1", port=0).start()
     try:
         assert srv.pipeline_depth in (2, 4, 8, 16, 24)
