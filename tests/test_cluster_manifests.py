@@ -1,0 +1,131 @@
+"""Structural validation of the k8s deployment manifests (L7).
+
+The reference's manifests were exercised against a live cluster
+(``/root/reference/README.md:57-62``); no cluster exists in CI, so this is
+the next-best thing: parse ``cluster/*.yaml`` and assert the cross-file
+invariants a deploy would trip over — commands point at files the image
+actually ships, ports line up between Service/container/server code, the
+Job's ``subdomain`` is backed by a headless Service, namespaces agree with
+the Makefiles, and TPU resource requests equal limits (GKE rejects
+fractional/mismatched TPU requests).
+"""
+
+import os
+import re
+
+import pytest
+
+yaml = pytest.importorskip("yaml")  # pyyaml is not a package dependency
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLUSTER = os.path.join(REPO, "cluster")
+
+
+def _load(name):
+    with open(os.path.join(CLUSTER, name)) as f:
+        return [d for d in yaml.safe_load_all(f) if d]
+
+
+def _by_kind(docs):
+    out = {}
+    for d in docs:
+        out.setdefault(d["kind"], []).append(d)
+    return out
+
+
+def test_pool_manifest_structure():
+    docs = _by_kind(_load("tpu_pool_cluster.yaml"))
+    assert set(docs) == {"Namespace", "Service", "Job"}
+
+    job = docs["Job"][0]
+    spec = job["spec"]
+    # indexed completion: every host runs exactly one worker process
+    assert spec["completionMode"] == "Indexed"
+    assert spec["completions"] == spec["parallelism"]
+
+    pod = spec["template"]["spec"]
+    assert pod["restartPolicy"] == "Never"
+
+    # the subdomain must be backed by a headless Service of the same name
+    # selecting these pods, or per-pod DNS records are never created
+    svc = docs["Service"][0]
+    assert pod["subdomain"] == svc["metadata"]["name"]
+    assert svc["spec"]["clusterIP"] in (None, "None")  # k8s spells it "None"
+    labels = spec["template"]["metadata"]["labels"]
+    assert svc["spec"]["selector"].items() <= labels.items()
+
+    (container,) = pod["containers"]
+    # the command must point at a file the Dockerfile ships (it COPYes
+    # benchmarks/ into /app and sets workingDir /app)
+    assert container["command"][0] == "python"
+    target = container["command"][1]
+    assert os.path.exists(os.path.join(REPO, target)), target
+    # GKE requires TPU requests == limits
+    res = container["resources"]
+    assert res["requests"]["google.com/tpu"] == res["limits"]["google.com/tpu"]
+
+
+def test_serve_manifest_structure():
+    docs = _by_kind(_load("tpu_serve_cluster.yaml"))
+    assert set(docs) == {"Service", "Deployment"}
+
+    svc = docs["Service"][0]
+    (port,) = svc["spec"]["ports"]
+    dep = docs["Deployment"][0]
+    pod = dep["spec"]["template"]["spec"]
+    (container,) = pod["containers"]
+
+    # Service target port == container port == the --port the server binds
+    assert port["targetPort"] == container["ports"][0]["containerPort"]
+    args = container["args"]
+    assert str(port["targetPort"]) == args[args.index("--port") + 1]
+
+    # the Service must select the Deployment's pods
+    labels = dep["spec"]["template"]["metadata"]["labels"]
+    assert svc["spec"]["selector"].items() <= labels.items()
+
+    # the command module must exist in the shipped package
+    assert container["command"][:2] == ["python", "-m"]
+    module = container["command"][2]
+    assert os.path.exists(os.path.join(REPO, *module.split(".")) + ".py")
+
+    # readiness probe must hit a route the server actually serves
+    probe_path = container["readinessProbe"]["httpGet"]["path"]
+    with open(os.path.join(REPO, "distributedkernelshap_tpu", "serving",
+                           "server.py")) as f:
+        assert f'"{probe_path}"' in f.read()
+
+    res = container["resources"]
+    assert res["requests"]["google.com/tpu"] == res["limits"]["google.com/tpu"]
+
+
+def test_namespaces_and_images_consistent():
+    pool = _load("tpu_pool_cluster.yaml")
+    serve = _load("tpu_serve_cluster.yaml")
+    namespaces = {d["metadata"].get("namespace")
+                  for d in pool + serve if d["kind"] != "Namespace"}
+    assert namespaces == {"dks-tpu"}
+
+    # the Makefiles' default NAMESPACE must match the manifests
+    for mk in ("Makefile.pool", "Makefile.serve"):
+        with open(os.path.join(CLUSTER, mk)) as f:
+            m = re.search(r"NAMESPACE \?= (\S+)", f.read())
+        assert m and m.group(1) == "dks-tpu", mk
+
+    # one image name across both manifests, matching dockerfiles/Makefile
+    images = {c["image"]
+              for d in pool + serve if d["kind"] in ("Job", "Deployment")
+              for c in d["spec"]["template"]["spec"]["containers"]}
+    assert len(images) == 1
+    with open(os.path.join(REPO, "dockerfiles", "Makefile")) as f:
+        m = re.search(r"IMAGE_NAME \?= (\S+)", f.read())
+    assert m and next(iter(images)).startswith(m.group(1) + ":")
+
+
+def test_pool_makefile_script_paths_exist():
+    """Makefile.pool copies/executes scripts by path — they must exist."""
+
+    with open(os.path.join(CLUSTER, "Makefile.pool")) as f:
+        text = f.read()
+    for rel in re.findall(r"\.\./(benchmarks/\S+\.py)", text):
+        assert os.path.exists(os.path.join(REPO, rel)), rel
