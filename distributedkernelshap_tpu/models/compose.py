@@ -515,6 +515,30 @@ def lift_bagging(method) -> Optional[BasePredictor]:
         return None
 
 
+def lift_search_cv(method) -> Optional[BasePredictor]:
+    """Lift fitted hyper-parameter searches (``GridSearchCV`` and friends) by
+    delegating to ``best_estimator_``: the search object routes ``predict*``
+    straight to the refit winner, so the winner's lift IS the search's lift
+    (and the composite is still probe-gated as a whole in ``as_predictor``)."""
+
+    owner = getattr(method, "__self__", None)
+    name = getattr(method, "__name__", "")
+    if owner is None or type(owner).__name__ not in (
+            "GridSearchCV", "RandomizedSearchCV",
+            "HalvingGridSearchCV", "HalvingRandomSearchCV"):
+        return None
+    if name not in ("predict", "predict_proba", "decision_function"):
+        return None
+    try:
+        best = getattr(owner, "best_estimator_", None)
+        if best is None:
+            return None  # refit=False: the search cannot predict at all
+        return _inner_lift(best, (name,))
+    except Exception as exc:
+        logger.info("search-cv lift failed structurally (%s); using host path", exc)
+        return None
+
+
 def lift_calibrated(method) -> Optional[BasePredictor]:
     """Lift binary ``CalibratedClassifierCV.predict_proba``: per-fold base
     model + sigmoid/isotonic calibrator, averaged over folds."""

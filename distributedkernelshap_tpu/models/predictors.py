@@ -477,6 +477,7 @@ def _nonlinear_lifters():
         lift_calibrated,
         lift_ovr,
         lift_pipeline,
+        lift_search_cv,
         lift_stacking,
         lift_voting,
     )
@@ -499,7 +500,8 @@ def _nonlinear_lifters():
             ("bagging ensemble", lift_bagging),
             ("stacking ensemble", lift_stacking),
             ("one-vs-rest classifier", lift_ovr),
-            ("calibrated classifier", lift_calibrated))
+            ("calibrated classifier", lift_calibrated),
+            ("hyper-parameter search", lift_search_cv))
 
 
 def structural_lift(method) -> Optional[BasePredictor]:

@@ -552,3 +552,45 @@ def test_explain_end_to_end_pipeline(data):
         # rtol absorbs the f32 blow-up of near-saturated probabilities
         # (|logit| ~ 12 means p within 1e-5 of 1)
         np.testing.assert_allclose(lhs, rhs, rtol=1e-3, atol=5e-3)
+
+
+def test_search_cv_delegates_to_best_estimator(data):
+    """GridSearchCV/RandomizedSearchCV route predict* to the refit winner;
+    the lift must be the winner's lift (here a pipeline that folds into one
+    LinearPredictor) and reproduce the search object's own outputs."""
+
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.model_selection import GridSearchCV, RandomizedSearchCV
+    from sklearn.pipeline import Pipeline
+    from sklearn.preprocessing import StandardScaler
+
+    from distributedkernelshap_tpu.models import LinearPredictor
+
+    X, y, _ = data
+    pipe = Pipeline([("sc", StandardScaler()), ("lr", LogisticRegression())])
+    gs = GridSearchCV(pipe, {"lr__C": [0.1, 1.0]}, cv=3).fit(X, y)
+    pred = as_predictor(gs.predict_proba, example_dim=X.shape[1],
+                        probe_data=X[:32])
+    assert isinstance(pred, LinearPredictor)
+    _check(pred, gs.predict_proba, X[:64])
+
+    rs = RandomizedSearchCV(LogisticRegression(), {"C": [0.5, 2.0]},
+                            n_iter=2, cv=3, random_state=0).fit(X, y)
+    pred_r = as_predictor(rs.predict_proba, example_dim=X.shape[1],
+                          probe_data=X[:32])
+    assert isinstance(pred_r, LinearPredictor)
+    _check(pred_r, rs.predict_proba, X[:64])
+
+
+def test_search_cv_without_refit_declines(data):
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.model_selection import GridSearchCV
+
+    from distributedkernelshap_tpu.models.compose import lift_search_cv
+
+    X, y, _ = data
+    gs = GridSearchCV(LogisticRegression(), {"C": [0.1, 1.0]}, cv=3,
+                      refit=False).fit(X, y)
+    # refit=False leaves no best_estimator_ and sklearn raises on predict*;
+    # the lifter must decline rather than crash (score is the only method)
+    assert lift_search_cv(getattr(gs, "predict_proba", None) or gs.score) is None
