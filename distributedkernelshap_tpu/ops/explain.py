@@ -171,6 +171,31 @@ def _ey_linear(W, b, activation: str, X, bg, bgw_n, mask, G, chunk,
 
         return fused_linear_ey(XWg, bgWg, bgW, bgw_n, mask, activation)
 
+    K = W.shape[1]
+    if activation == "softmax" and K == 2:
+        # binary softmax == sigmoid of the logit difference (the same
+        # shortcut the pallas kernel takes): only the class-difference
+        # tensors are needed, one transcendental per (b, s, n), and the k=0
+        # column is the complement since Σ bgw_n = 1.  Halves the chunk
+        # tensor and >halves the elementwise work on the XLA fallback path.
+        dXWg = XWg[:, :, 1] - XWg[:, :, 0]              # (B, M)
+        dbgWg = bgWg[:, :, 1] - bgWg[:, :, 0]           # (N, M)
+        dbgW = bgW[:, 1] - bgW[:, 0]                    # (N,)
+        # callers budget the chunk for (B, c, N, K) tensors; this branch's
+        # largest intermediate is K-free, so double the rows per step for
+        # the same memory footprint (half the lax.map trip count)
+        mask_chunks, S = _chunked(mask, min(mask.shape[0], 2 * chunk))
+
+        def one_chunk_binary(mask_c):
+            dp = jnp.einsum("sm,bm->bs", mask_c, dXWg)   # (B, c)
+            dt2 = jnp.einsum("sm,nm->sn", mask_c, dbgWg) - dbgW[None, :]
+            probs1 = jax.nn.sigmoid(dp[:, :, None] - dt2[None])  # (B, c, N)
+            return jnp.einsum("bcn,n->bc", probs1, bgw_n)
+
+        ey1 = jax.lax.map(one_chunk_binary, mask_chunks)
+        ey1 = jnp.moveaxis(ey1, 1, 0).reshape(X.shape[0], -1)[:, :S]
+        return jnp.stack([1.0 - ey1, ey1], axis=-1)
+
     mask_chunks, S = _chunked(mask, chunk)
 
     def one_chunk(mask_c):

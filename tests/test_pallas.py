@@ -127,3 +127,20 @@ def test_ey_linear_pallas_vs_xla_path():
     xla = np.asarray(_ey_linear(*args, use_pallas=False))
     pallas = np.asarray(_ey_linear(*args, use_pallas=True))
     np.testing.assert_allclose(pallas, xla, atol=1e-5)
+
+
+@pytest.mark.parametrize("K", [2, 3])
+def test_ey_linear_xla_fallback_matches_dense(K):
+    """The XLA fallback path (binary sigmoid-of-difference shortcut at K=2,
+    general softmax otherwise) must equal the dense synthetic-row formula."""
+
+    from distributedkernelshap_tpu.ops.explain import _ey_linear
+
+    B, S, N, M = 11, 77, 7, 5
+    X, bg, W, b, G, mask, bgw, *_ = _problem(B, S, N, M, K, seed=4)
+    ref = _dense_reference(X, bg, W, b, G, mask, bgw, "softmax")
+    got = np.asarray(_ey_linear(
+        jnp.asarray(W), jnp.asarray(b), "softmax", jnp.asarray(X),
+        jnp.asarray(bg), jnp.asarray(bgw), jnp.asarray(mask),
+        jnp.asarray(G), 13, use_pallas=False))
+    np.testing.assert_allclose(got, ref, atol=1e-5)
