@@ -1,0 +1,126 @@
+"""One-session TPU re-validation sweep.
+
+A wedged tunnel relay can block TPU backend init for hours; once it
+recovers, the recovery discipline is to do ALL pending device work in ONE
+connected process rather than reconnecting per task (each client exit is a
+fresh chance to re-wedge).  This script is that one session: it runs every
+measurement the round needs, in order, each step individually try/except'd
+and appended as a JSON line to ``results/tpu_revalidate.jsonl`` as soon as
+it finishes (a later hang cannot lose earlier numbers).
+
+    python benchmarks/tpu_revalidate.py [--skip adult_blackbox,...]
+
+Steps:
+
+1. every BASELINE.json config via ``benchmarks/configs.py`` (headline adult,
+   stress, lifted trees, model zoo, mnist, full covertype, host-eval
+   blackbox) — post-barrier re-validation incl. the ``model_err`` external
+   faithfulness columns;
+2. the fused-tree-eval regression check (``tpu_regression_check.main``);
+3. serving: auto-calibrated depth for coalesced (b=10) and uncoalesced
+   (b=1) modes, plus fixed depths 4 and 16 for the uncoalesced mode so the
+   auto-depth can be judged against hand-tuned rows;
+4. single-chip pool sweep points (w=1, b 320/2560) in the reference's
+   pickle convention.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT = os.path.join("results", "tpu_revalidate.jsonl")
+
+
+def _emit(record):
+    record["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    os.makedirs("results", exist_ok=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(record) + "\n")
+    print(json.dumps(record), flush=True)
+
+
+def _step(name, fn):
+    t0 = time.monotonic()
+    try:
+        result = fn()
+        _emit({"step": name, "ok": True,
+               "elapsed_s": round(time.monotonic() - t0, 1),
+               "result": result})
+    except Exception as e:  # keep the session going; later steps still run
+        _emit({"step": name, "ok": False,
+               "elapsed_s": round(time.monotonic() - t0, 1),
+               "error": f"{type(e).__name__}: {e}"})
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--skip", default="",
+                        help="comma-separated step names to skip")
+    args = parser.parse_args()
+    skip = set(filter(None, args.skip.split(",")))
+
+    import jax
+
+    t0 = time.monotonic()
+    devices = jax.devices()
+    _emit({"step": "backend", "ok": True,
+           "elapsed_s": round(time.monotonic() - t0, 1),
+           "result": {"devices": [str(d) for d in devices],
+                      "backend": jax.default_backend()}})
+
+    from benchmarks.configs import CONFIGS
+
+    for name in ("adult", "adult_stress", "adult_trees", "model_zoo",
+                 "mnist", "covertype", "adult_blackbox"):
+        if name in skip:
+            continue
+        _step(f"config:{name}", lambda n=name: CONFIGS[n](smoke=False))
+
+    if "regression" not in skip:
+        from benchmarks import tpu_regression_check
+
+        _step("regression_check",
+              lambda: (tpu_regression_check.main(), "ALL CLEAR")[1])
+
+    if "serve" not in skip:
+        from distributedkernelshap_tpu.utils import load_data, load_model
+        from benchmarks.serve_explanations import build_model, run_config
+
+        data = load_data()
+        predictor = load_model()
+        X = data["all"]["X"]["processed"]["test"].toarray()
+        model = build_model(predictor, data)
+        # (replicas, max_batch_size): 0 = auto-calibrated depth
+        for replicas, mbs in ((0, 10), (0, 1), (4, 1), (16, 1)):
+            _step(f"serve:r{replicas}_b{mbs}",
+                  lambda r=replicas, b=mbs: run_config(
+                      predictor, data, X, r, b, "0.0.0.0", 0, nruns=2,
+                      model=model))
+
+    if "pool" not in skip:
+        from benchmarks.pool import fit_kernel_shap_explainer, run_explainer
+        from distributedkernelshap_tpu.utils import load_data, load_model
+
+        data = load_data()
+        clf = load_model()
+        X = data["all"]["X"]["processed"]["test"].toarray()
+
+        def pool_point(batch):
+            opts = {"batch_size": batch, "n_devices": 1}
+            ex = fit_kernel_shap_explainer(clf, data, opts)
+            ex.explain(X[:batch], silent=True)  # warmup at the slab shape
+            run_explainer(ex, X, opts, nruns=3)
+            return f"results/ray_workers_1_bsize_{batch}_actorfr_1.0.pkl"
+
+        for batch in (320, 2560):
+            _step(f"pool:w1_b{batch}", lambda b=batch: pool_point(b))
+
+    _emit({"step": "done", "ok": True})
+
+
+if __name__ == "__main__":
+    main()
