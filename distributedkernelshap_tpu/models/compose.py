@@ -515,6 +515,174 @@ def lift_bagging(method) -> Optional[BasePredictor]:
         return None
 
 
+class AdaBoostPredictor(BasePredictor):
+    """SAMME AdaBoost on the device: each member votes with its argmax class
+    (one-hot of the member's lifted ``predict_proba``), votes weighted
+    ``+w`` for the predicted class and ``-w/(K-1)`` elsewhere, normalised by
+    ``Σw`` (sklearn ``AdaBoostClassifier.decision_function``).  Heads:
+    ``'proba'`` = ``softmax(decision/(K-1))`` (binary: softmax of
+    ``[-d, d]/2``), ``'decision'`` = the raw decision (binary: scalar).
+
+    The argmax makes the model piecewise-constant — fine for KernelSHAP,
+    which only evaluates (never differentiates) the predictor; the
+    faithfulness probe in ``as_predictor`` guards tie-breaking and member
+    class-order assumptions numerically.
+    """
+
+    def __init__(self, members: Sequence[BasePredictor], weights,
+                 n_classes: int, head: str = "proba"):
+        if not members:
+            raise ValueError("AdaBoostPredictor needs at least one member")
+        if head not in ("proba", "decision"):
+            raise ValueError("head must be 'proba' or 'decision'")
+        self.members = list(members)
+        self.weights = jnp.asarray(np.asarray(weights, np.float64), jnp.float32)
+        self.K = int(n_classes)
+        self.head = head
+        binary_decision = head == "decision" and self.K == 2
+        self.n_outputs = 1 if binary_decision else self.K
+        self.vector_out = not binary_decision
+
+    def __call__(self, X):
+        X = jnp.asarray(X, jnp.float32)
+        K = self.K
+        total = jnp.zeros((X.shape[0], K), jnp.float32)
+        for m, w in zip(self.members, self.weights):
+            onehot = jax.nn.one_hot(jnp.argmax(m(X), axis=-1), K)
+            total = total + jnp.where(onehot > 0, w, -w / (K - 1))
+        dec = total / jnp.sum(self.weights)
+        if self.head == "decision":
+            if K == 2:
+                return (dec[:, 1] - dec[:, 0])[:, None]
+            return dec
+        if K == 2:
+            d = dec[:, 1] - dec[:, 0]
+            return jax.nn.softmax(jnp.stack([-d, d], axis=-1) / 2.0, axis=-1)
+        return jax.nn.softmax(dec / (K - 1), axis=-1)
+
+
+def lift_adaboost(method) -> Optional[BasePredictor]:
+    """Lift ``AdaBoostClassifier.predict_proba`` / ``decision_function``
+    (SAMME — the only algorithm in current sklearn) when every member's
+    ``predict_proba`` lifts and member class order matches the ensemble's.
+    ``AdaBoostRegressor`` (weighted-median aggregation) declines to the
+    host path."""
+
+    owner = getattr(method, "__self__", None)
+    name = getattr(method, "__name__", "")
+    if owner is None or type(owner).__name__ != "AdaBoostClassifier" \
+            or name not in ("predict_proba", "decision_function"):
+        return None
+    try:
+        algorithm = getattr(owner, "algorithm", "SAMME")
+        if algorithm not in ("SAMME", "deprecated"):
+            return None  # SAMME.R (removed upstream) used log-proba votes
+        classes = np.asarray(owner.classes_)
+        if classes.shape[0] < 2:
+            return None
+        members = []
+        for est in owner.estimators_:
+            if not np.array_equal(np.asarray(est.classes_), classes):
+                return None  # member trained on a class subset: argmax index
+                # would not line up with the ensemble's class axis
+            inner = _inner_lift(est, ("predict_proba",))
+            if inner is None:
+                return None
+            members.append(inner)
+        return AdaBoostPredictor(
+            members, owner.estimator_weights_[:len(members)],
+            classes.shape[0],
+            head="proba" if name == "predict_proba" else "decision")
+    except Exception as exc:
+        logger.info("AdaBoost lift failed structurally (%s); using host path", exc)
+        return None
+
+
+class AffineOutputPredictor(BasePredictor):
+    """Inner predictor outputs mapped through ``y -> a*y + b`` (e.g. a
+    target-scaler's inverse transform).  Expectation is linear, so the inner
+    model's structure-aware masked evaluation forwards through the head."""
+
+    def __init__(self, inner: BasePredictor, a: float, b: float):
+        self.inner = inner
+        self.a = jnp.float32(a)
+        self.b = jnp.float32(b)
+        self.n_outputs = inner.n_outputs
+        self.vector_out = inner.vector_out
+
+    def __call__(self, X):
+        return self.inner(X) * self.a + self.b
+
+    @property
+    def supports_masked_ey(self) -> bool:
+        return getattr(self.inner, "supports_masked_ey", False)
+
+    def masked_ey_fits(self, **kwargs) -> bool:
+        return self.inner.masked_ey_fits(**kwargs)
+
+    def masked_ey(self, *args, **kwargs):
+        return self.inner.masked_ey(*args, **kwargs) * self.a + self.b
+
+
+def _affine_inverse(transformer) -> Optional[Tuple[float, float]]:
+    """``(a, b)`` with ``inverse_transform(y) == a*y + b``, or None.
+
+    TTR fits its transformer on ``y.reshape(-1, 1)``, so fitted statistics
+    are length-1 arrays."""
+
+    name = type(transformer).__name__
+    if name == "StandardScaler":
+        a = float(transformer.scale_[0]) if transformer.with_std else 1.0
+        b = float(transformer.mean_[0]) if transformer.with_mean else 0.0
+        return a, b
+    if name == "MinMaxScaler":
+        # forward: y*scale_ + min_  ->  inverse: (y - min_) / scale_
+        return 1.0 / float(transformer.scale_[0]), \
+            -float(transformer.min_[0]) / float(transformer.scale_[0])
+    if name == "MaxAbsScaler":
+        # scale_ is the zero-handled max_abs_ (1.0 for an all-zero target),
+        # matching sklearn's inverse_transform exactly
+        return float(transformer.scale_[0]), 0.0
+    if name == "FunctionTransformer" and transformer.inverse_func is None:
+        return 1.0, 0.0
+    return None
+
+
+def lift_transformed_target(method) -> Optional[BasePredictor]:
+    """Lift ``TransformedTargetRegressor.predict`` when the target
+    transformer's inverse is affine (Standard/MinMax/MaxAbs scaler or an
+    identity FunctionTransformer): ``predict = inverse(regressor_.predict)``.
+    Identity-activation linear inners fold the head into their weights so
+    the MXU fast path is kept; arbitrary ``inverse_func`` callables decline."""
+
+    owner = getattr(method, "__self__", None)
+    name = getattr(method, "__name__", "")
+    if owner is None or type(owner).__name__ != "TransformedTargetRegressor" \
+            or name != "predict":
+        return None
+    try:
+        inner = _inner_lift(owner.regressor_, ("predict",))
+        if inner is None:
+            return None
+        transformer = getattr(owner, "transformer_", None)
+        ab = (1.0, 0.0) if transformer is None else _affine_inverse(transformer)
+        if ab is None:
+            return None
+        a, b = ab
+        from distributedkernelshap_tpu.models.predictors import LinearPredictor
+
+        if isinstance(inner, LinearPredictor) and inner.activation == "identity":
+            return LinearPredictor(np.asarray(inner.W) * a,
+                                   np.asarray(inner.b) * a + b,
+                                   activation="identity",
+                                   vector_out=inner.vector_out)
+        return AffineOutputPredictor(inner, a, b)
+    except Exception as exc:
+        logger.info("transformed-target lift failed structurally (%s); "
+                    "using host path", exc)
+        return None
+
+
 def lift_search_cv(method) -> Optional[BasePredictor]:
     """Lift fitted hyper-parameter searches (``GridSearchCV`` and friends) by
     delegating to ``best_estimator_``: the search object routes ``predict*``

@@ -473,12 +473,14 @@ def _nonlinear_lifters():
     recurse through :func:`structural_lift` for their members)."""
 
     from distributedkernelshap_tpu.models.compose import (
+        lift_adaboost,
         lift_bagging,
         lift_calibrated,
         lift_ovr,
         lift_pipeline,
         lift_search_cv,
         lift_stacking,
+        lift_transformed_target,
         lift_voting,
     )
     from distributedkernelshap_tpu.models.lgbm import lift_lightgbm
@@ -501,7 +503,9 @@ def _nonlinear_lifters():
             ("stacking ensemble", lift_stacking),
             ("one-vs-rest classifier", lift_ovr),
             ("calibrated classifier", lift_calibrated),
-            ("hyper-parameter search", lift_search_cv))
+            ("hyper-parameter search", lift_search_cv),
+            ("AdaBoost ensemble", lift_adaboost),
+            ("transformed-target regressor", lift_transformed_target))
 
 
 def structural_lift(method) -> Optional[BasePredictor]:

@@ -594,3 +594,115 @@ def test_search_cv_without_refit_declines(data):
     # refit=False leaves no best_estimator_ and sklearn raises on predict*;
     # the lifter must decline rather than crash (score is the only method)
     assert lift_search_cv(getattr(gs, "predict_proba", None) or gs.score) is None
+
+
+def test_adaboost_classifier_lifts(data):
+    """SAMME AdaBoost: one-hot argmax votes of lifted tree members must
+    reproduce sklearn's decision_function and predict_proba exactly."""
+
+    from sklearn.ensemble import AdaBoostClassifier
+
+    from distributedkernelshap_tpu.models.compose import AdaBoostPredictor
+
+    X, y, _ = data
+    clf = AdaBoostClassifier(n_estimators=12, random_state=0).fit(X, y)
+    pred = as_predictor(clf.predict_proba, example_dim=X.shape[1],
+                        probe_data=X[:32])
+    assert isinstance(pred, AdaBoostPredictor)
+    _check(pred, clf.predict_proba, X[:64])
+
+    pred_d = as_predictor(clf.decision_function, example_dim=X.shape[1],
+                          probe_data=X[:32])
+    assert isinstance(pred_d, AdaBoostPredictor)
+    _check(pred_d, clf.decision_function, X[:64])
+
+
+def test_adaboost_multiclass_lifts():
+    from sklearn.ensemble import AdaBoostClassifier
+
+    from distributedkernelshap_tpu.models.compose import AdaBoostPredictor
+
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(300, 5))
+    y = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)  # 3 classes
+    clf = AdaBoostClassifier(n_estimators=10, random_state=0).fit(X, y)
+    pred = as_predictor(clf.predict_proba, example_dim=5, probe_data=X[:32])
+    assert isinstance(pred, AdaBoostPredictor)
+    _check(pred, clf.predict_proba, X[:64])
+    pred_d = as_predictor(clf.decision_function, example_dim=5, probe_data=X[:32])
+    assert isinstance(pred_d, AdaBoostPredictor)
+    _check(pred_d, clf.decision_function, X[:64])
+
+
+def test_adaboost_explain_end_to_end(data):
+    from sklearn.ensemble import AdaBoostClassifier
+
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.models.compose import AdaBoostPredictor
+
+    X, y, _ = data
+    clf = AdaBoostClassifier(n_estimators=8, random_state=0).fit(X, y)
+    ex = KernelShap(clf.predict_proba, link="logit", seed=0)
+    ex.fit(X[:40].astype(np.float32))
+    assert isinstance(ex._explainer.predictor, AdaBoostPredictor)
+    Xe = _quant(X[40:52]).astype(np.float32)
+    res = ex.explain(Xe, silent=True)
+    # external oracle: Σφ + E matches the ORIGINAL sklearn outputs
+    proba = np.clip(clf.predict_proba(Xe), 1e-7, 1 - 1e-7)
+    for k, phi in enumerate(res.shap_values):
+        lhs = phi.sum(axis=1) + res.expected_value[k]
+        rhs = np.log(proba[:, k] / (1 - proba[:, k]))
+        np.testing.assert_allclose(lhs, rhs, rtol=1e-3, atol=5e-3)
+
+
+def test_adaboost_regressor_declines(data):
+    from sklearn.ensemble import AdaBoostRegressor
+
+    from distributedkernelshap_tpu.models.compose import lift_adaboost
+
+    X, _, yr = data
+    reg = AdaBoostRegressor(n_estimators=5, random_state=0).fit(X, yr)
+    assert lift_adaboost(reg.predict) is None
+
+
+def test_transformed_target_regressor_lifts(data):
+    """TTR.predict = inverse(regressor.predict): an affine target scaler
+    folds into the linear inner model, keeping the MXU fast path; a GBT
+    inner keeps its masked fast path through the affine head."""
+
+    from sklearn.compose import TransformedTargetRegressor
+    from sklearn.ensemble import HistGradientBoostingRegressor
+    from sklearn.linear_model import LinearRegression
+    from sklearn.preprocessing import MinMaxScaler, StandardScaler
+
+    from distributedkernelshap_tpu.models import LinearPredictor
+    from distributedkernelshap_tpu.models.compose import AffineOutputPredictor
+
+    X, _, yr = data
+    ttr = TransformedTargetRegressor(
+        regressor=LinearRegression(), transformer=StandardScaler()).fit(X, yr)
+    pred = as_predictor(ttr.predict, example_dim=X.shape[1], probe_data=X[:32])
+    assert isinstance(pred, LinearPredictor)  # head folded into the weights
+    _check(pred, ttr.predict, X[:64])
+
+    ttr2 = TransformedTargetRegressor(
+        regressor=HistGradientBoostingRegressor(max_iter=8, random_state=0),
+        transformer=MinMaxScaler()).fit(X, yr)
+    pred2 = as_predictor(ttr2.predict, example_dim=X.shape[1], probe_data=X[:32])
+    assert isinstance(pred2, AffineOutputPredictor)
+    assert pred2.supports_masked_ey  # forwards the tree fast path
+    _check(pred2, ttr2.predict, X[:64])
+
+
+def test_transformed_target_nonaffine_declines(data):
+    from sklearn.compose import TransformedTargetRegressor
+    from sklearn.linear_model import LinearRegression
+
+    from distributedkernelshap_tpu.models.compose import lift_transformed_target
+
+    X, _, yr = data
+    yr_pos = np.abs(yr) + 1.0
+    ttr = TransformedTargetRegressor(
+        regressor=LinearRegression(), func=np.log,
+        inverse_func=np.exp).fit(X, yr_pos)
+    assert lift_transformed_target(ttr.predict) is None
