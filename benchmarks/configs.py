@@ -281,6 +281,20 @@ def config_model_zoo(smoke=False):
                          ("gb", HistGradientBoostingClassifier(
                              max_iter=10 if smoke else 50, random_state=0))])
                .fit(Xtr, ytr).predict_proba, PipelinePredictor)
+        from sklearn.ensemble import AdaBoostClassifier
+        from sklearn.linear_model import LogisticRegression
+        from sklearn.model_selection import GridSearchCV
+
+        from distributedkernelshap_tpu.models.compose import AdaBoostPredictor
+
+        yield ("adaboost",
+               AdaBoostClassifier(n_estimators=10 if smoke else 50,
+                                  random_state=0)
+               .fit(Xtr, ytr).predict_proba, AdaBoostPredictor)
+        yield ("grid_search_lr",
+               GridSearchCV(LogisticRegression(max_iter=500),
+                            {"C": [0.5, 1.0]}, cv=3)
+               .fit(Xtr, ytr).predict_proba, LinearPredictor)
 
     from distributedkernelshap_tpu.models.torch_lift import is_torch_module, torch_callback
 
