@@ -74,8 +74,8 @@ def main():
 
     from benchmarks.configs import CONFIGS
 
-    for name in ("adult", "adult_stress", "adult_trees", "model_zoo",
-                 "mnist", "covertype", "adult_blackbox"):
+    for name in ("adult", "adult_stress", "adult_trees", "adult_trees_exact",
+                 "model_zoo", "mnist", "covertype", "adult_blackbox"):
         if name in skip:
             continue
         _step(f"config:{name}", lambda n=name: CONFIGS[n](smoke=False))

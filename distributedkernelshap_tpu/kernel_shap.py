@@ -405,6 +405,16 @@ class KernelExplainerEngine:
             return 1 << math.ceil(math.log2(n))
         return 512 * math.ceil(n / 512)
 
+    def _pad_to_bucket(self, X: np.ndarray):
+        """``(X_padded, B)``: pad ``X`` up to its compile bucket by tiling
+        the last row (results are sliced back to ``B`` by the caller).
+        Shared by every device entry point so all paths bucket identically."""
+
+        B = X.shape[0]
+        pad = (self._bucket(B) - B) if self.config.bucket_batches else 0
+        Xp = np.concatenate([X, np.tile(X[-1:], (pad, 1))], 0) if pad else X
+        return Xp, B
+
     def _solve_fn(self):
         if 'solve' not in self._fn_cache:
             from distributedkernelshap_tpu.ops.explain import _wls_solve
@@ -492,11 +502,9 @@ class KernelExplainerEngine:
         predictors."""
 
         plan = self._plan(nsamples)
-        B = X.shape[0]
-        # same power-of-two padding as the device path: bounds solve
-        # recompiles across varying (coalesced-request) batch sizes
-        pad = (self._bucket(B) - B) if self.config.bucket_batches else 0
-        Xp = np.concatenate([X, np.tile(X[-1:], (pad, 1))], 0) if pad else X
+        # same bucket padding as the device path: bounds solve recompiles
+        # across varying (coalesced-request) batch sizes
+        Xp, B = self._pad_to_bucket(X)
         with profiler().phase('host_eval'):
             ey_adj, fx, e_val = self._hosteval_stats(Xp, plan, silent=silent)
         fx_minus_e = fx - e_val[None, :]
@@ -542,9 +550,7 @@ class KernelExplainerEngine:
         payload size, and concurrent copies overlap — the serving pipeline
         exploits both."""
 
-        B = X.shape[0]
-        pad = (self._bucket(B) - B) if self.config.bucket_batches else 0
-        Xp = np.concatenate([X, np.tile(X[-1:], (pad, 1))], 0) if pad else X
+        Xp, B = self._pad_to_bucket(X)
         out = self._fn()(jnp.asarray(Xp, jnp.float32), *self._device_args(plan))
         # one packed D2H instead of three; the copy itself blocks on the
         # value, so an explicit block_until_ready would add a second full
@@ -583,7 +589,8 @@ class KernelExplainerEngine:
         X = np.atleast_2d(np.asarray(X, dtype=np.float32))
         needs_chunking = (self.config.instance_chunk
                           and X.shape[0] > self.config.instance_chunk)
-        if self.config.host_eval or needs_chunking or self._l1_active(l1_reg, nsamples):
+        if (self.config.host_eval or needs_chunking or nsamples == 'exact'
+                or self._l1_active(l1_reg, nsamples)):
             # these paths don't gain from pipelining (host-eval is
             # host-bound; the l1 path re-dispatches device work and runs
             # sklearn lars; over-chunk batches must honour instance_chunk's
@@ -591,6 +598,8 @@ class KernelExplainerEngine:
             # synchronously on the dispatcher thread and close over the
             # results, keeping finalizer threads away from non-thread-safe
             # state
+            # (nsamples='exact' also lands here: its jitted fn is built
+            # lazily on the dispatcher thread like every other cache)
             values = self.get_explanation(X, nsamples=nsamples,
                                           l1_reg=l1_reg, silent=True)
             info = {
@@ -655,6 +664,15 @@ class KernelExplainerEngine:
             c = self.config.instance_chunk
             chunks = [X[i:i + c] for i in range(0, X.shape[0], c)]
 
+        if nsamples == 'exact':
+            # sampling-free interventional TreeSHAP (ops/treeshap.py): no
+            # coalition plan, no WLS — the Shapley values of the lifted
+            # ensemble's raw margin in closed form
+            values = self._exact_tree_explanation(chunks, X, l1_reg)
+            if batch_idx is not None:
+                return batch_idx, values
+            return values
+
         if len(chunks) > 1 and not self.config.host_eval:
             # dispatch ahead of the fetches so the per-chunk D2H round trips
             # (~70ms each through a tunnelled TPU) overlap across threads —
@@ -688,6 +706,68 @@ class KernelExplainerEngine:
         return values
 
     # ------------------------------------------------------------------ #
+
+    def _exact_tree_explanation(self, chunks, X, l1_reg):
+        """``nsamples='exact'``: closed-form interventional Shapley values
+        for a lifted tree ensemble (``ops/treeshap.exact_tree_shap``)."""
+
+        from distributedkernelshap_tpu.ops.treeshap import supports_exact
+
+        if not supports_exact(self.predictor):
+            raise ValueError(
+                "nsamples='exact' requires a device-lifted tree ensemble "
+                "with raw-margin outputs (out_transform='identity') and "
+                "path tensors; this predictor is "
+                f"{type(self.predictor).__name__}. Use a sampled nsamples "
+                "instead.")
+        if self.config.link != 'identity':
+            raise ValueError(
+                "nsamples='exact' explains the ensemble's raw margin; "
+                f"link={self.config.link!r} would change the target "
+                "quantity. Use link='identity'.")
+        if l1_reg not in (None, False, 0, 'auto'):
+            logger.warning(
+                "l1_reg=%r is ignored with nsamples='exact': there is no "
+                "sampling noise to regularise away.", l1_reg)
+
+        if 'exact' not in self._fn_cache:
+            from distributedkernelshap_tpu.ops.treeshap import (
+                background_reach,
+                exact_shap_from_reach,
+            )
+
+            pred = self.predictor
+            precision = self.config.shap.matmul_precision
+            # background reach tensors: computed once per fit, shared by
+            # every instance chunk (the background pass is N x T x L work
+            # that would otherwise repeat B/chunk times)
+            with jax.default_matmul_precision(precision):
+                reach = jax.jit(lambda bg, G: background_reach(pred, bg, G))(
+                    jnp.asarray(self.background), jnp.asarray(self.G))
+
+            def fn(Xc, bgw, G, reach=reach):
+                with jax.default_matmul_precision(precision):
+                    phi = exact_shap_from_reach(pred, Xc, reach, bgw, G)
+                    return {'shap_values': phi,
+                            'raw_prediction': pred(Xc)}
+
+            self._fn_cache['exact'] = jax.jit(fn)
+
+        results = []
+        for c in chunks:
+            Xp, B = self._pad_to_bucket(c)
+            out = self._fn_cache['exact'](
+                jnp.asarray(Xp, jnp.float32),
+                jnp.asarray(self.bg_weights), jnp.asarray(self.G))
+            results.append({
+                'shap_values': np.asarray(out['shap_values'])[:B],
+                'raw_prediction': np.asarray(out['raw_prediction'])[:B],
+            })
+        phi = np.concatenate([r['shap_values'] for r in results], 0)
+        self.last_raw_prediction = np.concatenate(
+            [r['raw_prediction'] for r in results], 0)
+        self.last_X_fingerprint = _fingerprint(X)
+        return split_shap_values(phi, self.vector_out)
 
     def _apply_l1_reg(self, phi, X, l1_reg, nsamples, silent: bool = True):
         """Optional host-side feature selection (reference surfaces shap's

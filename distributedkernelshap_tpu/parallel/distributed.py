@@ -291,6 +291,16 @@ class DistributedExplainer:
         kwargs.pop('silent', None)
         l1_reg = kwargs.pop('l1_reg', 'auto')
 
+        if nsamples == 'exact':
+            # closed-form interventional TreeSHAP (ops/treeshap.py) runs as
+            # one jitted program on the engine; instance-axis sharding of
+            # the exact path is not yet wired, so it executes single-program
+            values = self.engine.get_explanation(X, nsamples='exact',
+                                                 l1_reg=l1_reg)
+            self.last_raw_prediction = self.engine.last_raw_prediction
+            self.last_X_fingerprint = self.engine.last_X_fingerprint
+            return values
+
         X = np.atleast_2d(np.asarray(X, dtype=np.float32))
         B = X.shape[0]
         slab = int(self.batch_size) * self.n_data if self.batch_size else 0

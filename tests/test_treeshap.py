@@ -1,0 +1,180 @@
+"""Exact interventional TreeSHAP (ops/treeshap.py).
+
+Oracles: (a) brute-force Shapley values over all 2^M coalitions with
+composite rows — the definition itself; (b) this package's own KernelSHAP
+with exhaustive enumeration (``nsamples >= 2^M - 2`` makes the WLS solve
+exact for the same background distribution).  The closed form must match
+both to float tolerance, with and without column grouping.
+"""
+
+import itertools
+from math import factorial
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu.kernel_shap import KernelExplainerEngine
+from distributedkernelshap_tpu.models import as_predictor
+from distributedkernelshap_tpu.models.trees import TreeEnsemblePredictor
+from distributedkernelshap_tpu.ops import groups_to_matrix
+from distributedkernelshap_tpu.ops.treeshap import exact_tree_shap, supports_exact
+
+
+@pytest.fixture(scope="module")
+def gbt_setup():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(300, 6))
+    y = (2.0 * X[:, 0] + np.where(X[:, 1] > 0, 1.5, -0.5) * X[:, 2]
+         + 0.1 * rng.normal(size=300))
+    from sklearn.ensemble import GradientBoostingRegressor
+
+    gbt = GradientBoostingRegressor(n_estimators=8, max_depth=3,
+                                    random_state=0).fit(X, y)
+    pred = as_predictor(gbt.predict, example_dim=6,
+                        probe_data=X[:16].astype(np.float32))
+    assert isinstance(pred, TreeEnsemblePredictor)
+    assert supports_exact(pred)
+    return dict(pred=pred, X=X.astype(np.float32), gbt=gbt)
+
+
+def _brute_force_phi(pred, x, bg, groups):
+    """Shapley values by full enumeration over group coalitions."""
+
+    M = len(groups)
+
+    def f(S):
+        rows = bg.copy()
+        cols = [c for g in S for c in groups[g]]
+        rows[:, cols] = x[cols]
+        return float(np.asarray(pred(rows.astype(np.float32)))[:, 0].mean())
+
+    phi = np.zeros(M)
+    for j in range(M):
+        rest = [m for m in range(M) if m != j]
+        for r in range(M):
+            for S in itertools.combinations(rest, r):
+                w = factorial(r) * factorial(M - r - 1) / factorial(M)
+                phi[j] += w * (f(set(S) | {j}) - f(set(S)))
+    return phi
+
+
+def test_exact_matches_brute_force_ungrouped(gbt_setup):
+    s = gbt_setup
+    bg = s["X"][:10]
+    Xe = s["X"][50:53]
+    G = groups_to_matrix(None, 6)
+    out = exact_tree_shap(s["pred"], Xe, bg, np.ones(10, np.float32), G)
+    phi = np.asarray(out["shap_values"])
+    groups = [[c] for c in range(6)]
+    for b in range(Xe.shape[0]):
+        want = _brute_force_phi(s["pred"], Xe[b], bg, groups)
+        np.testing.assert_allclose(phi[b, 0], want, atol=1e-5)
+    total = phi.sum(-1) + np.asarray(out["expected_value"])[None, :]
+    np.testing.assert_allclose(total, np.asarray(out["raw_prediction"]),
+                               atol=1e-5)
+
+
+def test_exact_matches_brute_force_grouped(gbt_setup):
+    s = gbt_setup
+    bg = s["X"][:8]
+    Xe = s["X"][60:62]
+    groups = [[0, 1], [2, 3], [4, 5]]
+    G = groups_to_matrix(groups, 6)
+    out = exact_tree_shap(s["pred"], Xe, bg, np.ones(8, np.float32), G)
+    phi = np.asarray(out["shap_values"])
+    for b in range(Xe.shape[0]):
+        want = _brute_force_phi(s["pred"], Xe[b], bg, groups)
+        np.testing.assert_allclose(phi[b, 0], want, atol=1e-5)
+
+
+def test_exact_matches_exhaustive_kernel_shap(gbt_setup):
+    """With nsamples >= 2^M - 2 the sampled pipeline enumerates every
+    coalition and its WLS solve is exact — the two algorithms must agree."""
+
+    s = gbt_setup
+    engine = KernelExplainerEngine(s["pred"], s["X"][:10], link="identity",
+                                   seed=0)
+    Xe = s["X"][50:58]
+    sv_kernel = engine.get_explanation(Xe, nsamples=100, l1_reg=False)
+    sv_exact = engine.get_explanation(Xe, nsamples="exact")
+    np.testing.assert_allclose(np.asarray(sv_exact), np.asarray(sv_kernel),
+                               atol=5e-4)
+
+
+def test_exact_with_background_weights(gbt_setup):
+    """Weighted backgrounds: exact phi must equal brute force computed on a
+    weight-expanded background."""
+
+    s = gbt_setup
+    bg = s["X"][:6]
+    w = np.array([3.0, 1.0, 2.0, 1.0, 1.0, 1.0], np.float32)
+    Xe = s["X"][70:71]
+    G = groups_to_matrix(None, 6)
+    out = exact_tree_shap(s["pred"], Xe, bg, w, G)
+    # expand: row i repeated w_i times == weighting by w_i
+    bg_exp = np.repeat(bg, w.astype(int), axis=0)
+    want = _brute_force_phi(s["pred"], Xe[0], bg_exp, [[c] for c in range(6)])
+    np.testing.assert_allclose(np.asarray(out["shap_values"])[0, 0], want,
+                               atol=1e-5)
+
+
+def test_exact_via_public_api(gbt_setup):
+    from distributedkernelshap_tpu import KernelShap
+
+    s = gbt_setup
+    ex = KernelShap(s["gbt"].predict, seed=0)  # link defaults to identity
+    ex.fit(s["X"][:12])
+    res = ex.explain(s["X"][40:48], silent=True, nsamples="exact")
+    sv = np.asarray(res.shap_values)
+    want = s["gbt"].predict(s["X"][40:48].astype(np.float64))
+    total = sv.sum(-1).ravel() + np.ravel(res.expected_value)[0]
+    np.testing.assert_allclose(total, want, atol=1e-4)
+
+
+def test_exact_requires_tree_and_identity_link(gbt_setup):
+    from distributedkernelshap_tpu.models import LinearPredictor
+
+    s = gbt_setup
+    lin = LinearPredictor(np.ones((6, 1), np.float32),
+                          np.zeros(1, np.float32))
+    engine = KernelExplainerEngine(lin, s["X"][:10], link="identity", seed=0)
+    with pytest.raises(ValueError, match="tree ensemble"):
+        engine.get_explanation(s["X"][:2], nsamples="exact")
+
+    engine2 = KernelExplainerEngine(s["pred"], s["X"][:10], link="logit",
+                                    seed=0)
+    with pytest.raises(ValueError, match="raw margin"):
+        engine2.get_explanation(s["X"][:2], nsamples="exact")
+
+
+def test_exact_ungrouped_columns_match_sampled_semantics(gbt_setup):
+    """Columns in no group stay at their background values in every
+    coalition (the sampled ops-layer convention: ``zc = mask @ G`` leaves
+    them 0) — a background row that fails a split on an ungrouped column
+    must kill that leaf.  The public fit path cannot produce a partial
+    grouping (``DenseData`` requires a partition), so this pins the
+    ops-level contract directly: exact must equal brute force where
+    ungrouped columns are never taken from ``x``."""
+
+    s = gbt_setup
+    groups = [[0], [1], [2], [3]]  # columns 4, 5 ungrouped
+    G = groups_to_matrix(groups, 6)
+    bg = s["X"][:8]
+    Xe = s["X"][50:52]
+    out = exact_tree_shap(s["pred"], Xe, bg, np.ones(8, np.float32), G)
+    phi = np.asarray(out["shap_values"])
+    for b in range(Xe.shape[0]):
+        want = _brute_force_phi(s["pred"], Xe[b], bg, groups)
+        np.testing.assert_allclose(phi[b, 0], want, atol=1e-5)
+
+
+def test_exact_background_chunking_invariance(gbt_setup):
+    s = gbt_setup
+    bg = s["X"][:20]
+    Xe = s["X"][80:84]
+    G = groups_to_matrix(None, 6)
+    w = np.ones(20, np.float32)
+    full = exact_tree_shap(s["pred"], Xe, bg, w, G, bg_chunk=None)
+    small = exact_tree_shap(s["pred"], Xe, bg, w, G, bg_chunk=3)
+    np.testing.assert_allclose(np.asarray(full["shap_values"]),
+                               np.asarray(small["shap_values"]), atol=1e-5)
