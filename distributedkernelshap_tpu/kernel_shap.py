@@ -711,20 +711,9 @@ class KernelExplainerEngine:
         """``nsamples='exact'``: closed-form interventional Shapley values
         for a lifted tree ensemble (``ops/treeshap.exact_tree_shap``)."""
 
-        from distributedkernelshap_tpu.ops.treeshap import supports_exact
+        from distributedkernelshap_tpu.ops.treeshap import validate_exact
 
-        if not supports_exact(self.predictor):
-            raise ValueError(
-                "nsamples='exact' requires a device-lifted tree ensemble "
-                "with raw-margin outputs (out_transform='identity') and "
-                "path tensors; this predictor is "
-                f"{type(self.predictor).__name__}. Use a sampled nsamples "
-                "instead.")
-        if self.config.link != 'identity':
-            raise ValueError(
-                "nsamples='exact' explains the ensemble's raw margin; "
-                f"link={self.config.link!r} would change the target "
-                "quantity. Use link='identity'.")
+        validate_exact(self.predictor, self.config.link)
         if l1_reg not in (None, False, 0, 'auto'):
             logger.warning(
                 "l1_reg=%r is ignored with nsamples='exact': there is no "

@@ -55,6 +55,23 @@ def supports_exact(pred) -> bool:
             and getattr(pred, "path_sign", None) is not None)
 
 
+def validate_exact(pred, link: str) -> None:
+    """Raise with an actionable message when ``nsamples='exact'`` cannot
+    apply (shared by the engine and the distributed explainer)."""
+
+    if not supports_exact(pred):
+        raise ValueError(
+            "nsamples='exact' requires a device-lifted tree ensemble "
+            "with raw-margin outputs (out_transform='identity') and "
+            f"path tensors; this predictor is {type(pred).__name__}. "
+            "Use a sampled nsamples instead.")
+    if link != "identity":
+        raise ValueError(
+            "nsamples='exact' explains the ensemble's raw margin; "
+            f"link={link!r} would change the target quantity. "
+            "Use link='identity'.")
+
+
 def _beta_tables(dmax: int):
     """``W_plus[u, v] = (u-1)! v! / (u+v)!`` (0 for u=0) and
     ``W_minus[u, v] = u! (v-1)! / (u+v)!`` (0 for v=0), for u, v <= dmax.

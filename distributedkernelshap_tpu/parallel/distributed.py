@@ -224,18 +224,18 @@ class DistributedExplainer:
                 engine.G))
         return self._dev_cache[key]
 
-    def _dispatch_sharded(self, X: np.ndarray, nsamples):
-        """Launch one sharded device call over the global batch ``X``
-        WITHOUT blocking (JAX dispatch is asynchronous); returns
+    def _dispatch_call(self, fn, X: np.ndarray, args):
+        """Bucket-pad ``X`` to a whole number of device rows, launch ``fn``
+        WITHOUT blocking (JAX dispatch is asynchronous) and return
         ``(packed_device_array, B, padded_B)`` for :meth:`_fetch_sharded`.
 
         Splitting dispatch from fetch lets a multi-slab explain enqueue
         slab k+1's compute while slab k's D2H round trip (~70 ms through a
         tunnelled TPU, regardless of payload) is in flight — the same
-        overlap the serving pipeline exploits."""
+        overlap the serving pipeline exploits.  Shared by the sampled and
+        exact paths so their padding/packing can never diverge."""
 
         engine = self.engine
-        plan = engine._plan(nsamples)
         B = X.shape[0]
         # bucket to a power of two, then to a whole number of device rows —
         # bounds jit retraces across varying request sizes (same rationale as
@@ -245,12 +245,16 @@ class DistributedExplainer:
         if padded != B:
             filler = np.tile(X[-1:], (padded - B, 1))
             X = np.concatenate([X, filler], 0)
-        out = self._sharded_fn()(jnp.asarray(X, jnp.float32),
-                                 *self._device_args(plan))
+        out = fn(jnp.asarray(X, jnp.float32), *args)
         # one packed D2H instead of two (tunnelled transfers are latency-bound)
         packed_dev = jnp.concatenate(
             [out['shap_values'].ravel(), out['raw_prediction'].ravel()])
         return packed_dev, B, X.shape[0]
+
+    def _dispatch_sharded(self, X: np.ndarray, nsamples):
+        plan = self.engine._plan(nsamples)
+        return self._dispatch_call(self._sharded_fn(), X,
+                                   self._device_args(plan))
 
     def _fetch_sharded(self, dispatched) -> Tuple[np.ndarray, np.ndarray]:
         """Block on one dispatched call; returns ``(shap_values, link-space
@@ -278,6 +282,96 @@ class DistributedExplainer:
 
         return self._fetch_sharded(self._dispatch_sharded(X, nsamples))
 
+    def _exact_sharded_fn(self):
+        """Closed-form interventional TreeSHAP (``ops/treeshap.py``) with
+        the instance axis sharded over the mesh's ``data`` axis: the per-
+        instance computation has no cross-instance interaction, so sharding
+        is a ``shard_map`` over local blocks with replicated background
+        reach tensors (computed once per fit).  The ``coalition`` axis has
+        no role here — every coalition rank computes the same replicate."""
+
+        if 'exact' not in self._jit_cache:
+            from distributedkernelshap_tpu.ops.treeshap import (
+                background_reach,
+                exact_shap_from_reach,
+            )
+
+            engine = self.engine
+            pred = engine.predictor
+            precision = engine.config.shap.matmul_precision
+            with jax.default_matmul_precision(precision):
+                reach = jax.jit(lambda bg, G: background_reach(pred, bg, G))(
+                    jnp.asarray(engine.background), jnp.asarray(engine.G))
+
+            def body(Xl, bgw, G, z_ok, z_ung, onpath_g):
+                r = {'z_ok': z_ok, 'z_ung_dead': z_ung, 'onpath_g': onpath_g}
+                with jax.default_matmul_precision(precision):
+                    return {
+                        'shap_values': exact_shap_from_reach(pred, Xl, r, bgw, G),
+                        'raw_prediction': pred(Xl),
+                    }
+
+            sharded = jax.shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=(P(DATA_AXIS), P(), P(), P(), P(), P()),
+                out_specs={'shap_values': P(DATA_AXIS),
+                           'raw_prediction': P(DATA_AXIS)},
+                check_vma=False,
+            )
+            args = (jnp.asarray(engine.bg_weights), jnp.asarray(engine.G),
+                    reach['z_ok'], reach['z_ung_dead'], reach['onpath_g'])
+            shard = NamedSharding(self.mesh, P(DATA_AXIS))
+            repl = NamedSharding(self.mesh, P())
+            jitted = jax.jit(
+                sharded,
+                in_shardings=(shard,) + (repl,) * 5,
+                out_shardings={'shap_values': shard, 'raw_prediction': shard})
+            self._jit_cache['exact'] = (jitted, args)
+        return self._jit_cache['exact']
+
+    def _explain_exact_sharded(self, X: np.ndarray, l1_reg) -> Any:
+        from distributedkernelshap_tpu.ops.treeshap import validate_exact
+
+        engine = self.engine
+        validate_exact(engine.predictor, engine.config.link)
+        if l1_reg not in (None, False, 0, 'auto'):
+            logger.warning("l1_reg=%r is ignored with nsamples='exact'.", l1_reg)
+
+        X = np.atleast_2d(np.asarray(X, dtype=np.float32))
+        B = X.shape[0]
+        # same slab batching as the sampled path: batch_size bounds the per-
+        # device rows per call, so exact-mode memory does not scale with B
+        slab = int(self.batch_size) * self.n_data if self.batch_size else 0
+        if slab and B > slab:
+            padded, _ = pad_to_multiple(B, slab)
+            if padded != B:
+                X = np.concatenate([X, np.tile(X[-1:], (padded - B, 1))], 0)
+            slabs = make_batches(X, batch_size=slab)
+        else:
+            slabs = [X]
+
+        fn, args = self._exact_sharded_fn()
+        from collections import deque
+
+        window = 3
+        pending: deque = deque()
+        results = []
+        for s in slabs:
+            pending.append(self._dispatch_call(fn, s, args))
+            if len(pending) >= window:
+                results.append(self._fetch_sharded(pending.popleft()))
+        while pending:
+            results.append(self._fetch_sharded(pending.popleft()))
+
+        phi = np.concatenate([r[0] for r in results], 0)[:B]
+        self.last_raw_prediction = np.concatenate(
+            [r[1] for r in results], 0)[:B]
+        from distributedkernelshap_tpu.kernel_shap import _fingerprint
+
+        self.last_X_fingerprint = _fingerprint(X[:B])
+        return split_shap_values(phi, engine.vector_out)
+
     def get_explanation(self, X: np.ndarray, **kwargs) -> Any:
         """Explain ``X``, sharded over the mesh.
 
@@ -292,14 +386,7 @@ class DistributedExplainer:
         l1_reg = kwargs.pop('l1_reg', 'auto')
 
         if nsamples == 'exact':
-            # closed-form interventional TreeSHAP (ops/treeshap.py) runs as
-            # one jitted program on the engine; instance-axis sharding of
-            # the exact path is not yet wired, so it executes single-program
-            values = self.engine.get_explanation(X, nsamples='exact',
-                                                 l1_reg=l1_reg)
-            self.last_raw_prediction = self.engine.last_raw_prediction
-            self.last_X_fingerprint = self.engine.last_X_fingerprint
-            return values
+            return self._explain_exact_sharded(X, l1_reg)
 
         X = np.atleast_2d(np.asarray(X, dtype=np.float32))
         B = X.shape[0]
