@@ -226,3 +226,26 @@ def test_exact_sharded_slab_batching(gbt_setup):
     seq = KernelExplainerEngine(s["pred"], s["X"][:10], link="identity", seed=0)
     want = seq.get_explanation(Xe, nsamples="exact")
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_exact_classifier_margins_via_decision_function():
+    """Classifiers qualify for exact mode through decision_function: the
+    raw margin lifts with an identity head (the output shap's own
+    TreeExplainer explains), and additivity holds against sklearn."""
+
+    from sklearn.ensemble import HistGradientBoostingClassifier
+
+    from distributedkernelshap_tpu import KernelShap
+
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(300, 6))
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    clf = HistGradientBoostingClassifier(max_iter=10, random_state=0).fit(X, y)
+    ex = KernelShap(clf.decision_function, seed=0)
+    ex.fit(X[:20].astype(np.float32))
+    assert supports_exact(ex._explainer.predictor)
+    res = ex.explain(X[50:58].astype(np.float32), silent=True, nsamples="exact")
+    sv = np.asarray(res.shap_values)
+    total = sv.sum(-1).ravel() + np.ravel(res.expected_value)[0]
+    np.testing.assert_allclose(total, clf.decision_function(X[50:58]),
+                               atol=1e-4)
