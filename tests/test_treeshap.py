@@ -249,3 +249,35 @@ def test_exact_classifier_margins_via_decision_function():
     total = sv.sum(-1).ravel() + np.ravel(res.expected_value)[0]
     np.testing.assert_allclose(total, clf.decision_function(X[50:58]),
                                atol=1e-4)
+
+
+@pytest.mark.parametrize("family,depth", [("forest", 3), ("gbt", 1), ("gbt", 4)])
+def test_exact_across_families_and_depths(family, depth):
+    """Mean-aggregated forests (the aggregation='mean' branch) and boosted
+    stumps/deep trees must all match exhaustively-enumerated KernelSHAP."""
+
+    from sklearn.ensemble import GradientBoostingRegressor, RandomForestRegressor
+
+    rng = np.random.default_rng(depth + (0 if family == "gbt" else 7))
+    X = rng.normal(size=(240, 5)).astype(np.float64)
+    y = X[:, 0] - 2.0 * np.where(X[:, 2] > 0.3, X[:, 3], 0.0) \
+        + 0.1 * rng.normal(size=240)
+    if family == "forest":
+        model = RandomForestRegressor(n_estimators=6, max_depth=depth,
+                                      random_state=0).fit(X, y)
+    else:
+        model = GradientBoostingRegressor(n_estimators=6, max_depth=depth,
+                                          random_state=0).fit(X, y)
+    pred = as_predictor(model.predict, example_dim=5,
+                        probe_data=X[:16].astype(np.float32))
+    assert isinstance(pred, TreeEnsemblePredictor)
+    if family == "forest":
+        assert pred.aggregation == "mean"
+
+    engine = KernelExplainerEngine(pred, X[:9].astype(np.float32),
+                                   link="identity", seed=0)
+    Xe = X[100:106].astype(np.float32)
+    sv_kernel = engine.get_explanation(Xe, nsamples=64, l1_reg=False)  # 2^5-2=30: exhaustive
+    sv_exact = engine.get_explanation(Xe, nsamples="exact")
+    np.testing.assert_allclose(np.asarray(sv_exact), np.asarray(sv_kernel),
+                               atol=5e-4)
