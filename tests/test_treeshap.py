@@ -281,3 +281,31 @@ def test_exact_across_families_and_depths(family, depth):
     sv_exact = engine.get_explanation(Xe, nsamples="exact")
     np.testing.assert_allclose(np.asarray(sv_exact), np.asarray(sv_kernel),
                                atol=5e-4)
+
+
+def test_exact_xgboost_regression_dump():
+    """An XGBoost regression booster (identity objective) lifted from its
+    model JSON qualifies for exact mode; exact equals exhaustively-
+    enumerated KernelSHAP on the same lifted predictor."""
+
+    from distributedkernelshap_tpu.models import predictor_from_xgboost_json
+    from test_xgb_lift import _model, _tree
+
+    t0 = _tree([0, 1, 2, 0, 0, 0, 0],
+               [0.5, -1.0, 2.0, 0.3, -0.7, 1.1, -0.2],
+               [1, 3, 5, -1, -1, -1, -1],
+               [2, 4, 6, -1, -1, -1, -1],
+               [1, 0, 1, 0, 0, 0, 0])
+    t1 = _tree([2, 0, 0], [1.5, 0.25, -0.4], [1, -1, -1], [2, -1, -1],
+               [0, 0, 0])
+    pred = predictor_from_xgboost_json(_model([t0, t1], "reg:squarederror", 0.7))
+    assert pred is not None and supports_exact(pred)
+
+    rng = np.random.default_rng(6)
+    X = rng.normal(size=(60, 3)).astype(np.float32)
+    engine = KernelExplainerEngine(pred, X[:10], link="identity", seed=0)
+    Xe = X[20:26]
+    sv_kernel = engine.get_explanation(Xe, nsamples=16, l1_reg=False)  # 2^3-2=6
+    sv_exact = engine.get_explanation(Xe, nsamples="exact")
+    np.testing.assert_allclose(np.asarray(sv_exact), np.asarray(sv_kernel),
+                               atol=1e-5)
