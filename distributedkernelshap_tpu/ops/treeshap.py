@@ -122,8 +122,29 @@ def background_reach(pred: TreeEnsemblePredictor, bg, G):
     return {"z_ok": z_ok, "z_ung_dead": z_ung_dead, "onpath_g": onpath_g}
 
 
+def pad_background(z_ok, z_ung_dead, bgw, multiple: int):
+    """Pad the background axis of the reach tensors to a whole number of
+    ``multiple``-row blocks with ZERO-WEIGHT rows: ``z_ok`` pads with ones
+    (the row looks alive — a zero would interact with the dead-group count)
+    and the weight of 0 makes its phi contribution exactly 0.  Shared by
+    the chunking and the coalition-axis sharding so the invariant lives in
+    one place."""
+
+    N = z_ok.shape[0]
+    pad = (-N) % multiple
+    if not pad:
+        return z_ok, z_ung_dead, bgw
+    z_ok_p = jnp.concatenate(
+        [z_ok, jnp.ones((pad,) + z_ok.shape[1:], z_ok.dtype)], 0)
+    z_ung_p = jnp.concatenate(
+        [z_ung_dead, jnp.zeros((pad,) + z_ung_dead.shape[1:], bool)], 0)
+    bgw_p = jnp.concatenate([bgw, jnp.zeros((pad,), bgw.dtype)], 0)
+    return z_ok_p, z_ung_p, bgw_p
+
+
 def exact_shap_from_reach(pred: TreeEnsemblePredictor, X, reach, bgw, G,
-                          bg_chunk: Optional[int] = 16):
+                          bg_chunk: Optional[int] = 16,
+                          normalized: bool = False):
     """Exact phi ``(B, K, M)`` for ``X`` given precomputed background reach
     tensors (:func:`background_reach`).
 
@@ -131,11 +152,16 @@ def exact_shap_from_reach(pred: TreeEnsemblePredictor, X, reach, bgw, G,
     is processed in ``bg_chunk``-row chunks via ``lax.map`` with partial
     phi sums, so peak memory is ``B x bg_chunk x T x L`` rather than the
     full ``B x N`` block.
-    """
+
+    ``normalized=True`` skips the internal weight normalisation — for
+    callers that shard the background axis across devices and psum the
+    partial phi (normalising a local weight shard by its local sum would
+    be wrong; they normalise globally first)."""
 
     X = jnp.asarray(X, jnp.float32)
     bgw = jnp.asarray(bgw, jnp.float32)
-    bgw = bgw / jnp.sum(bgw)
+    if not normalized:
+        bgw = bgw / jnp.sum(bgw)
     G = jnp.asarray(G, jnp.float32)
 
     sign = pred.path_sign                       # (T, L, Nn): +1 left / -1 right
@@ -158,15 +184,7 @@ def exact_shap_from_reach(pred: TreeEnsemblePredictor, X, reach, bgw, G,
 
     N = z_ok.shape[0]
     chunk = max(1, min(int(bg_chunk or N), N))
-    pad = (-N) % chunk
-    if pad:
-        z_ok_p = jnp.concatenate(
-            [z_ok, jnp.ones((pad,) + z_ok.shape[1:], z_ok.dtype)], 0)
-        z_ung_p = jnp.concatenate(
-            [z_ung_dead, jnp.zeros((pad,) + z_ung_dead.shape[1:], bool)], 0)
-        bgw_p = jnp.concatenate([bgw, jnp.zeros((pad,), bgw.dtype)], 0)
-    else:
-        z_ok_p, z_ung_p, bgw_p = z_ok, z_ung_dead, bgw
+    z_ok_p, z_ung_p, bgw_p = pad_background(z_ok, z_ung_dead, bgw, chunk)
     z_chunks = z_ok_p.reshape(-1, chunk, *z_ok.shape[1:])
     zu_chunks = z_ung_p.reshape(-1, chunk, *z_ung_dead.shape[1:])
     w_chunks = bgw_p.reshape(-1, chunk)

@@ -283,12 +283,13 @@ class DistributedExplainer:
         return self._fetch_sharded(self._dispatch_sharded(X, nsamples))
 
     def _exact_sharded_fn(self):
-        """Closed-form interventional TreeSHAP (``ops/treeshap.py``) with
-        the instance axis sharded over the mesh's ``data`` axis: the per-
-        instance computation has no cross-instance interaction, so sharding
-        is a ``shard_map`` over local blocks with replicated background
-        reach tensors (computed once per fit).  The ``coalition`` axis has
-        no role here — every coalition rank computes the same replicate."""
+        """Closed-form interventional TreeSHAP (``ops/treeshap.py``) over
+        the full 2-D mesh: the instance axis shards over ``data`` (no
+        cross-instance interaction), and the background axis shards over
+        ``coalition`` — each rank computes partial phi over its background
+        slice (globally-normalised weights) and one ``psum`` over ICI
+        combines them exactly, the same decomposition the sampled path
+        uses for its normal equations."""
 
         if 'exact' not in self._jit_cache:
             from distributedkernelshap_tpu.ops.treeshap import (
@@ -299,33 +300,56 @@ class DistributedExplainer:
             engine = self.engine
             pred = engine.predictor
             precision = engine.config.shap.matmul_precision
+            n_coal = self.mesh.shape[COALITION_AXIS]
             with jax.default_matmul_precision(precision):
                 reach = jax.jit(lambda bg, G: background_reach(pred, bg, G))(
                     jnp.asarray(engine.background), jnp.asarray(engine.G))
 
-            def body(Xl, bgw, G, z_ok, z_ung, onpath_g):
-                r = {'z_ok': z_ok, 'z_ung_dead': z_ung, 'onpath_g': onpath_g}
+            # globally-normalised weights; pad the background axis to a
+            # whole number of coalition shards with zero-weight rows (their
+            # phi contribution is exactly 0 — shared helper with the
+            # chunking path so the padding invariant lives in one place)
+            from distributedkernelshap_tpu.ops.treeshap import pad_background
+
+            bgw = np.asarray(engine.bg_weights, np.float64)
+            bgw = jnp.asarray((bgw / bgw.sum()).astype(np.float32))
+            z_ok, z_ung, bgw = pad_background(
+                reach['z_ok'], reach['z_ung_dead'], bgw, n_coal)
+
+            def body(Xl, bgw_l, G, z_ok_l, z_ung_l, onpath_g):
+                r = {'z_ok': z_ok_l, 'z_ung_dead': z_ung_l,
+                     'onpath_g': onpath_g}
                 with jax.default_matmul_precision(precision):
+                    phi_local = exact_shap_from_reach(pred, Xl, r, bgw_l, G,
+                                                      normalized=True)
                     return {
-                        'shap_values': exact_shap_from_reach(pred, Xl, r, bgw, G),
+                        'shap_values': jax.lax.psum(phi_local, COALITION_AXIS),
                         'raw_prediction': pred(Xl),
                     }
 
             sharded = jax.shard_map(
                 body,
                 mesh=self.mesh,
-                in_specs=(P(DATA_AXIS), P(), P(), P(), P(), P()),
+                in_specs=(P(DATA_AXIS), P(COALITION_AXIS), P(),
+                          P(COALITION_AXIS), P(COALITION_AXIS), P()),
                 out_specs={'shap_values': P(DATA_AXIS),
                            'raw_prediction': P(DATA_AXIS)},
                 check_vma=False,
             )
-            args = (jnp.asarray(engine.bg_weights), jnp.asarray(engine.G),
-                    reach['z_ok'], reach['z_ung_dead'], reach['onpath_g'])
             shard = NamedSharding(self.mesh, P(DATA_AXIS))
             repl = NamedSharding(self.mesh, P())
+            coal = NamedSharding(self.mesh, P(COALITION_AXIS))
+            # commit the per-fit constants to their mesh shardings ONCE so
+            # each slab's dispatch reuses them instead of re-resharding the
+            # O(N*T*L*M) reach tensors from the default device every call
+            args = (jax.device_put(jnp.asarray(bgw), coal),
+                    jax.device_put(jnp.asarray(engine.G), repl),
+                    jax.device_put(z_ok, coal),
+                    jax.device_put(z_ung, coal),
+                    jax.device_put(reach['onpath_g'], repl))
             jitted = jax.jit(
                 sharded,
-                in_shardings=(shard,) + (repl,) * 5,
+                in_shardings=(shard, coal, repl, coal, coal, repl),
                 out_shardings={'shap_values': shard, 'raw_prediction': shard})
             self._jit_cache['exact'] = (jitted, args)
         return self._jit_cache['exact']

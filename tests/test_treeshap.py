@@ -182,8 +182,9 @@ def test_exact_background_chunking_invariance(gbt_setup):
 
 def test_exact_sharded_matches_single_device(gbt_setup):
     """nsamples='exact' through the DistributedExplainer (instance axis
-    shard_mapped over the 8-device mesh, replicated background reach) must
-    equal the single-device engine."""
+    shard_mapped over the data axis; background axis sharded over the
+    coalition axis with psum'd partial phi) must equal the single-device
+    engine."""
 
     from distributedkernelshap_tpu.parallel.distributed import DistributedExplainer
 
@@ -200,14 +201,25 @@ def test_exact_sharded_matches_single_device(gbt_setup):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
     assert np.asarray(got).shape == np.asarray(want).shape
 
-    # coalition_parallel>1: the coalition axis has no role for exact mode
-    # but the call must still work (replicated compute on that axis)
+    # coalition_parallel>1: the background axis shards over the coalition
+    # axis and partial phi combine with one psum — results identical
     dist2 = DistributedExplainer(
         {"n_devices": 8, "coalition_parallel": 2, "algorithm": "kernel_shap"},
         KernelExplainerEngine, (s["pred"], s["X"][:10]),
         {"link": "identity", "seed": 0})
     got2 = dist2.get_explanation(Xe, nsamples="exact")
     np.testing.assert_allclose(np.asarray(got2), np.asarray(want), atol=1e-5)
+
+    # N=9 background NOT divisible by coalition axis 4: exercises the
+    # zero-weight background padding inside the sharded fn
+    seq9 = KernelExplainerEngine(s["pred"], s["X"][:9], link="identity", seed=0)
+    want9 = seq9.get_explanation(Xe, nsamples="exact")
+    dist3 = DistributedExplainer(
+        {"n_devices": 8, "coalition_parallel": 4, "algorithm": "kernel_shap"},
+        KernelExplainerEngine, (s["pred"], s["X"][:9]),
+        {"link": "identity", "seed": 0})
+    got3 = dist3.get_explanation(Xe, nsamples="exact")
+    np.testing.assert_allclose(np.asarray(got3), np.asarray(want9), atol=1e-5)
 
 
 def test_exact_sharded_slab_batching(gbt_setup):
