@@ -362,7 +362,8 @@ class ExplainerServer:
 def serve_explainer(predictor, background_data, constructor_kwargs, fit_kwargs,
                     host: str = "0.0.0.0", port: int = 8000,
                     max_batch_size: int = 1, batched: bool = None,
-                    pipeline_depth: Optional[int] = None) -> ExplainerServer:
+                    pipeline_depth: Optional[int] = None,
+                    explain_kwargs: Optional[dict] = None) -> ExplainerServer:
     """Build, fit and serve an explainer in one call — the analog of the
     reference's ``backend_setup`` + ``endpont_setup``
     (``serve_explanations.py:27-67``).
@@ -378,7 +379,8 @@ def serve_explainer(predictor, background_data, constructor_kwargs, fit_kwargs,
     )
 
     cls = BatchKernelShapModel if (batched or max_batch_size > 1) else KernelShapModel
-    model = cls(predictor, background_data, constructor_kwargs, fit_kwargs)
+    model = cls(predictor, background_data, constructor_kwargs, fit_kwargs,
+                explain_kwargs=explain_kwargs)
     return ExplainerServer(model, host=host, port=port,
                            max_batch_size=max_batch_size,
                            pipeline_depth=pipeline_depth).start()

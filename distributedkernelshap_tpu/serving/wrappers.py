@@ -22,6 +22,22 @@ from distributedkernelshap_tpu.kernel_shap import KernelShap
 
 logger = logging.getLogger(__name__)
 
+# explain options a deployment may pin for every request: the keys every
+# request path supports — including the pipelined get_explanation_async,
+# whose signature has no **kwargs ('silent' would additionally collide
+# with the hard-coded silent=True of the serving calls)
+_EXPLAIN_KWARG_KEYS = ("nsamples", "l1_reg")
+
+
+def _check_explain_kwargs(explain_kwargs) -> Dict[str, Any]:
+    kwargs = dict(explain_kwargs or {})
+    bad = sorted(set(kwargs) - set(_EXPLAIN_KWARG_KEYS))
+    if bad:
+        raise ValueError(
+            f"explain_kwargs supports only {_EXPLAIN_KWARG_KEYS} (the keys "
+            f"every serving request path accepts); got {bad}")
+    return kwargs
+
 
 def _request_array(request) -> np.ndarray:
     """Extract the instance array from a request: either an object with a
@@ -41,7 +57,8 @@ class KernelShapModel:
                  predictor,
                  background_data: np.ndarray,
                  constructor_kwargs: Dict[str, Any],
-                 fit_kwargs: Dict[str, Any]):
+                 fit_kwargs: Dict[str, Any],
+                 explain_kwargs: Optional[Dict[str, Any]] = None):
         if hasattr(predictor, "predict_proba"):
             predict_fcn = predictor.predict_proba
         elif hasattr(predictor, "predict"):
@@ -52,14 +69,22 @@ class KernelShapModel:
             predict_fcn = predictor  # already a callable / framework predictor
         self.explainer = KernelShap(predict_fcn, **constructor_kwargs)
         self.explainer.fit(background_data, **fit_kwargs)
+        # per-deployment explain options applied to every request, e.g.
+        # {'nsamples': 'exact'} for a served tree regressor or a fixed
+        # nsamples/l1_reg policy; validated at construction so a bad key
+        # fails the deployment, not every request
+        self.explain_kwargs = _check_explain_kwargs(explain_kwargs)
 
     @classmethod
-    def from_explainer(cls, explainer: KernelShap) -> "KernelShapModel":
+    def from_explainer(cls, explainer: KernelShap,
+                       explain_kwargs: Optional[Dict[str, Any]] = None
+                       ) -> "KernelShapModel":
         """Wrap an already-fitted explainer (e.g. one restored with
         ``KernelShap.load``) without refitting."""
 
         model = cls.__new__(cls)
         model.explainer = explainer
+        model.explain_kwargs = _check_explain_kwargs(explain_kwargs)
         return model
 
     def __call__(self, request) -> str:
@@ -67,7 +92,8 @@ class KernelShapModel:
         (the wire schema of ``interface.Explanation.to_json``)."""
 
         instance = _request_array(request)
-        explanation = self.explainer.explain(instance, silent=True)
+        explanation = self.explainer.explain(instance, silent=True,
+                                             **self.explain_kwargs)
         return explanation.to_json()
 
     def _resplit_payloads(self, instances: np.ndarray, shap_values,
@@ -97,7 +123,8 @@ class KernelShapModel:
         """Explain a stacked array in one device call and re-split the
         results into per-request JSON payloads."""
 
-        explanation = self.explainer.explain(instances, silent=True)
+        explanation = self.explainer.explain(instances, silent=True,
+                                             **self.explain_kwargs)
         if split_sizes is None:
             split_sizes = [1] * instances.shape[0]
         return self._resplit_payloads(
@@ -126,7 +153,7 @@ class KernelShapModel:
             # sharded device calls are large, so pipelining matters less
             payloads = self.explain_batch(instances, split_sizes=split_sizes)
             return lambda: payloads
-        fin = engine.get_explanation_async(instances)
+        fin = engine.get_explanation_async(instances, **self.explain_kwargs)
         sizes = ([1] * instances.shape[0] if split_sizes is None
                  else list(split_sizes))
 

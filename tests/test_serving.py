@@ -343,3 +343,45 @@ def test_http_error_paths(server):
     with pytest.raises(urllib.error.HTTPError) as e:
         urllib.request.urlopen(req)
     assert e.value.code == 400
+
+
+def test_serving_exact_tree_mode():
+    """A served tree regressor can run exact mode for every request via
+    explain_kwargs={'nsamples': 'exact'}; responses match a direct exact
+    explain."""
+
+    from sklearn.ensemble import HistGradientBoostingRegressor
+
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.serving.server import serve_explainer
+
+    rng = np.random.default_rng(8)
+    X = rng.normal(size=(200, 5)).astype(np.float64)
+    y = X[:, 0] - np.where(X[:, 2] > 0, 1.0, -1.0) * X[:, 3]
+    gbr = HistGradientBoostingRegressor(max_iter=8, random_state=0).fit(X, y)
+    bg = X[:15].astype(np.float32)
+    srv = serve_explainer(gbr.predict, bg, {"seed": 0}, {}, port=0,
+                          max_batch_size=4, pipeline_depth=2,
+                          explain_kwargs={"nsamples": "exact"})
+    try:
+        url = f"http://127.0.0.1:{srv.port}/explain"
+        Xe = X[100:106].astype(np.float32)
+        payloads = distribute_requests(url, Xe)
+        direct = KernelShap(gbr.predict, seed=0)
+        direct.fit(bg)
+        want = np.asarray(direct.explain(Xe, silent=True,
+                                         nsamples="exact").shap_values)
+        for i in range(Xe.shape[0]):
+            got = np.asarray(json.loads(payloads[i])["data"]["shap_values"])
+            np.testing.assert_allclose(got[:, 0, :], want[:, i, :]
+                                       if want.ndim == 3 else want[i][None],
+                                       atol=1e-5)
+    finally:
+        srv.stop()
+
+
+def test_explain_kwargs_validated_at_construction(model_setup):
+    s = model_setup
+    with pytest.raises(ValueError, match="explain_kwargs"):
+        KernelShapModel(s["pred"], s["bg"], s["constructor_kwargs"],
+                        s["fit_kwargs"], explain_kwargs={"silent": False})
