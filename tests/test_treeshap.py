@@ -321,3 +321,42 @@ def test_exact_xgboost_regression_dump():
     sv_exact = engine.get_explanation(Xe, nsamples="exact")
     np.testing.assert_allclose(np.asarray(sv_exact), np.asarray(sv_kernel),
                                atol=1e-5)
+
+
+def test_exact_survives_checkpoint_roundtrip(gbt_setup, tmp_path):
+    """save/load must rebuild the exact-mode caches lazily: a restored
+    explainer produces identical exact values."""
+
+    from distributedkernelshap_tpu import KernelShap
+
+    s = gbt_setup
+    ex = KernelShap(s["gbt"].predict, seed=0)
+    ex.fit(s["X"][:12])
+    want = np.asarray(ex.explain(s["X"][40:44], silent=True,
+                                 nsamples="exact").shap_values)
+    path = str(tmp_path / "ck" / "explainer.pkl")
+    ex.save(path)
+    restored = KernelShap.load(path)
+    got = np.asarray(restored.explain(s["X"][40:44], silent=True,
+                                      nsamples="exact").shap_values)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_exact_lightgbm_regression_dump():
+    from distributedkernelshap_tpu.models import predictor_from_lightgbm_dump
+    from test_lgbm_lift import _dump, _leaf, _split
+
+    r0 = _split(0, 0.5, _split(1, -1.0, _leaf(0.3), _leaf(-0.7)),
+                _split(2, 2.0, _leaf(1.1), _leaf(-0.2)))
+    r1 = _split(2, 1.5, _leaf(0.25), _leaf(-0.4))
+    pred = predictor_from_lightgbm_dump(_dump([r0, r1], "regression"))
+    assert pred is not None and supports_exact(pred)
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(60, 3)).astype(np.float32)
+    engine = KernelExplainerEngine(pred, X[:10], link="identity", seed=0)
+    Xe = X[20:26]
+    sv_kernel = engine.get_explanation(Xe, nsamples=16, l1_reg=False)
+    sv_exact = engine.get_explanation(Xe, nsamples="exact")
+    np.testing.assert_allclose(np.asarray(sv_exact), np.asarray(sv_kernel),
+                               atol=1e-5)
