@@ -730,7 +730,8 @@ class KernelExplainerEngine:
             # background reach tensors: computed once per fit, shared by
             # every instance chunk (the background pass is N x T x L work
             # that would otherwise repeat B/chunk times)
-            with jax.default_matmul_precision(precision):
+            with profiler().phase('background_reach'), \
+                    jax.default_matmul_precision(precision):
                 reach = jax.jit(lambda bg, G: background_reach(pred, bg, G))(
                     jnp.asarray(self.background), jnp.asarray(self.G))
 
@@ -743,15 +744,16 @@ class KernelExplainerEngine:
             self._fn_cache['exact'] = jax.jit(fn)
 
         results = []
-        for c in chunks:
-            Xp, B = self._pad_to_bucket(c)
-            out = self._fn_cache['exact'](
-                jnp.asarray(Xp, jnp.float32),
-                jnp.asarray(self.bg_weights), jnp.asarray(self.G))
-            results.append({
-                'shap_values': np.asarray(out['shap_values'])[:B],
-                'raw_prediction': np.asarray(out['raw_prediction'])[:B],
-            })
+        with profiler().phase('device_explain'):
+            for c in chunks:
+                Xp, B = self._pad_to_bucket(c)
+                out = self._fn_cache['exact'](
+                    jnp.asarray(Xp, jnp.float32),
+                    jnp.asarray(self.bg_weights), jnp.asarray(self.G))
+                results.append({
+                    'shap_values': np.asarray(out['shap_values'])[:B],
+                    'raw_prediction': np.asarray(out['raw_prediction'])[:B],
+                })
         phi = np.concatenate([r['shap_values'] for r in results], 0)
         self.last_raw_prediction = np.concatenate(
             [r['raw_prediction'] for r in results], 0)
