@@ -29,7 +29,12 @@ def main():
     parser.add_argument("--checkpoint", default=None, type=str,
                         help="Serve a saved explainer (KernelShap.save) "
                              "instead of fitting the default Adult one.")
+    parser.add_argument("--exact", action="store_true",
+                        help="Serve exact interventional TreeSHAP responses "
+                             "(lifted tree ensembles with raw-margin outputs "
+                             "and link='identity' only; ops/treeshap.py).")
     args = parser.parse_args()
+    explain_kwargs = {"nsamples": "exact"} if args.exact else None
 
     if args.checkpoint:
         from distributedkernelshap_tpu.kernel_shap import KernelShap
@@ -37,7 +42,8 @@ def main():
         from distributedkernelshap_tpu.serving.wrappers import BatchKernelShapModel
 
         explainer = KernelShap.load(args.checkpoint)
-        model = BatchKernelShapModel.from_explainer(explainer)
+        model = BatchKernelShapModel.from_explainer(explainer,
+                                                    explain_kwargs=explain_kwargs)
         server = ExplainerServer(model, host=args.host, port=args.port,
                                  max_batch_size=args.max_batch_size,
                                  pipeline_depth=args.pipeline_depth or None).start()
@@ -52,6 +58,7 @@ def main():
             {"group_names": group_names, "groups": groups},
             host=args.host, port=args.port, max_batch_size=args.max_batch_size,
             pipeline_depth=args.pipeline_depth or None,
+            explain_kwargs=explain_kwargs,
         )
 
     stop = threading.Event()
