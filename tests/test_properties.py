@@ -261,3 +261,45 @@ def test_masked_ey_equivalence_random_shapes(data_st):
     ey_fast = np.asarray(pred.masked_ey(Xe, bg, bgw, mask, G))
     scale = max(1.0, np.abs(ey_rows).max())
     np.testing.assert_allclose(ey_fast, ey_rows, atol=3e-4 * scale)
+
+
+@settings(max_examples=15, deadline=None)
+@given(seed=st.integers(0, 2**20), B=st.integers(1, 13),
+       S=st.integers(2, 70), N=st.integers(1, 9), M=st.integers(1, 6),
+       K=st.integers(2, 4))
+def test_ey_linear_fallback_matches_dense_random_shapes(seed, B, S, N, M, K):
+    """The chunked XLA fallback (binary sigmoid-of-difference shortcut at
+    K=2, general softmax otherwise) equals the dense synthetic-row formula
+    at arbitrary shapes — guards the shortcut's padding/trim and the
+    doubled-chunk logic across shape space."""
+
+    import jax.numpy as jnp
+
+    from distributedkernelshap_tpu.ops.explain import _ey_linear
+
+    rng = np.random.default_rng(seed)
+    D = 2 * M
+    X = rng.normal(size=(B, D)).astype(np.float32)
+    bg = rng.normal(size=(N, D)).astype(np.float32)
+    W = rng.normal(size=(D, K)).astype(np.float32)
+    b = rng.normal(size=(K,)).astype(np.float32)
+    G = np.zeros((M, D), np.float32)
+    for m in range(M):
+        G[m, 2 * m:2 * m + 2] = 1.0
+    mask = (rng.random(size=(S, M)) < 0.5).astype(np.float32)
+    bgw = rng.random(N).astype(np.float32) + 0.1
+    bgw /= bgw.sum()
+
+    zc = mask @ G
+    masked = (X[:, None, None, :] * zc[None, :, None, :]
+              + bg[None, None] * (1.0 - zc[None, :, None, :]))
+    logits = masked @ W + b
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    ref = np.einsum("bsnk,n->bsk", e / e.sum(-1, keepdims=True), bgw)
+
+    chunk = int(rng.integers(1, S + 1))
+    got = np.asarray(_ey_linear(
+        jnp.asarray(W), jnp.asarray(b), "softmax", jnp.asarray(X),
+        jnp.asarray(bg), jnp.asarray(bgw), jnp.asarray(mask),
+        jnp.asarray(G), chunk, use_pallas=False))
+    np.testing.assert_allclose(got, ref, atol=2e-5)
