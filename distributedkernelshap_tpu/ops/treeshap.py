@@ -46,13 +46,30 @@ import numpy as np
 from distributedkernelshap_tpu.models.trees import TreeEnsemblePredictor
 
 
+def _unwrap(pred):
+    """``(tree_predictor, scale)`` behind affine output wrappers.
+
+    An affine head ``a*f + b`` scales Shapley values by ``a`` (the offset
+    moves into the expected value), so e.g. a TransformedTargetRegressor's
+    lifted GBT still qualifies for the exact path."""
+
+    from distributedkernelshap_tpu.models.compose import AffineOutputPredictor
+
+    if isinstance(pred, AffineOutputPredictor) \
+            and isinstance(pred.inner, TreeEnsemblePredictor):
+        return pred.inner, float(pred.a)
+    return pred, 1.0
+
+
 def supports_exact(pred) -> bool:
     """Whether ``pred`` can take the exact path (lifted tree ensemble with
-    raw-margin outputs and materialised path tensors)."""
+    raw-margin outputs and materialised path tensors, possibly behind an
+    affine output head)."""
 
-    return (isinstance(pred, TreeEnsemblePredictor)
-            and pred.out_transform == "identity"
-            and getattr(pred, "path_sign", None) is not None)
+    tree, _ = _unwrap(pred)
+    return (isinstance(tree, TreeEnsemblePredictor)
+            and tree.out_transform == "identity"
+            and getattr(tree, "path_sign", None) is not None)
 
 
 def validate_exact(pred, link: str) -> None:
@@ -98,7 +115,7 @@ def _unsat(pred, rows, onpath, want_left):
     return onpath[None] * jnp.abs(gl[:, :, None, :] - want_left[None])
 
 
-def background_reach(pred: TreeEnsemblePredictor, bg, G):
+def background_reach(pred, bg, G):
     """Background-side reach tensors, computed ONCE per (background, G) and
     reused across every instance chunk: ``z_ok (N, T, L, M)`` per-group
     satisfaction, ``z_ung_dead (N, T, L)`` leaves a background row already
@@ -107,6 +124,7 @@ def background_reach(pred: TreeEnsemblePredictor, bg, G):
     so such a split must be z-satisfied for the leaf to be reachable at
     all), and ``onpath_g (T, L, M)``."""
 
+    pred, _ = _unwrap(pred)
     bg = jnp.asarray(bg, jnp.float32)
     G = jnp.asarray(G, jnp.float32)
     sign = pred.path_sign
@@ -142,7 +160,7 @@ def pad_background(z_ok, z_ung_dead, bgw, multiple: int):
     return z_ok_p, z_ung_p, bgw_p
 
 
-def exact_shap_from_reach(pred: TreeEnsemblePredictor, X, reach, bgw, G,
+def exact_shap_from_reach(pred, X, reach, bgw, G,
                           bg_chunk: Optional[int] = 16,
                           normalized: bool = False):
     """Exact phi ``(B, K, M)`` for ``X`` given precomputed background reach
@@ -158,6 +176,7 @@ def exact_shap_from_reach(pred: TreeEnsemblePredictor, X, reach, bgw, G,
     partial phi (normalising a local weight shard by its local sum would
     be wrong; they normalise globally first)."""
 
+    pred, head_scale = _unwrap(pred)
     X = jnp.asarray(X, jnp.float32)
     bgw = jnp.asarray(bgw, jnp.float32)
     if not normalized:
@@ -208,14 +227,13 @@ def exact_shap_from_reach(pred: TreeEnsemblePredictor, X, reach, bgw, G,
 
     phi = jnp.sum(jax.lax.map(one_chunk, (z_chunks, zu_chunks, w_chunks)),
                   axis=0)
-    phi = phi * pred.scale
+    phi = phi * (pred.scale * head_scale)       # affine head: phi scales by a
     if pred.aggregation == "mean":
         phi = phi / T
     return jnp.swapaxes(phi, 1, 2)              # (B, K, M)
 
 
-def exact_tree_shap(pred: TreeEnsemblePredictor, X, bg, bgw, G,
-                    bg_chunk: Optional[int] = 16):
+def exact_tree_shap(pred, X, bg, bgw, G, bg_chunk: Optional[int] = 16):
     """Exact interventional Shapley values of ``pred``'s raw margin.
 
     Parameters mirror the sampled pipeline: ``X (B, D)`` instances,

@@ -360,3 +360,40 @@ def test_exact_lightgbm_regression_dump():
     sv_exact = engine.get_explanation(Xe, nsamples="exact")
     np.testing.assert_allclose(np.asarray(sv_exact), np.asarray(sv_kernel),
                                atol=1e-5)
+
+
+def test_exact_through_affine_output_head():
+    """A TransformedTargetRegressor's lifted GBT (AffineOutputPredictor over
+    a TreeEnsemblePredictor) qualifies for exact mode: Shapley values scale
+    by the head's slope, so exact must equal the exhaustively-enumerated
+    sampled path on the SAME wrapped predictor."""
+
+    from sklearn.compose import TransformedTargetRegressor
+    from sklearn.ensemble import HistGradientBoostingRegressor
+    from sklearn.preprocessing import StandardScaler
+
+    from distributedkernelshap_tpu.models.compose import AffineOutputPredictor
+
+    rng = np.random.default_rng(12)
+    X = rng.normal(size=(240, 5))
+    y = 40.0 * X[:, 0] - 25.0 * np.where(X[:, 2] > 0, X[:, 3], 0.0) + 100.0
+    ttr = TransformedTargetRegressor(
+        regressor=HistGradientBoostingRegressor(max_iter=8, random_state=0),
+        transformer=StandardScaler()).fit(X, y)
+    pred = as_predictor(ttr.predict, example_dim=5,
+                        probe_data=X[:16].astype(np.float32))
+    assert isinstance(pred, AffineOutputPredictor)
+    assert supports_exact(pred)
+
+    engine = KernelExplainerEngine(pred, X[:9].astype(np.float32),
+                                   link="identity", seed=0)
+    Xe = X[100:106].astype(np.float32)
+    sv_kernel = engine.get_explanation(Xe, nsamples=64, l1_reg=False)
+    sv_exact = engine.get_explanation(Xe, nsamples="exact")
+    np.testing.assert_allclose(np.asarray(sv_exact), np.asarray(sv_kernel),
+                               atol=1e-3)
+    # additivity against the ORIGINAL sklearn composite
+    total = np.asarray(sv_exact).sum(-1).ravel() \
+        + float(np.ravel(engine.expected_value)[0])
+    np.testing.assert_allclose(total, ttr.predict(Xe.astype(np.float64)),
+                               atol=1e-3)
