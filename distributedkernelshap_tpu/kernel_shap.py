@@ -1267,7 +1267,10 @@ class KernelShap(Explainer, FitMixin):
         """Explain the instances in ``X`` (reference kernel_shap.py:810-898).
 
         Keyword arguments mirror the reference: ``nsamples`` (coalition
-        budget), ``l1_reg`` (feature selection), ``silent``.
+        budget), ``l1_reg`` (feature selection), ``silent``.  Beyond the
+        reference, ``nsamples='exact'`` computes closed-form interventional
+        TreeSHAP for device-lifted tree ensembles with raw-margin outputs
+        (``ops/treeshap.py``) — no sampling, no regression solve.
         """
 
         if not self._fitted:
