@@ -204,7 +204,13 @@ def config_adult_trees(smoke=False):
     clf = HistGradientBoostingClassifier(max_iter=10 if smoke else 50,
                                          random_state=0).fit(Xtr, ytr)
 
-    X = data["all"]["X"]["processed"]["test"].toarray()
+    # f32 evaluation points for BOTH sides of the model_err oracle: the
+    # device evaluates in f32, and HistGBT routes threshold-adjacent rows
+    # differently for x vs float32(x) (measured 1.946 max logit diff on this
+    # batch from the cast alone, sklearn-vs-sklearn) — comparing an f32
+    # engine against the f64-input predictions would report that cast
+    # sensitivity as engine error
+    X = data["all"]["X"]["processed"]["test"].toarray().astype(np.float32)
     X = X[:8] if smoke else X[:256]
     ex = KernelShap(clf.predict_proba, link="logit", feature_names=gn, seed=0)
     ex.fit(data["background"]["X"]["preprocessed"], group_names=gn, groups=g)
@@ -285,7 +291,9 @@ def config_model_zoo(smoke=False):
     ytr = data["all"]["y"]["train"]
     if smoke:
         Xtr, ytr = Xtr[:3000], ytr[:3000]
-    X = data["all"]["X"]["processed"]["test"].toarray()
+    # f32 points for both explain and the model_err oracle (see
+    # config_adult_trees: the cast itself flips HistGBT threshold routing)
+    X = data["all"]["X"]["processed"]["test"].toarray().astype(np.float32)
     X = X[:16] if smoke else X[:256]
     bg = data["background"]["X"]["preprocessed"]
 

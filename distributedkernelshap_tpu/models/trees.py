@@ -124,6 +124,9 @@ class TreeEnsemblePredictor(BasePredictor):
     #: per-row MAC budget above which the path-matmul strategy is declined
     max_path_flops_per_row: int = 1 << 22
     target_chunk_elems: int = DEFAULT_CHUNK_ELEMS
+    #: total T*Nn*D above which _split_conditions computes the one-hot on
+    #: device (iota-compare) instead of embedding it as an XLA constant
+    onehot_constant_elems: int = 1 << 27
 
     def __init__(self, feature, threshold, left, right, value, depth: int,
                  aggregation: str = "sum", base=None, scale: float = 1.0,
@@ -148,6 +151,16 @@ class TreeEnsemblePredictor(BasePredictor):
         self.out_transform = out_transform
         self.n_outputs = 2 if out_transform == "binary_sigmoid" else k_raw
         self.vector_out = vector_out
+        self._onehot_cache = None
+        # finite, beyond every FINITE threshold (leaf padding is +-inf),
+        # far from f32 overflow: non-finite inputs are replaced by
+        # +-sentinel in _split_conditions, preserving the gather path's
+        # compare semantics (NaN/+inf <= t -> False, -inf <= t -> True)
+        thr_np = np.asarray(threshold, np.float64)
+        finite = thr_np[np.isfinite(thr_np)]
+        thr_hi = float(np.abs(finite).max()) if finite.size else 0.0
+        f32max = float(np.finfo(np.float32).max)
+        self._nan_sentinel = jnp.float32(min(2.0 * thr_hi + 1.0e6, f32max))
         self._build_paths(np.asarray(feature), np.asarray(left),
                           np.asarray(right), np.asarray(value))
 
@@ -207,25 +220,83 @@ class TreeEnsemblePredictor(BasePredictor):
         self.leaf_value = jnp.asarray(leaf_value)
         self.n_leaves = L
 
+    def _feature_onehot(self, D: int):
+        """``(T, Nn, D)`` one-hot of ``feature`` (numpy, cached) — the
+        gather-free way to read node feature values
+        (``xv = einsum('nd,tjd->ntj', X, onehot)``).
+
+        The XLA:TPU toolchain in this image miscompiles a column gather
+        (``X[:, idx]``) fused with the downstream threshold compare at
+        specific batch shapes (n=6400/6336/6464/8000 with the Adult GBT
+        tables: ~34% of lanes get another column's comparison; reproduced
+        minimally and shape-swept on hardware, 2026-07-31).  Barriers around
+        the gather do NOT remove the bad fusion; replacing the gather with a
+        one-hot contraction does, and is the TPU-idiomatic formulation
+        anyway (MXU work instead of scatter/gather lanes).  At
+        ``Precision.HIGHEST`` each output has exactly one nonzero term, so
+        the contraction is bit-exact.
+        """
+
+        oh_np = self._onehot_cache
+        if oh_np is None or oh_np.shape[-1] != D:
+            T, Nn = self.feature.shape
+            f = np.asarray(self.feature)
+            oh_np = np.zeros((T, Nn, D), np.float32)
+            oh_np[np.arange(T)[:, None], np.arange(Nn)[None, :], f] = 1.0
+            # cached as numpy: a jnp constant built under a jit trace would
+            # be a tracer and must not outlive the trace
+            self._onehot_cache = oh_np
+        return oh_np
+
     def _split_conditions(self, X):
         """``gl[n,t,j]``: does row ``n`` go left at node ``(t,j)``?  (f32)
 
-        The input is materialised behind an optimization barrier before the
-        node-column gather: on the TPU backend, letting XLA fuse this gather
-        with an upstream producer (e.g. the synthetic-row synthesis of
-        ``ops/explain._ey_generic``) was observed to corrupt the comparisons
-        at specific shapes (B=8/S=64/N=100 Adult: whole coalitions got wrong
-        leaf memberships, ~0.9 absolute output error), while every
-        constituent op is exact in isolation.  The barrier costs one
-        materialisation of ``X`` and removes the miscompiling fusion.
+        Gather-free: node feature values come from a one-hot contraction
+        (see ``_feature_onehot`` for the miscompilation this dodges).  NaN
+        inputs cannot ride a matmul (``NaN·0`` poisons the row), so they are
+        replaced by a sentinel above every threshold (→ compares False,
+        matching the gather's ``NaN <= t`` semantics) and re-routed through
+        ``missing_left`` via an indicator contraction when the ensemble has
+        missing-value semantics.
         """
 
-        X = jax.lax.optimization_barrier(X)
+        D = X.shape[1]
+        # ANY non-finite value would poison its whole row through the
+        # contraction (inf*0 = NaN), so all three are replaced by a finite
+        # sentinel with the sign that reproduces the gather's compare:
+        # NaN/+inf <= t -> False (+sentinel), -inf <= t -> True (-sentinel)
+        xnan = jnp.isnan(X)
+        Xc = jnp.where(jnp.isfinite(X), X,
+                       jnp.where(X == -jnp.inf, -self._nan_sentinel,
+                                 self._nan_sentinel))
         T, Nn = self.feature.shape
-        xv = X[:, self.feature.reshape(-1)].reshape(X.shape[0], T, Nn)
-        gl = xv <= self.threshold[None]
+        # chunk over trees so no single one-hot buffer exceeds ~64 MB; the
+        # x D MAC increase vs the gather is MXU work and D is at most a few
+        # hundred for every lifted family (__call__ additionally reroutes
+        # to the iterative eval when T*Nn*D is outsized)
+        tc = max(1, min(T, (1 << 24) // max(1, Nn * D)))
+        hi = jax.lax.Precision.HIGHEST
+        if T * Nn * D <= self.onehot_constant_elems:
+            oh_np = self._feature_onehot(D)
+            slices = [jnp.asarray(oh_np[t0:t0 + tc])
+                      for t0 in range(0, T, tc)]
+        else:
+            # oversized tables: a device-computed one-hot (iota compare)
+            # per chunk, so jitted executables never embed T*Nn*D constants
+            iota = jnp.arange(D, dtype=jnp.int32)[None, None, :]
+            slices = [
+                (self.feature[t0:t0 + tc, :, None] == iota).astype(jnp.float32)
+                for t0 in range(0, T, tc)]
+
+        def contract(A):
+            parts = [jnp.einsum("nd,tjd->ntj", A, oh, precision=hi)
+                     for oh in slices]
+            return parts[0] if len(parts) == 1 else jnp.concatenate(parts, 1)
+
+        gl = contract(Xc) <= self.threshold[None]
         if self.missing_left is not None:
-            gl = jnp.where(jnp.isnan(xv), self.missing_left[None], gl)
+            nv = contract(xnan.astype(jnp.float32)) > 0.5
+            gl = jnp.where(nv, self.missing_left[None], gl)
         return gl.astype(jnp.float32)
 
     def _eval_paths(self, X):
@@ -240,7 +311,9 @@ class TreeEnsemblePredictor(BasePredictor):
         return out / self.n_trees if self.aggregation == "mean" else out
 
     def _eval_iterative(self, X):
-        X = jax.lax.optimization_barrier(X)   # see _split_conditions
+        # take_along_axis inside the fori_loop body compiles correctly at
+        # every shape swept (unlike the fused column gather, _feature_onehot)
+        X = jax.lax.optimization_barrier(X)
         T = self.feature.shape[0]
         t_idx = jnp.arange(T)[None, :]                        # (1, T)
         node0 = jnp.zeros((X.shape[0], T), jnp.int32)
@@ -273,12 +346,15 @@ class TreeEnsemblePredictor(BasePredictor):
 
     def __call__(self, X):
         X = jnp.asarray(X, jnp.float32)
-        if self.path_sign is None:
+        T, Nn = self.feature.shape
+        # second clause: the gather-free split conditions carry a T*Nn*D
+        # one-hot constant (_feature_onehot); for outsized ensembles x wide
+        # feature spaces the iterative traversal is the better program
+        if self.path_sign is None or T * Nn * X.shape[1] > (1 << 27):
             raw = self._eval_iterative(X)
         else:
             from distributedkernelshap_tpu.models._chunking import padded_chunk_map
 
-            T, Nn = self.feature.shape
             per_row = T * max(Nn, self.n_leaves)
             chunk = max(1, min(X.shape[0], self.target_chunk_elems // per_row))
             if X.shape[0] <= chunk:

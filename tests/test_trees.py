@@ -569,3 +569,120 @@ def test_deep_tree_padding(reg_data):
                                 min_samples_leaf=1).fit(X, y)
     lifted = _assert_matches(reg.predict, X[:64], atol=1e-4)
     assert lifted.depth >= 5
+
+
+def _two_leaf_predictor(missing_left):
+    """One tree: root splits feature 1 at 0.0; leaves return -1.0 / +1.0."""
+
+    from distributedkernelshap_tpu.models.trees import TreeEnsemblePredictor
+
+    feature = np.array([[1, 0, 0]])
+    threshold = np.array([[0.0, np.inf, np.inf]], np.float32)
+    left = np.array([[1, 1, 2]])
+    right = np.array([[2, 1, 2]])
+    value = np.zeros((1, 3, 1), np.float32)
+    value[0, 1, 0] = -1.0
+    value[0, 2, 0] = 1.0
+    return TreeEnsemblePredictor(
+        feature, threshold, left, right, value, depth=1, vector_out=False,
+        missing_left=None if missing_left is None
+        else np.array([[missing_left, False, False]]))
+
+
+def test_nan_without_missing_semantics_goes_right():
+    """With no missing_left table, NaN must compare False (go right) — the
+    gather path's ``NaN <= t`` semantics, preserved through the one-hot
+    sentinel reformulation of _split_conditions."""
+
+    import jax
+
+    pred = _two_leaf_predictor(missing_left=None)
+    X = np.array([[9.0, -1.0], [9.0, np.nan], [9.0, 1.0]], np.float32)
+    out = np.asarray(jax.jit(pred)(X)).ravel()
+    assert out.tolist() == [-1.0, 1.0, 1.0]
+
+
+@pytest.mark.parametrize("go_left", [True, False])
+def test_nan_missing_left_routing(go_left):
+    import jax
+
+    pred = _two_leaf_predictor(missing_left=go_left)
+    X = np.array([[9.0, np.nan]], np.float32)
+    out = float(np.asarray(jax.jit(pred)(X)).ravel()[0])
+    assert out == (-1.0 if go_left else 1.0)
+
+
+def test_split_conditions_onehot_matches_gather_oracle():
+    """_split_conditions (one-hot contraction; see _feature_onehot for the
+    TPU gather+compare miscompile it dodges) must equal the direct
+    column-gather formulation bit-for-bit on random tables."""
+
+    import jax
+
+    from distributedkernelshap_tpu.models.trees import TreeEnsemblePredictor
+
+    rng = np.random.default_rng(3)
+    T, Nn, D, n = 7, 13, 11, 129
+    feature = rng.integers(0, D, size=(T, Nn))
+    threshold = rng.normal(size=(T, Nn)).astype(np.float32)
+    left = np.tile(np.arange(Nn), (T, 1))      # all self-loops: structure
+    right = left.copy()                        # irrelevant for this check
+    value = np.zeros((T, Nn, 1), np.float32)
+    pred = TreeEnsemblePredictor(feature, threshold, left, right, value,
+                                 depth=1)
+    X = rng.normal(size=(n, D)).astype(np.float32)
+    # make some entries EXACTLY equal to their threshold: boundary lanes
+    X[0, feature[0, 0]] = threshold[0, 0]
+    X[1, feature[3, 5]] = threshold[3, 5]
+    got = np.asarray(jax.jit(pred._split_conditions)(X))
+    want = (X[:, feature.reshape(-1)].reshape(n, T, Nn)
+            <= threshold[None]).astype(np.float32)
+    assert (got == want).all()
+
+
+def test_inf_inputs_route_like_the_gather_compare():
+    """+-inf inputs must survive the one-hot sentinel sanitisation:
+    -inf <= t -> True (left), +inf <= t -> False (right)."""
+
+    import jax
+
+    pred = _two_leaf_predictor(missing_left=None)
+    X = np.array([[9.0, -np.inf], [9.0, np.inf]], np.float32)
+    out = np.asarray(jax.jit(pred)(X)).ravel()
+    assert out.tolist() == [-1.0, 1.0]
+    # and an inf in an UNUSED feature must not poison the used one
+    X2 = np.array([[np.inf, -1.0], [-np.inf, 1.0]], np.float32)
+    out2 = np.asarray(jax.jit(pred)(X2)).ravel()
+    assert out2.tolist() == [-1.0, 1.0]
+
+
+def test_device_computed_onehot_fallback_matches_constant_path(clf_data):
+    """Above ``onehot_constant_elems`` _split_conditions switches to a
+    device-computed (iota-compare) one-hot with no embedded constant; the
+    split conditions must be identical, for every caller altitude
+    (masked_ey and treeshap call _split_conditions directly)."""
+
+    import jax
+
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    from distributedkernelshap_tpu.models import as_predictor
+    from distributedkernelshap_tpu.models.trees import TreeEnsemblePredictor
+
+    X, y = clf_data
+    clf = GradientBoostingClassifier(n_estimators=8, random_state=0).fit(X, y)
+    pred = as_predictor(clf.predict_proba, example_dim=X.shape[1])
+    assert isinstance(pred, TreeEnsemblePredictor)
+    Xf = np.asarray(X[:40], np.float32)
+    Xf[3, 0] = np.nan
+    Xf[5, 1] = np.inf
+    want = np.asarray(jax.jit(pred._split_conditions)(Xf))
+    old = TreeEnsemblePredictor.onehot_constant_elems
+    try:
+        TreeEnsemblePredictor.onehot_constant_elems = 0   # force the fallback
+        got = np.asarray(jax.jit(pred._split_conditions)(Xf))
+        out_fb = np.asarray(pred(Xf))
+    finally:
+        TreeEnsemblePredictor.onehot_constant_elems = old
+    assert (got == want).all()
+    assert np.abs(out_fb - np.asarray(pred(Xf))).max() == 0.0
