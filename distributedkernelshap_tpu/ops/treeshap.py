@@ -94,7 +94,10 @@ def _beta_tables(dmax: int):
     ``W_minus[u, v] = u! (v-1)! / (u+v)!`` (0 for v=0), for u, v <= dmax.
 
     Computed in log space (gammaln): plain factorials overflow float64 from
-    ~170, and the ensemble depth bound is 256."""
+    ~170, and the ensemble depth bound is 256.  The hot path computes the
+    same weights on-device via ``lax.lgamma`` (see ``one_chunk``); this f64
+    host table is the test oracle for that formula
+    (``tests/test_treeshap.py::test_device_beta_weights_match_f64_table``)."""
 
     from scipy.special import gammaln
 
@@ -105,6 +108,24 @@ def _beta_tables(dmax: int):
     wp[0, :] = 0.0   # u = 0: the group-in-coalition weight does not apply
     wm[:, 0] = 0.0   # v = 0: the group-out weight does not apply
     return wp.astype(np.float32), wm.astype(np.float32)
+
+
+def _device_beta_weights(u, v):
+    """``(W_plus, W_minus)`` Beta weights from exact small-int count tensors,
+    computed on-device via ``lax.lgamma`` — pure VPU work, replacing a
+    two-index table gather (slow on TPU, and the fused gather+consumer
+    pattern is the miscompile class worked around in
+    ``models/trees._feature_onehot``).  Absolute error vs the f64
+    ``_beta_tables`` oracle is <2e-6 over the full depth-256 grid (pinned
+    by ``tests/test_treeshap.py::test_device_beta_weights_match_f64_table``);
+    unreachable deep weights underflow f32 to 0 on both routes."""
+
+    lg_uv1 = jax.lax.lgamma(u + v + 1.0)
+    wp = jnp.exp(jax.lax.lgamma(jnp.maximum(u, 1.0))
+                 + jax.lax.lgamma(v + 1.0) - lg_uv1) * (u > 0.5)
+    wm = jnp.exp(jax.lax.lgamma(u + 1.0)
+                 + jax.lax.lgamma(jnp.maximum(v, 1.0)) - lg_uv1) * (v > 0.5)
+    return wp, wm
 
 
 def _unsat(pred, rows, onpath, want_left):
@@ -198,9 +219,6 @@ def exact_shap_from_reach(pred, X, reach, bgw, G,
     x_only = x_ok * onpath_g[None]              # groups x satisfies (incl. shared)
     x_not = (1.0 - x_ok) * onpath_g[None]       # groups x fails
 
-    wp_tab, wm_tab = _beta_tables(int(pred.depth))
-    wp_tab, wm_tab = jnp.asarray(wp_tab), jnp.asarray(wm_tab)
-
     N = z_ok.shape[0]
     chunk = max(1, min(int(bg_chunk or N), N))
     z_ok_p, z_ung_p, bgw_p = pad_background(z_ok, z_ung_dead, bgw, chunk)
@@ -214,11 +232,10 @@ def exact_shap_from_reach(pred, X, reach, bgw, G,
         u = jnp.einsum("btlg,ntlg->bntl", x_only, 1.0 - zc)
         v = jnp.einsum("btlg,ntlg->bntl", x_not, zc)
         dead = jnp.einsum("btlg,ntlg->bntl", x_not, 1.0 - zc)
-        ui = u.astype(jnp.int32)
-        vi = v.astype(jnp.int32)
         alive = ((dead < 0.5) & ~zu[None]).astype(jnp.float32)
-        wp = wp_tab[ui, vi] * alive             # (B, n, T, L)
-        wm = wm_tab[ui, vi] * alive
+        wp, wm = _device_beta_weights(u, v)     # (B, n, T, L)
+        wp = wp * alive
+        wm = wm * alive
         phi_p = jnp.einsum("bntl,btlg,ntlg,tlk,n->bgk",
                            wp, x_only, 1.0 - zc, leaf_val, wc)
         phi_m = jnp.einsum("bntl,btlg,ntlg,tlk,n->bgk",

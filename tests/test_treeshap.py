@@ -397,3 +397,25 @@ def test_exact_through_affine_output_head():
         + float(np.ravel(engine.expected_value)[0])
     np.testing.assert_allclose(total, ttr.predict(Xe.astype(np.float64)),
                                atol=1e-3)
+
+
+def test_device_beta_weights_match_f64_table():
+    """The on-device lgamma Beta weights (exact_shap_from_reach's hot path)
+    must match the f64 host table to <=2e-6 absolute wherever the f32
+    weights are representable (deeper (u, v) underflow to 0 on both
+    routes)."""
+
+    import jax.numpy as jnp
+
+    from distributedkernelshap_tpu.ops.treeshap import (
+        _beta_tables,
+        _device_beta_weights,
+    )
+
+    dmax = 256   # the full ensemble depth bound
+    wp_tab, wm_tab = _beta_tables(dmax)
+    u = jnp.asarray(np.arange(dmax + 1)[:, None], jnp.float32)
+    v = jnp.asarray(np.arange(dmax + 1)[None, :], jnp.float32)
+    wp, wm = _device_beta_weights(u, v)
+    assert np.abs(np.asarray(wp) - wp_tab).max() < 2e-6
+    assert np.abs(np.asarray(wm) - wm_tab).max() < 2e-6
