@@ -11,7 +11,7 @@ while true; do
     echo "[$(date +%H:%M:%S)] RECOVERED" >> .tpu_watch2.log
     sleep 30   # give any blocked-mid-RPC client a moment to resume/finish
     python benchmarks/tpu_revalidate.py \
-      --skip adult_stress,mnist,covertype,adult_blackbox,serve,pool,adult_trees_exact,regression \
+      --skip mnist,covertype,adult_blackbox,serve,pool,regression \
       >> .tpu_watch2.log 2>&1
     DKS_BENCH_SKIP_PROBE=1 DKS_BENCH_BUDGET=420 python bench.py \
       >> .tpu_watch2.log 2>&1

@@ -327,6 +327,8 @@ class KernelExplainerEngine:
         self._fn_cache: Dict[Any, Any] = {}
         self._dev_cache: Dict[Any, Any] = {}
         self.last_raw_prediction: Optional[np.ndarray] = None
+        #: list of K (B, M, M) arrays after an interactions=True explain
+        self.last_interaction_values: Optional[List[np.ndarray]] = None
 
         # black-box predictors can't run inside jit on backends without host
         # callbacks (tunnelled TPU PJRT rejects pure_callback while still
@@ -637,6 +639,7 @@ class KernelExplainerEngine:
                         nsamples: Union[str, int, None] = None,
                         l1_reg: Union[str, float, int, None] = 'auto',
                         silent: bool = False,
+                        interactions: bool = False,
                         **kwargs) -> Any:
         """Compute SHAP values for ``X``.
 
@@ -644,11 +647,26 @@ class KernelExplainerEngine:
         parity with reference ``kernel_shap.py:231-254``).  Returns a list of
         ``K`` ``(B, M)`` arrays for multi-output predictors, a single array
         otherwise; tuple input returns ``(batch_idx, result)``.
+
+        ``interactions=True`` (``nsamples='exact'`` only) additionally
+        computes the exact Shapley interaction matrices; they are exposed as
+        ``last_interaction_values`` (list of ``K`` ``(B, M, M)`` arrays, shap
+        TreeExplainer convention) and the returned shap values are their row
+        sums.
         """
 
         # kwargs accepted for parity; silent only matters on the slow
         # (host-eval) path — device explains finish in milliseconds
         del kwargs
+        if interactions and nsamples != 'exact':
+            raise ValueError(
+                "interactions=True requires nsamples='exact' (closed-form "
+                "interventional TreeSHAP); the sampled KernelSHAP estimator "
+                "does not produce interaction values.")
+        if not interactions:
+            # never let interaction tensors from an earlier explain pair
+            # with this call's fingerprint/raw predictions
+            self.last_interaction_values = None
         batch_idx = None
         if isinstance(X, tuple):
             batch_idx, X = X
@@ -668,7 +686,8 @@ class KernelExplainerEngine:
             # sampling-free interventional TreeSHAP (ops/treeshap.py): no
             # coalition plan, no WLS — the Shapley values of the lifted
             # ensemble's raw margin in closed form
-            values = self._exact_tree_explanation(chunks, X, l1_reg)
+            values = self._exact_tree_explanation(chunks, X, l1_reg,
+                                                  interactions=interactions)
             if batch_idx is not None:
                 return batch_idx, values
             return values
@@ -707,9 +726,12 @@ class KernelExplainerEngine:
 
     # ------------------------------------------------------------------ #
 
-    def _exact_tree_explanation(self, chunks, X, l1_reg):
+    def _exact_tree_explanation(self, chunks, X, l1_reg,
+                                interactions: bool = False):
         """``nsamples='exact'``: closed-form interventional Shapley values
-        for a lifted tree ensemble (``ops/treeshap.exact_tree_shap``)."""
+        for a lifted tree ensemble (``ops/treeshap.exact_tree_shap``);
+        with ``interactions`` also the exact interaction matrices
+        (``ops/treeshap.exact_interactions_from_reach``)."""
 
         from distributedkernelshap_tpu.ops.treeshap import validate_exact
 
@@ -719,44 +741,57 @@ class KernelExplainerEngine:
                 "l1_reg=%r is ignored with nsamples='exact': there is no "
                 "sampling noise to regularise away.", l1_reg)
 
-        if 'exact' not in self._fn_cache:
+        key = 'exact_inter' if interactions else 'exact'
+        if key not in self._fn_cache:
             from distributedkernelshap_tpu.ops.treeshap import (
                 background_reach,
+                exact_interactions_from_reach,
                 exact_shap_from_reach,
             )
 
             pred = self.predictor
             precision = self.config.shap.matmul_precision
-            # background reach tensors: computed once per fit, shared by
-            # every instance chunk (the background pass is N x T x L work
-            # that would otherwise repeat B/chunk times)
-            with profiler().phase('background_reach'), \
-                    jax.default_matmul_precision(precision):
-                reach = jax.jit(lambda bg, G: background_reach(pred, bg, G))(
-                    jnp.asarray(self.background), jnp.asarray(self.G))
+            # background reach tensors: computed once per fit and shared by
+            # every instance chunk AND both exact fn variants (reach depends
+            # only on (background, G), not on the interactions flag)
+            if 'exact_reach' not in self._fn_cache:
+                with profiler().phase('background_reach'), \
+                        jax.default_matmul_precision(precision):
+                    self._fn_cache['exact_reach'] = jax.jit(
+                        lambda bg, G: background_reach(pred, bg, G))(
+                            jnp.asarray(self.background), jnp.asarray(self.G))
+            reach = self._fn_cache['exact_reach']
 
             def fn(Xc, bgw, G, reach=reach):
                 with jax.default_matmul_precision(precision):
-                    phi = exact_shap_from_reach(pred, Xc, reach, bgw, G)
-                    return {'shap_values': phi,
-                            'raw_prediction': pred(Xc)}
+                    out = {'shap_values':
+                           exact_shap_from_reach(pred, Xc, reach, bgw, G),
+                           'raw_prediction': pred(Xc)}
+                    if interactions:
+                        out['interaction_values'] = \
+                            exact_interactions_from_reach(pred, Xc, reach,
+                                                          bgw, G)
+                    return out
 
-            self._fn_cache['exact'] = jax.jit(fn)
+            self._fn_cache[key] = jax.jit(fn)
 
         results = []
         with profiler().phase('device_explain'):
             for c in chunks:
                 Xp, B = self._pad_to_bucket(c)
-                out = self._fn_cache['exact'](
+                out = self._fn_cache[key](
                     jnp.asarray(Xp, jnp.float32),
                     jnp.asarray(self.bg_weights), jnp.asarray(self.G))
-                results.append({
-                    'shap_values': np.asarray(out['shap_values'])[:B],
-                    'raw_prediction': np.asarray(out['raw_prediction'])[:B],
-                })
+                results.append({k: np.asarray(v)[:B]
+                                for k, v in out.items()})
         phi = np.concatenate([r['shap_values'] for r in results], 0)
         self.last_raw_prediction = np.concatenate(
             [r['raw_prediction'] for r in results], 0)
+        if interactions:
+            inter = np.concatenate(
+                [r['interaction_values'] for r in results], 0)  # (B, K, M, M)
+            self.last_interaction_values = [inter[:, k]
+                                            for k in range(inter.shape[1])]
         self.last_X_fingerprint = _fingerprint(X)
         return split_shap_values(phi, self.vector_out)
 
@@ -1270,7 +1305,12 @@ class KernelShap(Explainer, FitMixin):
         budget), ``l1_reg`` (feature selection), ``silent``.  Beyond the
         reference, ``nsamples='exact'`` computes closed-form interventional
         TreeSHAP for device-lifted tree ensembles with raw-margin outputs
-        (``ops/treeshap.py``) — no sampling, no regression solve.
+        (``ops/treeshap.py``) — no sampling, no regression solve — and
+        ``interactions=True`` (exact mode only) additionally returns the
+        exact Shapley interaction matrices in
+        ``explanation.data['raw']['interaction_values']`` (list of ``K``
+        ``(B, M, M)`` arrays, shap TreeExplainer convention: symmetric,
+        rows sum to the shap values; rank-3 ``sum_categories`` applies).
         """
 
         if not self._fitted:
@@ -1296,7 +1336,7 @@ class KernelShap(Explainer, FitMixin):
         if isinstance(expected_value, (float, np.floating)):
             expected_value = [expected_value]
 
-        return self.build_explanation(
+        explanation = self.build_explanation(
             X,
             shap_values,
             expected_value,
@@ -1304,6 +1344,19 @@ class KernelShap(Explainer, FitMixin):
             cat_vars_start_idx=cat_vars_start_idx,
             cat_vars_enc_dim=cat_vars_enc_dim,
         )
+        if kwargs.get('interactions'):
+            inter = getattr(self._explainer, 'last_interaction_values', None)
+            if inter is not None:
+                # gate on the POST-validation decision (set by
+                # build_explanation via _check_result_summarisation), so the
+                # interaction tensors summarise exactly when the shap values
+                # did — the rows-sum-to-shap-values invariant must survive
+                # the warn-and-degrade matrix
+                if self.summarise_result:
+                    inter = [sum_categories(v, cat_vars_start_idx,
+                                            cat_vars_enc_dim) for v in inter]
+                explanation.data['raw']['interaction_values'] = inter
+        return explanation
 
     def build_explanation(self,
                           X: Union[np.ndarray, pd.DataFrame, sparse.spmatrix],

@@ -250,6 +250,134 @@ def exact_shap_from_reach(pred, X, reach, bgw, G,
     return jnp.swapaxes(phi, 1, 2)              # (B, K, M)
 
 
+def _device_interaction_weights(u, v):
+    """Pairwise Beta weights of the conjunction game's Shapley interaction
+    index, from the same exact count tensors as the main effects:
+
+        W_uu = (u-2)! v! / (u+v-1)!    both groups in U       (u >= 2)
+        W_vv = u! (v-2)! / (u+v-1)!    both groups in V       (v >= 2)
+        W_uv = -(u-1)! (v-1)! / (u+v-1)!   one in U, one in V (u, v >= 1)
+
+    Derived by collapsing the size-weighted sum over coalitions into Beta
+    integrals (free players binomial-sum to 1), and pinned against a
+    brute-force enumeration of the interaction index over random conjunction
+    games (``tests/test_treeshap.py::test_interaction_weights_brute_force``).
+    Computed via lgamma like :func:`_device_beta_weights` (no table
+    gather)."""
+
+    lg_uv = jax.lax.lgamma(jnp.maximum(u + v, 1.0))
+    w_uu = jnp.exp(jax.lax.lgamma(jnp.maximum(u - 1.0, 1.0))
+                   + jax.lax.lgamma(v + 1.0) - lg_uv) * (u > 1.5)
+    w_vv = jnp.exp(jax.lax.lgamma(u + 1.0)
+                   + jax.lax.lgamma(jnp.maximum(v - 1.0, 1.0)) - lg_uv) * (v > 1.5)
+    w_uv = -jnp.exp(jax.lax.lgamma(jnp.maximum(u, 1.0))
+                    + jax.lax.lgamma(jnp.maximum(v, 1.0)) - lg_uv) \
+        * (u > 0.5) * (v > 0.5)
+    return w_uu, w_vv, w_uv
+
+
+def exact_interactions_from_reach(pred, X, reach, bgw, G,
+                                  bg_chunk: Optional[int] = 16,
+                                  normalized: bool = False):
+    """Exact interventional Shapley **interaction** values ``(B, K, M, M)``
+    for ``X`` given precomputed background reach tensors.
+
+    Output follows the shap TreeExplainer convention: symmetric matrix,
+    off-diagonal ``[i, j]`` carries half the pairwise interaction index
+    ``I_ij`` (the other half sits at ``[j, i]``), and the diagonal absorbs
+    the remainder of the main effect so each row sums to phi_i and the full
+    matrix sums to ``f(x) - E[f]``.  The off-diagonal part is computed here
+    from the same reach tensors as the main effects; the diagonal is closed
+    over :func:`exact_shap_from_reach`'s phi.
+
+    Cost is ~``M``x the main-effect pass (one main-effect-shaped einsum set
+    per group); callers should keep ``M`` modest (raises above 64 groups).
+    """
+
+    M = int(jnp.asarray(G).shape[0])
+    if M > 64:
+        raise ValueError(
+            f"exact interactions scale as M x the main-effect pass; M={M} "
+            "groups is beyond the supported 64")
+
+    pred_t, head_scale = _unwrap(pred)
+    X = jnp.asarray(X, jnp.float32)
+    bgw = jnp.asarray(bgw, jnp.float32)
+    if not normalized:
+        bgw = bgw / jnp.sum(bgw)
+    G = jnp.asarray(G, jnp.float32)
+
+    sign = pred_t.path_sign
+    onpath = jnp.abs(sign)
+    want_left = (sign > 0).astype(jnp.float32)
+    leaf_val = pred_t.leaf_value                # (T, L, K)
+    T = leaf_val.shape[0]
+    GH = jnp.swapaxes(G, 0, 1)[pred_t.feature]
+
+    ux = _unsat(pred_t, X, onpath, want_left)
+    x_ok = (jnp.einsum("btlj,tjg->btlg", ux, GH) < 0.5).astype(jnp.float32)
+    z_ok, z_ung_dead, onpath_g = (reach["z_ok"], reach["z_ung_dead"],
+                                  reach["onpath_g"])
+    x_only = x_ok * onpath_g[None]
+    x_not = (1.0 - x_ok) * onpath_g[None]
+
+    N = z_ok.shape[0]
+    chunk = max(1, min(int(bg_chunk or N), N))
+    z_ok_p, z_ung_p, bgw_p = pad_background(z_ok, z_ung_dead, bgw, chunk)
+    z_chunks = z_ok_p.reshape(-1, chunk, *z_ok.shape[1:])
+    zu_chunks = z_ung_p.reshape(-1, chunk, *z_ung_dead.shape[1:])
+    w_chunks = bgw_p.reshape(-1, chunk)
+
+    def one_chunk(args):
+        zc, zu, wc = args
+        u = jnp.einsum("btlg,ntlg->bntl", x_only, 1.0 - zc)
+        v = jnp.einsum("btlg,ntlg->bntl", x_not, zc)
+        dead = jnp.einsum("btlg,ntlg->bntl", x_not, 1.0 - zc)
+        alive = ((dead < 0.5) & ~zu[None]).astype(jnp.float32)
+        w_uu, w_vv, w_uv = _device_interaction_weights(u, v)
+        out = []
+        # one main-effect-shaped pass per group g: the U/V membership
+        # indicators factorise over (b-side, n-side), so fixing g turns the
+        # pairwise contraction into the same einsum family as the phi pass
+        for g in range(M):
+            ag_b, ag_n = x_only[..., g], (1.0 - zc)[..., g]     # a_g factors
+            cg_b, cg_n = x_not[..., g], zc[..., g]              # c_g factors
+            wu_g = w_uu * alive * ag_b[:, None] * ag_n[None]    # (B, n, T, L)
+            wv_g = w_vv * alive * cg_b[:, None] * cg_n[None]
+            wm_g = w_uv * alive
+            row = (
+                jnp.einsum("bntl,btlh,ntlh,tlk,n->bhk",
+                           wu_g, x_only, 1.0 - zc, leaf_val, wc)
+                + jnp.einsum("bntl,btlh,ntlh,tlk,n->bhk",
+                             wv_g, x_not, zc, leaf_val, wc)
+                + jnp.einsum("bntl,btlh,ntlh,tlk,n->bhk",
+                             wm_g * ag_b[:, None] * ag_n[None],
+                             x_not, zc, leaf_val, wc)
+                + jnp.einsum("bntl,btlh,ntlh,tlk,n->bhk",
+                             wm_g * cg_b[:, None] * cg_n[None],
+                             x_only, 1.0 - zc, leaf_val, wc)
+            )
+            out.append(row)
+        return jnp.stack(out, axis=1)           # (B, M, M, K): [b, g, h, k]
+
+    inter = jnp.sum(jax.lax.map(one_chunk, (z_chunks, zu_chunks, w_chunks)),
+                    axis=0)
+    inter = inter * (pred_t.scale * head_scale)
+    if pred_t.aggregation == "mean":
+        inter = inter / T
+    inter = jnp.moveaxis(inter, -1, 1)          # (B, K, M, M)
+    # the g-loop pairs every (g, h) including g == h; the diagonal of the
+    # pairwise index is not defined, and the shap convention replaces it
+    # with the residual main effect: off-diag I/2 each side, diag makes
+    # rows sum to phi
+    eye = jnp.eye(M, dtype=inter.dtype)
+    off = inter * (1.0 - eye) * 0.5
+    phi = exact_shap_from_reach(pred, X, reach, bgw, G, bg_chunk=bg_chunk,
+                                normalized=True)
+    diag = phi - jnp.sum(off, axis=-1)
+    return off + diag[..., None] * eye
+
+
 def exact_tree_shap(pred, X, bg, bgw, G, bg_chunk: Optional[int] = 16):
     """Exact interventional Shapley values of ``pred``'s raw margin.
 

@@ -159,6 +159,7 @@ class DistributedExplainer:
         self._jit_cache: Dict[Any, Any] = {}
         self._dev_cache: Dict[Any, Any] = {}
         self.last_raw_prediction: Optional[np.ndarray] = None
+        self.last_interaction_values: Optional[List[np.ndarray]] = None
         self.last_X_fingerprint = None
 
     def __getattr__(self, item):
@@ -227,7 +228,8 @@ class DistributedExplainer:
     def _dispatch_call(self, fn, X: np.ndarray, args):
         """Bucket-pad ``X`` to a whole number of device rows, launch ``fn``
         WITHOUT blocking (JAX dispatch is asynchronous) and return
-        ``(packed_device_array, B, padded_B)`` for :meth:`_fetch_sharded`.
+        ``(packed_device_array, B, padded_B, has_interactions)`` for
+        :meth:`_fetch_sharded`.
 
         Splitting dispatch from fetch lets a multi-slab explain enqueue
         slab k+1's compute while slab k's D2H round trip (~70 ms through a
@@ -247,20 +249,23 @@ class DistributedExplainer:
             X = np.concatenate([X, filler], 0)
         out = fn(jnp.asarray(X, jnp.float32), *args)
         # one packed D2H instead of two (tunnelled transfers are latency-bound)
-        packed_dev = jnp.concatenate(
-            [out['shap_values'].ravel(), out['raw_prediction'].ravel()])
-        return packed_dev, B, X.shape[0]
+        parts = [out['shap_values'].ravel(), out['raw_prediction'].ravel()]
+        has_inter = 'interaction_values' in out
+        if has_inter:
+            parts.append(out['interaction_values'].ravel())
+        return jnp.concatenate(parts), B, X.shape[0], has_inter
 
     def _dispatch_sharded(self, X: np.ndarray, nsamples):
         plan = self.engine._plan(nsamples)
         return self._dispatch_call(self._sharded_fn(), X,
                                    self._device_args(plan))
 
-    def _fetch_sharded(self, dispatched) -> Tuple[np.ndarray, np.ndarray]:
+    def _fetch_sharded(self, dispatched):
         """Block on one dispatched call; returns ``(shap_values, link-space
-        raw predictions)``."""
+        raw predictions)`` plus the ``(B, K, M, M)`` interaction tensor when
+        the dispatched fn produced one."""
 
-        packed_dev, B, Bp = dispatched
+        packed_dev, B, Bp, has_inter = dispatched
         engine = self.engine
         if jax.process_count() > 1:
             # multi-host mesh: the result spans non-addressable devices, so
@@ -273,8 +278,15 @@ class DistributedExplainer:
         else:
             packed = np.asarray(packed_dev)
         K, M = engine.predictor.n_outputs, engine.M
-        phi, fx = np.split(packed, [Bp * K * M])
-        return phi.reshape(Bp, K, M)[:B], fx.reshape(Bp, K)[:B]
+        phi, rest = np.split(packed, [Bp * K * M])
+        out = [phi.reshape(Bp, K, M)[:B]]
+        if has_inter:
+            fx, inter = np.split(rest, [Bp * K])
+            out.append(fx.reshape(Bp, K)[:B])
+            out.append(inter.reshape(Bp, K, M, M)[:B])
+        else:
+            out.append(rest.reshape(Bp, K)[:B])
+        return tuple(out)
 
     def _explain_sharded(self, X: np.ndarray, nsamples) -> Tuple[np.ndarray, np.ndarray]:
         """One sharded device call over the global batch ``X``; returns
@@ -282,18 +294,25 @@ class DistributedExplainer:
 
         return self._fetch_sharded(self._dispatch_sharded(X, nsamples))
 
-    def _exact_sharded_fn(self):
+    def _exact_sharded_fn(self, interactions: bool = False):
         """Closed-form interventional TreeSHAP (``ops/treeshap.py``) over
         the full 2-D mesh: the instance axis shards over ``data`` (no
         cross-instance interaction), and the background axis shards over
         ``coalition`` — each rank computes partial phi over its background
         slice (globally-normalised weights) and one ``psum`` over ICI
         combines them exactly, the same decomposition the sampled path
-        uses for its normal equations."""
+        uses for its normal equations.
 
-        if 'exact' not in self._jit_cache:
+        ``interactions`` adds the exact interaction matrices: every term of
+        the local matrix (off-diagonals AND the diagonal's ``phi - row-sum``
+        residual) is linear in the background contributions, so the psum of
+        per-shard matrices IS the global matrix."""
+
+        key = ('exact', interactions)
+        if key not in self._jit_cache:
             from distributedkernelshap_tpu.ops.treeshap import (
                 background_reach,
+                exact_interactions_from_reach,
                 exact_shap_from_reach,
             )
 
@@ -301,20 +320,28 @@ class DistributedExplainer:
             pred = engine.predictor
             precision = engine.config.shap.matmul_precision
             n_coal = self.mesh.shape[COALITION_AXIS]
-            with jax.default_matmul_precision(precision):
-                reach = jax.jit(lambda bg, G: background_reach(pred, bg, G))(
-                    jnp.asarray(engine.background), jnp.asarray(engine.G))
+            if 'exact_reach' not in self._jit_cache:
+                # reach tensors + padded weights depend only on
+                # (background, G, mesh) — shared by both exact fn variants
+                with jax.default_matmul_precision(precision):
+                    reach = jax.jit(
+                        lambda bg, G: background_reach(pred, bg, G))(
+                            jnp.asarray(engine.background),
+                            jnp.asarray(engine.G))
 
-            # globally-normalised weights; pad the background axis to a
-            # whole number of coalition shards with zero-weight rows (their
-            # phi contribution is exactly 0 — shared helper with the
-            # chunking path so the padding invariant lives in one place)
-            from distributedkernelshap_tpu.ops.treeshap import pad_background
+                # globally-normalised weights; pad the background axis to a
+                # whole number of coalition shards with zero-weight rows
+                # (their phi contribution is exactly 0 — shared helper with
+                # the chunking path so the padding invariant lives in one
+                # place)
+                from distributedkernelshap_tpu.ops.treeshap import pad_background
 
-            bgw = np.asarray(engine.bg_weights, np.float64)
-            bgw = jnp.asarray((bgw / bgw.sum()).astype(np.float32))
-            z_ok, z_ung, bgw = pad_background(
-                reach['z_ok'], reach['z_ung_dead'], bgw, n_coal)
+                bgw0 = np.asarray(engine.bg_weights, np.float64)
+                bgw0 = jnp.asarray((bgw0 / bgw0.sum()).astype(np.float32))
+                self._jit_cache['exact_reach'] = (
+                    reach, pad_background(reach['z_ok'],
+                                          reach['z_ung_dead'], bgw0, n_coal))
+            reach, (z_ok, z_ung, bgw) = self._jit_cache['exact_reach']
 
             def body(Xl, bgw_l, G, z_ok_l, z_ung_l, onpath_g):
                 r = {'z_ok': z_ok_l, 'z_ung_dead': z_ung_l,
@@ -322,18 +349,27 @@ class DistributedExplainer:
                 with jax.default_matmul_precision(precision):
                     phi_local = exact_shap_from_reach(pred, Xl, r, bgw_l, G,
                                                       normalized=True)
-                    return {
+                    out = {
                         'shap_values': jax.lax.psum(phi_local, COALITION_AXIS),
                         'raw_prediction': pred(Xl),
                     }
+                    if interactions:
+                        inter_local = exact_interactions_from_reach(
+                            pred, Xl, r, bgw_l, G, normalized=True)
+                        out['interaction_values'] = jax.lax.psum(
+                            inter_local, COALITION_AXIS)
+                    return out
 
+            out_specs = {'shap_values': P(DATA_AXIS),
+                         'raw_prediction': P(DATA_AXIS)}
+            if interactions:
+                out_specs['interaction_values'] = P(DATA_AXIS)
             sharded = jax.shard_map(
                 body,
                 mesh=self.mesh,
                 in_specs=(P(DATA_AXIS), P(COALITION_AXIS), P(),
                           P(COALITION_AXIS), P(COALITION_AXIS), P()),
-                out_specs={'shap_values': P(DATA_AXIS),
-                           'raw_prediction': P(DATA_AXIS)},
+                out_specs=out_specs,
                 check_vma=False,
             )
             shard = NamedSharding(self.mesh, P(DATA_AXIS))
@@ -347,14 +383,18 @@ class DistributedExplainer:
                     jax.device_put(z_ok, coal),
                     jax.device_put(z_ung, coal),
                     jax.device_put(reach['onpath_g'], repl))
+            out_sh = {'shap_values': shard, 'raw_prediction': shard}
+            if interactions:
+                out_sh['interaction_values'] = shard
             jitted = jax.jit(
                 sharded,
                 in_shardings=(shard, coal, repl, coal, coal, repl),
-                out_shardings={'shap_values': shard, 'raw_prediction': shard})
-            self._jit_cache['exact'] = (jitted, args)
-        return self._jit_cache['exact']
+                out_shardings=out_sh)
+            self._jit_cache[key] = (jitted, args)
+        return self._jit_cache[key]
 
-    def _explain_exact_sharded(self, X: np.ndarray, l1_reg) -> Any:
+    def _explain_exact_sharded(self, X: np.ndarray, l1_reg,
+                               interactions: bool = False) -> Any:
         from distributedkernelshap_tpu.ops.treeshap import validate_exact
 
         engine = self.engine
@@ -375,7 +415,7 @@ class DistributedExplainer:
         else:
             slabs = [X]
 
-        fn, args = self._exact_sharded_fn()
+        fn, args = self._exact_sharded_fn(interactions=interactions)
         from collections import deque
 
         window = 3
@@ -391,6 +431,10 @@ class DistributedExplainer:
         phi = np.concatenate([r[0] for r in results], 0)[:B]
         self.last_raw_prediction = np.concatenate(
             [r[1] for r in results], 0)[:B]
+        if interactions:
+            inter = np.concatenate([r[2] for r in results], 0)[:B]
+            self.last_interaction_values = [inter[:, k]
+                                            for k in range(inter.shape[1])]
         from distributedkernelshap_tpu.kernel_shap import _fingerprint
 
         self.last_X_fingerprint = _fingerprint(X[:B])
@@ -408,9 +452,20 @@ class DistributedExplainer:
         nsamples = kwargs.pop('nsamples', None)
         kwargs.pop('silent', None)
         l1_reg = kwargs.pop('l1_reg', 'auto')
+        interactions = kwargs.pop('interactions', False)
+        if interactions and nsamples != 'exact':
+            raise ValueError(
+                "interactions=True requires nsamples='exact' (closed-form "
+                "interventional TreeSHAP); the sampled KernelSHAP estimator "
+                "does not produce interaction values.")
+        if not interactions:
+            # never let interaction tensors from an earlier explain pair
+            # with this call's fingerprint/raw predictions
+            self.last_interaction_values = None
 
         if nsamples == 'exact':
-            return self._explain_exact_sharded(X, l1_reg)
+            return self._explain_exact_sharded(X, l1_reg,
+                                               interactions=interactions)
 
         X = np.atleast_2d(np.asarray(X, dtype=np.float32))
         B = X.shape[0]

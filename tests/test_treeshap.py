@@ -419,3 +419,216 @@ def test_device_beta_weights_match_f64_table():
     wp, wm = _device_beta_weights(u, v)
     assert np.abs(np.asarray(wp) - wp_tab).max() < 2e-6
     assert np.abs(np.asarray(wm) - wm_tab).max() < 2e-6
+
+
+def _brute_force_interactions(pred, x, bg, groups):
+    """Shapley interaction index by full enumeration over group coalitions
+    of the REAL model expectation game — the definition itself."""
+
+    M = len(groups)
+
+    def f(S):
+        rows = bg.copy()
+        cols = [c for g in S for c in groups[g]]
+        rows[:, cols] = x[cols]
+        return float(np.asarray(pred(rows.astype(np.float32)))[:, 0].mean())
+
+    I = np.zeros((M, M))
+    for i, j in itertools.combinations(range(M), 2):
+        rest = [m for m in range(M) if m not in (i, j)]
+        for r in range(M - 1):
+            for S in itertools.combinations(rest, r):
+                w = factorial(r) * factorial(M - r - 2) / factorial(M - 1)
+                d = (f(set(S) | {i, j}) - f(set(S) | {i})
+                     - f(set(S) | {j}) + f(set(S)))
+                I[i, j] += w * d
+        I[j, i] = I[i, j]
+    return I
+
+
+def test_interaction_weights_brute_force():
+    """_device_interaction_weights' closed form == enumeration of the
+    interaction index over random conjunction games [U<=T][V&T=0]."""
+
+    import jax.numpy as jnp
+
+    from distributedkernelshap_tpu.ops.treeshap import (
+        _device_interaction_weights,
+    )
+
+    rng = np.random.default_rng(0)
+    for _ in range(60):
+        M = int(rng.integers(2, 7))
+        k = int(rng.integers(0, M + 1))
+        members = rng.permutation(M)[:k]
+        cut = int(rng.integers(0, k + 1)) if k else 0
+        U, V = set(members[:cut].tolist()), set(members[cut:].tolist())
+        u, v = len(U), len(V)
+
+        fgame = lambda T: float(U <= set(T) and not (V & set(T)))
+        w_uu, w_vv, w_uv = [
+            float(np.asarray(w)) for w in _device_interaction_weights(
+                jnp.asarray(float(u)), jnp.asarray(float(v)))]
+        for i, j in itertools.combinations(range(M), 2):
+            rest = [m for m in range(M) if m not in (i, j)]
+            want = 0.0
+            for r in range(M - 1):
+                for S in itertools.combinations(rest, r):
+                    w = factorial(r) * factorial(M - r - 2) / factorial(M - 1)
+                    want += w * (fgame(S + (i, j)) - fgame(S + (i,))
+                                 - fgame(S + (j,)) + fgame(S))
+            if i in U and j in U:
+                got = w_uu
+            elif i in V and j in V:
+                got = w_vv
+            elif {i, j} <= U | V:
+                got = w_uv
+            else:
+                got = 0.0
+            assert abs(got - want) < 1e-6, (M, U, V, i, j, got, want)
+
+
+def test_exact_interactions_match_brute_force(gbt_setup):
+    """exact_interactions_from_reach == enumeration of the interaction
+    index on the real lifted GBT, plus the shap conventions (symmetry,
+    rows sum to phi, total sums to f - E)."""
+
+    from distributedkernelshap_tpu.ops.treeshap import (
+        background_reach,
+        exact_interactions_from_reach,
+        exact_shap_from_reach,
+    )
+
+    pred, X = gbt_setup["pred"], gbt_setup["X"]
+    bg = X[50:70]
+    bgw = np.full(bg.shape[0], 1.0 / bg.shape[0], np.float32)
+    groups = [[0], [1], [2], [3], [4], [5]]
+    G = groups_to_matrix(groups, X.shape[1])
+    reach = background_reach(pred, bg, G)
+    inter = np.asarray(exact_interactions_from_reach(
+        pred, X[:3], reach, bgw, G))             # (B, K, M, M)
+    phi = np.asarray(exact_shap_from_reach(pred, X[:3], reach, bgw, G))
+
+    # symmetry + row sums + total
+    np.testing.assert_allclose(inter, np.swapaxes(inter, -1, -2), atol=1e-5)
+    np.testing.assert_allclose(inter.sum(-1), phi, atol=1e-5)
+    fx = np.asarray(pred(X[:3]))[:, 0]
+    e = float(np.asarray(pred(bg))[:, 0].mean())
+    np.testing.assert_allclose(inter[:, 0].sum((-1, -2)), fx - e, atol=1e-4)
+
+    # off-diagonals against the definition (I_ij split across both slots)
+    for b in range(2):
+        I = _brute_force_interactions(pred, X[b], bg.copy(), groups)
+        got = inter[b, 0]
+        off = ~np.eye(len(groups), dtype=bool)
+        np.testing.assert_allclose(got[off], (I / 2.0)[off], atol=1e-5)
+
+
+def test_exact_interactions_grouped(gbt_setup):
+    """Grouped columns: same conventions hold at group granularity."""
+
+    from distributedkernelshap_tpu.ops.treeshap import (
+        background_reach,
+        exact_interactions_from_reach,
+        exact_shap_from_reach,
+    )
+
+    pred, X = gbt_setup["pred"], gbt_setup["X"]
+    bg = X[50:66]
+    bgw = np.full(bg.shape[0], 1.0 / bg.shape[0], np.float32)
+    groups = [[0, 3], [1], [2, 4, 5]]
+    G = groups_to_matrix(groups, X.shape[1])
+    reach = background_reach(pred, bg, G)
+    inter = np.asarray(exact_interactions_from_reach(
+        pred, X[:2], reach, bgw, G))
+    phi = np.asarray(exact_shap_from_reach(pred, X[:2], reach, bgw, G))
+    np.testing.assert_allclose(inter, np.swapaxes(inter, -1, -2), atol=1e-5)
+    np.testing.assert_allclose(inter.sum(-1), phi, atol=1e-5)
+    I = _brute_force_interactions(pred, X[0], bg.copy(), groups)
+    off = ~np.eye(len(groups), dtype=bool)
+    np.testing.assert_allclose(inter[0, 0][off], (I / 2.0)[off], atol=1e-5)
+
+
+def test_interactions_engine_and_public_api(gbt_setup):
+    """interactions=True through the engine and the public KernelShap:
+    tensors attach to the Explanation, rows sum to the shap values, and
+    the sampled path rejects the flag."""
+
+    from distributedkernelshap_tpu import KernelShap
+
+    s = gbt_setup
+    eng = KernelExplainerEngine(s["pred"], s["X"][:10], link="identity", seed=0)
+    sv = eng.get_explanation(s["X"][:5], nsamples="exact", interactions=True)
+    inter = eng.last_interaction_values
+    assert isinstance(inter, list) and inter[0].shape == (5, 6, 6)
+    np.testing.assert_allclose(inter[0].sum(-1), np.asarray(sv[0])
+                               if isinstance(sv, list) else np.asarray(sv),
+                               atol=1e-5)
+
+    with pytest.raises(ValueError, match="nsamples='exact'"):
+        eng.get_explanation(s["X"][:5], nsamples=64, interactions=True)
+
+    ex = KernelShap(s["gbt"].predict, link="identity", seed=0)
+    ex.fit(s["X"][:10])
+    res = ex.explain(s["X"][:5], nsamples="exact", interactions=True)
+    got = res.data["raw"]["interaction_values"]
+    assert got[0].shape == (5, 6, 6)
+    np.testing.assert_allclose(got[0].sum(-1), res.shap_values[0], atol=1e-5)
+
+
+def test_interactions_sharded_matches_single_device(gbt_setup):
+    """Exact interactions through the DistributedExplainer (instance axis
+    + background axis over the coalition axis, psum'd local matrices — the
+    whole matrix is linear in background contributions) == single device,
+    with slab batching."""
+
+    from distributedkernelshap_tpu.parallel.distributed import DistributedExplainer
+
+    s = gbt_setup
+    Xe = s["X"][50:63]
+    seq = KernelExplainerEngine(s["pred"], s["X"][:10], link="identity", seed=0)
+    seq.get_explanation(Xe, nsamples="exact", interactions=True)
+    want = seq.last_interaction_values[0]
+
+    for opts in ({"n_devices": 8},
+                 {"n_devices": 8, "coalition_parallel": 4},
+                 {"n_devices": 8, "batch_size": 2}):
+        dist = DistributedExplainer(
+            {**opts, "algorithm": "kernel_shap"},
+            KernelExplainerEngine, (s["pred"], s["X"][:10]),
+            {"link": "identity", "seed": 0})
+        dist.get_explanation(Xe, nsamples="exact", interactions=True)
+        got = dist.last_interaction_values[0]
+        np.testing.assert_allclose(got, want, atol=1e-5, err_msg=str(opts))
+
+
+def test_interactions_stale_state_cleared(gbt_setup):
+    """A later explain without interactions must not leave earlier
+    interaction tensors paired with the new fingerprint."""
+
+    s = gbt_setup
+    eng = KernelExplainerEngine(s["pred"], s["X"][:10], link="identity", seed=0)
+    eng.get_explanation(s["X"][:4], nsamples="exact", interactions=True)
+    assert eng.last_interaction_values is not None
+    eng.get_explanation(s["X"][4:8], nsamples="exact")
+    assert eng.last_interaction_values is None
+
+
+def test_interactions_summarise_consistent_with_shap_values(gbt_setup):
+    """summarise_result must apply to the interaction tensors exactly when
+    it applied to the shap values (post-validation decision), keeping the
+    row-sum invariant."""
+
+    from distributedkernelshap_tpu import KernelShap
+
+    s = gbt_setup
+    ex = KernelShap(s["gbt"].predict, link="identity", seed=0)
+    ex.fit(s["X"][:10])
+    res = ex.explain(s["X"][:3], nsamples="exact", interactions=True,
+                     summarise_result=True, cat_vars_start_idx=[0],
+                     cat_vars_enc_dim=[2])
+    inter = res.data["raw"]["interaction_values"]
+    assert inter[0].shape == (3, 5, 5)          # 6 cols -> 5 groups
+    assert np.asarray(res.shap_values[0]).shape == (3, 5)
+    np.testing.assert_allclose(inter[0].sum(-1), res.shap_values[0],
+                               atol=1e-5)
