@@ -256,14 +256,21 @@ def config_adult_trees_exact(smoke=False):
                                    nsamples="exact")
     t_sampled, _ = _timed_explain(ex, X, nruns=1 if smoke else 3,
                                   l1_reg=False)
+    t_inter, expl_i = _timed_explain(ex, X, nruns=1 if smoke else 3,
+                                     nsamples="exact", interactions=True)
     total = np.asarray(expl.shap_values).sum(-1).ravel() \
         + np.ravel(expl.expected_value)[0]
     err = float(np.abs(total - gbr.predict(X.astype(np.float64))).max())
+    inter = expl_i.data["raw"]["interaction_values"][0]
+    inter_err = float(np.abs(inter.sum(-1)
+                             - np.asarray(expl_i.shap_values[0])).max())
     return {"metric": "adult_trees_exact_wall_s", "value": round(t_exact, 4),
             "unit": "s", "n_instances": X.shape[0],
             "sampled_wall_s": round(t_sampled, 4),
             "speedup_vs_sampled": round(t_sampled / t_exact, 2),
-            "model_err": err}
+            "model_err": err,
+            "interactions_wall_s": round(t_inter, 4),
+            "interactions_rowsum_err": inter_err}
 
 
 def config_model_zoo(smoke=False):

@@ -577,7 +577,8 @@ class KernelExplainerEngine:
     def get_explanation_async(self,
                               X: np.ndarray,
                               nsamples: Union[str, int, None] = None,
-                              l1_reg: Union[str, float, int, None] = 'auto'):
+                              l1_reg: Union[str, float, int, None] = 'auto',
+                              interactions: bool = False):
         """Asynchronous variant of :meth:`get_explanation` for the serving
         pipeline: dispatches the device work for ``X`` immediately and
         returns ``finalize() -> (values, info)`` where ``values`` matches
@@ -592,7 +593,7 @@ class KernelExplainerEngine:
         needs_chunking = (self.config.instance_chunk
                           and X.shape[0] > self.config.instance_chunk)
         if (self.config.host_eval or needs_chunking or nsamples == 'exact'
-                or self._l1_active(l1_reg, nsamples)):
+                or interactions or self._l1_active(l1_reg, nsamples)):
             # these paths don't gain from pipelining (host-eval is
             # host-bound; the l1 path re-dispatches device work and runs
             # sklearn lars; over-chunk batches must honour instance_chunk's
@@ -603,12 +604,18 @@ class KernelExplainerEngine:
             # (nsamples='exact' also lands here: its jitted fn is built
             # lazily on the dispatcher thread like every other cache)
             values = self.get_explanation(X, nsamples=nsamples,
-                                          l1_reg=l1_reg, silent=True)
+                                          l1_reg=l1_reg, silent=True,
+                                          interactions=interactions)
             info = {
                 'raw_prediction': self.last_raw_prediction,
                 'expected_value': np.atleast_1d(
                     np.asarray(self.expected_value, dtype=np.float32)),
             }
+            if interactions:
+                # captured HERE (dispatcher thread, before the next batch's
+                # dispatch can overwrite engine state) rather than read by
+                # finalizer threads later
+                info['interaction_values'] = self.last_interaction_values
             return lambda: (values, info)
 
         plan = self._plan(nsamples)

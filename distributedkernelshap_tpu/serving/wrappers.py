@@ -26,7 +26,7 @@ logger = logging.getLogger(__name__)
 # request path supports — including the pipelined get_explanation_async,
 # whose signature has no **kwargs ('silent' would additionally collide
 # with the hard-coded silent=True of the serving calls)
-_EXPLAIN_KWARG_KEYS = ("nsamples", "l1_reg")
+_EXPLAIN_KWARG_KEYS = ("nsamples", "l1_reg", "interactions")
 
 
 def _check_explain_kwargs(explain_kwargs) -> Dict[str, Any]:
@@ -36,6 +36,12 @@ def _check_explain_kwargs(explain_kwargs) -> Dict[str, Any]:
         raise ValueError(
             f"explain_kwargs supports only {_EXPLAIN_KWARG_KEYS} (the keys "
             f"every serving request path accepts); got {bad}")
+    if kwargs.get("interactions") and kwargs.get("nsamples") != "exact":
+        # value-level coupling checked here so a misconfigured deployment
+        # fails at construction, not on every request
+        raise ValueError(
+            "explain_kwargs={'interactions': True} requires "
+            "'nsamples': 'exact' (closed-form interventional TreeSHAP)")
     return kwargs
 
 
@@ -98,7 +104,8 @@ class KernelShapModel:
 
     def _resplit_payloads(self, instances: np.ndarray, shap_values,
                           expected_value, raw_predictions: np.ndarray,
-                          split_sizes: List[int]) -> List[str]:
+                          split_sizes: List[int],
+                          interaction_values=None) -> List[str]:
         """Re-split one batched run into per-request Explanation JSON,
         reusing the batched raw outputs (no per-slice predictor pass)."""
 
@@ -114,6 +121,9 @@ class KernelShapModel:
                 e_val,
                 raw_predictions=raw_predictions[sl],
             )
+            if interaction_values is not None:
+                piece.data['raw']['interaction_values'] = [
+                    v[sl] for v in interaction_values]
             payloads.append(piece.to_json())
             offset += size
         return payloads
@@ -129,7 +139,9 @@ class KernelShapModel:
             split_sizes = [1] * instances.shape[0]
         return self._resplit_payloads(
             instances, explanation.shap_values, explanation.expected_value,
-            explanation.data["raw"]["raw_prediction"], split_sizes)
+            explanation.data["raw"]["raw_prediction"], split_sizes,
+            interaction_values=explanation.data["raw"].get(
+                "interaction_values"))
 
     def explain_batch_async(self, instances: np.ndarray,
                             split_sizes: Optional[List[int]] = None):
@@ -161,7 +173,8 @@ class KernelShapModel:
             values, info = fin()
             return self._resplit_payloads(
                 instances, values, info["expected_value"],
-                info["raw_prediction"], sizes)
+                info["raw_prediction"], sizes,
+                interaction_values=info.get("interaction_values"))
 
         return finalize
 

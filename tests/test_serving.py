@@ -385,3 +385,49 @@ def test_explain_kwargs_validated_at_construction(model_setup):
     with pytest.raises(ValueError, match="explain_kwargs"):
         KernelShapModel(s["pred"], s["bg"], s["constructor_kwargs"],
                         s["fit_kwargs"], explain_kwargs={"silent": False})
+
+
+def test_serving_exact_interactions():
+    """explain_kwargs={'nsamples': 'exact', 'interactions': True}: every
+    response carries its slice of the interaction matrices, matching a
+    direct explain (batched responses must re-split the tensors)."""
+
+    from sklearn.ensemble import HistGradientBoostingRegressor
+
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.serving.server import serve_explainer
+
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(160, 5)).astype(np.float64)
+    y = X[:, 0] - np.where(X[:, 2] > 0, 1.0, -1.0) * X[:, 3]
+    gbr = HistGradientBoostingRegressor(max_iter=8, random_state=0).fit(X, y)
+    bg = X[:12].astype(np.float32)
+    srv = serve_explainer(
+        gbr.predict, bg, {"seed": 0}, {}, port=0, max_batch_size=4,
+        pipeline_depth=2,
+        explain_kwargs={"nsamples": "exact", "interactions": True})
+    try:
+        url = f"http://127.0.0.1:{srv.port}/explain"
+        Xe = X[100:106].astype(np.float32)
+        payloads = distribute_requests(url, Xe)
+        direct = KernelShap(gbr.predict, seed=0)
+        direct.fit(bg)
+        res = direct.explain(Xe, silent=True, nsamples="exact",
+                             interactions=True)
+        want = np.asarray(res.data["raw"]["interaction_values"][0])
+        for i in range(Xe.shape[0]):
+            data = json.loads(payloads[i])["data"]
+            iv = data["raw"]["interaction_values"]
+            assert isinstance(iv, list) and len(iv) == 1   # list of K tensors
+            got = np.asarray(iv[0])
+            assert got.shape == (1, 5, 5), got.shape
+            np.testing.assert_allclose(got[0], want[i], atol=1e-5)
+    finally:
+        srv.stop()
+
+
+def test_serving_interactions_require_exact_at_construction(model_setup):
+    s = model_setup
+    with pytest.raises(ValueError, match="exact"):
+        KernelShapModel(s["pred"], s["bg"], s["constructor_kwargs"],
+                        s["fit_kwargs"], explain_kwargs={"interactions": True})
