@@ -11,7 +11,10 @@ DCN stand-in), the way the reference's k8s Makefiles drove
 2. the lead process wrote the reference-format result pickle;
 3. the multi-process SHAP values byte-match across processes and agree with
    a single-process run of the same plan (the sequential == distributed
-   oracle of SURVEY.md §4, across a real process boundary).
+   oracle of SURVEY.md §4, across a real process boundary);
+4. exact TreeSHAP interaction matrices byte-match across processes and
+   agree with a single-process run (the psum-of-local-matrices
+   decomposition, across the same boundary).
 
 Prints ONE JSON line and exits 0/1 — suitable for cron/CI.
 
@@ -47,8 +50,9 @@ from distributedkernelshap_tpu.parallel.mesh import initialize_multihost
 initialize_multihost("127.0.0.1:" + sys.argv[2], 2, pid)
 assert jax.process_count() == 2
 import numpy as np
-from benchmarks.multihost_ci import explain_adult_slice
-np.save(sys.argv[3] + "/phi_" + str(pid) + ".npy", explain_adult_slice())
+import benchmarks.multihost_ci as ci
+fn = getattr(ci, sys.argv[5])
+np.save(sys.argv[3] + "/" + sys.argv[5] + "_" + str(pid) + ".npy", fn())
 """
 
 
@@ -68,6 +72,28 @@ def explain_adult_slice(n_devices: int = N_DEVICES) -> np.ndarray:
     ex.fit(bg, group_names=gn, groups=g)
     sv = ex.explain(X, silent=True, nsamples=NSAMPLES, l1_reg=False).shap_values
     return np.stack(sv, 1)
+
+
+def explain_exact_interactions_slice(n_devices: int = N_DEVICES) -> np.ndarray:
+    """Shared recipe: exact TreeSHAP interaction matrices for a small GBT,
+    sharded over the mesh (deterministic synthetic fit, so every process
+    trains the identical model)."""
+
+    from sklearn.ensemble import GradientBoostingRegressor
+
+    from distributedkernelshap_tpu import KernelShap
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(200, 5))
+    y = X[:, 0] * np.where(X[:, 1] > 0, 1.0, -2.0) + 0.5 * X[:, 3]
+    gbt = GradientBoostingRegressor(n_estimators=6, max_depth=3,
+                                    random_state=0).fit(X, y)
+    ex = KernelShap(gbt.predict, seed=0,
+                    distributed_opts={"n_devices": n_devices})
+    ex.fit(X[:16].astype(np.float32))
+    res = ex.explain(X[:24].astype(np.float32), silent=True,
+                     nsamples="exact", interactions=True)
+    return np.stack(res.data["raw"]["interaction_values"], 1)
 
 
 def _free_port() -> int:
@@ -138,17 +164,29 @@ def main() -> int:
             checks["pool_benchmark_2proc"] = "ok"
 
             # --- leg 2: cross-process phi equivalence --------------------
-            port = _free_port()
             worker = os.path.join(tmp, "worker.py")
             with open(worker, "w") as f:
                 f.write(_PHI_WORKER)
-            _run_two(lambda pid: [
-                sys.executable, worker, str(pid), str(port), tmp, REPO],
-                tmp, args.timeout)
-            phi0 = np.load(os.path.join(tmp, "phi_0.npy"))
-            phi1 = np.load(os.path.join(tmp, "phi_1.npy"))
-            np.testing.assert_array_equal(phi0, phi1)
+
+            def run_recipe(name: str) -> np.ndarray:
+                """Two coupled processes run recipe ``name``; byte-equality
+                of their outputs asserted, the shared value returned."""
+
+                rp = _free_port()
+                _run_two(lambda pid: [
+                    sys.executable, worker, str(pid), str(rp), tmp, REPO,
+                    name], tmp, args.timeout)
+                out0 = np.load(os.path.join(tmp, f"{name}_0.npy"))
+                out1 = np.load(os.path.join(tmp, f"{name}_1.npy"))
+                np.testing.assert_array_equal(out0, out1)
+                return out0
+
+            phi0 = run_recipe("explain_adult_slice")
             checks["phi_identical_across_processes"] = "ok"
+
+            # --- leg 3: exact TreeSHAP interactions across processes -----
+            iv0 = run_recipe("explain_exact_interactions_slice")
+            checks["interactions_identical_across_processes"] = "ok"
 
             # single-process reference on this process's own devices
             import jax
@@ -157,6 +195,9 @@ def main() -> int:
             jax.config.update("jax_num_cpu_devices", N_DEVICES)
             np.testing.assert_allclose(phi0, explain_adult_slice(), atol=1e-5)
             checks["phi_matches_single_process"] = "ok"
+            np.testing.assert_allclose(iv0, explain_exact_interactions_slice(),
+                                       atol=1e-5)
+            checks["interactions_match_single_process"] = "ok"
     except Exception as e:  # noqa: BLE001 - CI driver reports, never raises
         checks["error"] = f"{type(e).__name__}: {e}"
         print(json.dumps({"multihost_ci": "fail", **checks}))
