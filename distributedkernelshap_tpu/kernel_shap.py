@@ -14,6 +14,7 @@ Reference parity notes are cited per method as ``kernel_shap.py:<lines>``.
 
 import copy
 import logging
+from collections import deque
 import math
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -702,18 +703,25 @@ class KernelExplainerEngine:
         if len(chunks) > 1 and not self.config.host_eval:
             # dispatch ahead of the fetches so the per-chunk D2H round trips
             # (~70ms each through a tunnelled TPU) overlap across threads —
-            # but in bounded waves, so a huge X doesn't enqueue thousands of
-            # executions (and their device-resident buffers) at once
+            # bounded to a SLIDING window (not waves: a wave barrier idles
+            # the device during each wave's tail fetches), so a huge X never
+            # enqueues thousands of executions (and their device-resident
+            # buffers) at once.  Dispatch stays on this thread (it populates
+            # the jit/plan caches); only the fetches fan out.
             window = 8
             with profiler().phase('coalition_plan'):
                 plan = self._plan(nsamples)
             with profiler().phase('device_explain'):
+                pending: deque = deque()
                 results = []
                 with ThreadPoolExecutor(max_workers=window) as pool:
-                    for w0 in range(0, len(chunks), window):
-                        finalizers = [self._dispatch_array(c, plan)
-                                      for c in chunks[w0:w0 + window]]
-                        results.extend(pool.map(lambda f: f(), finalizers))
+                    for c in chunks:
+                        fin = self._dispatch_array(c, plan)
+                        pending.append(pool.submit(fin))
+                        if len(pending) >= window:
+                            results.append(pending.popleft().result())
+                    while pending:
+                        results.append(pending.popleft().result())
         else:
             results = [self._explain_array(c, nsamples, silent=silent)
                        for c in chunks]
