@@ -777,15 +777,20 @@ class KernelExplainerEngine:
                             jnp.asarray(self.background), jnp.asarray(self.G))
             reach = self._fn_cache['exact_reach']
 
+            budget = self.config.shap.target_chunk_elems
+
             def fn(Xc, bgw, G, reach=reach):
                 with jax.default_matmul_precision(precision):
                     out = {'shap_values':
-                           exact_shap_from_reach(pred, Xc, reach, bgw, G),
+                           exact_shap_from_reach(
+                               pred, Xc, reach, bgw, G,
+                               target_chunk_elems=budget),
                            'raw_prediction': pred(Xc)}
                     if interactions:
                         out['interaction_values'] = \
-                            exact_interactions_from_reach(pred, Xc, reach,
-                                                          bgw, G)
+                            exact_interactions_from_reach(
+                                pred, Xc, reach, bgw, G,
+                                target_chunk_elems=budget)
                     return out
 
             self._fn_cache[key] = jax.jit(fn)

@@ -128,6 +128,22 @@ def _device_beta_weights(u, v):
     return wp, wm
 
 
+def _bounded_bg_chunk(bg_chunk, N: int, B: int, T: int, L: int,
+                      budget: Optional[int] = None) -> int:
+    """Background chunk for the pairwise pass.  An EXPLICIT ``bg_chunk``
+    wins (bounded to ``[1, N]`` only — the codebase convention for chunk
+    overrides); ``None`` auto-sizes: 16 (right at benchmark shapes) capped
+    so the ``(B, chunk, T, L)`` intermediates respect ``budget`` elements
+    (``target_chunk_elems``; the default matches ``ShapConfig``'s)."""
+
+    if bg_chunk is not None:
+        return max(1, min(int(bg_chunk), N))
+    from distributedkernelshap_tpu.models._chunking import DEFAULT_CHUNK_ELEMS
+
+    cap = max(1, (budget or DEFAULT_CHUNK_ELEMS) // max(1, B * T * L))
+    return max(1, min(16, N, cap))
+
+
 def _unsat(pred, rows, onpath, want_left):
     """``unsat[r, t, l, j]``: on-path node ``j`` of leaf ``(t, l)`` whose
     branch row ``r`` does NOT take (0 off-path)."""
@@ -182,15 +198,17 @@ def pad_background(z_ok, z_ung_dead, bgw, multiple: int):
 
 
 def exact_shap_from_reach(pred, X, reach, bgw, G,
-                          bg_chunk: Optional[int] = 16,
-                          normalized: bool = False):
+                          bg_chunk: Optional[int] = None,
+                          normalized: bool = False,
+                          target_chunk_elems: Optional[int] = None):
     """Exact phi ``(B, K, M)`` for ``X`` given precomputed background reach
     tensors (:func:`background_reach`).
 
     The pairwise ``(B, N)`` interaction is the heavy axis; the background
-    is processed in ``bg_chunk``-row chunks via ``lax.map`` with partial
-    phi sums, so peak memory is ``B x bg_chunk x T x L`` rather than the
-    full ``B x N`` block.
+    is processed in chunks via ``lax.map`` with partial phi sums, so peak
+    memory is ``B x chunk x T x L`` rather than the full ``B x N`` block.
+    An explicit ``bg_chunk`` is honoured as passed; ``None`` (default)
+    auto-sizes against ``target_chunk_elems`` (see ``_bounded_bg_chunk``).
 
     ``normalized=True`` skips the internal weight normalisation — for
     callers that shard the background axis across devices and psum the
@@ -220,7 +238,8 @@ def exact_shap_from_reach(pred, X, reach, bgw, G,
     x_not = (1.0 - x_ok) * onpath_g[None]       # groups x fails
 
     N = z_ok.shape[0]
-    chunk = max(1, min(int(bg_chunk or N), N))
+    chunk = _bounded_bg_chunk(bg_chunk, N, X.shape[0], T, leaf_val.shape[1],
+                              budget=target_chunk_elems)
     z_ok_p, z_ung_p, bgw_p = pad_background(z_ok, z_ung_dead, bgw, chunk)
     z_chunks = z_ok_p.reshape(-1, chunk, *z_ok.shape[1:])
     zu_chunks = z_ung_p.reshape(-1, chunk, *z_ung_dead.shape[1:])
@@ -277,8 +296,9 @@ def _device_interaction_weights(u, v):
 
 
 def exact_interactions_from_reach(pred, X, reach, bgw, G,
-                                  bg_chunk: Optional[int] = 16,
-                                  normalized: bool = False):
+                                  bg_chunk: Optional[int] = None,
+                                  normalized: bool = False,
+                                  target_chunk_elems: Optional[int] = None):
     """Exact interventional Shapley **interaction** values ``(B, K, M, M)``
     for ``X`` given precomputed background reach tensors.
 
@@ -322,7 +342,8 @@ def exact_interactions_from_reach(pred, X, reach, bgw, G,
     x_not = (1.0 - x_ok) * onpath_g[None]
 
     N = z_ok.shape[0]
-    chunk = max(1, min(int(bg_chunk or N), N))
+    chunk = _bounded_bg_chunk(bg_chunk, N, X.shape[0], T, leaf_val.shape[1],
+                              budget=target_chunk_elems)
     z_ok_p, z_ung_p, bgw_p = pad_background(z_ok, z_ung_dead, bgw, chunk)
     z_chunks = z_ok_p.reshape(-1, chunk, *z_ok.shape[1:])
     zu_chunks = z_ung_p.reshape(-1, chunk, *z_ung_dead.shape[1:])
@@ -373,12 +394,13 @@ def exact_interactions_from_reach(pred, X, reach, bgw, G,
     eye = jnp.eye(M, dtype=inter.dtype)
     off = inter * (1.0 - eye) * 0.5
     phi = exact_shap_from_reach(pred, X, reach, bgw, G, bg_chunk=bg_chunk,
-                                normalized=True)
+                                normalized=True,
+                                target_chunk_elems=target_chunk_elems)
     diag = phi - jnp.sum(off, axis=-1)
     return off + diag[..., None] * eye
 
 
-def exact_tree_shap(pred, X, bg, bgw, G, bg_chunk: Optional[int] = 16):
+def exact_tree_shap(pred, X, bg, bgw, G, bg_chunk: Optional[int] = None):
     """Exact interventional Shapley values of ``pred``'s raw margin.
 
     Parameters mirror the sampled pipeline: ``X (B, D)`` instances,

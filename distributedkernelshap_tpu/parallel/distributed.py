@@ -319,6 +319,7 @@ class DistributedExplainer:
             engine = self.engine
             pred = engine.predictor
             precision = engine.config.shap.matmul_precision
+            budget = engine.config.shap.target_chunk_elems
             n_coal = self.mesh.shape[COALITION_AXIS]
             if 'exact_reach' not in self._jit_cache:
                 # reach tensors + padded weights depend only on
@@ -347,15 +348,17 @@ class DistributedExplainer:
                 r = {'z_ok': z_ok_l, 'z_ung_dead': z_ung_l,
                      'onpath_g': onpath_g}
                 with jax.default_matmul_precision(precision):
-                    phi_local = exact_shap_from_reach(pred, Xl, r, bgw_l, G,
-                                                      normalized=True)
+                    phi_local = exact_shap_from_reach(
+                        pred, Xl, r, bgw_l, G, normalized=True,
+                        target_chunk_elems=budget)
                     out = {
                         'shap_values': jax.lax.psum(phi_local, COALITION_AXIS),
                         'raw_prediction': pred(Xl),
                     }
                     if interactions:
                         inter_local = exact_interactions_from_reach(
-                            pred, Xl, r, bgw_l, G, normalized=True)
+                            pred, Xl, r, bgw_l, G, normalized=True,
+                            target_chunk_elems=budget)
                         out['interaction_values'] = jax.lax.psum(
                             inter_local, COALITION_AXIS)
                     return out
