@@ -354,12 +354,19 @@ def config_model_zoo(smoke=False):
                GridSearchCV(LogisticRegression(max_iter=500),
                             {"C": [0.5, 1.0]}, cv=3)
                .fit(Xtr, ytr).predict_proba, LinearPredictor)
+        from sklearn.ensemble import IsolationForest
+
+        yield ("isolation_forest",
+               IsolationForest(n_estimators=20 if smoke else 100,
+                               random_state=0).fit(Xtr).score_samples,
+               TreeEnsemblePredictor)
 
     from distributedkernelshap_tpu.models.torch_lift import is_torch_module, torch_callback
 
     families = {}
     for fam_name, predictor, expected_cls in zoo():
-        link = "logit" if fam_name != "svc_rbf" else "identity"
+        link = ("identity" if fam_name in ("svc_rbf", "isolation_forest")
+                else "logit")
         ex = KernelShap(predictor, link=link, feature_names=gn, seed=0)
         ex.fit(bg, group_names=gn, groups=g)
         lifted = isinstance(ex._explainer.predictor, expected_cls)

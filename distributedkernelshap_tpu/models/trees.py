@@ -22,6 +22,8 @@ Supported sklearn families (``lift_tree_ensemble``):
   (constant-init raw score + learning-rate-scaled sum; sigmoid / softmax)
 * ``HistGradientBoosting{Classifier,Regressor}`` (baseline + leaf sum, with
   missing-value routing; categorical splits are not lifted)
+* ``IsolationForest`` (``score_samples`` / ``decision_function``: per-leaf
+  isolation path lengths, the ``-2^(-E[h]/c)`` anomaly transform on device)
 
 Anything that does not match — or whose lifted outputs fail the numerical
 faithfulness probe in ``as_predictor`` — falls back to the host paths
@@ -40,7 +42,8 @@ from distributedkernelshap_tpu.models.predictors import BasePredictor
 
 logger = logging.getLogger(__name__)
 
-OUT_TRANSFORMS = ("identity", "binary_sigmoid", "sigmoid", "softmax")
+OUT_TRANSFORMS = ("identity", "binary_sigmoid", "sigmoid", "softmax",
+                  "neg_exp2")
 
 
 def f32_le_threshold(t) -> np.ndarray:
@@ -342,6 +345,10 @@ class TreeEnsemblePredictor(BasePredictor):
             return jax.nn.sigmoid(out)
         if self.out_transform == "softmax":
             return jax.nn.softmax(out, axis=-1)
+        if self.out_transform == "neg_exp2":
+            # IsolationForest anomaly score: -2^(-E[h]/c) with the -1/c
+            # folded into ``scale``
+            return -jnp.exp2(out)
         return out
 
     def __call__(self, X):
@@ -558,6 +565,73 @@ def _sklearn_tree_table(tree, k_slot: Optional[int] = None, k_total: int = 1,
             "right": right, "value": value.astype(np.float32)}
 
 
+def _average_path_length(n) -> np.ndarray:
+    """sklearn's ``_average_path_length``: expected external-path length of
+    an unsuccessful BST search among ``n`` samples (the c(n) normaliser of
+    Isolation Forests).  Reimplemented (it is private in sklearn) so the
+    lift does not depend on sklearn internals."""
+
+    n = np.asarray(n, np.float64)
+    out = np.zeros_like(n)
+    out[n == 2] = 1.0
+    big = n > 2
+    nb = n[big]
+    out[big] = 2.0 * (np.log(nb - 1.0) + np.euler_gamma) - 2.0 * (nb - 1.0) / nb
+    return out
+
+
+def _iforest_tree_table(tree, features: Optional[np.ndarray]) -> Optional[dict]:
+    """Node table whose leaf payload is the isolation path length
+    ``h = depth(leaf) + c(n_node_samples(leaf))`` (sklearn's per-tree
+    ``decision_path.sum(1) + c(leaf_samples) - 1``).  ``features`` remaps
+    the tree's subset-relative feature ids to absolute columns
+    (``estimators_features_``).  Structure (self-loops, threshold casts,
+    leaf padding) comes from ``_sklearn_tree_table`` so the conventions
+    live in one place; only the payload and the feature remap differ."""
+
+    table = _sklearn_tree_table(tree)
+    if table is None:
+        return None
+    if features is not None:
+        table["feature"] = np.asarray(features, np.int64)[
+            table["feature"]].astype(np.int32)
+    left = table["left"]
+    depth = np.zeros(len(left), np.float64)
+    stack = [(0, 0.0)]
+    while stack:
+        j, d = stack.pop()
+        depth[j] = d
+        if left[j] != j:                 # self-loop == leaf
+            stack.append((int(left[j]), d + 1.0))
+            stack.append((int(table["right"][j]), d + 1.0))
+    value = depth + _average_path_length(tree.n_node_samples)
+    table["value"] = value[:, None].astype(np.float32)
+    return table
+
+
+def _lift_isolation_forest(owner, method_name: str):
+    """IsolationForest ``score_samples`` (= -2^(-E[h]/c(max_samples))) or
+    ``decision_function`` (= score_samples - offset_): per-tree isolation
+    path lengths averaged on-device, the -1/c normaliser folded into
+    ``scale`` and the anomaly transform into ``out_transform='neg_exp2'``;
+    the decision offset rides an affine output head."""
+
+    feats = getattr(owner, "estimators_features_",
+                    [None] * len(owner.estimators_))
+    tables = [_iforest_tree_table(e.tree_, f)
+              for e, f in zip(owner.estimators_, feats)]
+    c_norm = float(_average_path_length([owner.max_samples_])[0])
+    inner = _finalise(tables, aggregation="mean", out_transform="neg_exp2",
+                      scale=-1.0 / c_norm, vector_out=False)
+    if inner is None:
+        return None
+    if method_name == "decision_function":
+        from distributedkernelshap_tpu.models.compose import AffineOutputPredictor
+
+        return AffineOutputPredictor(inner, 1.0, -float(owner.offset_))
+    return inner
+
+
 def _hist_tree_table(predictor, k_slot: int, k_total: int) -> Optional[dict]:
     """Node table from a HistGradientBoosting ``TreePredictor``."""
 
@@ -604,10 +678,11 @@ def _finalise(tables: Sequence[Optional[dict]], **kwargs) -> Optional[TreeEnsemb
         **kwargs)
 
 
-def lift_tree_ensemble(method) -> Optional[TreeEnsemblePredictor]:
-    """Lift a bound ``predict_proba`` / ``predict`` / ``decision_function`` of
-    an sklearn tree model into a :class:`TreeEnsemblePredictor`, or None when
-    the estimator does not match a supported family.
+def lift_tree_ensemble(method) -> Optional[BasePredictor]:
+    """Lift a bound ``predict_proba`` / ``predict`` / ``decision_function`` /
+    ``score_samples`` of an sklearn tree model into a
+    :class:`TreeEnsemblePredictor` (possibly behind an affine output head),
+    or None when the estimator does not match a supported family.
 
     The caller (``as_predictor``) numerically verifies the lift against the
     original callable before trusting it, so this function only needs to be
@@ -616,10 +691,14 @@ def lift_tree_ensemble(method) -> Optional[TreeEnsemblePredictor]:
 
     owner = getattr(method, "__self__", None)
     name = getattr(method, "__name__", "")
-    if owner is None or name not in ("predict", "predict_proba", "decision_function"):
+    if owner is None or name not in ("predict", "predict_proba",
+                                     "decision_function", "score_samples"):
         return None
     cls = type(owner).__name__
     try:
+        if cls == "IsolationForest" and name in ("score_samples",
+                                                 "decision_function"):
+            return _lift_isolation_forest(owner, name)
         if cls in ("DecisionTreeClassifier", "DecisionTreeRegressor",
                    "ExtraTreeClassifier", "ExtraTreeRegressor"):
             return _lift_forest([owner], cls.endswith("Classifier"), name)

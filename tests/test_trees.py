@@ -686,3 +686,37 @@ def test_device_computed_onehot_fallback_matches_constant_path(clf_data):
         TreeEnsemblePredictor.onehot_constant_elems = old
     assert (got == want).all()
     assert np.abs(out_fb - np.asarray(pred(Xf))).max() == 0.0
+
+
+def test_isolation_forest_lift_and_explain():
+    """IsolationForest score_samples / decision_function lift (per-leaf
+    isolation path lengths, -1/c in scale, neg_exp2 transform, offset via
+    affine head; max_features subsets remap through estimators_features_)
+    and explain end-to-end with additivity against the anomaly score."""
+
+    from sklearn.ensemble import IsolationForest
+
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.models.trees import lift_tree_ensemble
+
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(400, 5))
+    X[::40] += 3.5                      # a few planted outliers
+    clf = IsolationForest(n_estimators=25, max_features=0.6,
+                          random_state=0).fit(X)
+    Xq = X[:96].astype(np.float32)
+
+    for name in ("score_samples", "decision_function"):
+        lifted = lift_tree_ensemble(getattr(clf, name))
+        assert lifted is not None
+        got = np.asarray(lifted(Xq)).ravel()
+        want = getattr(clf, name)(Xq.astype(np.float64))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    ex = KernelShap(clf.score_samples, link="identity", seed=0)
+    ex.fit(X[:40].astype(np.float32))
+    res = ex.explain(Xq[:16], silent=True, l1_reg=False)
+    total = np.asarray(res.shap_values[0]).sum(1) + float(
+        np.ravel(res.expected_value)[0])
+    np.testing.assert_allclose(
+        total, clf.score_samples(Xq[:16].astype(np.float64)), atol=1e-3)

@@ -174,8 +174,13 @@ def test_exact_background_chunking_invariance(gbt_setup):
     Xe = s["X"][80:84]
     G = groups_to_matrix(None, 6)
     w = np.ones(20, np.float32)
-    full = exact_tree_shap(s["pred"], Xe, bg, w, G, bg_chunk=None)
+    # bg_chunk=N is the genuinely unchunked reference (None now AUTO-sizes
+    # against the element budget and may itself chunk)
+    full = exact_tree_shap(s["pred"], Xe, bg, w, G, bg_chunk=bg.shape[0])
+    auto = exact_tree_shap(s["pred"], Xe, bg, w, G, bg_chunk=None)
     small = exact_tree_shap(s["pred"], Xe, bg, w, G, bg_chunk=3)
+    np.testing.assert_allclose(np.asarray(full["shap_values"]),
+                               np.asarray(auto["shap_values"]), atol=1e-5)
     np.testing.assert_allclose(np.asarray(full["shap_values"]),
                                np.asarray(small["shap_values"]), atol=1e-5)
 
