@@ -75,6 +75,54 @@ def linear_path_cost(B, S, N, K, D, M, tb=256, ts=512):
             "transcendentals": transcendental, "hbm_bytes": hbm}
 
 
+def tree_masked_cost(B, S, N, K, M, T, L, Nn):
+    """Work of one explain call on the separable masked tree path
+    (``models/trees.masked_ey``): per-side hit contractions (Q/R), the
+    mask contractions (hx/hb), and the ``S*B*N*L`` bulk per tree
+    (add + compare on the VPU, leaf einsum on the MXU), plus the output
+    transform + weighted background average."""
+
+    f32 = 4
+    bulk = S * B * N * L * T
+    mxu = (2 * B * T * L * Nn * M      # Q (per-instance hits)
+           + 2 * N * T * L * Nn * M    # R (background hits)
+           + 2 * S * B * T * L * M     # hx
+           + 2 * S * N * T * L * M     # hb
+           + 2 * bulk * K              # eq x leaf_value einsum
+           + 2 * S * B * N * K)        # background-weighted average
+    vpu = 3 * bulk                     # hb broadcast add + compare + cast
+    transcendental = S * B * N * max(1, K - 1)   # _finish sigmoid/softmax
+    hbm = f32 * (B * Nn + N * Nn + S * M         # inputs
+                 + (N + B) * T * L * M           # persistent R / Q tensors
+                 + S * B * K + B * K * M)        # ey + phi out
+    return {"mxu_flops": mxu, "vpu_ops": vpu,
+            "transcendentals": transcendental, "hbm_bytes": hbm}
+
+
+def tree_exact_cost(B, N, K, M, T, L, Nn, interactions=False):
+    """Work of one exact interventional TreeSHAP call
+    (``ops/treeshap.exact_shap_from_reach``): the (b, n) pairwise counts
+    (u/v/dead), on-device Beta weights via lgamma (5 lgamma + 2 exp per
+    pair-leaf), and the phi contractions; ``interactions`` multiplies the
+    pairwise contraction stage by ~M (one main-effect-shaped einsum set
+    per group, ``exact_interactions_from_reach``)."""
+
+    f32 = 4
+    pairs = B * N * T * L
+    contraction_sets = (3 + 4 * M) if interactions else (3 + 2)
+    mxu = (2 * pairs * M * contraction_sets      # u/v/dead + phi passes
+           + 2 * (B + N) * T * L * Nn * M)       # x_ok / z_ok reach einsums
+    weight_sets = 2 if interactions else 1       # main + pairwise weights
+    transcendental = 7 * pairs * weight_sets
+    vpu = 6 * pairs * (M if interactions else 1)  # masks/products per pass
+    hbm = f32 * (B * Nn + N * Nn
+                 + N * T * L * M                 # persistent z_ok reach
+                 + B * T * L * M                 # x_ok
+                 + B * K * M * (M if interactions else 1))
+    return {"mxu_flops": mxu, "vpu_ops": vpu,
+            "transcendentals": transcendental, "hbm_bytes": hbm}
+
+
 def floors(cost):
     return {
         "mxu_s": cost["mxu_flops"] / PEAK["mxu_f32_flops"],
@@ -87,9 +135,12 @@ def floors(cost):
 # measured single-chip wall-clocks (RESULTS.md, axon tunnel; each includes at
 # least one ~70 ms tunnel round trip that is NOT device work)
 MEASURED = {
-    "adult": 0.086,         # 2026-07-29 bench.py
-    "adult_stress": 0.073,  # 2026-07-30
+    "adult": 0.086,         # 2026-07-29 bench.py (0.09-0.15 on 07-31)
+    "adult_stress": 0.073,  # 2026-07-30 (0.125 on 07-31)
     "covertype_65536": 2.13,  # 2026-07-30, 65,536-row sub-run
+    "covertype_full": 13.08,  # 2026-07-31, full 581k rows, one chip
+    "adult_trees": 0.2671,    # 2026-07-31 (separable masked tree path)
+    "adult_trees_exact": 0.8835,  # 2026-07-31, PRE-lgamma (gather weights)
 }
 
 CONFIGS = {
@@ -100,6 +151,18 @@ CONFIGS = {
     "covertype_full": dict(B=581012, S=2072, N=100, K=7, D=54, M=12),
 }
 
+# tree-path configs (Adult HistGBT max_iter=50: T=50 trees, L=31 leaves,
+# Nn=61 node slots; introspected from the fitted lift)
+TREE_CONFIGS = {
+    "adult_trees": (tree_masked_cost,
+                    dict(B=256, S=2072, N=100, K=2, M=12, T=50, L=31, Nn=61)),
+    "adult_trees_exact": (tree_exact_cost,
+                          dict(B=256, N=100, K=1, M=12, T=50, L=31, Nn=61)),
+    "adult_trees_exact_inter": (tree_exact_cost,
+                                dict(B=256, N=100, K=1, M=12, T=50, L=31,
+                                     Nn=61, interactions=True)),
+}
+
 
 def main():
     parser = argparse.ArgumentParser()
@@ -107,8 +170,11 @@ def main():
     args = parser.parse_args()
 
     rows = []
-    for name, dims in CONFIGS.items():
-        cost = linear_path_cost(**dims)
+    all_costs = [(name, linear_path_cost(**dims), dims)
+                 for name, dims in CONFIGS.items()]
+    all_costs += [(name, fn(**dims), dims)
+                  for name, (fn, dims) in TREE_CONFIGS.items()]
+    for name, cost, dims in all_costs:
         fl = floors(cost)
         floor = max(fl.values())
         bound = max(fl, key=fl.get)
