@@ -98,3 +98,32 @@ def test_save_unfitted_raises():
                                     np.zeros(2, np.float32)))
     with pytest.raises(ValueError, match="unfitted"):
         ex.save("/tmp/nope.pkl")
+
+
+def test_save_load_exact_interactions(tmp_path):
+    """A restored explainer must run the exact path with interactions:
+    the lazily-built fn caches rebuild after load, and the tensors match
+    the writer process's."""
+
+    from sklearn.ensemble import GradientBoostingRegressor
+
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(150, 4))
+    y = X[:, 0] * np.where(X[:, 1] > 0, 1.0, -1.0)
+    gbt = GradientBoostingRegressor(n_estimators=5, max_depth=3,
+                                    random_state=0).fit(X, y)
+    ex = KernelShap(gbt.predict, seed=0)
+    ex.fit(X[:12].astype(np.float32))
+    Xq = X[:6].astype(np.float32)
+    before = ex.explain(Xq, silent=True, nsamples="exact", interactions=True)
+
+    path = str(tmp_path / "exact" / "explainer.pkl")
+    ex.save(path)
+    loaded = KernelShap.load(path)
+    after = loaded.explain(Xq, silent=True, nsamples="exact",
+                           interactions=True)
+    np.testing.assert_allclose(
+        before.data["raw"]["interaction_values"][0],
+        after.data["raw"]["interaction_values"][0], atol=1e-6)
+    np.testing.assert_allclose(before.shap_values[0], after.shap_values[0],
+                               atol=1e-6)
