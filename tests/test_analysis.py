@@ -79,3 +79,32 @@ def test_plot_rows_writes_png(results_dir, tmp_path):
     out = str(tmp_path / "plot.png")
     plot_rows(rows, out, baseline=125.05)
     assert os.path.getsize(out) > 1000
+
+
+def test_roofline_model_rows():
+    """The analytic roofline emits one sane row per config: positive work
+    terms, floors consistent with the stated peaks, and the documented
+    boundedness readings (adult latency-bound with a ~1 ms floor; the
+    masked tree path VPU-bound; exact transcendental- or MXU-bound)."""
+
+    import json
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, "benchmarks/roofline.py", "--json"],
+        capture_output=True, text=True, check=True).stdout
+    rows = {r["config"]: r for r in map(json.loads, out.splitlines()) if r}
+    assert {"adult", "adult_stress", "covertype_full", "adult_trees",
+            "adult_trees_exact", "adult_trees_exact_inter"} <= set(rows)
+    for r in rows.values():
+        for key in ("mxu_flops", "vpu_ops", "transcendentals", "hbm_bytes"):
+            assert r[key] > 0, (r["config"], key)
+        assert r["roofline_floor_s"] == max(
+            r["mxu_s"], r["vpu_s"], r["transcendental_s"], r["hbm_s"])
+    assert rows["adult"]["roofline_floor_s"] < 2e-3          # latency-bound
+    assert rows["adult_trees"]["bound"] == "vpu_s"
+    assert rows["adult_trees_exact"]["bound"] == "transcendental_s"
+    # interactions cost ~M x the exact pass's contraction stage
+    assert (rows["adult_trees_exact_inter"]["mxu_flops"]
+            > 5 * rows["adult_trees_exact"]["mxu_flops"])
