@@ -88,13 +88,17 @@ def test_roofline_model_rows():
     masked tree path VPU-bound; exact transcendental- or MXU-bound)."""
 
     import json
+    import os
     import subprocess
     import sys
 
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benchmarks", "roofline.py")
     out = subprocess.run(
-        [sys.executable, "benchmarks/roofline.py", "--json"],
+        [sys.executable, script, "--json"],
         capture_output=True, text=True, check=True).stdout
-    rows = {r["config"]: r for r in map(json.loads, out.splitlines()) if r}
+    rows = {r["config"]: r
+            for r in (json.loads(line) for line in out.splitlines() if line)}
     assert {"adult", "adult_stress", "covertype_full", "adult_trees",
             "adult_trees_exact", "adult_trees_exact_inter"} <= set(rows)
     for r in rows.values():
