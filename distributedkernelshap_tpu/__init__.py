@@ -25,6 +25,7 @@ from distributedkernelshap_tpu.kernel_shap import (  # noqa: F401
     KernelExplainerEngine,
     KernelShap,
     rank_by_importance,
+    rank_interaction_pairs,
     sum_categories,
 )
 

@@ -222,6 +222,50 @@ def _summing_matrix(start_idx: Sequence[int], enc_feat_dim: Sequence[int],
     return S
 
 
+def rank_interaction_pairs(interaction_values: List[np.ndarray],
+                           feature_names: Union[List[str], Tuple[str], None] = None,
+                           top: Optional[int] = None) -> Dict:
+    """Rank feature PAIRS by mean |interaction| — the pairwise analog of
+    :func:`rank_by_importance` for the exact interaction matrices
+    (``explain(..., nsamples='exact', interactions=True)``).
+
+    ``interaction_values``: list of ``K`` ``(B, M, M)`` arrays (shap
+    TreeExplainer convention — symmetric, off-diagonal ``[i, j]`` holds
+    half the pairwise index, so a pair's total effect is ``2 * |[i, j]|``).
+    Returns the reference-style structure ``{'0': {'ranked_effect',
+    'names'}, ..., 'aggregated': {...}}`` where each name is an ``(i, j)``
+    feature-name tuple, sorted most- to least-interacting; ``top`` keeps
+    only the strongest pairs.
+    """
+
+    def batched(values: np.ndarray) -> np.ndarray:
+        vals = np.asarray(values)
+        return vals[None] if vals.ndim == 2 else vals   # single instance
+
+    M = batched(interaction_values[0]).shape[-1]
+    if not feature_names or len(feature_names) != M:
+        if feature_names:
+            logger.warning(
+                "Feature names do not match the interaction matrices: got "
+                "%d names for %d features; falling back to default names.",
+                len(feature_names), M)
+        feature_names = [f'feature_{i}' for i in range(M)]
+    iu, ju = np.triu_indices(M, k=1)
+    pair_names = [(feature_names[i], feature_names[j])
+                  for i, j in zip(iu, ju)]
+
+    # a pair's total effect is its two symmetric halves -> 2x one entry;
+    # ranking itself delegates to rank_by_importance over the (B, P)
+    # pair-value arrays so the convention lives in one place
+    pair_values = [2.0 * batched(v)[:, iu, ju] for v in interaction_values]
+    importances = rank_by_importance(pair_values, pair_names)
+    if top is not None:
+        for entry in importances.values():
+            entry['ranked_effect'] = entry['ranked_effect'][:top]
+            entry['names'] = entry['names'][:top]
+    return importances
+
+
 def sum_categories(values: np.ndarray, start_idx: Sequence[int], enc_feat_dim: Sequence[int]):
     """Reduce one-hot-encoded categorical slices to one value per variable.
 
