@@ -32,9 +32,15 @@ expectation no longer commutes with the transform, so exact margin-space
 values would not match KernelSHAP's link-space target; those stay on the
 sampled path.
 
+The same conjunction game also yields the pairwise **Shapley interaction
+index** in closed form (``exact_interactions_from_reach``; weights
+``W_uu = (u-2)! v! / (u+v-1)!`` etc., brute-force-pinned), exposed as
+``explain(..., nsamples='exact', interactions=True)``.
+
 Validated against this package's own exhaustively-enumerated KernelSHAP
 (``nsamples >= 2^M`` makes the WLS solve exact), which is a Shapley oracle
-for the same background distribution.
+for the same background distribution, and against direct enumeration of
+the (interaction) index definitions.
 """
 
 from typing import Optional
