@@ -15,16 +15,27 @@ and includes host->device transfer of the batch + full retrieval of the
 Explanation payload.
 
 Budgeting: EVERYTHING here is bounded by ``DKS_BENCH_BUDGET`` seconds
-(default 420 — the probe phase then resolves within ~205 s, inside even a
-conservative 300 s driver timeout) so an external driver always receives a
-parseable JSON line — success or error — instead of killing an unresponsive
-process (round 1 recorded ``rc: 124`` with no output because the probe +
-retry budget exceeded the driver's).  The budget splits into a backend
-probe phase (a wedged TPU tunnel relay blocks backend init uninterruptibly;
-probing in a throwaway child lets us fail fast) and the benchmark run
-itself, which executes in a child process killed at the remaining budget.
-On this VM the healthy path needs ~100-140 s total (data/assets cached,
-compile ~15-40 s), so the default leaves ample margin.
+(default 420) so an external driver always receives a parseable JSON line —
+success or error — instead of killing an unresponsive process (round 1
+recorded ``rc: 124`` with no output because the probe + retry budget
+exceeded the driver's).  The budget splits into a backend probe phase (a
+wedged TPU tunnel relay blocks backend init uninterruptibly; probing in a
+throwaway child lets us fail fast — and retrying: a relay recovering from a
+wedge can answer the second attempt, so the probe phase makes two bounded
+attempts by default) and the benchmark run itself, which executes in a
+child process killed at the remaining budget.  On this VM the healthy path
+needs ~100-140 s total (data/assets cached, compile ~15-40 s), so the
+default leaves ample margin.
+
+When the device stays unreachable (or the run phase dies), a reserved tail
+of the budget (``DKS_BENCH_FALLBACK_RESERVE``, 100 s cap — worst-case
+wedged-path wall time stays inside a conservative 300 s driver timeout)
+runs the SAME jitted pipeline on CPU in a child with the axon hook
+stripped (``PYTHONPATH='' JAX_PLATFORMS=cpu`` — CPU-forced processes work
+even under a relay wedge) and reports it as a clearly-labelled
+``cpu_fallback_wall_s`` secondary field in the error JSON, so the driver
+artifact always carries a real measurement without misrepresenting it as a
+TPU number.
 """
 
 import json
@@ -78,8 +89,14 @@ def _device_probe(timeout_s: float):
         return False, f"backend init did not complete within {timeout_s:.0f}s"
 
 
-def run_benchmark() -> int:
-    """The actual benchmark (child-process entry: ``python bench.py --run``)."""
+def run_benchmark(cpu_fallback: bool = False) -> int:
+    """The actual benchmark (child-process entry: ``python bench.py --run``).
+
+    ``cpu_fallback`` is the ``--run-cpu`` entry: same pipeline, run by a
+    child whose env strips the axon hook and forces the CPU backend; its
+    result is reported under a distinct metric name so it can never be
+    mistaken for a TPU measurement.
+    """
 
     import jax
 
@@ -117,37 +134,122 @@ def run_benchmark() -> int:
     sv = explanation.shap_values
     total = np.stack(sv, 1).sum(-1) + np.asarray(explanation.expected_value)[None, :]
     err = float(np.abs(total - explanation.data["raw"]["raw_prediction"]).max())
+    metric = _METRIC + ("_cpu_fallback" if cpu_fallback else "")
     if not err < 1e-3:
-        print(json.dumps({"metric": _METRIC,
+        print(json.dumps({"metric": metric,
                           "error": f"additivity violated: {err}"}))
         return 1
 
     value = float(np.median(times))
-    print(json.dumps({
-        "metric": _METRIC,
+    record = {
+        "metric": metric,
         "value": round(value, 4),
         "unit": "s",
         "vs_baseline": round(RAY_POOL_32VCPU_BASELINE_S / value, 1),
-    }))
+        # honest-labelling: 'tpu' through the axon tunnel, 'cpu' when no
+        # accelerator backend was reachable (never silently conflated)
+        "platform": jax.default_backend(),
+    }
+    print(json.dumps(record))
     return 0
 
 
+def _cpu_fallback(timeout_s: float):
+    """Run the same pipeline CPU-only in a child; returns the measured
+    wall-clock (or an error string).
+
+    The child strips ``PYTHONPATH`` so the axon sitecustomize hook never
+    loads (a wedged relay blocks axon *backend init*, not CPU work) and
+    forces ``JAX_PLATFORMS=cpu`` — the one combination verified to run
+    reliably under a relay wedge.
+    """
+
+    if timeout_s < 30:
+        return None, "no budget left for the CPU fallback"
+    env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--run-cpu"],
+        stdout=subprocess.PIPE, cwd=os.path.dirname(os.path.abspath(__file__)),
+        env=env)
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.communicate(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass
+        return None, f"cpu fallback exceeded {timeout_s:.0f}s"
+    try:
+        last = out.decode().strip().splitlines()[-1]
+        rec = json.loads(last)
+        if not isinstance(rec, dict):  # a bare number/list is not a result
+            raise ValueError(last)
+        if "value" in rec:
+            return float(rec["value"]), None
+        return None, rec.get("error", "cpu fallback returned no value")
+    except (IndexError, ValueError, TypeError):
+        return None, f"cpu fallback exited rc={proc.returncode} without JSON"
+
+
+def _emit_error(payload: dict, t_start: float, budget: float) -> int:
+    """Print the error JSON, augmented with a clearly-labelled CPU-fallback
+    measurement when the remaining budget allows — the driver artifact then
+    always carries a number, without misrepresenting it as a TPU result.
+
+    The fallback is capped at ``DKS_BENCH_FALLBACK_RESERVE`` (not the whole
+    remaining budget): total wall time on the wedged path must stay well
+    inside a conservative 300 s driver timeout, not merely inside
+    ``DKS_BENCH_BUDGET``.
+    """
+
+    reserve = float(os.environ.get("DKS_BENCH_FALLBACK_RESERVE", "100"))
+    remaining = min(budget - (time.monotonic() - t_start) - 10.0, reserve)
+    value, err = _cpu_fallback(remaining)
+    if value is not None:
+        payload["cpu_fallback_wall_s"] = value
+        payload["cpu_fallback_note"] = (
+            "same jitted pipeline, CPU backend, ONE core — NOT a TPU "
+            f"measurement (reference 32-vCPU pool best: "
+            f"{RAY_POOL_32VCPU_BASELINE_S} s)")
+    elif err:
+        payload["cpu_fallback_error"] = err
+    print(json.dumps(payload))
+    return 1
+
+
 def main() -> int:
+    if "--run-cpu" in sys.argv:
+        return run_benchmark(cpu_fallback=True)
     if "--run" in sys.argv:
         return run_benchmark()
 
     t_start = time.monotonic()
     budget = _total_budget()
 
+    # the CPU fallback needs ~60-90 s (imports + compile + 3 timed runs);
+    # reserving it inside the budget keeps the hard bound: probe + fallback
+    # (wedged path) or probe + run (healthy path) both resolve within
+    # DKS_BENCH_BUDGET.  Worst-case wedged-path latency with the default
+    # budget: ~145 s probe + ~100 s fallback ≈ 250 s — still inside a
+    # conservative 300 s driver timeout.
+    fallback_reserve = min(
+        float(os.environ.get("DKS_BENCH_FALLBACK_RESERVE", "100")),
+        0.3 * budget)
+
     if os.environ.get("DKS_BENCH_SKIP_PROBE") != "1":
-        # probe phase: at most ~55% of the budget across all attempts, so the
-        # run phase always keeps enough time to finish (a cached-compile TPU
-        # run needs well under a minute; the first-ever compile ~40 s)
-        attempts = max(1, int(os.environ.get("DKS_BENCH_PROBE_RETRIES", "0")) + 1)
-        retry_delay = float(os.environ.get("DKS_BENCH_PROBE_RETRY_DELAY", "30"))
+        # probe phase: at most ~35% of the budget across all attempts, so
+        # the run phase (or the CPU fallback) always keeps enough time to
+        # finish (a cached-compile TPU run needs well under a minute; the
+        # first-ever compile ~40 s).  Two attempts by default: a relay
+        # recovering from a wedge often answers a later attempt (the wedge
+        # clears asynchronously), and a healthy backend answers the first
+        # attempt in <1 s either way.
+        attempts = max(1, int(os.environ.get("DKS_BENCH_PROBE_RETRIES", "1")) + 1)
+        retry_delay = float(os.environ.get("DKS_BENCH_PROBE_RETRY_DELAY", "20"))
         probe_timeout = float(os.environ.get(
             "DKS_BENCH_PROBE_TIMEOUT",
-            max(30.0, 0.55 * budget / attempts - retry_delay)))
+            max(30.0, (0.35 * budget - (attempts - 1) * retry_delay) / attempts)))
         ok, detail = False, ""
         for attempt in range(attempts):
             ok, detail = _device_probe(probe_timeout)
@@ -158,22 +260,26 @@ def main() -> int:
             if attempt < attempts - 1:
                 time.sleep(retry_delay)
         if not ok:
-            print(json.dumps({
+            return _emit_error({
                 "metric": _METRIC,
                 "error": "device backend unreachable (tunnel relay wedged?); "
                          "see .claude/skills/verify/SKILL.md for recovery notes",
                 "detail": detail,
-            }))
-            return 1
+            }, t_start, budget)
 
-    # run phase in a child bounded by the remaining budget: even if the
-    # probe succeeded and the device wedges mid-run, the driver still gets
-    # a JSON line instead of rc=124
-    remaining = budget - (time.monotonic() - t_start) - 5.0
-    if remaining <= 0:
+    # run phase in a child, bounded by what's left after reserving the
+    # fallback tail (even if the probe succeeded and the device wedges
+    # mid-run, the driver still gets a JSON line instead of rc=124).  If the
+    # probe somehow consumed nearly everything, fail with a JSON line
+    # immediately rather than over-running the budget.
+    left = budget - (time.monotonic() - t_start) - 5.0
+    if left <= 30:
         print(json.dumps({"metric": _METRIC,
                           "error": "probe phase consumed the whole budget"}))
         return 1
+    # forgo the fallback reserve rather than squeeze the run below a useful
+    # bound (the run itself is the better artifact when it completes)
+    remaining = left - fallback_reserve if left - fallback_reserve >= 60 else left
     proc = subprocess.Popen([sys.executable, os.path.abspath(__file__), "--run"],
                             stdout=subprocess.PIPE)
     try:
@@ -185,13 +291,12 @@ def main() -> int:
         try:
             json.loads(last)
         except ValueError:
-            print(json.dumps({
+            return _emit_error({
                 "metric": _METRIC,
                 "error": f"benchmark child exited rc={proc.returncode} "
                          f"without a JSON result",
                 "detail": last[-400:],
-            }))
-            return 1
+            }, t_start, budget)
         sys.stdout.write(text)
         return proc.returncode
     except subprocess.TimeoutExpired:
@@ -204,13 +309,12 @@ def main() -> int:
                 proc.communicate(timeout=5)
             except subprocess.TimeoutExpired:
                 pass
-        print(json.dumps({
+        return _emit_error({
             "metric": _METRIC,
             "error": f"benchmark run exceeded the remaining budget "
                      f"({remaining:.0f}s of DKS_BENCH_BUDGET="
                      f"{budget:.0f}s); device hang mid-run?",
-        }))
-        return 1
+        }, t_start, budget)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,207 @@
+"""Contract tests for the driver benchmark entry (``bench.py``).
+
+The driver runs ``python bench.py`` once per round and records the LAST
+stdout line; the contract is that this line is always ONE parseable JSON
+object carrying either a ``value`` (TPU measurement) or an ``error`` — and,
+since round 3, error payloads also carry a clearly-labelled
+``cpu_fallback_wall_s`` measurement whenever the remaining budget allows
+(VERDICT.md round 2, "What's weak" item 8).  These tests pin the helper
+behaviour without touching any device backend.
+"""
+
+import io
+import json
+import sys
+import time
+from contextlib import redirect_stdout
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import bench  # noqa: E402
+
+
+def _capture(fn, *args, **kwargs):
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = fn(*args, **kwargs)
+    return rc, buf.getvalue()
+
+
+def _pin_bench_env(monkeypatch):
+    """Pin every budget knob the tests' expectations assume, so an ambient
+    DKS_BENCH_* export can't flip the assertions."""
+
+    monkeypatch.delenv("DKS_BENCH_SKIP_PROBE", raising=False)
+    monkeypatch.delenv("DKS_BENCH_PROBE_TIMEOUT", raising=False)
+    monkeypatch.setenv("DKS_BENCH_BUDGET", "420")
+    monkeypatch.setenv("DKS_BENCH_PROBE_RETRIES", "1")
+    monkeypatch.setenv("DKS_BENCH_PROBE_RETRY_DELAY", "20")
+
+
+def test_emit_error_attaches_fallback_measurement(monkeypatch):
+    monkeypatch.setattr(bench, "_cpu_fallback", lambda t: (0.53, None))
+    rc, out = _capture(bench._emit_error,
+                       {"metric": bench._METRIC, "error": "wedged"},
+                       time.monotonic(), 420.0)
+    assert rc == 1
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert rec["error"] == "wedged"
+    assert rec["cpu_fallback_wall_s"] == 0.53
+    # the label must make clear this is NOT a TPU number
+    assert "NOT a TPU measurement" in rec["cpu_fallback_note"]
+
+
+def test_emit_error_still_parseable_when_fallback_fails(monkeypatch):
+    monkeypatch.setattr(bench, "_cpu_fallback",
+                        lambda t: (None, "cpu fallback exceeded 30s"))
+    rc, out = _capture(bench._emit_error,
+                       {"metric": bench._METRIC, "error": "wedged"},
+                       time.monotonic(), 420.0)
+    assert rc == 1
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert rec["error"] == "wedged"
+    assert "cpu_fallback_wall_s" not in rec
+    assert rec["cpu_fallback_error"] == "cpu fallback exceeded 30s"
+
+
+def test_emit_error_caps_fallback_at_reserve(monkeypatch):
+    """The fallback must be capped at DKS_BENCH_FALLBACK_RESERVE, not the
+    whole remaining budget — total wedged-path wall time has to stay inside
+    a ~300 s driver timeout even with DKS_BENCH_BUDGET=420."""
+
+    granted = []
+    monkeypatch.setenv("DKS_BENCH_FALLBACK_RESERVE", "100")
+    monkeypatch.setattr(bench, "_cpu_fallback",
+                        lambda t: granted.append(t) or (0.5, None))
+    rc, out = _capture(bench._emit_error,
+                       {"metric": bench._METRIC, "error": "wedged"},
+                       time.monotonic(), 420.0)
+    assert rc == 1
+    assert granted and granted[0] <= 100.0
+
+
+def test_cpu_fallback_refuses_without_budget():
+    value, err = bench._cpu_fallback(5.0)
+    assert value is None
+    assert "budget" in err
+
+
+def test_cpu_fallback_rejects_non_dict_json(monkeypatch):
+    """A last line that parses as JSON but isn't an object (a stray '100'
+    progress line, say) must not crash the error-emission path."""
+
+    class _Proc:
+        returncode = 0
+
+        def communicate(self, timeout=None):
+            return b"100\n", b""
+
+    monkeypatch.setattr(bench.subprocess, "Popen",
+                        lambda *a, **k: _Proc())
+    value, err = bench._cpu_fallback(120.0)
+    assert value is None
+    assert "without JSON" in err
+
+
+def test_cpu_fallback_handles_child_without_json(monkeypatch):
+    class _Proc:
+        returncode = 1
+
+        def communicate(self, timeout=None):
+            return b"Traceback (most recent call last):\n  boom\n", b""
+
+    monkeypatch.setattr(bench.subprocess, "Popen",
+                        lambda *a, **k: _Proc())
+    value, err = bench._cpu_fallback(120.0)
+    assert value is None
+    assert "without JSON" in err
+
+
+def test_cpu_fallback_parses_child_json(monkeypatch):
+    class _Proc:
+        returncode = 0
+
+        def communicate(self, timeout=None):
+            line = json.dumps({"metric": bench._METRIC + "_cpu_fallback",
+                               "value": 0.61, "unit": "s"})
+            return ("some warning line\n" + line + "\n").encode(), b""
+
+    monkeypatch.setattr(bench.subprocess, "Popen",
+                        lambda *a, **k: _Proc())
+    value, err = bench._cpu_fallback(120.0)
+    assert err is None
+    assert value == 0.61
+
+
+def test_probe_retry_only_on_timeout_failures(monkeypatch):
+    """The probe phase retries ONLY the transient wedged-relay signature
+    (timeout); fast-failing probes are permanent errors."""
+
+    calls = []
+
+    def fake_probe(timeout_s):
+        calls.append(timeout_s)
+        if len(calls) == 1:
+            return False, f"backend init did not complete within {timeout_s:.0f}s"
+        return True, ""
+
+    monkeypatch.setattr(bench, "_device_probe", fake_probe)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    # run only the probe phase: make the run phase a no-op success
+    monkeypatch.setattr(bench.subprocess, "Popen", _succeeding_run_proc)
+    _pin_bench_env(monkeypatch)
+    rc, out = _capture(bench.main)
+    assert rc == 0
+    assert len(calls) == 2  # retried the timeout once (default retries=1)
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert rec["value"] == 0.1
+
+
+def _succeeding_run_proc(*a, **k):
+    class _Proc:
+        returncode = 0
+
+        def communicate(self, timeout=None):
+            return (json.dumps({"metric": bench._METRIC, "value": 0.1,
+                                "unit": "s"}) + "\n").encode(), b""
+
+    return _Proc()
+
+
+def test_wedged_probe_retries_then_reports_fallback(monkeypatch):
+    """Both attempts time out (wedged relay): the error JSON still carries
+    the labelled CPU measurement and the probe was retried exactly once."""
+
+    calls = []
+
+    def fake_probe(timeout_s):
+        calls.append(timeout_s)
+        return False, f"backend init did not complete within {timeout_s:.0f}s"
+
+    monkeypatch.setattr(bench, "_device_probe", fake_probe)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setattr(bench, "_cpu_fallback", lambda t: (0.53, None))
+    _pin_bench_env(monkeypatch)
+    rc, out = _capture(bench.main)
+    assert rc == 1
+    assert len(calls) == 2
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert "unreachable" in rec["error"]
+    assert rec["cpu_fallback_wall_s"] == 0.53
+
+
+def test_probe_permanent_failure_does_not_retry(monkeypatch):
+    calls = []
+
+    def fake_probe(timeout_s):
+        calls.append(timeout_s)
+        return False, "ImportError: no backend"
+
+    monkeypatch.setattr(bench, "_device_probe", fake_probe)
+    monkeypatch.setattr(bench, "_cpu_fallback", lambda t: (0.5, None))
+    _pin_bench_env(monkeypatch)
+    rc, out = _capture(bench.main)
+    assert rc == 1
+    assert len(calls) == 1
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert "error" in rec and rec["cpu_fallback_wall_s"] == 0.5
