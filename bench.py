@@ -185,25 +185,28 @@ def _cpu_fallback(timeout_s: float):
         rec = json.loads(last)
         if not isinstance(rec, dict):  # a bare number/list is not a result
             raise ValueError(last)
-        if "value" in rec:
-            return float(rec["value"]), None
-        return None, rec.get("error", "cpu fallback returned no value")
-    except (IndexError, ValueError, TypeError):
+    except (IndexError, ValueError):
         return None, f"cpu fallback exited rc={proc.returncode} without JSON"
+    if "value" in rec:
+        try:
+            return float(rec["value"]), None
+        except (TypeError, ValueError):
+            return None, f"cpu fallback JSON had a non-numeric value: {rec['value']!r}"
+    return None, rec.get("error", "cpu fallback returned no value")
 
 
-def _emit_error(payload: dict, t_start: float, budget: float) -> int:
+def _emit_error(payload: dict, t_start: float, budget: float,
+                reserve: float) -> int:
     """Print the error JSON, augmented with a clearly-labelled CPU-fallback
     measurement when the remaining budget allows — the driver artifact then
     always carries a number, without misrepresenting it as a TPU result.
 
-    The fallback is capped at ``DKS_BENCH_FALLBACK_RESERVE`` (not the whole
-    remaining budget): total wall time on the wedged path must stay well
-    inside a conservative 300 s driver timeout, not merely inside
-    ``DKS_BENCH_BUDGET``.
+    The fallback is capped at ``reserve`` (main()'s clamped
+    ``DKS_BENCH_FALLBACK_RESERVE``, not the whole remaining budget): total
+    wall time on the wedged path must stay well inside a conservative 300 s
+    driver timeout, not merely inside ``DKS_BENCH_BUDGET``.
     """
 
-    reserve = float(os.environ.get("DKS_BENCH_FALLBACK_RESERVE", "100"))
     remaining = min(budget - (time.monotonic() - t_start) - 10.0, reserve)
     value, err = _cpu_fallback(remaining)
     if value is not None:
@@ -265,7 +268,7 @@ def main() -> int:
                 "error": "device backend unreachable (tunnel relay wedged?); "
                          "see .claude/skills/verify/SKILL.md for recovery notes",
                 "detail": detail,
-            }, t_start, budget)
+            }, t_start, budget, fallback_reserve)
 
     # run phase in a child, bounded by what's left after reserving the
     # fallback tail (even if the probe succeeded and the device wedges
@@ -296,7 +299,7 @@ def main() -> int:
                 "error": f"benchmark child exited rc={proc.returncode} "
                          f"without a JSON result",
                 "detail": last[-400:],
-            }, t_start, budget)
+            }, t_start, budget, fallback_reserve)
         sys.stdout.write(text)
         return proc.returncode
     except subprocess.TimeoutExpired:
@@ -314,7 +317,7 @@ def main() -> int:
             "error": f"benchmark run exceeded the remaining budget "
                      f"({remaining:.0f}s of DKS_BENCH_BUDGET="
                      f"{budget:.0f}s); device hang mid-run?",
-        }, t_start, budget)
+        }, t_start, budget, fallback_reserve)
 
 
 if __name__ == "__main__":

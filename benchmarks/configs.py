@@ -403,7 +403,17 @@ def config_mnist(smoke=False):
     X = te_images.reshape(te_images.shape[0], -1)
     X = X[:16] if smoke else X[:10000]
 
-    ex = KernelShap(pred, link="logit", feature_names=names, seed=0)
+    from distributedkernelshap_tpu.kernel_shap import EngineConfig
+
+    # instance_chunk: run the 10k-image batch as five ~2k-image dispatches
+    # through the shared sliding window (parallel/pipeline.py) instead of
+    # ONE giant call — H2D/compute/D2H of successive chunks overlap, so the
+    # config stops paying the session's full transfer latency serially
+    # (12.25 s vs 5.02 s across 07-30/07-31 sessions was pure exposure to
+    # per-session tunnel throughput; VERDICT r2 item 5)
+    ex = KernelShap(pred, link="logit", feature_names=names, seed=0,
+                    engine_config=None if smoke else EngineConfig(
+                        instance_chunk=2048))
     ex.fit(bg, group_names=names, groups=groups)
     # l1_reg=False: with M=49 superpixels shap's 'auto' default would switch
     # to host-side AIC selection (sampled fraction << 0.2); keep the bench on
@@ -437,11 +447,19 @@ def config_covertype(smoke=False):
     X_explain = X[:512] if smoke else X
     sub = 65536
     n_dev = len(jax.devices())
-    opts, cfg = None, None
+    # f16 result transfer: the full-dataset phi tensor (581k x 7 x 12 ≈
+    # 195 MB f32) dominates the D2H wire through a session-throughput-
+    # limited tunnel; halving it costs ~5e-4 absolute phi rounding
+    # (reported additivity_err rises to ~1e-3 — still far under the 1e-2
+    # faithfulness bar; VERDICT r2 item 4)
+    from distributedkernelshap_tpu.ops.explain import ShapConfig
+
+    shap_cfg = ShapConfig(transfer_dtype=None if smoke else "float16")
+    opts, cfg = None, EngineConfig(shap=shap_cfg)
     if n_dev > 1:
         opts = {"n_devices": n_dev, "batch_size": max(1, sub // n_dev)}
     else:
-        cfg = EngineConfig(instance_chunk=sub)
+        cfg = EngineConfig(instance_chunk=sub, shap=shap_cfg)
     ex = KernelShap(clf.predict_proba, link="logit", feature_names=names, seed=0,
                     distributed_opts=opts, engine_config=cfg)
     ex.fit(X[:100], group_names=names, groups=groups)

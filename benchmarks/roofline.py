@@ -123,6 +123,31 @@ def tree_exact_cost(B, N, K, M, T, L, Nn, interactions=False):
             "transcendentals": transcendental, "hbm_bytes": hbm}
 
 
+def cnn_masked_cost(B, S, N, K, D, M, flops_per_eval=1.16e6):
+    """Work of one image explain call (``ops/image`` superpixel masking +
+    the generic synthetic-row path): every (coalition, instance, background)
+    triple synthesises one masked image and evaluates the CNN on it.
+
+    ``flops_per_eval`` for the benchmark CNN (``models/cnn.py``:
+    Conv16(3x3,s2) 2*14*14*16*9 = 56k, Conv32(3x3,s2) 2*7*7*32*9*16 = 903k,
+    Dense64 2*1568*64 = 201k, Dense10 2*64*10 = 1.3k ≈ 1.16 MFLOP/image).
+    Unlike the tabular paths the synthetic rows DO hit HBM: the generic
+    path materialises each ``lax.map`` coalition chunk before the predictor
+    consumes it (one write + one read)."""
+
+    f32 = 4
+    rows = B * S * N
+    mxu = rows * flops_per_eval + 2 * B * S * (M - 1) * K + 2 * S * (M - 1) ** 2
+    vpu = 3 * rows * D            # per-pixel select/lerp synthesis
+    transcendental = rows * K     # softmax over the logits
+    hbm = f32 * (2 * rows * D     # synthetic chunk written + read
+                 + B * D + N * D + S * M            # inputs
+                 + 2 * B * S * K                    # ey written + read
+                 + B * K * M)                       # phi out
+    return {"mxu_flops": mxu, "vpu_ops": vpu,
+            "transcendentals": transcendental, "hbm_bytes": hbm}
+
+
 def floors(cost):
     return {
         "mxu_s": cost["mxu_flops"] / PEAK["mxu_f32_flops"],
@@ -141,6 +166,9 @@ MEASURED = {
     "covertype_full": 13.08,  # 2026-07-31, full 581k rows, one chip
     "adult_trees": 0.2671,    # 2026-07-31 (separable masked tree path)
     "adult_trees_exact": 0.8835,  # 2026-07-31, PRE-lgamma (gather weights)
+    "mnist": 5.02,            # 2026-07-30 session (12.25 on the slower
+                              # 07-31 session — pre-instance_chunk, so the
+                              # whole 10k-image batch ran as ONE dispatch)
 }
 
 CONFIGS = {
@@ -174,6 +202,10 @@ def main():
                  for name, dims in CONFIGS.items()]
     all_costs += [(name, fn(**dims), dims)
                   for name, (fn, dims) in TREE_CONFIGS.items()]
+    # image config: B=10240 (10k bucketed), S = 2*49 + 2048, mean background
+    # (N=1), K=10 digits, D=28*28 pixels, M=49 superpixels
+    mnist_dims = dict(B=10240, S=2146, N=1, K=10, D=784, M=49)
+    all_costs.append(("mnist", cnn_masked_cost(**mnist_dims), mnist_dims))
     for name, cost, dims in all_costs:
         fl = floors(cost)
         floor = max(fl.values())

@@ -14,7 +14,6 @@ Reference parity notes are cited per method as ``kernel_shap.py:<lines>``.
 
 import copy
 import logging
-from collections import deque
 import math
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -313,6 +312,10 @@ class EngineConfig:
     # without host-callback support, e.g. the axon TPU tunnel); the WLS solve
     # stays on device either way
     host_eval: Optional[bool] = None
+    # in-flight bound for the instance-chunk dispatch/fetch pipeline
+    # (None = resolve via parallel/pipeline.resolve_window: env override or
+    # a live round-trip probe — ~8 through a tunnelled chip, 2 locally)
+    dispatch_window: Optional[int] = None
     # host-eval chunk fan-out across host cores (None = sequential): the
     # reference's worker-pool parallelism applied to the only part of the
     # pipeline that still runs on the host — black-box predictor calls.
@@ -605,10 +608,12 @@ class KernelExplainerEngine:
         packed = jnp.concatenate([out['shap_values'].ravel(),
                                   out['expected_value'].ravel(),
                                   out['raw_prediction'].ravel()])
+        if self.config.shap.transfer_dtype:  # opt-in halved D2H (see ShapConfig)
+            packed = packed.astype(self.config.shap.transfer_dtype)
         Bp = Xp.shape[0]
 
         def finalize() -> Dict[str, np.ndarray]:
-            flat = np.asarray(packed)
+            flat = np.asarray(packed).astype(np.float32, copy=False)
             K, M = self.predictor.n_outputs, self.M
             phi, e_val, fx = np.split(flat, [Bp * K * M, Bp * K * M + K])
             return {
@@ -751,21 +756,24 @@ class KernelExplainerEngine:
             # the device during each wave's tail fetches), so a huge X never
             # enqueues thousands of executions (and their device-resident
             # buffers) at once.  Dispatch stays on this thread (it populates
-            # the jit/plan caches); only the fetches fan out.
-            window = 8
+            # the jit/plan caches); only the fetches fan out.  The window is
+            # resolved by the shared helper (explicit config > env > RTT
+            # probe) instead of round 2's hand-set 8.
+            from distributedkernelshap_tpu.parallel.pipeline import (
+                resolve_window,
+                run_pipeline,
+            )
+
+            window = resolve_window(self.config.dispatch_window,
+                                    n_items=len(chunks))
             with profiler().phase('coalition_plan'):
                 plan = self._plan(nsamples)
             with profiler().phase('device_explain'):
-                pending: deque = deque()
-                results = []
-                with ThreadPoolExecutor(max_workers=window) as pool:
-                    for c in chunks:
-                        fin = self._dispatch_array(c, plan)
-                        pending.append(pool.submit(fin))
-                        if len(pending) >= window:
-                            results.append(pending.popleft().result())
-                    while pending:
-                        results.append(pending.popleft().result())
+                results = run_pipeline(
+                    chunks,
+                    lambda c: self._dispatch_array(c, plan),
+                    lambda fin: fin(),
+                    window=window)
         else:
             results = [self._explain_array(c, nsamples, silent=silent)
                        for c in chunks]
@@ -839,15 +847,35 @@ class KernelExplainerEngine:
 
             self._fn_cache[key] = jax.jit(fn)
 
-        results = []
         with profiler().phase('device_explain'):
-            for c in chunks:
+            from distributedkernelshap_tpu.parallel.pipeline import (
+                resolve_window,
+                run_pipeline,
+            )
+
+            # per-fit constants uploaded once, not once per chunk
+            bgw_dev = jnp.asarray(self.bg_weights)
+            G_dev = jnp.asarray(self.G)
+
+            td = self.config.shap.transfer_dtype
+
+            def _dispatch(c):
                 Xp, B = self._pad_to_bucket(c)
                 out = self._fn_cache[key](
-                    jnp.asarray(Xp, jnp.float32),
-                    jnp.asarray(self.bg_weights), jnp.asarray(self.G))
-                results.append({k: np.asarray(v)[:B]
-                                for k, v in out.items()})
+                    jnp.asarray(Xp, jnp.float32), bgw_dev, G_dev)
+                if td:  # opt-in halved D2H — same contract as the sampled path
+                    out = {k: v.astype(td) for k, v in out.items()}
+                return out, B
+
+            def _fetch(handle):
+                out, B = handle
+                return {k: np.asarray(v)[:B].astype(np.float32, copy=False)
+                        for k, v in out.items()}
+
+            results = run_pipeline(
+                chunks, _dispatch, _fetch,
+                window=resolve_window(self.config.dispatch_window,
+                                      n_items=len(chunks)))
         phi = np.concatenate([r['shap_values'] for r in results], 0)
         self.last_raw_prediction = np.concatenate(
             [r['raw_prediction'] for r in results], 0)

@@ -55,6 +55,14 @@ class ShapConfig:
     # fallback everywhere).  GSPMD-sharded callers must disable it — a
     # pallas_call has no SPMD partitioning rule; shard_map callers are fine.
     use_pallas: Optional[bool] = None
+    # D2H dtype of the packed (phi, E, f(x)) result: None keeps float32.
+    # 'float16' halves the transfer — worthwhile for huge-batch configs whose
+    # result tensor dominates the wire (Covertype: 581k x 7 x 12 phi ≈
+    # 195 MB f32 through a session-throughput-limited tunnel) at the cost of
+    # ~5e-4 absolute rounding on phi (reported additivity error rises to
+    # ~1e-3; the WLS solve itself stays full f32 on device).  Opt-in per
+    # config; never set it where results feed further numeric work.
+    transfer_dtype: Optional[str] = None
 
 
 def groups_to_matrix(groups: Optional[Sequence[Sequence[int]]], n_columns: int) -> np.ndarray:

@@ -1,0 +1,183 @@
+"""Bounded dispatch/fetch pipelining shared by every multi-call explain path.
+
+Three call sites process a long batch as a sequence of device calls: the
+engine's instance-chunk loop (``kernel_shap.py``), and the sharded pool and
+exact paths (``parallel/distributed.py``).  All three need the same two
+things the reference got from Ray's actor pool for free
+(``explainers/distributed.py:152``):
+
+* **dispatch ahead of fetch** — JAX dispatch is asynchronous, so slab k+1's
+  compute can be enqueued while slab k's D2H round trip is in flight;
+* **overlapping fetches** — through a tunnelled TPU every D2H sync is a
+  ~70 ms RPC regardless of payload, and round trips overlap only across
+  *threads* (serial fetches from one thread serialise their RPCs).
+
+Round 2 hand-set the in-flight window per call site (3 on the sharded
+paths, 8 on the chunk loop) with no measurement behind either value; this
+module replaces those constants with one shared, overridable resolution
+(VERDICT.md round 2, item 7): an explicit request beats the
+``DKS_DISPATCH_WINDOW`` environment knob beats a latency-derived default
+measured from the live backend — the same principle as the serving layer's
+:func:`~distributedkernelshap_tpu.serving.server.calibrate_pipeline_depth`,
+but from a single cheap round-trip probe instead of a throughput sweep
+(pool slabs are real work; burning probe slabs at startup would cost more
+than the window mis-set ever could).
+
+Multi-host caveat: sharded fetches embed collectives (``process_allgather``
+over ICI/DCN), so every process must dispatch and fetch in the SAME order —
+the window must be deterministic across hosts and the fetches serial.  The
+resolver therefore never probes under ``jax.process_count() > 1`` and
+:func:`run_pipeline` must be called with ``threaded=False`` there (the
+callers gate on process count).
+"""
+
+import logging
+import math
+import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Iterable, List, Optional
+
+logger = logging.getLogger(__name__)
+
+#: fixed window used whenever a measured one would be unsafe or unavailable
+#: (multi-host meshes need cross-process determinism; probe failures).
+DETERMINISTIC_WINDOW = 3
+
+#: in-flight ceiling: each slot holds one slab's device-resident
+#: inputs+outputs, so the window bounds peak HBM residency of the loop.
+MAX_WINDOW = 8
+
+_rtt_cache: Optional[float] = None
+_rtt_lock = threading.Lock()
+
+
+def device_round_trip_s(probes: int = 3, refresh: bool = False) -> float:
+    """Median wall-clock of a tiny dispatch+D2H on the default device.
+
+    The payload is 8 floats: through a tunnelled TPU the cost is pure RPC
+    latency (~70 ms/call observed), on a locally attached chip ~1 ms, on
+    the CPU backend ~microseconds.  Cached per process — the probe itself
+    costs ``probes`` round trips.
+    """
+
+    global _rtt_cache
+    with _rtt_lock:
+        if _rtt_cache is not None and not refresh:
+            return _rtt_cache
+        import jax.numpy as jnp
+        import numpy as np
+
+        x = jnp.arange(8.0, dtype=jnp.float32)
+        np.asarray(x + 0.0)  # warm: backend init + compile out of the timing
+        times = []
+        for i in range(1, probes + 1):
+            t0 = time.perf_counter()
+            np.asarray(x + float(i))  # np.asarray blocks on the value
+            times.append(time.perf_counter() - t0)
+        _rtt_cache = float(sorted(times)[len(times) // 2])
+        logger.debug("device round trip: %.1f ms", _rtt_cache * 1e3)
+        return _rtt_cache
+
+
+def resolve_window(requested: Optional[int] = None,
+                   n_items: Optional[int] = None) -> int:
+    """Resolve the dispatch window for a multi-call explain loop.
+
+    Priority: ``requested`` (``distributed_opts['dispatch_window']`` /
+    ``EngineConfig.dispatch_window``) > ``DKS_DISPATCH_WINDOW`` env >
+    latency-derived default ``1 + ceil(rtt / 10 ms)`` clamped to
+    ``[2, MAX_WINDOW]`` — a tunnelled chip (rtt ≈ 70 ms) resolves to 8, a
+    locally attached chip or the CPU backend to 2.  The 10 ms divisor is
+    the round figure below the smallest per-slab device time seen at
+    benchmark shapes (~25 ms for a 320-row Adult slab), so the window
+    always hides at least one fetch RTT behind in-flight compute; slower
+    slabs simply leave later slots idle, costing nothing but their buffer
+    residency.
+
+    Under multi-host execution the window must be identical on every
+    process (fetches embed collectives), so the probe is skipped and
+    :data:`DETERMINISTIC_WINDOW` (or the explicit/env override, which is
+    assumed uniform across hosts) is used.
+    """
+
+    cap = MAX_WINDOW if n_items is None else max(1, min(MAX_WINDOW, n_items))
+    if requested:
+        return max(1, min(int(requested), cap))
+    env = os.environ.get("DKS_DISPATCH_WINDOW")
+    if env:
+        try:
+            return max(1, min(int(env), cap))
+        except ValueError:
+            logger.warning("ignoring non-integer DKS_DISPATCH_WINDOW=%r", env)
+    import jax
+
+    if jax.process_count() > 1:
+        return min(DETERMINISTIC_WINDOW, cap)
+    try:
+        rtt = device_round_trip_s()
+    except Exception:  # never let a probe failure break an explain call
+        logger.warning("device RTT probe failed; window=%d",
+                       DETERMINISTIC_WINDOW, exc_info=True)
+        return min(DETERMINISTIC_WINDOW, cap)
+    return max(2, min(1 + math.ceil(rtt / 0.010), cap))
+
+
+def run_pipeline(items: Iterable[Any],
+                 dispatch: Callable[[Any], Any],
+                 fetch: Callable[[Any], Any],
+                 window: int,
+                 threaded: bool = True) -> List[Any]:
+    """``[fetch(dispatch(item)) for item in items]`` with bounded overlap.
+
+    ``dispatch`` runs on the calling thread, in order (it may populate jit
+    caches and must keep device program order deterministic); at most
+    ``window`` dispatched-but-unfetched items exist at any moment, bounding
+    peak device residency.  With ``threaded=True`` fetches fan out to a
+    small pool so their D2H round trips overlap; results are returned in
+    item order regardless.  ``threaded=False`` (required on multi-host
+    meshes, where fetches embed collectives that must stay ordered) keeps
+    the round-2 serial sliding window.
+
+    A fetch/dispatch exception propagates to the caller after in-flight
+    work drains (the executor joins on exit), matching the serial path's
+    fail-fast behaviour closely enough for callers that treat any failure
+    as fatal.
+    """
+
+    items = list(items)
+    window = max(1, int(window))
+    if not threaded or window <= 1 or len(items) <= 1:
+        pending: deque = deque()
+        results = []
+        for it in items:
+            pending.append(dispatch(it))
+            if len(pending) >= window:
+                results.append(fetch(pending.popleft()))
+        while pending:
+            results.append(fetch(pending.popleft()))
+        return results
+
+    sem = threading.BoundedSemaphore(window)
+    failed = threading.Event()  # fail fast: stop dispatching once a fetch dies
+    with ThreadPoolExecutor(max_workers=min(window, MAX_WINDOW)) as pool:
+        futures = []
+        for it in items:
+            sem.acquire()  # bounds dispatched-but-unfetched slabs
+            if failed.is_set():
+                break  # don't burn device work after a fatal fetch error
+            handle = dispatch(it)
+
+            def _fetch(handle=handle):
+                try:
+                    return fetch(handle)
+                except BaseException:
+                    failed.set()
+                    raise
+                finally:
+                    sem.release()
+
+            futures.append(pool.submit(_fetch))
+        return [f.result() for f in futures]

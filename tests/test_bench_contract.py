@@ -42,7 +42,7 @@ def test_emit_error_attaches_fallback_measurement(monkeypatch):
     monkeypatch.setattr(bench, "_cpu_fallback", lambda t: (0.53, None))
     rc, out = _capture(bench._emit_error,
                        {"metric": bench._METRIC, "error": "wedged"},
-                       time.monotonic(), 420.0)
+                       time.monotonic(), 420.0, 100.0)
     assert rc == 1
     rec = json.loads(out.strip().splitlines()[-1])
     assert rec["error"] == "wedged"
@@ -56,7 +56,7 @@ def test_emit_error_still_parseable_when_fallback_fails(monkeypatch):
                         lambda t: (None, "cpu fallback exceeded 30s"))
     rc, out = _capture(bench._emit_error,
                        {"metric": bench._METRIC, "error": "wedged"},
-                       time.monotonic(), 420.0)
+                       time.monotonic(), 420.0, 100.0)
     assert rc == 1
     rec = json.loads(out.strip().splitlines()[-1])
     assert rec["error"] == "wedged"
@@ -75,7 +75,7 @@ def test_emit_error_caps_fallback_at_reserve(monkeypatch):
                         lambda t: granted.append(t) or (0.5, None))
     rc, out = _capture(bench._emit_error,
                        {"metric": bench._METRIC, "error": "wedged"},
-                       time.monotonic(), 420.0)
+                       time.monotonic(), 420.0, 100.0)
     assert rc == 1
     assert granted and granted[0] <= 100.0
 

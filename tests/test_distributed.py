@@ -104,6 +104,33 @@ def test_distributed_batch_size_slabs(setup):
     np.testing.assert_allclose(sv[0], sv_seq[0], atol=1e-5)
 
 
+def test_distributed_f16_transfer_and_window(setup):
+    """The sharded slab pipeline honours dispatch_window and the opt-in
+    f16 result transfer; results stay float32 on the host and match the
+    f32 path to f16 rounding."""
+
+    from distributedkernelshap_tpu.kernel_shap import EngineConfig
+    from distributedkernelshap_tpu.ops.explain import ShapConfig
+
+    seq = KernelExplainerEngine(setup["pred"], setup["data"], link="logit", seed=0)
+    sv_seq = seq.get_explanation(setup["X"], nsamples=64)
+
+    dist = DistributedExplainer(
+        {"n_devices": 8, "batch_size": 1, "dispatch_window": 2,
+         "algorithm": "kernel_shap"},
+        KernelExplainerEngine,
+        (setup["pred"], setup["data"]),
+        {"link": "logit", "seed": 0,
+         "config": EngineConfig(shap=ShapConfig(transfer_dtype="float16"))},
+    )
+    assert dist.dispatch_window == 2
+    sv = dist.get_explanation(setup["X"], nsamples=64)
+    for a, b in zip(sv_seq, sv):
+        assert np.asarray(b).dtype == np.float32
+        np.testing.assert_allclose(a, b, atol=2e-3)
+    assert dist.last_raw_prediction.dtype == np.float32
+
+
 def test_distributed_batch_fits_one_slab(setup):
     """batch_size >= B must not pad the batch up to batch_size * n_devices
     (that multiplied the work by up to n_devices): it runs as one sharded
