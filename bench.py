@@ -115,10 +115,13 @@ def run_benchmark(cpu_fallback: bool = False) -> int:
     n_devices = len(jax.devices())
     distributed_opts = {"n_devices": n_devices} if n_devices > 1 else None
 
+    from distributedkernelshap_tpu.utils import data_provenance
+
     explainer = KernelShap(clf.predict_proba, link="logit",
                            feature_names=group_names, seed=0,
                            distributed_opts=distributed_opts)
-    explainer.fit(background, group_names=group_names, groups=groups)
+    explainer.fit(background, group_names=group_names, groups=groups,
+                  data_provenance=data_provenance(data))
 
     # warmup: compile + first run (the reference also reuses a fitted
     # explainer across its nruns timing loop, ray_pool.py:70-79)
@@ -149,6 +152,10 @@ def run_benchmark(cpu_fallback: bool = False) -> int:
         # honest-labelling: 'tpu' through the axon tunnel, 'cpu' when no
         # accelerator backend was reachable (never silently conflated)
         "platform": jax.default_backend(),
+        # 'uci' (real fetch) | 'synthetic' (offline lookalike) —
+        # measurements always declare which data they ran on
+        "data_provenance": explanation.meta.get("data_provenance",
+                                                "unspecified"),
     }
     print(json.dumps(record))
     return 0
@@ -166,7 +173,9 @@ def _cpu_fallback(timeout_s: float):
 
     if timeout_s < 30:
         return None, "no budget left for the CPU fallback"
-    env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu")
+    # DKS_OFFLINE: the fallback's budget must never be spent on network
+    # attempts if the data caches are somehow missing
+    env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu", DKS_OFFLINE="1")
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--run-cpu"],
         stdout=subprocess.PIPE, cwd=os.path.dirname(os.path.abspath(__file__)),

@@ -85,6 +85,15 @@ def _model_err(explanation, model_out, link="logit"):
     return float(np.abs(total - out.reshape(total.shape)).max())
 
 
+
+def _prov(data):
+    """Provenance tag for a load_data() dict (see utils.data_provenance)."""
+
+    from distributedkernelshap_tpu.utils import data_provenance
+
+    return data_provenance(data)
+
+
 def config_adult(smoke=False):
     from distributedkernelshap_tpu import KernelShap
     from distributedkernelshap_tpu.utils import load_data, load_model
@@ -99,7 +108,8 @@ def config_adult(smoke=False):
     ex.fit(data["background"]["X"]["preprocessed"], group_names=gn, groups=g)
     t, explanation = _timed_explain(ex, X)
     return {"metric": "adult_2560_bg100_wall_s", "value": round(t, 4), "unit": "s",
-            "n_instances": X.shape[0], "additivity_err": _additivity(explanation)}
+            "n_instances": X.shape[0], "additivity_err": _additivity(explanation),
+            "data_provenance": _prov(data)}
 
 
 def config_adult_stress(smoke=False):
@@ -130,7 +140,8 @@ def config_adult_stress(smoke=False):
     ex.fit(bg, group_names=gn, groups=g)
     t, explanation = _timed_explain(ex, X, nsamples=2048)
     return {"metric": "adult_bg1000_ns2048_wall_s", "value": round(t, 4), "unit": "s",
-            "n_instances": n_x, "additivity_err": _additivity(explanation)}
+            "n_instances": n_x, "additivity_err": _additivity(explanation),
+            "data_provenance": _prov(data)}
 
 
 def config_adult_blackbox(smoke=False):
@@ -177,6 +188,7 @@ def config_adult_blackbox(smoke=False):
     t, explanation = _timed_explain(ex, X, nruns=1)
     return {"metric": "adult_blackbox_wall_s", "value": round(t, 4), "unit": "s",
             "n_instances": X.shape[0], "additivity_err": _additivity(explanation),
+            "data_provenance": _prov(data),
             "predictor": type(clf).__name__}
 
 
@@ -218,6 +230,7 @@ def config_adult_trees(smoke=False):
     t, explanation = _timed_explain(ex, X, nruns=1 if smoke else 3)
     return {"metric": "adult_trees_wall_s", "value": round(t, 4), "unit": "s",
             "n_instances": X.shape[0], "additivity_err": _additivity(explanation),
+            "data_provenance": _prov(data),
             "model_err": _model_err(explanation, clf.predict_proba(X)),
             "predictor": type(clf).__name__, "device_lifted": lifted}
 
@@ -266,6 +279,7 @@ def config_adult_trees_exact(smoke=False):
                              - np.asarray(expl_i.shap_values[0])).max())
     return {"metric": "adult_trees_exact_wall_s", "value": round(t_exact, 4),
             "unit": "s", "n_instances": X.shape[0],
+            "data_provenance": _prov(data),
             "sampled_wall_s": round(t_sampled, 4),
             "speedup_vs_sampled": round(t_sampled / t_exact, 2),
             "model_err": err,
@@ -378,6 +392,7 @@ def config_model_zoo(smoke=False):
     worst = max(v["wall_s"] for v in families.values())
     return {"metric": "model_zoo_worst_wall_s", "value": worst, "unit": "s",
             "n_instances": X.shape[0], "families": families,
+            "data_provenance": _prov(data),
             "additivity_err": max(v["additivity_err"] for v in families.values()),
             "model_err": max(v["model_err"] for v in families.values())}
 
@@ -420,6 +435,7 @@ def config_mnist(smoke=False):
     # the fully on-device pipeline
     t, explanation = _timed_explain(ex, X, nruns=1 if smoke else 3, l1_reg=False)
     return {"metric": "mnist_cnn_superpixel_wall_s", "value": round(t, 4), "unit": "s",
+            "data_provenance": data.get("provenance", "synthetic"),
             "n_instances": X.shape[0], "cnn_test_acc": round(acc, 3),
             "n_superpixels": len(groups), "additivity_err": _additivity(explanation)}
 
@@ -465,6 +481,7 @@ def config_covertype(smoke=False):
     ex.fit(X[:100], group_names=names, groups=groups)
     t, explanation = _timed_explain(ex, X_explain, nruns=1 if smoke else 3)
     return {"metric": "covertype_sharded_wall_s", "value": round(t, 4), "unit": "s",
+            "data_provenance": data.get("provenance", "synthetic"),
             "n_instances": X_explain.shape[0], "n_devices": n_dev,
             "inst_per_s": round(X_explain.shape[0] / t, 1),
             "additivity_err": _additivity(explanation)}

@@ -34,12 +34,15 @@ def fit_kernel_shap_explainer(clf, data: dict, distributed_opts: Dict[str, Any] 
     """Fitted KernelShap explainer for ``clf`` with grouping from ``data``
     (reference ray_pool.py:18-38 call shape)."""
 
+    from distributedkernelshap_tpu.utils import data_provenance
+
     pred_fcn = clf.predict_proba
     group_names, groups = data['all']['group_names'], data['all']['groups']
     explainer = KernelShap(pred_fcn, link='logit', feature_names=group_names,
                            distributed_opts=distributed_opts, seed=0)
     explainer.fit(data['background']['X']['preprocessed'],
-                  group_names=group_names, groups=groups)
+                  group_names=group_names, groups=groups,
+                  data_provenance=data_provenance(data))
     return explainer
 
 
@@ -51,7 +54,9 @@ def run_explainer(explainer, X_explain: np.ndarray, distributed_opts: dict, nrun
         os.mkdir('./results')
     batch_size = distributed_opts['batch_size']
     workers = distributed_opts.get('n_devices') or distributed_opts.get('n_cpus')
-    result = {'t_elapsed': []}
+    result = {'t_elapsed': [],
+              'data_provenance': explainer.meta.get('data_provenance',
+                                                    'unspecified')}
     for run in range(nruns):
         logging.info("run: %d", run)
         t_start = timer()

@@ -34,10 +34,13 @@ def prepare_explainer_args(data: dict):
     """Constructor/fit args for the served explainer
     (reference serve_explanations.py:70-93 call shape)."""
 
+    from distributedkernelshap_tpu.utils import data_provenance
+
     group_names, groups = data['all']['group_names'], data['all']['groups']
     background = data['background']['X']['preprocessed']
     constructor_kwargs = {'link': 'logit', 'feature_names': group_names, 'seed': 0}
-    fit_kwargs = {'group_names': group_names, 'groups': groups}
+    fit_kwargs = {'group_names': group_names, 'groups': groups,
+                  'data_provenance': data_provenance(data)}
     return background, constructor_kwargs, fit_kwargs
 
 
@@ -102,7 +105,9 @@ def run_config(predictor, data, X_explain, replicas: int, max_batch_size: int,
             from distributedkernelshap_tpu.utils import batch as make_batches
 
             minibatches = make_batches(X_explain, batch_size=max_batch_size)
-        result = {'t_elapsed': []}
+        result = {'t_elapsed': [],
+                  'data_provenance': server.model.explainer.meta.get(
+                      'data_provenance', 'unspecified')}
         for run in range(nruns):
             logging.info("run: %d", run)
             t_start = timer()

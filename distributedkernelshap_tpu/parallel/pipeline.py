@@ -106,6 +106,8 @@ def resolve_window(requested: Optional[int] = None,
     cap = MAX_WINDOW if n_items is None else max(1, min(MAX_WINDOW, n_items))
     if requested:
         return max(1, min(int(requested), cap))
+    if cap < 2:
+        return cap  # nothing to pipeline: skip the probe entirely
     env = os.environ.get("DKS_DISPATCH_WINDOW")
     if env:
         try:

@@ -124,6 +124,18 @@ def load_data():
     return data
 
 
+def data_provenance(data: dict) -> str:
+    """Which data a ``load_data()`` dict carries: ``'uci'`` (real fetch),
+    ``'synthetic'`` (offline lookalike) or ``'unknown-cache'`` for cache
+    files written before provenance stamping.  Benchmarks write this into
+    every result artifact (VERDICT r2 item 6)."""
+
+    try:
+        return str(data["all"].get("provenance", "unknown-cache"))
+    except (KeyError, TypeError, AttributeError):
+        return "unknown-cache"
+
+
 def _load_script(name: str):
     """Import a module from the repo-root ``scripts/`` directory regardless of
     the caller's working directory or sys.path."""

@@ -61,21 +61,166 @@ CATEGORY_COUNTS = {
 }
 N_ROWS = 32561  # UCI Adult size
 
+# real-data sources, tried in order (reference process_adult_data.py:20-24)
+ADULT_URLS = [
+    "https://storage.googleapis.com/seldon-datasets/adult/adult.data",
+    "https://archive.ics.uci.edu/ml/machine-learning-databases/adult/adult.data",
+    "http://mlr.cs.umass.edu/ml/machine-learning-databases/adult/adult.data",
+]
+
+# category remappings applied to the raw UCI data before encoding — these
+# tables ARE the reference's ETL specification (process_adult_data.py:77-122);
+# reproduced so a real fetch yields byte-compatible groups
+_EDUCATION_MAP = {
+    "10th": "Dropout", "11th": "Dropout", "12th": "Dropout",
+    "1st-4th": "Dropout", "5th-6th": "Dropout", "7th-8th": "Dropout",
+    "9th": "Dropout", "Preschool": "Dropout",
+    "HS-grad": "High School grad", "Some-college": "High School grad",
+    "Masters": "Masters", "Prof-school": "Prof-School",
+    "Assoc-acdm": "Associates", "Assoc-voc": "Associates",
+}
+_OCCUPATION_MAP = {
+    "Adm-clerical": "Admin", "Armed-Forces": "Military",
+    "Craft-repair": "Blue-Collar", "Exec-managerial": "White-Collar",
+    "Farming-fishing": "Blue-Collar", "Handlers-cleaners": "Blue-Collar",
+    "Machine-op-inspct": "Blue-Collar", "Other-service": "Service",
+    "Priv-house-serv": "Service", "Prof-specialty": "Professional",
+    "Protective-serv": "Other", "Sales": "Sales", "Tech-support": "Other",
+    "Transport-moving": "Blue-Collar",
+}
+_COUNTRY_MAP = {
+    "Cambodia": "SE-Asia", "Canada": "British-Commonwealth", "China": "China",
+    "Columbia": "South-America", "Cuba": "Other",
+    "Dominican-Republic": "Latin-America", "Ecuador": "South-America",
+    "El-Salvador": "South-America", "England": "British-Commonwealth",
+    "France": "Euro_1", "Germany": "Euro_1", "Greece": "Euro_2",
+    "Guatemala": "Latin-America", "Haiti": "Latin-America",
+    "Holand-Netherlands": "Euro_1", "Honduras": "Latin-America",
+    "Hong": "China", "Hungary": "Euro_2", "India": "British-Commonwealth",
+    "Iran": "Other", "Ireland": "British-Commonwealth", "Italy": "Euro_1",
+    "Jamaica": "Latin-America", "Japan": "Other", "Laos": "SE-Asia",
+    "Mexico": "Latin-America", "Nicaragua": "Latin-America",
+    "Outlying-US(Guam-USVI-etc)": "Latin-America", "Peru": "South-America",
+    "Philippines": "SE-Asia", "Poland": "Euro_2", "Portugal": "Euro_2",
+    "Puerto-Rico": "Latin-America", "Scotland": "British-Commonwealth",
+    "South": "Euro_2", "Taiwan": "China", "Thailand": "SE-Asia",
+    "Trinadad&Tobago": "Latin-America", "United-States": "United-States",
+    "Vietnam": "SE-Asia",
+}
+_MARRIED_MAP = {
+    "Never-married": "Never-Married", "Married-AF-spouse": "Married",
+    "Married-civ-spouse": "Married", "Married-spouse-absent": "Separated",
+    "Separated": "Separated", "Divorced": "Separated", "Widowed": "Widowed",
+}
+
+
+def _fetch_adult_uci(timeout_s: float = 5.0):
+    """Download + transform the REAL UCI Adult set (reference
+    process_adult_data.py:30-147): drop ``fnlwgt``/``Education-Num``, apply
+    the category remap tables, label-encode categoricals.  Returns a Bunch
+    with ``provenance='uci'`` or ``None`` when every source is unreachable
+    (this build's default environment has zero egress — the path exists so
+    deployments WITH network record real-data results)."""
+
+    import urllib.error
+    import urllib.request
+
+    raw_features = ["Age", "Workclass", "fnlwgt", "Education", "Education-Num",
+                    "Marital Status", "Occupation", "Relationship", "Race",
+                    "Sex", "Capital Gain", "Capital Loss", "Hours per week",
+                    "Country", "Target"]
+    text = None
+    for url in ADULT_URLS:
+        try:
+            with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+                text = resp.read().decode("utf-8", errors="replace")
+            break
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            logger.info("Adult source %s unreachable (%s)", url, e)
+    if text is None:
+        return None
+
+    import io
+
+    import pandas as pd
+    from sklearn.preprocessing import LabelEncoder
+
+    try:
+        raw = pd.read_csv(io.StringIO(text), names=raw_features,
+                          delimiter=", ", engine="python").fillna("?")
+        labels = (raw["Target"] == ">50K").astype(int).values
+        data = raw.drop(["fnlwgt", "Education-Num", "Target"], axis=1)
+        features = list(data.columns)
+        for feat, fmap in (("Education", _EDUCATION_MAP),
+                           ("Occupation", _OCCUPATION_MAP),
+                           ("Country", _COUNTRY_MAP),
+                           ("Marital Status", _MARRIED_MAP)):
+            data[feat] = data[feat].map(lambda v, m=fmap: m.get(v, v))
+
+        category_map = {}
+        for f in features:
+            if data[f].dtype == "O":
+                le = LabelEncoder()
+                data[f] = le.fit_transform(data[f].values)
+                category_map[features.index(f)] = list(le.classes_)
+
+        bunch = Bunch(data=data.values.astype(float), target=labels,
+                      feature_names=features, target_names=["<=50K", ">50K"],
+                      category_map=category_map, provenance="uci")
+    except (ValueError, KeyError, TypeError) as e:
+        # an HTTP-200 error page / truncated transfer parses "successfully"
+        # under the lenient python engine but dies in the transform
+        logger.warning("Downloaded Adult data failed to parse (%s); "
+                       "discarding it rather than caching a bad copy.", e)
+        return None
+    # schema guard BEFORE anything caches this: an HTTP-200 error page or a
+    # truncated transfer parses "successfully" under the lenient python
+    # engine and would otherwise poison the cache as provenance='uci'
+    if (bunch.data.shape != (N_ROWS, len(FEATURE_NAMES))
+            or features != FEATURE_NAMES
+            or sorted(bunch.category_map) != sorted(
+                FEATURE_NAMES.index(f) for f in CATEGORY_COUNTS)):
+        logger.warning(
+            "Downloaded Adult data failed the schema check (shape=%s); "
+            "discarding it rather than caching a bad copy.",
+            bunch.data.shape)
+        return None
+    return bunch
+
 
 def fetch_adult(return_X_y: bool = False, seed: int = 42):
     """Return the Adult dataset as a Bunch (reference process_adult_data.py:30-147).
 
-    Loads ``data/adult_raw.pkl`` if present (a cached real copy); otherwise
-    generates a synthetic lookalike deterministically from ``seed``.
+    Resolution order: a cached copy (``data/adult_raw.pkl``), then — unless
+    ``DKS_OFFLINE=1`` — the real UCI download, then the deterministic
+    synthetic lookalike.  The returned Bunch carries ``provenance``
+    (``'uci'`` | ``'synthetic'``), which flows into every saved pickle and
+    result artifact so measurements always declare which data they used.
     """
 
     cache = os.path.join(REPO_ROOT, "data", "adult_raw.pkl")
     if os.path.exists(cache):
         with open(cache, "rb") as f:
             bunch = pickle.load(f)
+        if "provenance" not in bunch:  # pre-provenance cache files
+            bunch.provenance = "unknown-cache"
         if return_X_y:
             return bunch.data, bunch.target
         return bunch
+
+    if os.environ.get("DKS_OFFLINE") != "1":
+        bunch = _fetch_adult_uci()
+        if bunch is not None:
+            ensure_dir(cache)
+            with open(cache, "wb") as f:
+                pickle.dump(bunch, f)
+            logger.info("Fetched real UCI Adult (%d rows); cached to %s",
+                        bunch.data.shape[0], cache)
+            if return_X_y:
+                return bunch.data, bunch.target
+            return bunch
+        logger.info("No Adult source reachable; generating the synthetic "
+                    "lookalike (provenance='synthetic').")
 
     rng = np.random.default_rng(seed)
     n = N_ROWS
@@ -117,6 +262,7 @@ def fetch_adult(return_X_y: bool = False, seed: int = 42):
         feature_names=list(FEATURE_NAMES),
         target_names=["<=50K", ">50K"],
         category_map=category_map,
+        provenance="synthetic",
     )
     if return_X_y:
         return data, labels
@@ -188,6 +334,9 @@ def preprocess_adult_dataset(dataset, seed=0, n_train_examples=30000):
         "orig_feature_names": feature_names,
         "groups": groups,
         "group_names": group_names,
+        # which data this is: 'uci' (real fetch) | 'synthetic' (offline
+        # lookalike) — stamped into every downstream result artifact
+        "provenance": dataset.get("provenance", "synthetic"),
     }
 
 
@@ -199,7 +348,8 @@ def generate_and_save(n_background_samples: int = 100, n_train_examples: int = 3
 
     adult_dataset = load_adult_dataset()
     adult_preprocessed = preprocess_adult_dataset(adult_dataset, n_train_examples=n_train_examples)
-    background_dataset = {"X": {"raw": None, "preprocessed": None}, "y": None}
+    background_dataset = {"X": {"raw": None, "preprocessed": None}, "y": None,
+                          "provenance": adult_preprocessed["provenance"]}
     n = n_background_samples
     background_dataset["X"]["raw"] = adult_preprocessed["X"]["raw"]["train"][0:n, :]
     background_dataset["X"]["preprocessed"] = adult_preprocessed["X"]["processed"]["train"][0:n, :]

@@ -73,7 +73,8 @@ def load_covertype(seed: int = 0, n_rows: int = N_ROWS):
         + [f"wilderness_{i}" for i in range(N_WILDERNESS)]
         + [f"soil_{i}" for i in range(N_SOIL)]
     )
-    data = {"X": X, "y": y, "feature_names": feature_names, "synthetic": True}
+    data = {"X": X, "y": y, "feature_names": feature_names,
+            "synthetic": True, "provenance": "synthetic"}
     if cache_writable:
         ensure_dir(COVERTYPE_LOCAL)
         with open(COVERTYPE_LOCAL, "wb") as f:

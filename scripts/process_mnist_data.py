@@ -65,7 +65,7 @@ def load_mnist(seed: int = 0):
     templates = _class_templates(rng)
     train = _synthetic_digits(60000, rng, templates)
     test = _synthetic_digits(10000, rng, templates)
-    data = {"train": train, "test": test}
+    data = {"train": train, "test": test, "provenance": "synthetic"}
     ensure_dir(MNIST_LOCAL)
     with open(MNIST_LOCAL, "wb") as f:
         pickle.dump(data, f)
