@@ -163,7 +163,19 @@ class TreeEnsemblePredictor(BasePredictor):
         finite = thr_np[np.isfinite(thr_np)]
         thr_hi = float(np.abs(finite).max()) if finite.size else 0.0
         f32max = float(np.finfo(np.float32).max)
-        self._nan_sentinel = jnp.float32(min(2.0 * thr_hi + 1.0e6, f32max))
+        if 2.0 * thr_hi + 1.0e6 >= f32max:
+            # the sentinel would clamp to f32max and could compare <= a
+            # finite threshold near f32max as True, flipping NaN/+inf
+            # routing relative to the gather semantics the one-hot path
+            # preserves (ADVICE r2).  No real model has thresholds within
+            # 2x of f32 overflow; refuse loudly instead of mis-routing
+            # silently.
+            raise ValueError(
+                f"tree thresholds reach |t|={thr_hi:.3g}, too close to the "
+                f"float32 maximum for the non-finite-input sentinel to stay "
+                f"ordered above every finite threshold; rescale the feature "
+                f"or threshold units before lifting this ensemble")
+        self._nan_sentinel = jnp.float32(2.0 * thr_hi + 1.0e6)
         self._build_paths(np.asarray(feature), np.asarray(left),
                           np.asarray(right), np.asarray(value))
 

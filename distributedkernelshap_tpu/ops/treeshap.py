@@ -215,6 +215,9 @@ def exact_shap_from_reach(pred, X, reach, bgw, G,
     memory is ``B x chunk x T x L`` rather than the full ``B x N`` block.
     An explicit ``bg_chunk`` is honoured as passed; ``None`` (default)
     auto-sizes against ``target_chunk_elems`` (see ``_bounded_bg_chunk``).
+    (The default changed from a fixed ``16`` to ``None`` in round 3 —
+    numerically invariant, but direct callers that tuned peak memory
+    around the old fixed slab should pass ``bg_chunk=16`` explicitly.)
 
     ``normalized=True`` skips the internal weight normalisation — for
     callers that shard the background axis across devices and psum the
@@ -318,6 +321,11 @@ def exact_interactions_from_reach(pred, X, reach, bgw, G,
 
     Cost is ~``M``x the main-effect pass (one main-effect-shaped einsum set
     per group); callers should keep ``M`` modest (raises above 64 groups).
+    The per-group loop is unrolled into the jitted graph (~4 large einsums
+    per group per chunk body), so COMPILE time and program size also scale
+    linearly with ``M`` — near the M=64 cap that is ~260 einsums; if
+    compile latency ever matters there, convert the loop to a ``lax.map``
+    over a stacked group axis (runtime cost is unchanged either way).
     """
 
     M = int(jnp.asarray(G).shape[0])
@@ -417,6 +425,14 @@ def exact_tree_shap(pred, X, bg, bgw, G, bg_chunk: Optional[int] = None):
     Callers explaining many instance chunks should hoist
     :func:`background_reach` + :func:`exact_shap_from_reach` instead of
     paying the background pass per chunk (the engine does).
+
+    .. versionchanged:: round 3
+        ``bg_chunk`` defaults to ``None`` (auto-sized from
+        ``target_chunk_elems``) instead of the former fixed ``16``.
+        Numerically invariant, but peak memory now scales with the element
+        budget rather than a fixed background-slab count — direct callers
+        that tuned around the old default should pass ``bg_chunk=16``
+        explicitly.
     """
 
     if not supports_exact(pred):

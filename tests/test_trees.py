@@ -612,6 +612,28 @@ def test_nan_missing_left_routing(go_left):
     assert out == (-1.0 if go_left else 1.0)
 
 
+def test_thresholds_near_f32max_refused_at_construction():
+    """Thresholds within 2x of float32 overflow would clamp the non-finite
+    sentinel below a finite threshold, silently flipping NaN/+inf routing
+    (ADVICE r2) — construction must refuse instead."""
+
+    from distributedkernelshap_tpu.models.trees import TreeEnsemblePredictor
+
+    feature = np.array([[1, 0, 0]])
+    f32max = float(np.finfo(np.float32).max)
+    threshold = np.array([[0.75 * f32max, np.inf, np.inf]], np.float32)
+    left = np.array([[1, 1, 2]])
+    right = np.array([[2, 1, 2]])
+    value = np.zeros((1, 3, 1), np.float32)
+    with pytest.raises(ValueError, match="float32 maximum"):
+        TreeEnsemblePredictor(feature, threshold, left, right, value, depth=1)
+    # comfortably-finite thresholds construct fine with an ordered sentinel
+    ok = TreeEnsemblePredictor(feature, np.array([[1e30, np.inf, np.inf]],
+                                                 np.float32),
+                               left, right, value, depth=1)
+    assert float(ok._nan_sentinel) > 1e30
+
+
 def test_split_conditions_onehot_matches_gather_oracle():
     """_split_conditions (one-hot contraction; see _feature_onehot for the
     TPU gather+compare miscompile it dodges) must equal the direct
