@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: test bench configs serve sweep-pool sweep-serve analysis multihost-ci
 
-multihost-ci:    ## 2-process multi-host validation (one JSON line, rc 0/1)
+multihost-ci:    ## multi-host validation: 2-proc pool/phi/interactions, 4-proc 2x2 mesh, 2-proc serve (one JSON line, rc 0/1)
 	$(PY) benchmarks/multihost_ci.py
 
 test:            ## full suite on CPU with 8 virtual devices

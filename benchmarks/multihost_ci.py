@@ -1,11 +1,10 @@
 """CI-style multi-host validation driver (standalone, exits nonzero on fail).
 
-Drives ``benchmarks/multihost_pool.py`` with two real OS processes joining
-one ``jax.distributed`` runtime (2 virtual CPU devices each -> a global
-4-device mesh, collectives crossing the process boundary over gloo — the
-DCN stand-in), the way the reference's k8s Makefiles drove
-``k8s_ray_pool.py`` against a live cluster (``cluster/Makefile.pool``,
-``k8s_ray_pool.py:90``).  Checks:
+Drives ``benchmarks/multihost_pool.py`` with real OS processes joining one
+``jax.distributed`` runtime (2 virtual CPU devices each, collectives
+crossing the process boundary over gloo — the DCN stand-in), the way the
+reference's k8s Makefiles drove ``k8s_ray_pool.py`` against a live cluster
+(``cluster/Makefile.pool``, ``k8s_ray_pool.py:90``).  Checks:
 
 1. both processes exit 0 and report a 2-process / 4-device runtime;
 2. the lead process wrote the reference-format result pickle;
@@ -14,7 +13,14 @@ DCN stand-in), the way the reference's k8s Makefiles drove
    oracle of SURVEY.md §4, across a real process boundary);
 4. exact TreeSHAP interaction matrices byte-match across processes and
    agree with a single-process run (the psum-of-local-matrices
-   decomposition, across the same boundary).
+   decomposition, across the same boundary);
+5. FOUR processes x 2 devices on a 2-D ``data(4) x coalition(2)`` mesh —
+   the data axis spans processes while coalition partners are
+   process-local — run the pool benchmark end-to-end (VERDICT r2 item 9);
+6. the multi-host SERVING path: lead process serves HTTP over the
+   2-process mesh via the broadcast protocol
+   (``serving/multihost.py``), and the served shap values match a
+   single-process direct explain.
 
 Prints ONE JSON line and exits 0/1 — suitable for cron/CI.
 
@@ -39,7 +45,12 @@ N_INSTANCES = 64
 NSAMPLES = 64
 N_DEVICES = 4
 
-_PHI_WORKER = """
+# one worker template for every in-process recipe leg (phi, interactions,
+# serve): argv = (pid, coordinator_port, outdir, repo, recipe_name).  A
+# recipe returning an array gets it saved per-process for byte-equality
+# checks; a recipe returning None (the serve leg writes its own artifact)
+# just runs.
+_RECIPE_WORKER = """
 import sys
 sys.path.insert(0, sys.argv[4])
 import jax
@@ -51,8 +62,9 @@ initialize_multihost("127.0.0.1:" + sys.argv[2], 2, pid)
 assert jax.process_count() == 2
 import numpy as np
 import benchmarks.multihost_ci as ci
-fn = getattr(ci, sys.argv[5])
-np.save(sys.argv[3] + "/" + sys.argv[5] + "_" + str(pid) + ".npy", fn())
+out = getattr(ci, sys.argv[5])()
+if out is not None:
+    np.save(sys.argv[3] + "/" + sys.argv[5] + "_" + str(pid) + ".npy", out)
 """
 
 
@@ -96,21 +108,86 @@ def explain_exact_interactions_slice(n_devices: int = N_DEVICES) -> np.ndarray:
     return np.stack(res.data["raw"]["interaction_values"], 1)
 
 
+SERVE_ROWS = 12
+
+
+def serve_leg(n_devices: int = N_DEVICES) -> None:
+    """Per-process body of the multi-host serving leg: the lead serves HTTP
+    over the mesh (``serving/multihost.py`` broadcast protocol), fans
+    ``SERVE_ROWS`` single-row requests at itself, and saves the served phi
+    to the working directory; followers participate via the broadcast loop
+    until shutdown.  Returns None (the recipe worker skips the per-process
+    save)."""
+
+    from distributedkernelshap_tpu.serving.multihost import serve_multihost
+    from distributedkernelshap_tpu.utils import load_data, load_model
+
+    data = load_data()
+    clf = load_model()
+    gn, g = data["all"]["group_names"], data["all"]["groups"]
+    bg = data["background"]["X"]["preprocessed"]
+    srv = serve_multihost(
+        clf, bg, {"link": "logit", "feature_names": gn, "seed": 0},
+        {"group_names": gn, "groups": g}, {"n_devices": n_devices},
+        host="127.0.0.1", port=0, max_batch_size=4, max_rows=64)
+    if srv is None:
+        return  # follower: returns once the lead broadcasts shutdown
+
+    import json as _json
+
+    from distributedkernelshap_tpu.serving import client as cl
+
+    X = data["all"]["X"]["processed"]["test"].toarray()[:SERVE_ROWS].astype(
+        np.float32)
+    try:
+        payloads = cl.distribute_requests(
+            f"http://127.0.0.1:{srv.port}/explain", X, max_workers=8)
+        phi = np.stack([
+            np.asarray(_json.loads(p)["data"]["shap_values"])[:, 0]
+            for p in payloads])                      # (rows, K, M)
+    finally:
+        srv.stop()
+        srv.model.shutdown_followers()
+    np.save(os.path.join(os.getcwd(), "served_phi.npy"), phi)
+
+
+def explain_adult_serving_defaults(rows: int = SERVE_ROWS,
+                                   n_devices: int = N_DEVICES) -> np.ndarray:
+    """Single-process reference for the serving leg: same rows, the serving
+    path's default explain options (auto nsamples, l1_reg='auto')."""
+
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.utils import load_data, load_model
+
+    data = load_data()
+    clf = load_model()
+    gn, g = data["all"]["group_names"], data["all"]["groups"]
+    X = data["all"]["X"]["processed"]["test"].toarray()[:rows]
+    ex = KernelShap(clf.predict_proba, link="logit", feature_names=gn, seed=0,
+                    distributed_opts={"n_devices": n_devices})
+    ex.fit(data["background"]["X"]["preprocessed"], group_names=gn, groups=g)
+    sv = ex.explain(X, silent=True).shap_values
+    return np.stack(sv, 1)
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
 
 
-def _run_two(argv_for_pid, workdir: str, timeout: float):
-    """Two collectively-coupled processes; logs to files (a process blocking
-    on a full pipe would stall its peer inside a shared collective)."""
+def _run_procs(argv_for_pid, workdir: str, timeout: float, n_procs: int = 2,
+               log_prefix: str = "proc"):
+    """``n_procs`` collectively-coupled processes; logs to files (a process
+    blocking on a full pipe would stall its peers inside a shared
+    collective)."""
 
     env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu")
-    logs = [os.path.join(workdir, f"proc{pid}.log") for pid in range(2)]
+    logs = [os.path.join(workdir, f"{log_prefix}{pid}.log")
+            for pid in range(n_procs)]
     procs = []
     try:
-        for pid in range(2):
+        for pid in range(n_procs):
             with open(logs[pid], "wb") as log:
                 procs.append(subprocess.Popen(
                     argv_for_pid(pid), cwd=workdir, env=env,
@@ -133,6 +210,10 @@ def _run_two(argv_for_pid, workdir: str, timeout: float):
             raise RuntimeError(
                 f"process {pid} exited {p.returncode}:\n{texts[pid][-2000:]}")
     return texts
+
+
+def _run_two(argv_for_pid, workdir: str, timeout: float):
+    return _run_procs(argv_for_pid, workdir, timeout, n_procs=2)
 
 
 def main() -> int:
@@ -166,7 +247,7 @@ def main() -> int:
             # --- leg 2: cross-process phi equivalence --------------------
             worker = os.path.join(tmp, "worker.py")
             with open(worker, "w") as f:
-                f.write(_PHI_WORKER)
+                f.write(_RECIPE_WORKER)
 
             def run_recipe(name: str) -> np.ndarray:
                 """Two coupled processes run recipe ``name``; byte-equality
@@ -188,6 +269,37 @@ def main() -> int:
             iv0 = run_recipe("explain_exact_interactions_slice")
             checks["interactions_identical_across_processes"] = "ok"
 
+            # --- leg 4: FOUR processes on a data(4) x coalition(2) mesh --
+            port4 = _free_port()
+            texts4 = _run_procs(lambda pid: [
+                sys.executable, os.path.join(REPO, "benchmarks",
+                                             "multihost_pool.py"),
+                "-b", "8", "-w", "8", "-n", "1", "--limit", "64",
+                "--coalition_parallel", "2",
+                "--platform", "cpu", "--cpu_devices", "2",
+                "--coordinator", f"127.0.0.1:{port4}",
+                "--num_processes", "4", "--process_id", str(pid)],
+                tmp, args.timeout, n_procs=4, log_prefix="p4_")
+            for out in texts4:
+                if "jax.distributed initialised: 4 processes, 8 devices" not in out:
+                    raise RuntimeError("runtime did not span 4 processes:\n"
+                                       + out[-1500:])
+            with open(os.path.join(tmp, "results",
+                                   "ray_workers_8_bsize_8_actorfr_1.0.pkl"),
+                      "rb") as f:
+                result4 = pickle.load(f)
+            assert result4["t_elapsed"] and result4["t_elapsed"][0] > 0
+            checks["pool_benchmark_4proc_2x2_mesh"] = "ok"
+
+            # --- leg 5: multi-host SERVING over the broadcast protocol ---
+            sp = _free_port()
+            _run_procs(lambda pid: [
+                sys.executable, worker, str(pid), str(sp), tmp, REPO,
+                "serve_leg"], tmp, args.timeout, n_procs=2,
+                log_prefix="serve_")
+            served_phi = np.load(os.path.join(tmp, "served_phi.npy"))
+            checks["serve_2proc_mesh"] = "ok"
+
             # single-process reference on this process's own devices
             import jax
 
@@ -198,6 +310,9 @@ def main() -> int:
             np.testing.assert_allclose(iv0, explain_exact_interactions_slice(),
                                        atol=1e-5)
             checks["interactions_match_single_process"] = "ok"
+            np.testing.assert_allclose(
+                served_phi, explain_adult_serving_defaults(), atol=1e-5)
+            checks["served_phi_matches_single_process"] = "ok"
     except Exception as e:  # noqa: BLE001 - CI driver reports, never raises
         checks["error"] = f"{type(e).__name__}: {e}"
         print(json.dumps({"multihost_ci": "fail", **checks}))

@@ -4,3 +4,8 @@ from distributedkernelshap_tpu.serving.wrappers import (  # noqa: F401
 )
 from distributedkernelshap_tpu.serving.server import ExplainerServer, serve_explainer  # noqa: F401
 from distributedkernelshap_tpu.serving.client import distribute_requests, explain_request  # noqa: F401
+from distributedkernelshap_tpu.serving.multihost import (  # noqa: F401
+    MultihostServingModel,
+    follower_loop,
+    serve_multihost,
+)

@@ -1,0 +1,193 @@
+"""SPMD serving over a multi-host mesh.
+
+The single-host server (``serving/server.py``) owns the whole device mesh
+from one process.  On a multi-host mesh (``jax.distributed`` across
+TPU-VM workers — the reference analog is Ray Serve replicas spread over the
+k8s cluster, ``cluster/ray_cluster.yaml:119-141``) a device call is a
+*collective* program: every process must enter the same sharded computation
+in the same order, but HTTP requests arrive only at the lead process.
+
+The bridge is a broadcast protocol, the serving-plane counterpart of the
+SPMD benchmark drivers (``benchmarks/multihost_pool.py``): the lead process
+runs the normal :class:`~distributedkernelshap_tpu.serving.server.ExplainerServer`
+around a :class:`MultihostServingModel`, which prefixes every device call
+with ``multihost_utils.broadcast_one_to_all`` of a fixed-shape header +
+padded batch; follower processes sit in :func:`follower_loop`, receive each
+broadcast, and enter the identical explain call so the mesh's collectives
+line up.  Responses are built on the lead only (host-side work, no
+collectives).  Shutdown is a zero header broadcast.
+
+Pipelining note: the lock-step protocol requires one device call at a time
+in a deterministic order, so the multihost model deliberately does NOT
+expose ``explain_batch_async`` — the server then runs its synchronous
+dispatch path and ``pipeline_depth`` is forced to 1.  Within one coalesced
+batch the device work is still fully sharded across all hosts' devices.
+"""
+
+import logging
+import threading
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_CMD_SHUTDOWN = 0
+_CMD_EXPLAIN = 1
+
+
+def _broadcast(value, is_source: bool):
+    import jax
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.broadcast_one_to_all(
+        value, is_source=is_source if jax.process_count() > 1 else True))
+
+
+class MultihostServingModel:
+    """Wraps a fitted serving model (``KernelShapModel``-like) so every
+    device call is preceded by a header+batch broadcast to the follower
+    processes.
+
+    Parameters
+    ----------
+    model
+        A fitted single-process serving model whose explainer was built
+        with ``distributed_opts`` spanning the multi-host mesh.
+    max_rows
+        Broadcast slot size: every batch is padded to this many rows (the
+        collective needs one static shape on all processes).  Requests
+        stacking more than ``max_rows`` rows are rejected per-request by
+        the server's error path rather than crashing the mesh.
+    """
+
+    def __init__(self, model, max_rows: int = 256):
+        import jax
+
+        self.model = model
+        self.explainer = model.explainer  # passthrough for introspection
+        self.max_rows = int(max_rows)
+        self._n_features = int(
+            model.explainer._explainer.background.shape[1])
+        # one lock serialises EVERY lead-side broadcast: the server's
+        # dispatcher thread runs explain_batch while shutdown_followers may
+        # be called from the main thread — interleaved broadcasts would
+        # desync the followers' header/payload pairing
+        self._bcast_lock = threading.Lock()
+        self._shut = False
+        self._is_lead = jax.process_index() == 0
+        if not self._is_lead:
+            raise RuntimeError(
+                "MultihostServingModel must be constructed on the lead "
+                "process only; followers run follower_loop()")
+
+    # the server treats the absence of explain_batch_async as "dispatch
+    # synchronously" — exactly what the lock-step protocol needs.
+
+    def explain_batch(self, stacked: np.ndarray, split_sizes=None):
+        stacked = np.atleast_2d(np.asarray(stacked, dtype=np.float32))
+        rows = stacked.shape[0]
+        if rows > self.max_rows:
+            raise ValueError(
+                f"batch of {rows} rows exceeds the multihost broadcast slot "
+                f"({self.max_rows}); raise max_rows or lower max_batch_size")
+        header = np.array([_CMD_EXPLAIN, rows], np.int32)
+        padded = np.zeros((self.max_rows, self._n_features), np.float32)
+        padded[:rows] = stacked
+        with self._bcast_lock:
+            if self._shut:
+                # a batch the dispatcher popped before stop(): fail it as a
+                # per-request error instead of broadcasting into a mesh
+                # whose followers have already exited (peerless collective
+                # = permanent hang)
+                raise RuntimeError("multihost serving mesh already shut down")
+            _broadcast(header, is_source=True)
+            _broadcast(padded, is_source=True)
+            return self.model.explain_batch(stacked, split_sizes=split_sizes)
+
+    def shutdown_followers(self):
+        """Release the follower loops.  Idempotent: the first call
+        broadcasts the shutdown header; later calls are no-ops (a second
+        broadcast would block forever — the followers are gone)."""
+
+        with self._bcast_lock:
+            if self._shut:
+                return
+            self._shut = True
+            _broadcast(np.array([_CMD_SHUTDOWN, 0], np.int32), is_source=True)
+
+
+def follower_loop(model, max_rows: int = 256):
+    """Run on every non-lead process: enter each broadcast explain call so
+    the mesh collectives pair with the lead's, until shutdown.
+
+    ``model`` must be built from the SAME constructor/fit arguments as the
+    lead's (SPMD discipline — identical jitted programs and shardings),
+    with the same ``max_rows``.
+    """
+
+    import jax
+
+    if jax.process_index() == 0:
+        raise RuntimeError("follower_loop must not run on the lead process")
+    n_features = int(model.explainer._explainer.background.shape[1])
+    while True:
+        header = _broadcast(np.zeros(2, np.int32), is_source=False)
+        if int(header[0]) == _CMD_SHUTDOWN:
+            logger.info("follower %d: shutdown", jax.process_index())
+            return
+        rows = int(header[1])
+        padded = _broadcast(np.zeros((max_rows, n_features), np.float32),
+                            is_source=False)
+        # identical call shape as the lead's explain_batch: same bucket
+        # padding, same sharded program, same collective sequence.  The
+        # response payloads are host-side only and discarded here.
+        try:
+            model.explain_batch(padded[:rows], split_sizes=[rows])
+        except Exception:
+            # mirror the lead's catch-and-continue (server.py answers the
+            # request with a 500 and keeps serving): a data-dependent
+            # explain error must degrade to one failed request, not kill
+            # this loop and leave the lead's next broadcast peerless.
+            # (If the error struck INSIDE a collective the mesh may be
+            # unrecoverable regardless — SPMD's inherent hazard — but
+            # symmetric host-side failures recover cleanly.)
+            logger.exception("follower %d: explain failed; staying in loop",
+                             jax.process_index())
+
+
+def serve_multihost(predictor, background_data, constructor_kwargs,
+                    fit_kwargs, distributed_opts, host: str = "0.0.0.0",
+                    port: int = 8000, max_batch_size: int = 1,
+                    max_rows: int = 256,
+                    explain_kwargs: Optional[dict] = None):
+    """Entry point for every process of a multi-host serve deployment.
+
+    On the lead process: builds the fitted model over the multi-host mesh,
+    wraps it for broadcast, starts the HTTP server, and returns the server
+    (caller stops it with ``.stop()`` then ``model.shutdown_followers()``).
+    On follower processes: builds the identical model and blocks in
+    :func:`follower_loop` until shutdown (returns None).
+    """
+
+    import jax
+
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+    from distributedkernelshap_tpu.serving.wrappers import (
+        BatchKernelShapModel,
+        KernelShapModel,
+    )
+
+    cls = BatchKernelShapModel if max_batch_size > 1 else KernelShapModel
+    ctor = dict(constructor_kwargs)
+    ctor["distributed_opts"] = dict(distributed_opts)
+    base = cls(predictor, background_data, ctor, fit_kwargs,
+               explain_kwargs=explain_kwargs)
+    if jax.process_index() != 0:
+        follower_loop(base, max_rows=max_rows)
+        return None
+    model = MultihostServingModel(base, max_rows=max_rows)
+    server = ExplainerServer(model, host=host, port=port,
+                             max_batch_size=max_batch_size,
+                             pipeline_depth=1)
+    return server.start()
