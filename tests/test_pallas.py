@@ -82,7 +82,9 @@ def test_fused_linear_ey_many_classes_covertype_shape():
 
     B, S, N, M, K = 40, 300, 20, 12, 7
     tb, ts = _tile_sizes(B, S, N, M, K, _TB, _TS)
-    assert 6 * K * tb * ts * 4 + 2 * K * N * ts * 4 <= _VMEM_BUDGET
+    # round-3 footprint model: (4K+4) live tile sets (recompute-based
+    # multi-pass softmax) + the dT2 scratch
+    assert (4 * K + 4) * tb * ts * 4 + 2 * K * N * ts * 4 <= _VMEM_BUDGET
     assert tb >= 8 and ts >= 128
 
     X, bg, W, b, G, mask, bgw, XWg, bgWg, bgW = _problem(B, S, N, M, K, seed=3)
@@ -112,6 +114,22 @@ def test_tile_sizes_defaults_unchanged_for_small_k():
     from distributedkernelshap_tpu.ops.pallas_kernels import _TB, _TS, _tile_sizes
 
     assert _tile_sizes(B=2560, S=2072, N=100, M=12, K=2, tb=_TB, ts=_TS) == (_TB, _TS)
+
+
+def test_tile_search_is_tb_major():
+    """Under VMEM pressure the search must sacrifice ts before tb: the
+    dominant re-staging cost (per-tile-row dT2 rebuild) scales with B/tb
+    only, so (256, 256) beats the round-2 shrink order's (64, 512) at
+    equal VMEM (Covertype K=7 sat at 13% of its roofline partly on this)."""
+
+    from distributedkernelshap_tpu.ops.pallas_kernels import _TB, _TS, _tile_sizes
+
+    tb, ts = _tile_sizes(B=65536, S=2072, N=100, M=12, K=7, tb=_TB, ts=_TS)
+    assert tb == _TB          # full-size batch tile kept
+    assert ts < _TS           # the lane tile absorbed the shrink
+    # the stress shape (bg=1000 scratch pressure) must also keep tb large
+    tb2, _ = _tile_sizes(B=512, S=2048, N=1000, M=12, K=2, tb=_TB, ts=_TS)
+    assert tb2 == _TB
 
 
 def test_ey_linear_pallas_vs_xla_path():
