@@ -140,3 +140,92 @@ def test_two_process_phi_matches_single_process(tmp_path):
 
     # single-process reference: same recipe on this process's own devices
     np.testing.assert_allclose(phi0, _explain_adult(), atol=1e-5)
+
+
+def _serve_tiny(port0_file):
+    """Serve leg recipe (tiny synthetic problem so the pytest leg stays
+    fast): lead serves HTTP over the 2-process mesh via the broadcast
+    protocol; followers join each device call.  Lead saves the served phi
+    and a direct sharded explain of the same rows for comparison."""
+
+    import json as _json
+
+    import numpy as np
+
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.serving import client as cl
+    from distributedkernelshap_tpu.serving.multihost import serve_multihost
+
+    rng = np.random.default_rng(0)
+    D, K, N = 6, 3, 12
+    W = rng.normal(size=(D, K)).astype(np.float32)
+    bg = rng.normal(size=(N, D)).astype(np.float32)
+    X = rng.normal(size=(8, D)).astype(np.float32)
+
+    def pred(A):
+        import jax.numpy as jnp
+
+        z = A @ W
+        return jnp.exp(z) / jnp.exp(z).sum(-1, keepdims=True)
+
+    # direct sharded explain FIRST, on every process simultaneously (a
+    # sharded explain is a collective program — running it on the lead
+    # after the followers exited would be a peerless collective and hang)
+    ex = KernelShap(pred, link="identity", seed=0,
+                    distributed_opts={"n_devices": N_DEVICES})
+    ex.fit(bg)
+    direct = np.stack(
+        ex.explain(X, silent=True, nsamples=64, l1_reg=False).shap_values, 1)
+
+    srv = serve_multihost(pred, bg, {"link": "identity", "seed": 0},
+                          {}, {"n_devices": N_DEVICES}, host="127.0.0.1",
+                          port=0, max_batch_size=4, max_rows=16,
+                          explain_kwargs={"nsamples": 64, "l1_reg": False})
+    if srv is None:
+        return None  # follower: released by the shutdown broadcast
+    try:
+        payloads = cl.distribute_requests(
+            f"http://127.0.0.1:{srv.port}/explain", X, max_workers=4)
+        phi = np.stack([
+            np.asarray(_json.loads(p)["data"]["shap_values"])[:, 0]
+            for p in payloads])
+    finally:
+        srv.stop()
+        srv.model.shutdown_followers()
+    np.save(port0_file, np.stack([phi, direct]))
+    return None
+
+
+_SERVE_WORKER = """
+import sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+pid = int(sys.argv[1])
+from distributedkernelshap_tpu.parallel.mesh import initialize_multihost
+initialize_multihost("127.0.0.1:" + sys.argv[2], 2, pid)
+assert jax.process_count() == 2
+
+sys.path.insert(0, {tests_dir!r})
+from test_multihost import _serve_tiny
+_serve_tiny(sys.argv[3] + "/served.npy")
+"""
+
+
+def test_two_process_serving_matches_direct_explain(tmp_path):
+    """The multi-host serving path (serving/multihost.py broadcast
+    protocol): served shap values must equal a direct sharded explain of
+    the same rows over the same 2-process mesh."""
+
+    import numpy as np
+
+    port = _free_port()
+    worker = tmp_path / "serve_worker.py"
+    worker.write_text(_SERVE_WORKER.format(
+        repo=REPO, tests_dir=os.path.dirname(os.path.abspath(__file__))))
+    _run_two_procs(tmp_path, lambda pid: [
+        sys.executable, str(worker), str(pid), str(port), str(tmp_path)])
+
+    served, direct = np.load(tmp_path / "served.npy")
+    np.testing.assert_allclose(served, direct, atol=1e-5)
