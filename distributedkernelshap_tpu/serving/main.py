@@ -33,10 +33,81 @@ def main():
                         help="Serve exact interventional TreeSHAP responses "
                              "(lifted tree ensembles with raw-margin outputs "
                              "and link='identity' only; ops/treeshap.py).")
+    parser.add_argument("--coordinator", default=None, type=str,
+                        help="Multi-host: jax.distributed coordinator "
+                             "address.  All pods run this entry; process 0 "
+                             "serves HTTP, the rest join each device call "
+                             "via the broadcast protocol "
+                             "(serving/multihost.py).")
+    parser.add_argument("--num_processes", default=None, type=int)
+    parser.add_argument("--process_id", default=None, type=int)
+    parser.add_argument("--max_rows", default=256, type=int,
+                        help="Multi-host broadcast slot (rows per stacked "
+                             "batch).")
     args = parser.parse_args()
     explain_kwargs = {"nsamples": "exact"} if args.exact else None
 
-    if args.checkpoint:
+    if args.coordinator is None and (args.num_processes is not None
+                                     or args.process_id is not None):
+        parser.error("--num_processes/--process_id require --coordinator "
+                     "(a would-be follower must never start its own server)")
+
+    def _load_default_args():
+        from distributedkernelshap_tpu.utils import data_provenance
+
+        data = load_data()
+        predictor = load_model()
+        group_names, groups = data["all"]["group_names"], data["all"]["groups"]
+        return (predictor, data["background"]["X"]["preprocessed"],
+                {"link": "logit", "feature_names": group_names, "seed": 0},
+                {"group_names": group_names, "groups": groups,
+                 "data_provenance": data_provenance(data)})
+
+    if args.coordinator is not None:
+        # multi-host deployment: every pod runs this same entry (SPMD).
+        # Followers block inside serve_multihost until the shutdown
+        # broadcast; the flag combinations the branch cannot honour fail
+        # loudly instead of misrouting.
+        if args.checkpoint:
+            parser.error("--checkpoint is not supported with --coordinator "
+                         "yet (the multihost branch always fits the default "
+                         "Adult explainer)")
+        if args.exact:
+            parser.error("--exact needs a lifted tree-ensemble checkpoint, "
+                         "which the multihost branch cannot load yet")
+        if args.process_id is not None and int(args.process_id) != 0:
+            # a pod-wide SIGTERM (k8s rollout) must not kill followers
+            # before the lead broadcasts shutdown — their orderly exit IS
+            # the shutdown broadcast.  If the lead dies hard instead, k8s
+            # SIGKILLs them at the grace-period boundary.
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)
+            signal.signal(signal.SIGINT, signal.SIG_IGN)
+
+        import jax
+
+        from distributedkernelshap_tpu.parallel.mesh import initialize_multihost
+        from distributedkernelshap_tpu.serving.multihost import serve_multihost
+
+        initialize_multihost(args.coordinator, args.num_processes,
+                             args.process_id)
+        predictor, background, ctor_kwargs, fit_kwargs = _load_default_args()
+        server = serve_multihost(
+            predictor, background, ctor_kwargs, fit_kwargs,
+            {"n_devices": len(jax.devices())},
+            host=args.host, port=args.port,
+            max_batch_size=args.max_batch_size, max_rows=args.max_rows,
+            explain_kwargs=explain_kwargs,
+        )
+        if server is None:
+            logging.info("follower %d released; exiting", jax.process_index())
+            return
+        banner = (f"multi-host serving on {server.host}:{server.port} "
+                  f"(lead of {jax.process_count()} processes)")
+
+        def on_stop():
+            server.stop()
+            server.model.shutdown_followers()
+    elif args.checkpoint:
         from distributedkernelshap_tpu.kernel_shap import KernelShap
         from distributedkernelshap_tpu.serving.server import ExplainerServer
         from distributedkernelshap_tpu.serving.wrappers import BatchKernelShapModel
@@ -47,26 +118,25 @@ def main():
         server = ExplainerServer(model, host=args.host, port=args.port,
                                  max_batch_size=args.max_batch_size,
                                  pipeline_depth=args.pipeline_depth or None).start()
+        banner = f"serving on {server.host}:{server.port} — Ctrl-C to stop"
+        on_stop = server.stop
     else:
-        data = load_data()
-        predictor = load_model()
-        group_names, groups = data["all"]["group_names"], data["all"]["groups"]
+        predictor, background, ctor_kwargs, fit_kwargs = _load_default_args()
         server = serve_explainer(
-            predictor,
-            data["background"]["X"]["preprocessed"],
-            {"link": "logit", "feature_names": group_names, "seed": 0},
-            {"group_names": group_names, "groups": groups},
+            predictor, background, ctor_kwargs, fit_kwargs,
             host=args.host, port=args.port, max_batch_size=args.max_batch_size,
             pipeline_depth=args.pipeline_depth or None,
             explain_kwargs=explain_kwargs,
         )
+        banner = f"serving on {server.host}:{server.port} — Ctrl-C to stop"
+        on_stop = server.stop
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
-    logging.info("serving on %s:%d — Ctrl-C to stop", server.host, server.port)
+    logging.info(banner)
     stop.wait()
-    server.stop()
+    on_stop()
 
 
 if __name__ == "__main__":
