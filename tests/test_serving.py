@@ -431,3 +431,28 @@ def test_serving_interactions_require_exact_at_construction(model_setup):
     with pytest.raises(ValueError, match="exact"):
         KernelShapModel(s["pred"], s["bg"], s["constructor_kwargs"],
                         s["fit_kwargs"], explain_kwargs={"interactions": True})
+
+
+def test_serving_main_flag_guards(monkeypatch, capsys):
+    """serving.main must refuse incompatible flag combinations at parse
+    time instead of silently misrouting (multihost branch ignores
+    --checkpoint; follower flags without a coordinator would start a
+    stray single-host server)."""
+
+    import pytest as _pytest
+
+    from distributedkernelshap_tpu.serving import main as serving_main
+
+    def run(argv):
+        monkeypatch.setattr("sys.argv", ["main.py"] + argv)
+        with _pytest.raises(SystemExit) as exc:
+            serving_main.main()
+        assert exc.value.code == 2  # argparse parser.error
+        return capsys.readouterr().err
+
+    err = run(["--num_processes", "2", "--process_id", "1"])
+    assert "require --coordinator" in err
+    err = run(["--coordinator", "127.0.0.1:1", "--checkpoint", "x.pkl"])
+    assert "--checkpoint is not supported" in err
+    err = run(["--coordinator", "127.0.0.1:1", "--exact"])
+    assert "--exact needs" in err
