@@ -127,7 +127,8 @@ def test_distributed_f16_transfer_and_window(setup):
     sv = dist.get_explanation(setup["X"], nsamples=64)
     for a, b in zip(sv_seq, sv):
         assert np.asarray(b).dtype == np.float32
-        np.testing.assert_allclose(a, b, atol=2e-3)
+        # f16 rounding is relative (~5e-4 of |phi|): pair rtol with atol
+        np.testing.assert_allclose(a, b, atol=1e-3, rtol=2e-3)
     assert dist.last_raw_prediction.dtype == np.float32
 
 

@@ -231,5 +231,6 @@ def test_transfer_dtype_f16_matches_f32_to_rounding():
     got = f16.get_explanation(X, nsamples=64, l1_reg=False)
     for a, b in zip(ref, got):
         assert np.asarray(b).dtype == np.float32
-        np.testing.assert_allclose(a, b, atol=2e-3)
+        # f16 rounding is relative (~5e-4 of |phi|): pair rtol with atol
+        np.testing.assert_allclose(a, b, atol=1e-3, rtol=2e-3)
     assert f16.last_raw_prediction.dtype == np.float32
