@@ -721,3 +721,28 @@ def test_rank_interaction_pairs(gbt_setup):
     # single-instance (M, M) input promotes to a batch of one
     single = rank_interaction_pairs([np.asarray(inter[0])[0]], names)
     assert len(single["aggregated"]["names"]) == 15   # C(6, 2) pairs
+
+
+def test_backend_dispatched_weights_match_lgamma_route():
+    """The CPU table-gather route and the TPU lgamma route must agree over
+    the full count grid for BOTH weight families (the backend dispatch in
+    _beta_weights/_interaction_weights must never change numerics — only
+    which backend pays which cost: lgamma measured ~5x the whole exact pass
+    on CPU, gathers slow on TPU)."""
+
+    import jax.numpy as jnp
+
+    from distributedkernelshap_tpu.ops import treeshap as ts
+
+    M = 64
+    uu, vv = np.meshgrid(np.arange(M + 1, dtype=np.float32),
+                         np.arange(M + 1, dtype=np.float32), indexing="ij")
+    wp_l, wm_l = ts._device_beta_weights(jnp.asarray(uu), jnp.asarray(vv))
+    wp_t, wm_t = ts._beta_weights(jnp.asarray(uu), jnp.asarray(vv), M)
+    np.testing.assert_allclose(np.asarray(wp_t), np.asarray(wp_l), atol=2e-6)
+    np.testing.assert_allclose(np.asarray(wm_t), np.asarray(wm_l), atol=2e-6)
+
+    lg = ts._device_interaction_weights(jnp.asarray(uu), jnp.asarray(vv))
+    tb = ts._interaction_weights(jnp.asarray(uu), jnp.asarray(vv), M)
+    for a, b in zip(lg, tb):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=2e-6)
