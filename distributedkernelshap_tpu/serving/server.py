@@ -38,13 +38,14 @@ class _HTTPServer(ThreadingHTTPServer):
 
 
 class _Pending:
-    __slots__ = ("array", "event", "response", "error")
+    __slots__ = ("array", "event", "response", "error", "t_enqueued")
 
     def __init__(self, array: np.ndarray):
         self.array = array
         self.event = threading.Event()
         self.response: Optional[str] = None
         self.error: Optional[str] = None
+        self.t_enqueued = time.monotonic()
 
 
 def calibrate_pipeline_depth(model, example_array: Optional[np.ndarray] = None,
@@ -165,6 +166,13 @@ class ExplainerServer:
         self.batch_timeout_s = batch_timeout_s
         self.pipeline_depth = (None if pipeline_depth is None
                                else max(1, int(pipeline_depth)))
+        # serving metrics (Prometheus text format at /metrics — beyond the
+        # reference, which exposes no metrics at all, SURVEY.md §5.5); one
+        # lock guards the counters (updated per completed request)
+        self._metrics_lock = threading.Lock()
+        self._metrics = {"requests_total": 0, "errors_total": 0,
+                         "rows_total": 0, "batches_total": 0,
+                         "request_seconds_sum": 0.0}
         self._queue: "queue.Queue[_Pending]" = queue.Queue()
         # (batch, finalize) pairs already dispatched to the device; bounded so
         # a slow host can't pile up unbounded in-flight device work (the
@@ -177,14 +185,47 @@ class ExplainerServer:
 
     # ------------------------------------------------------------------ #
 
-    @staticmethod
-    def _complete(batch, payloads=None, error=None):
+    def _complete(self, batch, payloads=None, error=None):
         for i, p in enumerate(batch):
             if error is not None:
                 p.error = error
             else:
                 p.response = payloads[i]
             p.event.set()
+        with self._metrics_lock:
+            self._metrics["batches_total"] += 1
+            self._metrics["requests_total"] += len(batch)
+            self._metrics["rows_total"] += sum(p.array.shape[0] for p in batch)
+            if error is not None:
+                self._metrics["errors_total"] += len(batch)
+            now = time.monotonic()
+            self._metrics["request_seconds_sum"] += sum(
+                now - p.t_enqueued for p in batch)
+
+    def _render_metrics(self) -> str:
+        with self._metrics_lock:
+            m = dict(self._metrics)
+        lines = [
+            "# HELP dks_serve_requests_total Requests answered.",
+            "# TYPE dks_serve_requests_total counter",
+            f"dks_serve_requests_total {m['requests_total']}",
+            "# HELP dks_serve_errors_total Requests answered with an error.",
+            "# TYPE dks_serve_errors_total counter",
+            f"dks_serve_errors_total {m['errors_total']}",
+            "# HELP dks_serve_rows_total Instance rows explained.",
+            "# TYPE dks_serve_rows_total counter",
+            f"dks_serve_rows_total {m['rows_total']}",
+            "# HELP dks_serve_batches_total Coalesced device batches.",
+            "# TYPE dks_serve_batches_total counter",
+            f"dks_serve_batches_total {m['batches_total']}",
+            "# HELP dks_serve_request_seconds_sum Total queue+explain time.",
+            "# TYPE dks_serve_request_seconds_sum counter",
+            f"dks_serve_request_seconds_sum {m['request_seconds_sum']:.6f}",
+            "# HELP dks_serve_pipeline_depth In-flight device batches.",
+            "# TYPE dks_serve_pipeline_depth gauge",
+            f"dks_serve_pipeline_depth {self.pipeline_depth or 0}",
+        ]
+        return "\n".join(lines) + "\n"
 
     def _fill_batch(self):
         """Pop up to ``max_batch_size`` requests, waiting ``batch_timeout_s``
@@ -274,6 +315,10 @@ class ExplainerServer:
                 route = self.path.rstrip("/")
                 if route == "/healthz":
                     self._reply(200, json.dumps({"status": "ok"}))
+                    return
+                if route == "/metrics":
+                    self._reply(200, server._render_metrics(),
+                                ctype="text/plain; version=0.0.4")
                     return
                 if route != "/explain":
                     self._reply(404, json.dumps({"error": "unknown route"}))

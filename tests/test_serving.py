@@ -456,3 +456,34 @@ def test_serving_main_flag_guards(monkeypatch, capsys):
     assert "--checkpoint is not supported" in err
     err = run(["--coordinator", "127.0.0.1:1", "--exact"])
     assert "--exact needs" in err
+
+
+def test_metrics_endpoint(model_setup):
+    """/metrics exposes Prometheus-format serving counters (beyond the
+    reference, which exports no metrics: SURVEY.md §5.5)."""
+
+    import urllib.request
+
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+    from distributedkernelshap_tpu.serving.wrappers import BatchKernelShapModel
+
+    model = BatchKernelShapModel(model_setup["pred"], model_setup["bg"],
+                                 model_setup["constructor_kwargs"],
+                                 model_setup["fit_kwargs"])
+    server = ExplainerServer(model, host="127.0.0.1", port=0,
+                             max_batch_size=4, pipeline_depth=2).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        distribute_requests(f"{base}/explain", model_setup["X"][:6],
+                            max_workers=3)
+        text = urllib.request.urlopen(f"{base}/metrics", timeout=30).read().decode()
+    finally:
+        server.stop()
+    metrics = {line.split()[0]: float(line.split()[1])
+               for line in text.splitlines() if line and not line.startswith("#")}
+    assert metrics["dks_serve_requests_total"] == 6
+    assert metrics["dks_serve_rows_total"] == 6
+    assert metrics["dks_serve_errors_total"] == 0
+    assert 1 <= metrics["dks_serve_batches_total"] <= 6
+    assert metrics["dks_serve_request_seconds_sum"] > 0
+    assert metrics["dks_serve_pipeline_depth"] == 2
