@@ -19,7 +19,7 @@ def fitted(tmp_path):
     X = rng.normal(size=(4, D)).astype(np.float32)
     pred = LinearPredictor(W, np.zeros(2, np.float32), activation="softmax")
     ex = KernelShap(pred, link="logit", feature_names=names, seed=0)
-    ex.fit(bg, group_names=names, groups=groups)
+    ex.fit(bg, group_names=names, groups=groups, data_provenance="synthetic")
     return ex, X, tmp_path
 
 
@@ -72,6 +72,9 @@ def test_save_load_roundtrip(fitted):
     np.testing.assert_allclose(np.asarray(before.expected_value),
                                np.asarray(loaded.expected_value), atol=1e-6)
     assert loaded.feature_names == ex.feature_names
+    # provenance survives the checkpoint round trip (meta is saved whole)
+    assert loaded.meta["data_provenance"] == "synthetic"
+    assert after.meta["data_provenance"] == "synthetic"
 
 
 def test_save_load_preserves_engine_config(fitted, tmp_path):
