@@ -74,8 +74,14 @@ def main():
 
     from benchmarks.configs import CONFIGS
 
+    # value-per-minute order: the short configs (headline, stress, trees,
+    # the exact A/B) and the two whose code changed most recently
+    # (mnist/covertype) run BEFORE model_zoo — the zoo trains 8 model
+    # families on one host core (~80 min observed) and must not starve the
+    # rest if the relay session turns out short (round 2's window was
+    # 75 min and the zoo died mid-run at the end of it)
     for name in ("adult", "adult_stress", "adult_trees", "adult_trees_exact",
-                 "model_zoo", "mnist", "covertype", "adult_blackbox"):
+                 "mnist", "covertype", "model_zoo", "adult_blackbox"):
         if name in skip:
             continue
         _step(f"config:{name}", lambda n=name: CONFIGS[n](smoke=False))
