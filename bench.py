@@ -284,6 +284,13 @@ def main() -> int:
     # mid-run, the driver still gets a JSON line instead of rc=124).  If the
     # probe somehow consumed nearly everything, fail with a JSON line
     # immediately rather than over-running the budget.
+    #
+    # DKS_BENCH_DEADLINE additionally bounds when the LAST line prints on
+    # the worst path (run hangs -> kill escalation -> CPU fallback): the
+    # run timeout is clamped so run + fallback still land inside it.  A
+    # healthy first-ever-compile TPU run needs ~140 s, comfortably under
+    # the ~160 s this leaves with the defaults.
+    deadline = float(os.environ.get("DKS_BENCH_DEADLINE", "280"))
     left = budget - (time.monotonic() - t_start) - 5.0
     if left <= 30:
         print(json.dumps({"metric": _METRIC,
@@ -292,6 +299,10 @@ def main() -> int:
     # forgo the fallback reserve rather than squeeze the run below a useful
     # bound (the run itself is the better artifact when it completes)
     remaining = left - fallback_reserve if left - fallback_reserve >= 60 else left
+    until_deadline = (deadline - (time.monotonic() - t_start)
+                      - fallback_reserve - 20.0)  # kill escalation margin
+    if until_deadline >= 60:
+        remaining = min(remaining, until_deadline)
     proc = subprocess.Popen([sys.executable, os.path.abspath(__file__), "--run"],
                             stdout=subprocess.PIPE)
     try:

@@ -456,7 +456,13 @@ class DistributedExplainer:
         )
 
         multihost = jax.process_count() > 1
-        window = resolve_window(self.dispatch_window, n_items=len(slabs))
+        # the opts key wins; EngineConfig.dispatch_window is the same knob
+        # spelled at engine level (README documents both) and must not be
+        # silently ignored on the sharded path
+        requested = (self.dispatch_window
+                     if self.dispatch_window is not None
+                     else self.engine.config.dispatch_window)
+        window = resolve_window(requested, n_items=len(slabs))
         return run_pipeline(slabs, dispatch, self._fetch_sharded,
                             window=window, threaded=not multihost)
 

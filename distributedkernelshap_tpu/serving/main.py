@@ -75,13 +75,15 @@ def main():
         if args.exact:
             parser.error("--exact needs a lifted tree-ensemble checkpoint, "
                          "which the multihost branch cannot load yet")
-        if args.process_id is not None and int(args.process_id) != 0:
-            # a pod-wide SIGTERM (k8s rollout) must not kill followers
-            # before the lead broadcasts shutdown — their orderly exit IS
-            # the shutdown broadcast.  If the lead dies hard instead, k8s
-            # SIGKILLs them at the grace-period boundary.
-            signal.signal(signal.SIGTERM, signal.SIG_IGN)
-            signal.signal(signal.SIGINT, signal.SIG_IGN)
+        # a pod-wide SIGTERM (k8s rollout) must not kill followers before
+        # the lead broadcasts shutdown — their orderly exit IS the shutdown
+        # broadcast.  The rank may be auto-inferred (unknown until after
+        # init), so EVERY process ignores the signals first; the lead
+        # reinstalls its stop handlers at the shared block below once
+        # serve_multihost identifies it.  If the lead dies hard, k8s
+        # SIGKILLs the followers at the grace-period boundary.
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        signal.signal(signal.SIGINT, signal.SIG_IGN)
 
         import jax
 

@@ -56,9 +56,11 @@ class MultihostServingModel:
         with ``distributed_opts`` spanning the multi-host mesh.
     max_rows
         Broadcast slot size: every batch is padded to this many rows (the
-        collective needs one static shape on all processes).  Requests
-        stacking more than ``max_rows`` rows are rejected per-request by
-        the server's error path rather than crashing the mesh.
+        collective needs one static shape on all processes).  The server
+        reads this attribute to reject single over-slot requests with 413
+        at enqueue time and to stop coalescing before a stacked batch
+        would overflow the slot; the check in :meth:`explain_batch` is the
+        backstop.
     """
 
     def __init__(self, model, max_rows: int = 256):
@@ -139,11 +141,14 @@ def follower_loop(model, max_rows: int = 256):
         rows = int(header[1])
         padded = _broadcast(np.zeros((max_rows, n_features), np.float32),
                             is_source=False)
-        # identical call shape as the lead's explain_batch: same bucket
-        # padding, same sharded program, same collective sequence.  The
-        # response payloads are host-side only and discarded here.
+        # identical DEVICE call as the lead's explain_batch (explain_batch
+        # == explainer.explain + host-side response building): same bucket
+        # padding, same sharded program, same collective sequence — but the
+        # response JSON is built on the lead only, so followers skip
+        # _resplit_payloads/to_json instead of rendering and discarding it.
         try:
-            model.explain_batch(padded[:rows], split_sizes=[rows])
+            model.explainer.explain(padded[:rows], silent=True,
+                                    **model.explain_kwargs)
         except Exception:
             # mirror the lead's catch-and-continue (server.py answers the
             # request with a 500 and keeps serving): a data-dependent

@@ -174,6 +174,9 @@ class ExplainerServer:
                          "rows_total": 0, "batches_total": 0,
                          "request_seconds_sum": 0.0}
         self._queue: "queue.Queue[_Pending]" = queue.Queue()
+        # request popped by _fill_batch that would overflow the model's
+        # max_rows slot: carried into the next batch (dispatcher-only state)
+        self._carry: Optional[_Pending] = None
         # (batch, finalize) pairs already dispatched to the device; bounded so
         # a slow host can't pile up unbounded in-flight device work (the
         # queue is created in start(), once the depth is known)
@@ -186,12 +189,9 @@ class ExplainerServer:
     # ------------------------------------------------------------------ #
 
     def _complete(self, batch, payloads=None, error=None):
-        for i, p in enumerate(batch):
-            if error is not None:
-                p.error = error
-            else:
-                p.response = payloads[i]
-            p.event.set()
+        # counters update BEFORE the response events: a client that gets
+        # its answer and immediately scrapes /metrics must see itself
+        # counted
         with self._metrics_lock:
             self._metrics["batches_total"] += 1
             self._metrics["requests_total"] += len(batch)
@@ -201,6 +201,12 @@ class ExplainerServer:
             now = time.monotonic()
             self._metrics["request_seconds_sum"] += sum(
                 now - p.t_enqueued for p in batch)
+        for i, p in enumerate(batch):
+            if error is not None:
+                p.error = error
+            else:
+                p.response = payloads[i]
+            p.event.set()
 
     def _render_metrics(self) -> str:
         with self._metrics_lock:
@@ -229,23 +235,38 @@ class ExplainerServer:
 
     def _fill_batch(self):
         """Pop up to ``max_batch_size`` requests, waiting ``batch_timeout_s``
-        after the first arrival for the batch to fill."""
+        after the first arrival for the batch to fill.
 
-        try:
-            first = self._queue.get(timeout=0.1)
-        except queue.Empty:
-            return None
+        A model may declare ``max_rows`` (the multihost broadcast slot):
+        coalescing then also stops before the stacked row count would
+        exceed it — the item that would overflow is carried into the next
+        batch instead of failing innocent neighbours."""
+
+        max_rows = getattr(self.model, "max_rows", None)
+        if self._carry is not None:
+            first, self._carry = self._carry, None
+        else:
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                return None
         batch = [first]
+        rows = first.array.shape[0]
         if self.max_batch_size > 1:
             deadline = time.monotonic() + self.batch_timeout_s
             while len(batch) < self.max_batch_size:
                 remaining = deadline - time.monotonic()
                 try:
                     # drain immediately-available items even past the deadline
-                    batch.append(self._queue.get(timeout=max(0.0, remaining))
-                                 if remaining > 0 else self._queue.get_nowait())
+                    item = (self._queue.get(timeout=max(0.0, remaining))
+                            if remaining > 0 else self._queue.get_nowait())
                 except queue.Empty:
                     break
+                if max_rows and rows + item.array.shape[0] > max_rows:
+                    self._carry = item
+                    break
+                batch.append(item)
+                rows += item.array.shape[0]
         return batch
 
     def _dispatch_loop(self):
@@ -329,6 +350,15 @@ class ExplainerServer:
                     array = np.atleast_2d(np.asarray(payload["array"], dtype=np.float32))
                 except (KeyError, ValueError, json.JSONDecodeError) as e:
                     self._reply(400, json.dumps({"error": f"bad request: {e}"}))
+                    return
+                max_rows = getattr(server.model, "max_rows", None)
+                if max_rows and array.shape[0] > max_rows:
+                    # a single request larger than the model's slot can
+                    # never be served; reject IT without failing the batch
+                    # it would have been coalesced into
+                    self._reply(413, json.dumps({
+                        "error": f"request of {array.shape[0]} rows exceeds "
+                                 f"this deployment's max_rows={max_rows}"}))
                     return
                 pending = _Pending(array)
                 server._queue.put(pending)

@@ -190,6 +190,44 @@ def test_wedged_probe_retries_then_reports_fallback(monkeypatch):
     assert rec["cpu_fallback_wall_s"] == 0.53
 
 
+def test_run_timeout_clamped_to_deadline(monkeypatch):
+    """A mid-run device hang must still produce the JSON line inside
+    DKS_BENCH_DEADLINE: the run child's timeout is clamped so the kill
+    escalation + CPU fallback land before the driver's ~300 s axe."""
+
+    seen = {}
+
+    class _HangingProc:
+        returncode = 1
+
+        def communicate(self, timeout=None):
+            if timeout is not None and timeout > 20:  # the run-phase wait
+                seen["timeout"] = timeout
+                raise bench.subprocess.TimeoutExpired("bench", timeout)
+            return b"", b""  # kill-escalation waits
+
+        def terminate(self):
+            pass
+
+        def kill(self):
+            pass
+
+    monkeypatch.setattr(bench.subprocess, "Popen",
+                        lambda *a, **k: _HangingProc())
+    monkeypatch.setattr(bench, "_device_probe", lambda t: (True, ""))
+    monkeypatch.setattr(bench, "_cpu_fallback", lambda t: (0.5, None))
+    _pin_bench_env(monkeypatch)
+    monkeypatch.setenv("DKS_BENCH_DEADLINE", "280")
+    monkeypatch.setenv("DKS_BENCH_FALLBACK_RESERVE", "100")
+    rc, out = _capture(bench.main)
+    assert rc == 1
+    # 280 deadline - 100 fallback reserve - 20 escalation margin ≈ 160
+    assert seen["timeout"] <= 160.5
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert "exceeded the remaining budget" in rec["error"]
+    assert rec["cpu_fallback_wall_s"] == 0.5
+
+
 def test_probe_permanent_failure_does_not_retry(monkeypatch):
     calls = []
 

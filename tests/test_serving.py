@@ -487,3 +487,46 @@ def test_metrics_endpoint(model_setup):
     assert 1 <= metrics["dks_serve_batches_total"] <= 6
     assert metrics["dks_serve_request_seconds_sum"] > 0
     assert metrics["dks_serve_pipeline_depth"] == 2
+
+
+def test_max_rows_slot_rejection_and_coalescing_cap(model_setup):
+    """A model declaring max_rows (the multihost broadcast slot): single
+    over-slot requests get 413 at enqueue; coalescing stops before the
+    stacked batch would overflow the slot (the overflowing item is carried
+    to the next batch instead of failing innocent neighbours)."""
+
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+    from distributedkernelshap_tpu.serving.wrappers import BatchKernelShapModel
+
+    model = BatchKernelShapModel(model_setup["pred"], model_setup["bg"],
+                                 model_setup["constructor_kwargs"],
+                                 model_setup["fit_kwargs"])
+    model.max_rows = 4  # declare a tiny slot
+    server = ExplainerServer(model, host="127.0.0.1", port=0,
+                             max_batch_size=8, pipeline_depth=1).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        # single request larger than the slot -> 413, others unaffected
+        big = _json.dumps(
+            {"array": model_setup["X"][:6].tolist()}).encode()
+        req = urllib.request.Request(f"{base}/explain", data=big,
+                                     method="POST")
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            raise AssertionError("expected HTTP 413")
+        except urllib.error.HTTPError as e:
+            assert e.code == 413
+            assert "max_rows" in e.read().decode()
+        # six 1-row requests with an 8-request coalescer and a 4-row slot:
+        # every request must still succeed (batches capped at 4 rows)
+        payloads = distribute_requests(f"{base}/explain",
+                                       model_setup["X"][:6], max_workers=6)
+        assert len(payloads) == 6
+        for p in payloads:
+            assert _json.loads(p)["data"]["shap_values"]
+    finally:
+        server.stop()
