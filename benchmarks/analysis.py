@@ -114,12 +114,62 @@ def plot_rows(rows, out_path: str, baseline: float = None) -> None:
     print(f"plot written to {out_path}")
 
 
+def summarise_jsonl(path: str):
+    """Latest successful row per step of a ``tpu_revalidate.jsonl`` file
+    (the one-session hardware sweep appends per-step records; re-runs
+    supersede in time order).  Returns ``[(step, record)]`` sorted by step
+    — the table RESULTS.md's numbers are folded from."""
+
+    import json
+
+    latest = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            step = rec.get("step")
+            if step in (None, "done"):
+                continue
+            # a failed re-run must not shadow an earlier success
+            if rec.get("ok") or step not in latest:
+                latest[step] = rec
+    return sorted(latest.items())
+
+
+def print_jsonl_summary(path: str) -> None:
+    rows = summarise_jsonl(path)
+    hdr = f"{'step':<26} {'ok':>3} {'value':>10} {'extra'}"
+    print(hdr)
+    print("-" * 78)
+    for step, rec in rows:
+        result = rec.get("result") or {}
+        value = result.get("value")
+        extras = {k: v for k, v in result.items()
+                  if k in ("additivity_err", "model_err", "inst_per_s",
+                           "data_provenance", "vs_baseline", "platform",
+                           "sampled_wall_s", "speedup_vs_sampled")}
+        extra = (" ".join(f"{k}={v}" for k, v in extras.items())
+                 if rec.get("ok") else rec.get("error", "")[:48])
+        value_s = f"{value:.4f}" if isinstance(value, (int, float)) else "-"
+        print(f"{step:<26} {'y' if rec.get('ok') else 'N':>3} "
+              f"{value_s:>10} {extra}")
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--results", default="results")
     parser.add_argument("--serve", default=0, type=int)
     parser.add_argument("--plot", default=None, type=str)
+    parser.add_argument("--jsonl", default=None, type=str,
+                        help="Summarise a tpu_revalidate.jsonl sweep "
+                             "(latest row per step) instead of pickles.")
     args = parser.parse_args()
+
+    if args.jsonl:
+        print_jsonl_summary(args.jsonl)
+        return
 
     runtimes = read_runtimes(args.results, serve=bool(args.serve))
     if not runtimes:

@@ -112,3 +112,27 @@ def test_roofline_model_rows():
     # interactions cost ~M x the exact pass's contraction stage
     assert (rows["adult_trees_exact_inter"]["mxu_flops"]
             > 5 * rows["adult_trees_exact"]["mxu_flops"])
+
+
+def test_summarise_jsonl_latest_success_wins(tmp_path):
+    """Per step: the latest row wins, except a failed re-run never shadows
+    an earlier success (the wedge-interrupted model_zoo case)."""
+
+    import json
+
+    from benchmarks.analysis import summarise_jsonl
+
+    p = tmp_path / "sweep.jsonl"
+    rows = [
+        {"step": "backend", "ok": True, "result": {}},
+        {"step": "config:adult", "ok": True, "result": {"value": 0.15}},
+        {"step": "config:adult", "ok": True, "result": {"value": 0.09}},
+        {"step": "config:model_zoo", "ok": True, "result": {"value": 0.7}},
+        {"step": "config:model_zoo", "ok": False, "error": "wedge"},
+        {"step": "done", "ok": True},
+    ]
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    latest = dict(summarise_jsonl(str(p)))
+    assert latest["config:adult"]["result"]["value"] == 0.09
+    assert latest["config:model_zoo"]["ok"] is True  # failure didn't shadow
+    assert "done" not in latest
