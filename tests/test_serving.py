@@ -530,3 +530,31 @@ def test_max_rows_slot_rejection_and_coalescing_cap(model_setup):
             assert _json.loads(p)["data"]["shap_values"]
     finally:
         server.stop()
+
+
+def test_multihost_model_single_process_semantics(model_setup):
+    """MultihostServingModel unit behaviour without a second process
+    (broadcast_one_to_all is the identity at process_count()==1): payloads
+    match the wrapped model, over-slot batches raise, shutdown is
+    idempotent, and post-shutdown explains fail loudly instead of
+    broadcasting into a dead mesh."""
+
+    import pytest as _pytest
+
+    from distributedkernelshap_tpu.serving.multihost import MultihostServingModel
+    from distributedkernelshap_tpu.serving.wrappers import BatchKernelShapModel
+
+    base = BatchKernelShapModel(model_setup["pred"], model_setup["bg"],
+                                model_setup["constructor_kwargs"],
+                                model_setup["fit_kwargs"])
+    wrapped = MultihostServingModel(base, max_rows=4)
+    X = model_setup["X"][:3]
+    assert wrapped.explain_batch(X, split_sizes=[3]) == \
+        base.explain_batch(X, split_sizes=[3])
+    with _pytest.raises(ValueError, match="max_rows"):
+        wrapped.explain_batch(model_setup["X"][:6], split_sizes=[6])
+
+    wrapped.shutdown_followers()
+    wrapped.shutdown_followers()  # idempotent: second call is a no-op
+    with _pytest.raises(RuntimeError, match="shut down"):
+        wrapped.explain_batch(X, split_sizes=[3])
