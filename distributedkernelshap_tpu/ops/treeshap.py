@@ -378,9 +378,10 @@ def exact_interactions_from_reach(pred, X, reach, bgw, G,
     per group); callers should keep ``M`` modest (raises above 64 groups).
     The per-group loop is unrolled into the jitted graph (~4 large einsums
     per group per chunk body), so COMPILE time and program size also scale
-    linearly with ``M`` — near the M=64 cap that is ~260 einsums; if
-    compile latency ever matters there, convert the loop to a ``lax.map``
-    over a stacked group axis (runtime cost is unchanged either way).
+    linearly with ``M`` — measured (CPU backend, tiny ensemble): 1.6 s at
+    M=8, 2.5 s at M=16, 4.5 s at M=32, extrapolating to ~9 s at the M=64
+    cap — a one-time-per-fit cost that does not justify the fusion loss a
+    ``lax.map`` over a stacked group axis would introduce.
     """
 
     M = int(jnp.asarray(G).shape[0])
