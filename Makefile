@@ -3,13 +3,18 @@
 
 PY ?= python
 
-.PHONY: test bench configs serve sweep-pool sweep-serve analysis multihost-ci
+.PHONY: test fuzz bench configs serve sweep-pool sweep-serve analysis multihost-ci
 
 multihost-ci:    ## multi-host validation: 2-proc pool/phi/interactions, 4-proc 2x2 mesh, 2-proc serve (one JSON line, rc 0/1)
 	$(PY) benchmarks/multihost_ci.py
 
 test:            ## full suite on CPU with 8 virtual devices
 	env PYTHONPATH= JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q
+
+fuzz:            ## 3x fresh-seed hypothesis property sweeps (new examples per run)
+	for i in 1 2 3; do \
+	  env PYTHONPATH= JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_properties.py -q -p no:cacheprovider || exit 1; \
+	done
 
 bench:           ## headline benchmark (one JSON line, runs on the attached chip)
 	$(PY) bench.py
