@@ -301,8 +301,18 @@ def main() -> int:
     remaining = left - fallback_reserve if left - fallback_reserve >= 60 else left
     until_deadline = (deadline - (time.monotonic() - t_start)
                       - fallback_reserve - 20.0)  # kill escalation margin
-    if until_deadline >= 60:
-        remaining = min(remaining, until_deadline)
+    if until_deadline < 60:
+        # a slow probe path ate the deadline: a <60 s run slot can't fit
+        # even a cached-compile TPU run, and silently dropping the clamp
+        # (the round-3 behaviour) could overrun the stated deadline by
+        # run + fallback.  Skip the run phase; the labelled CPU fallback
+        # inside the reserve is the best artifact the deadline still allows.
+        return _emit_error({
+            "metric": _METRIC,
+            "error": "probe phase left too little time before "
+                     "DKS_BENCH_DEADLINE for a device run",
+        }, t_start, budget, fallback_reserve)
+    remaining = min(remaining, until_deadline)
     proc = subprocess.Popen([sys.executable, os.path.abspath(__file__), "--run"],
                             stdout=subprocess.PIPE)
     try:

@@ -41,7 +41,9 @@ from distributedkernelshap_tpu.ops.explain import (
     ShapConfig,
     build_explainer_fn,
     groups_to_matrix,
+    pack_transfer,
     split_shap_values,
+    unpack_transfer,
 )
 from distributedkernelshap_tpu.ops.links import convert_to_link
 from distributedkernelshap_tpu.ops.summarise import kmeans_summary, subsample
@@ -568,6 +570,19 @@ class KernelExplainerEngine:
             'raw_prediction': fx[:B],
         }
 
+    def reset_device_state(self) -> None:
+        """Drop device-resident caches (uploaded constants, jitted
+        executables) so the next explain rebuilds them from host state.
+
+        The serving watchdog's recovery hook after a device wedge: buffers
+        that lived on a backend that has since restarted are dead handles,
+        and handing them to a fresh backend fails opaquely.  Everything
+        dropped is a cache — the next call pays re-upload + re-trace only.
+        Coalition plans (``_plan_cache``) survive: pure host numpy."""
+
+        self._fn_cache.clear()
+        self._dev_cache.clear()
+
     def _device_args(self, plan):
         """Device-resident copies of the per-fit constants.
 
@@ -604,18 +619,20 @@ class KernelExplainerEngine:
         out = self._fn()(jnp.asarray(Xp, jnp.float32), *self._device_args(plan))
         # one packed D2H instead of three; the copy itself blocks on the
         # value, so an explicit block_until_ready would add a second full
-        # round trip.
-        packed = jnp.concatenate([out['shap_values'].ravel(),
-                                  out['expected_value'].ravel(),
-                                  out['raw_prediction'].ravel()])
-        if self.config.shap.transfer_dtype:  # opt-in halved D2H (see ShapConfig)
-            packed = packed.astype(self.config.shap.transfer_dtype)
+        # round trip.  With transfer_dtype set, only phi rides the reduced
+        # dtype — E[f]/f(x) are K and B*K floats whose truncation would
+        # inflate the reported additivity error for free (ADVICE.md r3).
+        td = self.config.shap.transfer_dtype  # opt-in halved D2H (ShapConfig)
+        packed = pack_transfer(
+            out['shap_values'],
+            jnp.concatenate([out['expected_value'].ravel(),
+                             out['raw_prediction'].ravel()]), td)
         Bp = Xp.shape[0]
 
         def finalize() -> Dict[str, np.ndarray]:
-            flat = np.asarray(packed).astype(np.float32, copy=False)
             K, M = self.predictor.n_outputs, self.M
-            phi, e_val, fx = np.split(flat, [Bp * K * M, Bp * K * M + K])
+            phi, tail = unpack_transfer(packed, Bp * K * M, td)
+            e_val, fx = np.split(tail, [K])
             return {
                 'shap_values': phi.reshape(Bp, K, M)[:B],
                 'expected_value': e_val,
@@ -864,7 +881,10 @@ class KernelExplainerEngine:
                 out = self._fn_cache[key](
                     jnp.asarray(Xp, jnp.float32), bgw_dev, G_dev)
                 if td:  # opt-in halved D2H — same contract as the sampled path
-                    out = {k: v.astype(td) for k, v in out.items()}
+                    # phi/interactions dominate the wire; f(x) is B*K floats
+                    # and stays f32 so the additivity report isn't degraded
+                    out = {k: (v if k == 'raw_prediction' else v.astype(td))
+                           for k, v in out.items()}
                 return out, B
 
             def _fetch(handle):

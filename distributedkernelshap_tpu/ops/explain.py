@@ -65,6 +65,54 @@ class ShapConfig:
     transfer_dtype: Optional[str] = None
 
 
+def pack_transfer(wide, narrow, transfer_dtype):
+    """Pack a device result into ONE array for a single D2H copy, casting
+    only the dominant segment to ``transfer_dtype``.
+
+    ``wide`` is the segment that dominates the wire (phi, and interaction
+    values where present); ``narrow`` is the tiny remainder (E[f(x)] /
+    f(x): K and B*K floats).  Casting the whole packed vector (the round-3
+    behaviour) needlessly truncated the narrow segment, inflating the
+    *reported* additivity error while saving nothing on the wire
+    (ADVICE.md round 3).  For a 16-bit ``transfer_dtype`` both segments are
+    bitcast to ``uint16`` — f16 wide, full-precision f32 narrow — so the
+    transfer stays a single copy (through a tunnelled TPU every D2H costs a
+    full RPC round trip regardless of payload, which is why the packing
+    exists at all).  :func:`unpack_transfer` is the host-side inverse.
+    """
+
+    wide = wide.ravel()
+    narrow = narrow.ravel().astype(jnp.float32)
+    if not transfer_dtype:
+        return jnp.concatenate([wide.astype(jnp.float32), narrow])
+    td = jnp.dtype(transfer_dtype)
+    if td.itemsize != 2:
+        return jnp.concatenate([wide.astype(td), narrow.astype(td)])
+    wide_u = jax.lax.bitcast_convert_type(wide.astype(td), jnp.uint16)
+    narrow_u = jax.lax.bitcast_convert_type(narrow, jnp.uint16)
+    return jnp.concatenate([wide_u.ravel(), narrow_u.ravel()])
+
+
+def unpack_transfer(flat: np.ndarray, n_wide: int,
+                    transfer_dtype) -> tuple:
+    """Host-side inverse of :func:`pack_transfer`.
+
+    ``flat`` is the fetched host copy, ``n_wide`` the element count of the
+    wide segment; returns ``(wide_f32, narrow_f32)`` 1-D arrays.
+    """
+
+    flat = np.asarray(flat)
+    if flat.dtype != np.uint16:
+        flat = flat.astype(np.float32, copy=False)
+        return flat[:n_wide], flat[n_wide:]
+    td = jnp.dtype(transfer_dtype)
+    wide = flat[:n_wide].view(td).astype(np.float32)
+    # .copy(): the tail's byte offset (2*n_wide) need not be 4-aligned, and
+    # numpy refuses misaligned views; the tail is K + B*K floats — tiny.
+    narrow = flat[n_wide:].copy().view(np.float32)
+    return wide, narrow
+
+
 def groups_to_matrix(groups: Optional[Sequence[Sequence[int]]], n_columns: int) -> np.ndarray:
     """Build the static ``(M, D)`` 0/1 group-assignment matrix.
 

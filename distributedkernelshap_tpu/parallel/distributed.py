@@ -29,7 +29,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from distributedkernelshap_tpu.ops.explain import build_explainer_fn, split_shap_values
+from distributedkernelshap_tpu.ops.explain import (
+    build_explainer_fn,
+    pack_transfer,
+    split_shap_values,
+    unpack_transfer,
+)
 from distributedkernelshap_tpu.parallel.mesh import (
     COALITION_AXIS,
     DATA_AXIS,
@@ -175,6 +180,16 @@ class DistributedExplainer:
 
     # ------------------------------------------------------------------ #
 
+    def reset_device_state(self) -> None:
+        """Drop the sharded jitted fns + device-resident constants AND the
+        wrapped engine's caches (see
+        ``KernelExplainerEngine.reset_device_state``) — the serving
+        watchdog's recovery hook after a device wedge."""
+
+        self._jit_cache.clear()
+        self._dev_cache.clear()
+        self.engine.reset_device_state()
+
     def _sharded_fn(self):
         key = 'fn'
         if key not in self._jit_cache:
@@ -252,14 +267,16 @@ class DistributedExplainer:
             filler = np.tile(X[-1:], (padded - B, 1))
             X = np.concatenate([X, filler], 0)
         out = fn(jnp.asarray(X, jnp.float32), *args)
-        # one packed D2H instead of two (tunnelled transfers are latency-bound)
-        parts = [out['shap_values'].ravel(), out['raw_prediction'].ravel()]
+        # one packed D2H instead of two (tunnelled transfers are latency-bound);
+        # with transfer_dtype set only the wide segment (phi + interactions)
+        # rides the reduced dtype — f(x) is B*K floats and stays f32
+        wide = [out['shap_values'].ravel()]
         has_inter = 'interaction_values' in out
         if has_inter:
-            parts.append(out['interaction_values'].ravel())
-        packed = jnp.concatenate(parts)
-        if engine.config.shap.transfer_dtype:  # opt-in halved D2H (ShapConfig)
-            packed = packed.astype(engine.config.shap.transfer_dtype)
+            wide.append(out['interaction_values'].ravel())
+        packed = pack_transfer(jnp.concatenate(wide),
+                               out['raw_prediction'].ravel(),
+                               engine.config.shap.transfer_dtype)
         return packed, B, X.shape[0], has_inter
 
     def _dispatch_sharded(self, X: np.ndarray, nsamples):
@@ -284,16 +301,15 @@ class DistributedExplainer:
                 multihost_utils.process_allgather(packed_dev, tiled=True))
         else:
             packed = np.asarray(packed_dev)
-        packed = packed.astype(np.float32, copy=False)
         K, M = engine.predictor.n_outputs, engine.M
-        phi, rest = np.split(packed, [Bp * K * M])
-        out = [phi.reshape(Bp, K, M)[:B]]
+        n_phi = Bp * K * M
+        n_wide = n_phi + (Bp * K * M * M if has_inter else 0)
+        wide, fx = unpack_transfer(packed, n_wide,
+                                   engine.config.shap.transfer_dtype)
+        out = [wide[:n_phi].reshape(Bp, K, M)[:B]]
+        out.append(fx.reshape(Bp, K)[:B])
         if has_inter:
-            fx, inter = np.split(rest, [Bp * K])
-            out.append(fx.reshape(Bp, K)[:B])
-            out.append(inter.reshape(Bp, K, M, M)[:B])
-        else:
-            out.append(rest.reshape(Bp, K)[:B])
+            out.append(wide[n_phi:].reshape(Bp, K, M, M)[:B])
         return tuple(out)
 
     def _explain_sharded(self, X: np.ndarray, nsamples) -> Tuple[np.ndarray, np.ndarray]:

@@ -53,6 +53,13 @@ MAX_WINDOW = 8
 _rtt_cache: Optional[float] = None
 _rtt_lock = threading.Lock()
 
+# multihost agreed-window cache: the broadcast is a blocking device
+# collective, and the window cannot change for the process lifetime — pay
+# the collective once per (request, env, cap) key, not once per explain.
+# Safe under SPMD discipline: every process runs the same driver code, so
+# cache misses (and therefore broadcasts) stay symmetric across processes.
+_window_cache: dict = {}
+
 
 def device_round_trip_s(probes: int = 3, refresh: bool = False) -> float:
     """Median wall-clock of a tiny dispatch+D2H on the default device.
@@ -98,33 +105,78 @@ def resolve_window(requested: Optional[int] = None,
     residency.
 
     Under multi-host execution the window must be identical on every
-    process (fetches embed collectives), so the probe is skipped and
-    :data:`DETERMINISTIC_WINDOW` (or the explicit/env override, which is
-    assumed uniform across hosts) is used.
+    process (fetches embed collectives): the probe is skipped, each process
+    resolves explicit/env/:data:`DETERMINISTIC_WINDOW` locally, and the
+    lead process's value is broadcast to all — a per-host skew in the env
+    or config becomes a logged warning instead of a collective-order wedge.
     """
 
-    cap = MAX_WINDOW if n_items is None else max(1, min(MAX_WINDOW, n_items))
-    if requested:
-        return max(1, min(int(requested), cap))
-    if cap < 2:
-        return cap  # nothing to pipeline: skip the probe entirely
-    env = os.environ.get("DKS_DISPATCH_WINDOW")
-    if env:
-        try:
-            return max(1, min(int(env), cap))
-        except ValueError:
-            logger.warning("ignoring non-integer DKS_DISPATCH_WINDOW=%r", env)
     import jax
 
-    if jax.process_count() > 1:
-        return min(DETERMINISTIC_WINDOW, cap)
-    try:
-        rtt = device_round_trip_s()
-    except Exception:  # never let a probe failure break an explain call
-        logger.warning("device RTT probe failed; window=%d",
-                       DETERMINISTIC_WINDOW, exc_info=True)
-        return min(DETERMINISTIC_WINDOW, cap)
-    return max(2, min(1 + math.ceil(rtt / 0.010), cap))
+    cap = MAX_WINDOW if n_items is None else max(1, min(MAX_WINDOW, n_items))
+    multihost = jax.process_count() > 1
+    resolved: Optional[int] = None
+    if requested is not None:
+        if int(requested) < 1:
+            # warn-and-degrade (package convention): an explicit non-positive
+            # request is meaningless — fall through to env/probe resolution.
+            logger.warning("ignoring non-positive dispatch_window=%r", requested)
+        else:
+            if int(requested) > cap:
+                logger.info("clamping explicit dispatch_window=%d to %d "
+                            "(MAX_WINDOW/n_items bound)", int(requested), cap)
+            resolved = max(1, min(int(requested), cap))
+    if resolved is None and cap < 2:
+        resolved = cap  # nothing to pipeline: skip the probe entirely
+    if resolved is None:
+        env = os.environ.get("DKS_DISPATCH_WINDOW")
+        if env:
+            try:
+                resolved = max(1, min(int(env), cap))
+            except ValueError:
+                logger.warning("ignoring non-integer DKS_DISPATCH_WINDOW=%r",
+                               env)
+    if resolved is None and multihost:
+        resolved = min(DETERMINISTIC_WINDOW, cap)
+    if resolved is None:
+        try:
+            rtt = device_round_trip_s()
+        except Exception:  # never let a probe failure break an explain call
+            logger.warning("device RTT probe failed; window=%d",
+                           DETERMINISTIC_WINDOW, exc_info=True)
+            resolved = min(DETERMINISTIC_WINDOW, cap)
+        else:
+            resolved = max(2, min(1 + math.ceil(rtt / 0.010), cap))
+    if multihost:
+        # Sharded fetches embed collectives, so a window that differs across
+        # processes (a stray per-host DKS_DISPATCH_WINDOW, a config skew)
+        # desyncs the mesh's collective order — a permanent hang.  Make the
+        # lead's resolution authoritative: broadcast once per key (the
+        # broadcast is itself a blocking collective — per-call would tax
+        # every explain), every process uses the same value, and a skew is
+        # a logged warning instead of a wedge.
+        cache_key = (resolved, cap)
+        if cache_key in _window_cache:
+            return _window_cache[cache_key]
+        from jax.experimental import multihost_utils
+
+        try:
+            agreed = int(multihost_utils.broadcast_one_to_all(resolved))
+        except Exception:
+            # no live multi-process runtime behind process_count (tests
+            # spoofing the count; a backend without collectives): the local
+            # resolution is the only one available
+            logger.warning("dispatch-window broadcast unavailable; using "
+                           "locally resolved %d", resolved, exc_info=True)
+            return resolved
+        if agreed != resolved:
+            logger.warning(
+                "dispatch window %d on process %d differs from lead's %d; "
+                "using the lead's (per-host env/config skew?)",
+                resolved, jax.process_index(), agreed)
+        resolved = max(1, min(agreed, cap))
+        _window_cache[cache_key] = resolved
+    return resolved
 
 
 def run_pipeline(items: Iterable[Any],

@@ -156,9 +156,54 @@ def follower_loop(model, max_rows: int = 256):
             # this loop and leave the lead's next broadcast peerless.
             # (If the error struck INSIDE a collective the mesh may be
             # unrecoverable regardless — SPMD's inherent hazard — but
-            # symmetric host-side failures recover cleanly.)
+            # symmetric host-side failures recover cleanly.  An ASYMMETRIC
+            # lead-side failure before it enters the device call leaves
+            # this loop's next collective peerless, and a blocked XLA
+            # collective cannot be timed out from Python: the deployment's
+            # liveness probe + termination grace period are the required
+            # backstop — cluster/tpu_serve_cluster.yaml documents the
+            # wiring.)
             logger.exception("follower %d: explain failed; staying in loop",
                              jax.process_index())
+
+
+def follower_health_server(port: int):
+    """Minimal ``/healthz`` listener for follower pods.
+
+    Followers must NOT serve the explain API (requests go to the lead), but
+    a kubelet liveness probe against a port nobody listens on would kill a
+    healthy follower in a restart loop.  This answers process liveness
+    only — deliberately WITHOUT a device round trip: an idle follower sits
+    inside ``broadcast_one_to_all``'s pending collective, so a probe op
+    queued behind it would hang and misreport healthy-idle as wedged.  The
+    wedge detector for the whole group is the LEAD's device-probing
+    ``/healthz`` (``server.py``); its restart takes the slice down together.
+    Returns the started ``ThreadingHTTPServer`` (daemon threads; caller may
+    ignore it for the life of the process).
+    """
+
+    import json
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = json.dumps({"status": "alive", "role": "follower"}).encode()
+            code = 200 if self.path.rstrip("/") == "/healthz" else 404
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):
+            logger.debug("follower health: " + fmt, *args)
+
+    httpd = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    httpd.daemon_threads = True
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    logger.info("follower health listener on :%d/healthz",
+                httpd.server_address[1])
+    return httpd
 
 
 def serve_multihost(predictor, background_data, constructor_kwargs,
@@ -171,7 +216,9 @@ def serve_multihost(predictor, background_data, constructor_kwargs,
     On the lead process: builds the fitted model over the multi-host mesh,
     wraps it for broadcast, starts the HTTP server, and returns the server
     (caller stops it with ``.stop()`` then ``model.shutdown_followers()``).
-    On follower processes: builds the identical model and blocks in
+    On follower processes: starts the health listener on the same port
+    (liveness/readiness probes must not kill pods that correctly serve no
+    explain API), builds the identical model and blocks in
     :func:`follower_loop` until shutdown (returns None).
     """
 
@@ -189,7 +236,12 @@ def serve_multihost(predictor, background_data, constructor_kwargs,
     base = cls(predictor, background_data, ctor, fit_kwargs,
                explain_kwargs=explain_kwargs)
     if jax.process_index() != 0:
-        follower_loop(base, max_rows=max_rows)
+        health = follower_health_server(port)
+        try:
+            follower_loop(base, max_rows=max_rows)
+        finally:
+            health.shutdown()
+            health.server_close()
         return None
     model = MultihostServingModel(base, max_rows=max_rows)
     server = ExplainerServer(model, host=host, port=port,

@@ -38,7 +38,7 @@ class _HTTPServer(ThreadingHTTPServer):
 
 
 class _Pending:
-    __slots__ = ("array", "event", "response", "error", "t_enqueued")
+    __slots__ = ("array", "event", "response", "error", "t_enqueued", "done")
 
     def __init__(self, array: np.ndarray):
         self.array = array
@@ -46,6 +46,10 @@ class _Pending:
         self.response: Optional[str] = None
         self.error: Optional[str] = None
         self.t_enqueued = time.monotonic()
+        # set once answered; lets the watchdog fail a wedged batch while a
+        # blocked finalize may still complete it later — whoever is second
+        # must not double-answer or double-count
+        self.done = False
 
 
 def calibrate_pipeline_depth(model, example_array: Optional[np.ndarray] = None,
@@ -154,11 +158,27 @@ class ExplainerServer:
         In-flight device batches (the TPU-native reading of the reference's
         replica count).  ``None`` (default) self-calibrates at ``start()``
         via :func:`calibrate_pipeline_depth`.
+    watchdog_timeout_s
+        Fault isolation (the reference got replica-process crash isolation
+        from Ray Serve for free; one process serving one device mesh needs
+        an explicit liveness story): if dispatched work makes no progress
+        for this long, the watchdog fails every affected request with a
+        fast error, marks the server wedged (``/explain`` answers 503,
+        ``/healthz`` fails so an orchestrator restarts the pod) and drops
+        the model's device-resident state so a recovered backend is not
+        handed dead buffers.  A later successful batch clears the flag.
+    device_probe_timeout_s
+        Bound on the tiny device round trip ``/healthz`` performs — a
+        wedged tunnel turns the probe into a hang, which the bound converts
+        into an unhealthy verdict.
     """
 
     def __init__(self, model, host: str = "0.0.0.0", port: int = 8000,
                  max_batch_size: int = 1, batch_timeout_s: float = 0.01,
-                 pipeline_depth: Optional[int] = None):
+                 pipeline_depth: Optional[int] = None,
+                 watchdog_timeout_s: float = 120.0,
+                 first_batch_grace_s: float = 600.0,
+                 device_probe_timeout_s: float = 5.0):
         self.model = model
         self.host = host
         self.port = port
@@ -166,6 +186,29 @@ class ExplainerServer:
         self.batch_timeout_s = batch_timeout_s
         self.pipeline_depth = (None if pipeline_depth is None
                                else max(1, int(pipeline_depth)))
+        self.watchdog_timeout_s = float(watchdog_timeout_s)
+        # a server that has never completed a batch may legitimately be
+        # inside its first jit compile (~40-140 s on a tunnelled chip, and
+        # serve_multihost skips the calibration warm-up that would absorb
+        # it) — the watchdog must not declare that a wedge
+        self.first_batch_grace_s = max(float(first_batch_grace_s),
+                                       self.watchdog_timeout_s)
+        self.device_probe_timeout_s = float(device_probe_timeout_s)
+        # dispatched-but-unanswered batches, keyed by id(batch): the
+        # watchdog's view of what a wedged device call is holding hostage
+        self._active = {}
+        self._active_lock = threading.Lock()
+        self._last_progress = time.monotonic()
+        self._ever_completed = False
+        self._wedged = threading.Event()
+        # at most one outstanding health probe thread: while the device is
+        # wedged the probe thread is stuck inside an XLA call
+        # (uncancellable) — concurrent health checks JOIN the in-flight
+        # probe instead of stacking threads
+        self._probe_lock = threading.Lock()
+        self._probe_thread: Optional[threading.Thread] = None
+        self._probe_done: Optional[threading.Event] = None
+        self._probe_started = 0.0
         # serving metrics (Prometheus text format at /metrics — beyond the
         # reference, which exposes no metrics at all, SURVEY.md §5.5); one
         # lock guards the counters (updated per completed request)
@@ -191,17 +234,44 @@ class ExplainerServer:
     def _complete(self, batch, payloads=None, error=None):
         # counters update BEFORE the response events: a client that gets
         # its answer and immediately scrapes /metrics must see itself
-        # counted
+        # counted.  Claiming happens under the metrics lock so a batch the
+        # watchdog failed and a late-returning finalize can never both
+        # answer (or both count) the same request.
         with self._metrics_lock:
+            live = [(i, p) for i, p in enumerate(batch) if not p.done]
+            for _, p in live:
+                p.done = True
+            if not live:
+                # a batch the watchdog already failed: the work still
+                # finishing is itself the recovery signal
+                with self._active_lock:
+                    self._active.pop(id(batch), None)
+                self._last_progress = time.monotonic()
+                if error is None and self._wedged.is_set():
+                    logger.warning("serving recovered: a previously failed "
+                                   "batch's device work completed")
+                    self._wedged.clear()
+                return
             self._metrics["batches_total"] += 1
-            self._metrics["requests_total"] += len(batch)
-            self._metrics["rows_total"] += sum(p.array.shape[0] for p in batch)
+            self._metrics["requests_total"] += len(live)
+            self._metrics["rows_total"] += sum(
+                p.array.shape[0] for _, p in live)
             if error is not None:
-                self._metrics["errors_total"] += len(batch)
+                self._metrics["errors_total"] += len(live)
             now = time.monotonic()
             self._metrics["request_seconds_sum"] += sum(
-                now - p.t_enqueued for p in batch)
-        for i, p in enumerate(batch):
+                now - p.t_enqueued for _, p in live)
+        with self._active_lock:
+            self._active.pop(id(batch), None)
+        self._last_progress = time.monotonic()
+        if error is None:
+            self._ever_completed = True
+            if self._wedged.is_set():
+                # the device answered again (relay unwedged): resume serving
+                logger.warning("serving recovered: a batch completed after "
+                               "the watchdog declared a wedge")
+                self._wedged.clear()
+        for i, p in live:
             if error is not None:
                 p.error = error
             else:
@@ -284,7 +354,16 @@ class ExplainerServer:
                 batch = self._fill_batch()
                 if batch is None:
                     continue
+                # requests the wedge handling already answered (handler-side
+                # fail, watchdog drain) must not cost device work
+                batch = [p for p in batch if not p.done]
+                if not batch:
+                    continue
                 sizes = [p.array.shape[0] for p in batch]
+                with self._active_lock:
+                    # registered BEFORE the device call so the watchdog can
+                    # fail it if the call never returns
+                    self._active[id(batch)] = batch
                 try:
                     stacked = np.concatenate([p.array for p in batch], axis=0)
                     if pipelined:
@@ -317,6 +396,128 @@ class ExplainerServer:
                 logger.exception("finalize batch failed")
                 self._complete(batch, error=str(e))
 
+    def _watchdog_loop(self):
+        """Fault isolation for a one-process serving deployment.
+
+        The reference's Ray Serve replicas fail independently (a crashed
+        replica's requests error; the rest keep serving,
+        ``explainers/wrappers.py:10-88`` + ``restartPolicy: Always``).  Here
+        one process owns the device, so a wedged device call — a dead relay
+        tunnel mid-RPC, a backend restart — would otherwise hold every
+        in-flight request's socket open forever.  This loop watches for
+        dispatched work that stops progressing, fails the affected requests
+        with a fast error, flips the server into a wedged state (fast 503s,
+        failing ``/healthz``), and drops the model's device-resident state
+        so a recovered backend starts from clean buffers.  The blocked OS
+        thread itself is unrecoverable (an XLA call cannot be cancelled) —
+        if it eventually returns, ``_complete`` notices and clears the
+        wedge; if it never does, the failing ``/healthz`` gets the pod
+        restarted (``cluster/tpu_serve_cluster.yaml``)."""
+
+        while not self._stop.is_set():
+            if self._stop.wait(min(1.0, self.watchdog_timeout_s / 4)):
+                break
+            with self._active_lock:
+                active = list(self._active.values())
+            if not active:
+                self._last_progress = time.monotonic()
+                continue
+            stalled_s = time.monotonic() - self._last_progress
+            # before the first completed batch, allow the first-compile
+            # grace window instead of the steady-state timeout
+            limit = (self.watchdog_timeout_s if self._ever_completed
+                     else self.first_batch_grace_s)
+            if stalled_s <= limit:
+                continue
+            logger.error(
+                "watchdog: %d in-flight batch(es) made no progress for "
+                "%.0f s; failing them and marking the server wedged",
+                len(active), stalled_s)
+            self._wedged.set()
+            msg = (f"device call exceeded the {limit:.0f}s "
+                   f"watchdog timeout; server marked unhealthy")
+            for batch in active:
+                self._complete(batch, error=msg)
+            # requests parked behind the wedged dispatcher never reach a
+            # device call: fail them too instead of letting them wait out
+            # the pod restart (new arrivals fast-503 via the handler)
+            drained = []
+            while True:
+                try:
+                    drained.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            if drained:
+                self._complete(drained, error=msg)
+            reset = getattr(self.model, "reset", None)
+            if reset is not None:
+                try:
+                    reset()
+                except Exception:
+                    logger.exception("model reset after wedge failed")
+
+    def _device_probe_ok(self) -> bool:
+        """One tiny device round trip, bounded by ``device_probe_timeout_s``.
+
+        A wedged backend turns the probe into an indefinite hang inside the
+        XLA runtime, which cannot be interrupted — so the probe runs on a
+        daemon thread and at most ONE probe thread exists.  Concurrent
+        health checks (k8s points readiness AND liveness at ``/healthz``,
+        so probes can coincide) JOIN the in-flight probe and share its
+        verdict; only a probe that has already outlived its own timeout
+        fails later callers fast."""
+
+        with self._probe_lock:
+            t = self._probe_thread
+            if t is not None and t.is_alive():
+                age = time.monotonic() - self._probe_started
+                if age > self.device_probe_timeout_s:
+                    return False  # stuck probe: the device is not answering
+                done = self._probe_done
+            else:
+                done = threading.Event()
+
+                def probe():
+                    try:
+                        import jax.numpy as jnp
+
+                        np.asarray(jnp.zeros((), jnp.float32) + 1.0)
+                        done.set()
+                    except Exception:
+                        logger.exception("health device probe failed")
+
+                self._probe_done = done
+                self._probe_started = time.monotonic()
+                self._probe_thread = threading.Thread(target=probe,
+                                                      daemon=True)
+                self._probe_thread.start()
+        return done.wait(self.device_probe_timeout_s)
+
+    def _health(self):
+        """(status_code, payload) for ``/healthz``: wedged state, then the
+        in-flight-progress shortcut, then a bounded device round trip.
+
+        Busy is not wedged: under sustained load the probe op would queue
+        behind all in-flight device work and time out on a perfectly
+        healthy pod — but recent batch progress is itself proof the device
+        answers, so the probe is skipped while work is flowing."""
+
+        if self._wedged.is_set():
+            return 503, {"status": "wedged",
+                         "error": "device made no progress within the "
+                                  "watchdog timeout"}
+        with self._active_lock:
+            busy = bool(self._active)
+        if busy and (time.monotonic() - self._last_progress
+                     < self.watchdog_timeout_s):
+            return 200, {"status": "ok", "detail": "in-flight work "
+                         "progressing; device probe skipped"}
+        if not self._device_probe_ok():
+            return 503, {"status": "device-unreachable",
+                         "error": f"device round trip exceeded "
+                                  f"{self.device_probe_timeout_s:.1f}s"}
+        return 200, {"status": "ok"}
+
     def _make_handler(server):  # noqa: N805 - closure over the server
         class Handler(BaseHTTPRequestHandler):
             # keep-alive: clients reuse one connection for their whole request
@@ -335,7 +536,8 @@ class ExplainerServer:
             def _handle(self):
                 route = self.path.rstrip("/")
                 if route == "/healthz":
-                    self._reply(200, json.dumps({"status": "ok"}))
+                    code, payload = server._health()
+                    self._reply(code, json.dumps(payload))
                     return
                 if route == "/metrics":
                     self._reply(200, server._render_metrics(),
@@ -351,6 +553,16 @@ class ExplainerServer:
                 except (KeyError, ValueError, json.JSONDecodeError) as e:
                     self._reply(400, json.dumps({"error": f"bad request: {e}"}))
                     return
+                if server._wedged.is_set():
+                    # fast error instead of a socket that hangs until the
+                    # pod restart: the reference's crashed-replica requests
+                    # failed fast too (connection reset).  Checked AFTER the
+                    # body read — an unconsumed body would desync the next
+                    # request on this keep-alive connection.
+                    self._reply(503, json.dumps({
+                        "error": "server wedged: device made no progress "
+                                 "within the watchdog timeout"}))
+                    return
                 max_rows = getattr(server.model, "max_rows", None)
                 if max_rows and array.shape[0] > max_rows:
                     # a single request larger than the model's slot can
@@ -362,12 +574,25 @@ class ExplainerServer:
                     return
                 pending = _Pending(array)
                 server._queue.put(pending)
-                # re-check shutdown periodically so in-flight requests fail
-                # fast instead of hanging on a dead dispatcher
+                # re-check shutdown/wedge periodically so in-flight requests
+                # fail fast instead of hanging on a dead dispatcher
                 while not pending.event.wait(timeout=1.0):
                     if server._stop.is_set():
                         pending.error = pending.error or "server shutting down"
                         break
+                    if server._wedged.is_set():
+                        # catches requests the watchdog's queue drain can't
+                        # see (the dispatcher's carry slot, races with
+                        # _fill_batch); claim under the metrics lock so a
+                        # late completion can't double-answer
+                        with server._metrics_lock:
+                            if not pending.done:
+                                pending.done = True
+                                pending.error = (
+                                    "server wedged: device made no progress "
+                                    "within the watchdog timeout")
+                        if pending.error is not None:
+                            break
                 if pending.error is not None:
                     self._reply(500, json.dumps({"error": pending.error}))
                 else:
@@ -408,7 +633,9 @@ class ExplainerServer:
         t_disp.start()
         for t in t_fin:
             t.start()
-        self._threads = [t_http, t_disp, *t_fin]
+        t_dog = threading.Thread(target=self._watchdog_loop, daemon=True)
+        t_dog.start()
+        self._threads = [t_http, t_disp, t_dog, *t_fin]
         logger.info("ExplainerServer listening on %s:%d/explain (max_batch_size=%d)",
                     self.host, self.port, self.max_batch_size)
         return self

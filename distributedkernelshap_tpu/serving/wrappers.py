@@ -93,6 +93,21 @@ class KernelShapModel:
         model.explain_kwargs = _check_explain_kwargs(explain_kwargs)
         return model
 
+    def reset(self) -> None:
+        """Drop device-resident state (uploaded constants, jitted
+        executables) so the next explain rebuilds from host copies.
+
+        Called by the serving watchdog after a device wedge: buffers that
+        lived on a backend that has since restarted are dead handles, and
+        feeding them to a fresh backend fails opaquely.  Everything dropped
+        here is a cache — correctness is unaffected, the next call just
+        pays re-upload + re-trace."""
+
+        inner = getattr(self.explainer, "_explainer", None)
+        reset = getattr(inner, "reset_device_state", None)
+        if reset is not None:
+            reset()
+
     def __call__(self, request) -> str:
         """Explain a single request; returns the Explanation as JSON
         (the wire schema of ``interface.Explanation.to_json``)."""
