@@ -74,6 +74,148 @@ def _fingerprint(X: np.ndarray):
     X = np.ascontiguousarray(X)
     return (X.shape, str(X.dtype), hash(X.tobytes()))
 
+def _lars_knots_batched(G: np.ndarray, XtY: np.ndarray, max_steps: int,
+                        lasso: bool) -> np.ndarray:
+    """Coefficient knots of LARS (``lasso=False``) / lasso-LARS
+    (``lasso=True``) regularisation paths for ``T`` targets sharing ONE
+    Gram matrix, vectorized over the target axis.
+
+    Returns ``(n_knots, p, T)`` float64 — knot 0 is the all-zero start,
+    knot ``k`` the coefficients after the ``k``-th path step, exactly the
+    per-target output of sklearn's ``lars_path_gram(Xy=XtY[:, t], Gram=G)``
+    stacked over ``t`` (pinned by
+    ``tests/test_kernel_shap.py::test_l1_select_batch_matches_sklearn_per_fit``).
+
+    Why not sklearn per target: the reference's surfaced ``l1_reg`` knob
+    runs one selection per (instance, output) — B*K ≈ 10k targets for the
+    headline task — and per-fit Python overhead dominated the wall clock
+    (41.7 s vs 0.15 s for the pipeline it decorates, VERDICT r3 #5).  All
+    targets share the design, so each path step here is a handful of
+    batched O(T·p²) numpy ops + one batched ``(T, p, p)`` LAPACK solve;
+    target count stops mattering.  Per step and target: the entering
+    variable is the max-|correlation| inactive one, the direction solves
+    ``G_AA w = sign_A`` (masked solve: inactive rows/cols replaced by
+    identity so ``w`` is exactly 0 off the active set — which is what
+    makes ``np.nonzero`` selection semantics survive batching), the step
+    size is Efron's min-positive candidate, and the lasso variant drops a
+    variable whose coefficient would cross zero mid-step.  Finished
+    targets (residual correlation ~0) freeze and replay their final knot,
+    which leaves the downstream criterion argmin unchanged.
+
+    Returns ``(knots, ok)`` where ``ok`` is a ``(T,)`` bool mask: False
+    marks targets whose path hit a degenerate active-set Gram (exactly or
+    nearly collinear coalition columns — one target must not crash or
+    silently corrupt the other ~10k) or did not converge within the step
+    cap.  Such targets freeze immediately; the caller routes them through
+    sklearn's per-target path, which carries its own degeneracy handling.
+    """
+
+    p, T = XtY.shape
+    beta = np.zeros((p, T))
+    active = np.zeros((p, T), bool)
+    sign = np.zeros((p, T))
+    done = np.zeros(T, bool)
+    degenerate = np.zeros(T, bool)
+    converged = np.zeros(T, bool)
+    drop_flag = np.zeros(T, bool)
+    knots = [beta.copy()]
+    tiny = np.finfo(np.float64).tiny
+    diag = np.arange(p)
+    scale = np.maximum(1.0, np.abs(XtY).max(axis=0))
+    idx = np.arange(T)
+    for _ in range(max_steps):
+        c = XtY - G @ beta                       # (p, T) residual correlations
+        camp = np.abs(c)
+        C = camp.max(axis=0)                     # (T,)
+        converged |= (~degenerate) & (C < 1e-10 * scale)
+        done |= converged
+        if done.all():
+            break
+        # entering variable (skipped right after a lasso drop, per Efron)
+        camp_inact = np.where(active, -np.inf, camp)
+        j_star = camp_inact.argmax(axis=0)
+        can_add = (~done) & (~drop_flag) & ~active.all(axis=0)
+        active[j_star[can_add], idx[can_add]] = True
+        sign[j_star[can_add], idx[can_add]] = np.sign(
+            c[j_star[can_add], idx[can_add]])
+        drop_flag[:] = False
+        # equiangular direction: masked batched solve of G_AA w = sign_A
+        MT = active.T                            # (T, p)
+        M = np.where(MT[:, :, None] & MT[:, None, :], G[None, :, :], 0.0)
+        M[:, diag, diag] = np.where(MT, G[diag, diag][None, :], 1.0)
+        try:
+            w = np.linalg.solve(M, sign.T[:, :, None])[:, :, 0].T  # (p, T)
+        except np.linalg.LinAlgError:
+            # the batched solve raises if ANY target's G_AA is exactly
+            # singular (collinear coalition columns).  Exceptional path:
+            # identify the offenders individually so one degenerate target
+            # does not take down the other ~10k.
+            w = np.zeros((p, T))
+            for t in range(T):
+                try:
+                    w[:, t] = np.linalg.solve(M[t], sign[:, t])
+                except np.linalg.LinAlgError:
+                    degenerate[t] = True
+            done |= degenerate
+        denom = np.einsum('pt,pt->t', w, sign)
+        # near-singular signature (sklearn warns + falls back on its
+        # cholesky pivot): a non-positive w·sign would overflow AA and
+        # silently corrupt the target's path — flag and freeze instead
+        bad = (~done) & ((denom <= tiny) | ~np.isfinite(w).all(axis=0))
+        if bad.any():
+            degenerate |= bad
+            done |= bad
+        AA = 1.0 / np.sqrt(np.maximum(denom, tiny))
+        w = np.where(done[None, :], 0.0, w * AA[None, :])
+        a = G @ w                                # (p, T)
+        with np.errstate(divide='ignore', invalid='ignore'):
+            g1 = (C[None, :] - c) / (AA[None, :] - a)
+            g2 = (C[None, :] + c) / (AA[None, :] + a)
+
+        def _min_pos(x):
+            x = np.where(~active & np.isfinite(x) & (x > tiny), x, np.inf)
+            return x.min(axis=0)
+
+        gamma = np.minimum(_min_pos(g1), _min_pos(g2))
+        # no (valid) inactive candidate -> the full step to zero residual
+        # correlation; also a numerical safety cap
+        gamma = np.minimum(gamma, C / AA)
+        # zero-crossing check runs in BOTH modes (sklearn: a crossing sets
+        # `drop`, which skips the next iteration's add; lasso additionally
+        # truncates the step at the crossing and evicts the variable, while
+        # plain LARS keeps stepping but flips the crossing sign)
+        with np.errstate(divide='ignore', invalid='ignore'):
+            z = -beta / w
+        z = np.where(active & (np.abs(w) > tiny) & (z > tiny), z, np.inf)
+        z_pos = z.min(axis=0)
+        hit = (~done) & (z_pos < gamma)
+        if lasso:
+            gamma = np.where(hit, z_pos, gamma)
+        gamma = np.where(done, 0.0, gamma)
+        beta = beta + gamma[None, :] * w
+        crossing = hit[None, :] & (z <= z_pos[None, :])
+        if lasso:
+            beta = np.where(crossing, 0.0, beta)
+            active &= ~crossing
+            sign = np.where(crossing, 0.0, sign)
+        else:
+            sign = np.where(crossing, -sign, sign)
+        drop_flag = hit
+        knots.append(beta.copy())
+    else:
+        # step cap hit with unfinished targets: their truncated paths must
+        # not silently masquerade as full sklearn semantics
+        converged |= (~degenerate) & (np.abs(XtY - G @ beta).max(axis=0)
+                                      < 1e-10 * scale)
+    ok = ~degenerate & np.isfinite(knots[-1]).all(axis=0)
+    if lasso:
+        # full-path semantics (aic/bic): an unconverged path is a silent
+        # truncation.  The 'lar' mode stops at max_steps BY DESIGN
+        # (num_features(k)), so truncation is the contract there.
+        ok &= converged
+    return np.stack(knots), ok
+
+
 def _l1_select_batch(Xw, Yw, l1_reg) -> List[np.ndarray]:
     """Feature-selection index sets for every column of ``Yw`` against the
     shared weighted design ``Xw`` (``(S, p)``; p = n_groups - 1).
@@ -97,7 +239,7 @@ def _l1_select_batch(Xw, Yw, l1_reg) -> List[np.ndarray]:
       form ``y'y - 2c·X'y + c'Gc`` rather than per-step residual vectors.
     """
 
-    from sklearn.linear_model import Lasso, lars_path_gram
+    from sklearn.linear_model import Lasso
 
     S, p = Xw.shape
     T = Yw.shape[1]
@@ -109,14 +251,25 @@ def _l1_select_batch(Xw, Yw, l1_reg) -> List[np.ndarray]:
         return [np.nonzero(coef[t])[0] for t in range(T)]
 
     if isinstance(l1_reg, str) and l1_reg.startswith('num_features('):
+        from sklearn.linear_model import lars_path_gram
+
         nfeat = int(l1_reg[len('num_features('):-1])
         G = Xw.T @ Xw
         XtY = Xw.T @ Yw
-        sels = []
+        knots, ok = _lars_knots_batched(G, XtY, max_steps=nfeat, lasso=False)
+        last = knots[-1]                                    # (p, T)
+        sels = [None] * T
         for t in range(T):
-            _, _, coefs = lars_path_gram(Xy=XtY[:, t], Gram=G, n_samples=S,
-                                         max_iter=nfeat)
-            sels.append(np.nonzero(coefs[:, -1])[0])
+            if ok[t]:
+                sels[t] = np.nonzero(last[:, t])[0]
+            else:
+                # degenerate design for this target: sklearn's per-target
+                # path carries its own collinearity handling (warn + drop)
+                logger.warning("l1_reg num_features: degenerate design for "
+                               "target %d; using sklearn per-target path", t)
+                _, _, coefs = lars_path_gram(Xy=XtY[:, t], Gram=G,
+                                             n_samples=S, max_iter=nfeat)
+                sels[t] = np.nonzero(coefs[:, -1])[0]
         return sels
 
     if isinstance(l1_reg, str) and l1_reg in ('aic', 'bic'):
@@ -134,15 +287,41 @@ def _l1_select_batch(Xw, Yw, l1_reg) -> List[np.ndarray]:
             + np.einsum('pt,pt->t', C_ols, G @ C_ols)
         sigma2 = np.maximum(rss_ols / (S - p - 1), np.finfo(np.float64).tiny)
         factor = 2.0 if l1_reg == 'aic' else np.log(S)
-        sels = []
+        # full lasso paths for ALL targets in one batched sweep (a lasso
+        # path can exceed p steps via drop/re-entry; 8p+16 is far beyond
+        # observed path lengths, and finished targets freeze early)
+        knots, ok = _lars_knots_batched(G, XtY, max_steps=8 * p + 16,
+                                        lasso=True)
+        Gk = np.einsum('pq,kqt->kpt', G, knots)
+        rss = yty[None, :] - 2 * np.einsum('kpt,pt->kt', knots, XtY) \
+            + np.einsum('kpt,kpt->kt', knots, Gk)           # (n_knots, T)
+        df = (np.abs(knots) > np.finfo(knots.dtype).eps).sum(axis=1)
+        crit = S * np.log(2 * np.pi * sigma2)[None, :] \
+            + rss / sigma2[None, :] + factor * df
+        best = crit.argmin(axis=0)                          # (T,)
+        sels = [None] * T
         for t in range(T):
-            _, _, coefs = lars_path_gram(Xy=XtY[:, t], Gram=G, n_samples=S,
-                                         method='lasso', alpha_min=0.0)
-            rss = yty[t] - 2 * XtY[:, t] @ coefs \
-                + np.einsum('ps,ps->s', coefs, G @ coefs)
-            df = (np.abs(coefs) > np.finfo(coefs.dtype).eps).sum(axis=0)
-            crit = S * np.log(2 * np.pi * sigma2[t]) + rss / sigma2[t] + factor * df
-            sels.append(np.nonzero(coefs[:, np.argmin(crit)])[0])
+            if ok[t]:
+                sels[t] = np.nonzero(knots[best[t], :, t])[0]
+            else:
+                # degenerate or unconverged path for this target: sklearn's
+                # per-target machinery (the round-3 implementation) handles
+                # collinearity with its own warn-and-continue semantics
+                logger.warning("l1_reg %s: degenerate/unconverged path for "
+                               "target %d; using sklearn per-target path",
+                               l1_reg, t)
+                from sklearn.linear_model import lars_path_gram
+
+                _, _, coefs = lars_path_gram(Xy=XtY[:, t], Gram=G,
+                                             n_samples=S, method='lasso',
+                                             alpha_min=0.0)
+                rss_t = yty[t] - 2 * XtY[:, t] @ coefs \
+                    + np.einsum('ps,ps->s', coefs, G @ coefs)
+                df_t = (np.abs(coefs)
+                        > np.finfo(coefs.dtype).eps).sum(axis=0)
+                crit_t = S * np.log(2 * np.pi * sigma2[t]) \
+                    + rss_t / sigma2[t] + factor * df_t
+                sels[t] = np.nonzero(coefs[:, np.argmin(crit_t)])[0]
         return sels
 
     raise ValueError(f"Unsupported l1_reg value: {l1_reg!r}")
