@@ -200,3 +200,45 @@ def test_get_explanation_async_fallback_paths():
     got, _ = eng_l1.get_explanation_async(X, nsamples=40,
                                           l1_reg="num_features(4)")()
     np.testing.assert_allclose(got[0], want[0], atol=1e-6)
+
+
+def test_hosteval_workers_scale_with_gil_releasing_predictor():
+    """VERDICT r3 #6: `host_eval_workers` must deliver measured SPEEDUP,
+    not just correctness, when the predictor releases the GIL (sklearn /
+    XGBoost release it inside their numeric cores; here a sleep stands in
+    so the test is deterministic even on a 1-core host).  Eight coalition
+    chunks at ~60 ms each: sequential ≈ 480 ms, four workers ≈ 2 waves.
+    The margin (×0.6) is deliberately loose for loaded CI hosts."""
+
+    import time as _time
+
+    rng = np.random.default_rng(11)
+    D, K, N, B = 8, 2, 8, 4
+    W = rng.normal(size=(D, K)).astype(np.float32)
+    bg = rng.normal(size=(N, D)).astype(np.float32)
+    X = rng.normal(size=(B, D)).astype(np.float32)
+
+    def slow_host_model(x):
+        _time.sleep(0.06)  # GIL released, like a BLAS/XGBoost core
+        z = x @ W
+        e = np.exp(z - z.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    def run(workers):
+        cb = CallbackPredictor(slow_host_model, example_dim=D)
+        cfg = EngineConfig(host_eval=True, host_eval_workers=workers)
+        # nsamples=128 / chunk=16 -> 8 coalition chunks
+        cfg = replace(cfg, shap=replace(cfg.shap, coalition_chunk=16))
+        eng = KernelExplainerEngine(cb, bg, link="logit", seed=0, config=cfg)
+        t0 = _time.perf_counter()
+        sv = eng.get_explanation(X, nsamples=128)
+        return _time.perf_counter() - t0, sv
+
+    t_seq, sv_seq = run(1)
+    t_par, sv_par = run(4)
+    for a, b_ in zip(sv_seq, sv_par):
+        np.testing.assert_array_equal(a, b_)
+    assert t_par < t_seq * 0.6, (
+        f"host_eval_workers=4 took {t_par:.2f}s vs sequential {t_seq:.2f}s "
+        f"— the chunk fan-out is not overlapping GIL-releasing predictor "
+        f"calls")
