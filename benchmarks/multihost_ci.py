@@ -20,7 +20,15 @@ reference's k8s Makefiles drove ``k8s_ray_pool.py`` against a live cluster
 6. the multi-host SERVING path: lead process serves HTTP over the
    2-process mesh via the broadcast protocol
    (``serving/multihost.py``), and the served shap values match a
-   single-process direct explain.
+   single-process direct explain;
+7. 16-device envelope (VERDICT r3 #7 — the v5e-64 Covertype projection
+   must rest on exercised shapes): ``data(4) x coalition(4)`` and
+   ``data(8) x coalition(2)`` on 4 processes x 4 devices;
+8. a multi-slice-shaped mesh: 2 processes x 8 devices with
+   ``coalition_parallel=8`` — every coalition collective (the psum'd
+   normal equations) stays process-local (the ICI analog) while the data
+   axis is PURE cross-process traffic (the DCN analog), the axis layout
+   of a real multi-slice deployment.
 
 Prints ONE JSON line and exits 0/1 — suitable for cron/CI.
 
@@ -224,25 +232,48 @@ def main() -> int:
     checks = {}
     try:
         with tempfile.TemporaryDirectory() as tmp:
+
+            def run_pool_leg(name: str, n_procs: int, dev_per_proc: int,
+                             coalition_parallel: int = 1) -> None:
+                """One pool-benchmark leg: ``n_procs`` coupled processes on
+                a ``data x coalition`` mesh of ``n_procs * dev_per_proc``
+                devices; asserts the runtime spanned all processes and the
+                lead wrote THIS leg's reference-format result pickle."""
+
+                workers = n_procs * dev_per_proc
+                pkl = os.path.join(
+                    tmp, "results",
+                    f"ray_workers_{workers}_bsize_8_actorfr_1.0.pkl")
+                # several legs share a worker count: a leftover pickle from
+                # an earlier leg must not satisfy this leg's check
+                if os.path.exists(pkl):
+                    os.remove(pkl)
+                port = _free_port()
+                texts = _run_procs(lambda pid: [
+                    sys.executable, os.path.join(REPO, "benchmarks",
+                                                 "multihost_pool.py"),
+                    "-b", "8", "-w", str(workers), "-n", "1", "--limit", "64",
+                    "--coalition_parallel", str(coalition_parallel),
+                    "--platform", "cpu", "--cpu_devices", str(dev_per_proc),
+                    "--coordinator", f"127.0.0.1:{port}",
+                    "--num_processes", str(n_procs),
+                    "--process_id", str(pid)],
+                    tmp, args.timeout, n_procs=n_procs,
+                    log_prefix=f"{name}_")
+                want = (f"jax.distributed initialised: {n_procs} processes, "
+                        f"{workers} devices")
+                for out in texts:
+                    if want not in out:
+                        raise RuntimeError(
+                            f"{name}: runtime did not span {n_procs} "
+                            f"processes:\n" + out[-1500:])
+                with open(pkl, "rb") as f:
+                    result = pickle.load(f)
+                assert result["t_elapsed"] and result["t_elapsed"][0] > 0
+                checks[name] = "ok"
+
             # --- leg 1: the pool benchmark across two processes ----------
-            port = _free_port()
-            texts = _run_two(lambda pid: [
-                sys.executable, os.path.join(REPO, "benchmarks", "multihost_pool.py"),
-                "-b", "8", "-w", str(N_DEVICES), "-n", "1", "--limit", "64",
-                "--platform", "cpu", "--cpu_devices", "2",
-                "--coordinator", f"127.0.0.1:{port}",
-                "--num_processes", "2", "--process_id", str(pid)],
-                tmp, args.timeout)
-            for out in texts:
-                if "jax.distributed initialised: 2 processes, 4 devices" not in out:
-                    raise RuntimeError("runtime did not span 2 processes:\n"
-                                       + out[-1500:])
-            pkl = os.path.join(tmp, "results",
-                               "ray_workers_4_bsize_8_actorfr_1.0.pkl")
-            with open(pkl, "rb") as f:
-                result = pickle.load(f)
-            assert result["t_elapsed"] and result["t_elapsed"][0] > 0
-            checks["pool_benchmark_2proc"] = "ok"
+            run_pool_leg("pool_benchmark_2proc", n_procs=2, dev_per_proc=2)
 
             # --- leg 2: cross-process phi equivalence --------------------
             worker = os.path.join(tmp, "worker.py")
@@ -270,26 +301,20 @@ def main() -> int:
             checks["interactions_identical_across_processes"] = "ok"
 
             # --- leg 4: FOUR processes on a data(4) x coalition(2) mesh --
-            port4 = _free_port()
-            texts4 = _run_procs(lambda pid: [
-                sys.executable, os.path.join(REPO, "benchmarks",
-                                             "multihost_pool.py"),
-                "-b", "8", "-w", "8", "-n", "1", "--limit", "64",
-                "--coalition_parallel", "2",
-                "--platform", "cpu", "--cpu_devices", "2",
-                "--coordinator", f"127.0.0.1:{port4}",
-                "--num_processes", "4", "--process_id", str(pid)],
-                tmp, args.timeout, n_procs=4, log_prefix="p4_")
-            for out in texts4:
-                if "jax.distributed initialised: 4 processes, 8 devices" not in out:
-                    raise RuntimeError("runtime did not span 4 processes:\n"
-                                       + out[-1500:])
-            with open(os.path.join(tmp, "results",
-                                   "ray_workers_8_bsize_8_actorfr_1.0.pkl"),
-                      "rb") as f:
-                result4 = pickle.load(f)
-            assert result4["t_elapsed"] and result4["t_elapsed"][0] > 0
-            checks["pool_benchmark_4proc_2x2_mesh"] = "ok"
+            run_pool_leg("pool_benchmark_4proc_2x2_mesh", n_procs=4,
+                         dev_per_proc=2, coalition_parallel=2)
+
+            # --- legs 4b-4d: the 16-device envelope ----------------------
+            # (VERDICT r3 #7) dp4 x cp4 and dp8 x cp2 on 4 procs x 4 dev,
+            # plus the multi-slice axis layout: 2 procs x 8 dev with all
+            # coalition collectives process-local ("ICI") and the data
+            # axis purely cross-process ("DCN").
+            run_pool_leg("pool_16dev_dp4xcp4", n_procs=4, dev_per_proc=4,
+                         coalition_parallel=4)
+            run_pool_leg("pool_16dev_dp8xcp2", n_procs=4, dev_per_proc=4,
+                         coalition_parallel=2)
+            run_pool_leg("pool_16dev_multislice_dp2xcp8", n_procs=2,
+                         dev_per_proc=8, coalition_parallel=8)
 
             # --- leg 5: multi-host SERVING over the broadcast protocol ---
             sp = _free_port()
