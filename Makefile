@@ -32,4 +32,5 @@ sweep-serve:     ## serving sweep (reference serve_explanations.py analog)
 	$(PY) benchmarks/serve_explanations.py --replicas 8 -b 1 5 10 -n 1
 
 analysis:        ## aggregate result pickles and plot
-	$(PY) benchmarks/analysis.py --results results --plot results/scaling.png
+	$(PY) benchmarks/analysis.py --results results --plot results/scaling.png \
+		--compare images/comparison_tpu_vs_reference.png
