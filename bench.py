@@ -36,6 +36,13 @@ even under a relay wedge) and reports it as a clearly-labelled
 ``cpu_fallback_wall_s`` secondary field in the error JSON, so the driver
 artifact always carries a real measurement without misrepresenting it as a
 TPU number.
+
+Retry horizon beyond one invocation: every on-chip success caches its
+record to ``results/bench_last_success.json`` (the relay-recovery watcher
+runs this benchmark the moment the chip answers), and the wedged-path
+error JSON attaches that cache as ``last_onchip`` with its age — so ONE
+healthy relay window anywhere in the round is enough for the driver
+artifact to carry an on-chip number, clearly labelled as cached.
 """
 
 import json
@@ -49,6 +56,25 @@ import numpy as np
 RAY_POOL_32VCPU_BASELINE_S = 125.05  # BASELINE.md: best single-node reference
 
 _METRIC = "adult_2560_bg100_wall_s"
+
+#: on-chip success cache (see module docstring, "Retry horizon")
+_CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "results", "bench_last_success.json")
+
+
+def _code_version() -> str:
+    """Short commit hash of the code that produced a measurement (ties a
+    cached record to what was benchmarked; 'unknown' outside a checkout)."""
+
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)), timeout=10)
+        if out.returncode == 0:
+            return out.stdout.decode().strip() or "unknown"
+    except (OSError, subprocess.TimeoutExpired):
+        pass
+    return "unknown"
 
 
 def _total_budget() -> float:
@@ -158,6 +184,26 @@ def run_benchmark(cpu_fallback: bool = False) -> int:
                                                 "unspecified"),
     }
     print(json.dumps(record))
+    if not cpu_fallback and record["platform"] != "cpu":
+        # persist the on-chip success for the wedged-path error JSON: the
+        # relay's uptime windows rarely align with the driver's end-of-round
+        # bench run, but a recovery watcher runs this same benchmark the
+        # moment the chip answers — caching here lets ONE healthy window
+        # anywhere in the round put an on-chip number (clearly labelled as
+        # cached) into the driver artifact.
+        try:
+            record_cached = dict(record, captured_unix=time.time(),
+                                 code_version=_code_version())
+            os.makedirs(os.path.dirname(_CACHE_PATH), exist_ok=True)
+            # atomic replace: a concurrently-wedging driver invocation must
+            # never read a half-written cache (that race window is exactly
+            # what this cache exists to cover)
+            tmp = _CACHE_PATH + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(record_cached, f)
+            os.replace(tmp, _CACHE_PATH)
+        except OSError:
+            pass  # caching is best-effort; the printed line is the contract
     return 0
 
 
@@ -226,6 +272,22 @@ def _emit_error(payload: dict, t_start: float, budget: float,
             f"{RAY_POOL_32VCPU_BASELINE_S} s)")
     elif err:
         payload["cpu_fallback_error"] = err
+    # widen the effective retry horizon beyond this single invocation
+    # (VERDICT r3 #1): if any session this round captured an on-chip run
+    # (the recovery watcher runs this same benchmark on relay recovery and
+    # run_benchmark caches its success), attach it — clearly labelled as
+    # cached, never as this invocation's measurement.
+    try:
+        with open(_CACHE_PATH) as f:
+            last = json.load(f)
+        age_h = (time.time() - float(last.pop("captured_unix"))) / 3600.0
+        payload["last_onchip"] = dict(
+            last, age_hours=round(age_h, 2),
+            note="cached on-chip run from an earlier bench.py invocation; "
+                 "NOT measured by this run — age_hours says how stale, "
+                 "code_version what was benchmarked")
+    except (OSError, ValueError, KeyError, TypeError):
+        pass
     print(json.dumps(payload))
     return 1
 
@@ -293,9 +355,11 @@ def main() -> int:
     deadline = float(os.environ.get("DKS_BENCH_DEADLINE", "280"))
     left = budget - (time.monotonic() - t_start) - 5.0
     if left <= 30:
-        print(json.dumps({"metric": _METRIC,
-                          "error": "probe phase consumed the whole budget"}))
-        return 1
+        # still goes through _emit_error: the fallback will refuse for lack
+        # of budget, but a cached on-chip record still reaches the artifact
+        return _emit_error({"metric": _METRIC,
+                            "error": "probe phase consumed the whole budget"},
+                           t_start, budget, fallback_reserve)
     # forgo the fallback reserve rather than squeeze the run below a useful
     # bound (the run itself is the better artifact when it completes)
     remaining = left - fallback_reserve if left - fallback_reserve >= 60 else left

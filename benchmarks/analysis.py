@@ -144,24 +144,30 @@ def comparison_figure(out_path: str,
         ("serve best\n32 vCPU", REFERENCE_BASELINES["ray_serve_32cpu_best"], "ref"),
         ("pool best\nk8s 56 vCPU", REFERENCE_BASELINES["ray_pool_k8s_56cpu_best"], "ref"),
     ]
-    # serve, coalesced b=10, auto depth — one TPU chip
+    # serve, coalesced b=10, auto depth — one TPU chip.  Malformed artifacts
+    # (truncated pickle/jsonl from a killed sweep) drop their bar like
+    # missing ones — this figure must never abort the rest of the analysis.
     serve_pkl = os.path.join(results_dir,
                              "ray_replicas_0_maxbatch_10_actorfr_1.0.pkl")
-    if os.path.exists(serve_pkl):
+    try:
         import pickle as _pickle
 
         with open(serve_pkl, "rb") as f:
             t = _pickle.load(f)["t_elapsed"]
         bars.append(("serve b=10\n1 TPU chip", float(np.mean(t)), "ours"))
+    except (OSError, KeyError, ValueError, _pickle.UnpicklingError, EOFError):
+        pass
     # direct sharded explain — one TPU chip (latest successful sweep row,
     # through the same scan the RESULTS.md summary table uses)
-    if os.path.exists(jsonl):
+    try:
         rec = dict(summarise_jsonl(jsonl)).get("config:adult")
         if rec and rec.get("ok") and isinstance(rec.get("result"), dict):
             adult = rec["result"].get("value")
             if adult:
                 bars.append(("direct explain\n1 TPU chip", float(adult),
                              "ours"))
+    except (OSError, ValueError):
+        pass
 
     seq = REFERENCE_BASELINES["sequential_1cpu"]
     colors = {"ref": "#9aa0a6", "ours": "#3b76d6"}
