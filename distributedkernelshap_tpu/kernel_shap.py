@@ -1027,12 +1027,15 @@ class KernelExplainerEngine:
 
             budget = self.config.shap.target_chunk_elems
 
+            use_pallas = self.config.shap.use_pallas
+
             def fn(Xc, bgw, G, reach=reach):
                 with jax.default_matmul_precision(precision):
                     out = {'shap_values':
                            exact_shap_from_reach(
                                pred, Xc, reach, bgw, G,
-                               target_chunk_elems=budget),
+                               target_chunk_elems=budget,
+                               use_pallas=use_pallas),
                            'raw_prediction': pred(Xc)}
                     if interactions:
                         out['interaction_values'] = \
@@ -1071,10 +1074,36 @@ class KernelExplainerEngine:
                 return {k: np.asarray(v)[:B].astype(np.float32, copy=False)
                         for k, v in out.items()}
 
-            results = run_pipeline(
-                chunks, _dispatch, _fetch,
-                window=resolve_window(self.config.dispatch_window,
-                                      n_items=len(chunks)))
+            try:
+                results = run_pipeline(
+                    chunks, _dispatch, _fetch,
+                    window=resolve_window(self.config.dispatch_window,
+                                          n_items=len(chunks)))
+            except Exception as e:  # pragma: no cover - needs a TPU Mosaic
+                # The fused exact kernel auto-enables on TPU backends but
+                # cannot be compile-checked off-chip (interpret mode skips
+                # Mosaic): if Mosaic rejects it at first execution, degrade
+                # to the chunked-einsum path instead of failing the explain.
+                msg = str(e)
+                pallas_error = any(s in msg.lower()
+                                   for s in ("mosaic", "pallas", "vmem"))
+                if not pallas_error or self.config.shap.use_pallas is False:
+                    raise
+                logger.warning(
+                    "exact-path Pallas kernel failed to compile/run "
+                    "(%s...); retrying with the XLA einsum path",
+                    msg[:200])
+                # drop EVERY cached exact fn (not just this variant): any
+                # of them may close over the pre-degrade use_pallas=True
+                self._fn_cache.pop('exact', None)
+                self._fn_cache.pop('exact_inter', None)
+                # persist the degrade: retrying the broken kernel on every
+                # explain would recompile-and-fail each time
+                self.config = replace(
+                    self.config,
+                    shap=replace(self.config.shap, use_pallas=False))
+                return self._exact_tree_explanation(
+                    chunks, X, l1_reg, interactions=interactions)
         phi = np.concatenate([r['shap_values'] for r in results], 0)
         self.last_raw_prediction = np.concatenate(
             [r['raw_prediction'] for r in results], 0)

@@ -152,6 +152,180 @@ def _ey_kernel(XWg_ref, maskT_ref, bgWg_ref, bgW_ref, bgw_ref, out_ref,
         out_ref[k] = accs[k]
 
 
+def _exact_footprint(tb: int, tp: int, N: int, M: int, K: int) -> int:
+    """Scoped-VMEM bytes of one :func:`exact_tree_phi` grid step.
+
+    Live per step: x_only/x_not tiles + the s_p/s_m carry
+    (4 × (tb, M, tp)), the full-N background tiles z_ok (N, M, tp) and
+    z_dead (N, tp), leaf values (tp, K), the (tb, M, K) output tile, and a
+    handful of (tb, tp) temporaries; doubled for Mosaic staging."""
+
+    Mp = max(8, -(-M // 8) * 8)                  # sublane-padded group axis
+    tiles = 4 * tb * Mp * tp * 4
+    z = (N * Mp * tp + N * tp) * 4
+    small = (tp * max(K, 8) + tb * Mp * max(K, 8) + 6 * tb * tp) * 4
+    return 2 * (tiles + z + small)
+
+
+def _exact_tile_sizes(B: int, P: int, N: int, M: int, K: int,
+                      tb: int, tp: int) -> tuple:
+    """(tb, tp) for :func:`exact_tree_phi` whose VMEM working set fits
+    (:func:`_exact_footprint`)."""
+
+    tb_c = min(tb, max(8, B))
+    while tb_c >= 8:
+        tp_c = min(tp, max(128, P))
+        while tp_c >= 128:
+            if _exact_footprint(tb_c, tp_c, N, M, K) <= _VMEM_BUDGET:
+                return tb_c, tp_c
+            tp_c = max(128, tp_c // 2) if tp_c > 128 else 64
+        tb_c = max(8, tb_c // 2) if tb_c > 8 else 4
+    return 8, 128
+
+
+def exact_kernel_fits(N: int, M: int, K: int) -> bool:
+    """Whether :func:`exact_tree_phi`'s MINIMAL (8, 128) tile fits the VMEM
+    budget — the dispatch gate's up-front check, so callers route to the
+    einsum path deterministically (before any tracing) instead of compiling
+    a kernel Mosaic would reject."""
+
+    return _exact_footprint(8, 128, N, M, K) <= _VMEM_BUDGET
+
+
+def _exact_phi_kernel(x_only_ref, x_not_ref, z_ok_ref, z_dead_ref, lv_ref,
+                      bgw_ref, out_ref, *, N: int, dmax: int):
+    """One (tb, tp) tile of the exact-TreeSHAP phi contraction.
+
+    Refs: x_only/x_not (tb, M, tp), z_ok (N, M, tp), z_dead (N, tp),
+    lv (tp, K), bgw (N,) in SMEM; out (tb, M, K) accumulated over the
+    path-tile grid axis.
+
+    The Beta weights are computed IN REGISTERS from the conjunction-game
+    counts via ``(u-1)! v! / (u+v)! = 1 / (u * C(u+v, u))`` (and the
+    ``v``-side mirror — the two weights share one binomial), with the
+    binomial as a ``dmax``-step masked product: pure VPU, no lgamma (not
+    Mosaic-lowerable), no table gather (the TPU-miscompile class worked
+    around in ``models/trees._feature_onehot``).  Relative error vs the f64
+    table is ~``dmax``·eps_f32 (pinned <5e-5 by
+    ``tests/test_treeshap.py::test_exact_pallas_binom_weights_match_f64_table``,
+    with end-to-end equivalence in the ``test_exact_pallas_kernel_*``
+    siblings)."""
+
+    x_only = x_only_ref[:]                      # (tb, M, tp)
+    x_not = x_not_ref[:]
+
+    def body(n, carry):
+        s_p, s_m = carry
+        z = z_ok_ref[n]                         # (M, tp)
+        zd = z_dead_ref[n]                      # (tp,)
+        nz = 1.0 - z
+        u = jnp.sum(x_only * nz[None], axis=1)  # (tb, tp)
+        v = jnp.sum(x_not * z[None], axis=1)
+        dead = jnp.sum(x_not * nz[None], axis=1)
+        alive = (dead < 0.5) & (zd[None, :] < 0.5)
+
+        def bin_body(i, acc):
+            fi = jnp.asarray(i, jnp.float32)
+            return acc * jnp.where(fi <= u + 0.5, (v + fi) / fi, 1.0)
+
+        binom = jax.lax.fori_loop(1, dmax + 1, bin_body,
+                                  jnp.ones_like(u), unroll=True)
+        a = jnp.where(alive, bgw_ref[n] / binom, 0.0)
+        wp = jnp.where(u > 0.5, a / jnp.maximum(u, 1.0), 0.0)
+        wm = jnp.where(v > 0.5, a / jnp.maximum(v, 1.0), 0.0)
+        return (s_p + wp[:, None, :] * nz[None],
+                s_m + wm[:, None, :] * z[None])
+
+    zeros = jnp.zeros(x_only.shape, jnp.float32)
+    s_p, s_m = jax.lax.fori_loop(0, N, body, (zeros, zeros))
+    d = s_p * x_only - s_m * x_not              # (tb, M, tp)
+    contrib = jax.lax.dot_general(
+        d, lv_ref[:], (((2,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)     # (tb, M, K)
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        out_ref[:] = contrib
+
+    @pl.when(pl.program_id(1) != 0)
+    def _acc():
+        out_ref[:] += contrib
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("tb", "tp", "dmax", "interpret"))
+def exact_tree_phi(x_only, x_not, z_ok, z_dead, leaf_val, bgw,
+                   dmax: int, tb: int = 64, tp: int = 256,
+                   interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Fused exact-TreeSHAP main-effect contraction (``ops/treeshap.py``
+    semantics, flattened over paths).
+
+    Parameters: ``x_only/x_not (B, P, M)`` instance-side reach indicators
+    (P = trees x leaves), ``z_ok (N, P, M)`` background-side satisfaction,
+    ``z_dead (N, P)`` leaves killed through ungrouped splits, ``leaf_val
+    (P, K)``, ``bgw (N,)`` normalised weights, ``dmax`` the static count
+    bound (min(M, max path depth)).  Returns ``phi (B, M, K)``.
+
+    Why a kernel: the XLA path materialises ~six ``(B, n, T, L)`` weight
+    and count tensors in HBM per background chunk; here the whole
+    counts -> Beta weights -> reach contraction chain lives in VMEM per
+    (tb, tp) tile, so HBM traffic drops to the tensors' one-time reads
+    plus the tiny phi output — the same restructuring
+    :func:`fused_linear_ey` applies to the sampled path's masked eval.
+
+    ``interpret=None`` auto-selects interpreter mode off-TPU so the same
+    code path is testable on CPU.
+    """
+
+    B, P, M = x_only.shape
+    N = z_ok.shape[0]
+    K = leaf_val.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() in ("cpu", "gpu")
+    tb, tp = _exact_tile_sizes(B, P, N, M, K, tb, tp)
+
+    pad_b = (-B) % tb
+    pad_p = (-P) % tp
+    # padded paths carry leaf_val = 0, so their contribution is exactly 0
+    # regardless of the indicator padding; padded instance rows are sliced
+    # off the output
+    x_only_t = jnp.pad(jnp.transpose(x_only, (0, 2, 1)).astype(jnp.float32),
+                       ((0, pad_b), (0, 0), (0, pad_p)))
+    x_not_t = jnp.pad(jnp.transpose(x_not, (0, 2, 1)).astype(jnp.float32),
+                      ((0, pad_b), (0, 0), (0, pad_p)))
+    z_ok_t = jnp.pad(jnp.transpose(z_ok, (0, 2, 1)).astype(jnp.float32),
+                     ((0, 0), (0, 0), (0, pad_p)), constant_values=1.0)
+    z_dead_t = jnp.pad(z_dead.astype(jnp.float32), ((0, 0), (0, pad_p)))
+    lv_t = jnp.pad(leaf_val.astype(jnp.float32), ((0, pad_p), (0, 0)))
+    bgw = bgw.astype(jnp.float32)
+
+    grid = (pl.cdiv(B + pad_b, tb), pl.cdiv(P + pad_p, tp))
+    kernel = functools.partial(_exact_phi_kernel, N=N, dmax=dmax)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tb, M, tp), lambda i, j: (i, 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, M, tp), lambda i, j: (i, 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((N, M, tp), lambda i, j: (0, 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((N, tp), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tp, K), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((tb, M, K), lambda i, j: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B + pad_b, M, K), jnp.float32),
+        interpret=interpret,
+    )(x_only_t, x_not_t, z_ok_t, z_dead_t, lv_t, bgw)
+    return out[:B]
+
+
 @functools.partial(jax.jit, static_argnames=("activation", "tb", "ts", "interpret"))
 def fused_linear_ey(XWg, bgWg, bgW, bgw, mask,
                     activation: str = "softmax",

@@ -159,16 +159,27 @@ def _bounded_bg_chunk(bg_chunk, N: int, B: int, T: int, L: int,
                       budget: Optional[int] = None) -> int:
     """Background chunk for the pairwise pass.  An EXPLICIT ``bg_chunk``
     wins (bounded to ``[1, N]`` only — the codebase convention for chunk
-    overrides); ``None`` auto-sizes: 16 (right at benchmark shapes) capped
-    so the ``(B, chunk, T, L)`` intermediates respect ``budget`` elements
-    (``target_chunk_elems``; the default matches ``ShapConfig``'s)."""
+    overrides); ``None`` auto-sizes against ``budget`` elements for the
+    ``(B, chunk, T, L)`` intermediates (``target_chunk_elems``; default
+    matches ``ShapConfig``'s).
+
+    Backend split: on CPU the chunk is additionally capped at 16 — measured
+    right at Adult-GBT benchmark shapes there (round 3).  On accelerators
+    the full budget-derived chunk is used: each ``lax.map`` step is a
+    serialized sweep over the same ``(B, chunk, T, L)`` working set, so
+    fewer/larger steps amortise per-step HBM restaging (the fixed 16 was
+    tuned before the lgamma weight path replaced the gather-dominated
+    profile; the recovery watcher's ``adult_trees_exact`` leg re-measures).
+    """
 
     if bg_chunk is not None:
         return max(1, min(int(bg_chunk), N))
     from distributedkernelshap_tpu.models._chunking import DEFAULT_CHUNK_ELEMS
 
     cap = max(1, (budget or DEFAULT_CHUNK_ELEMS) // max(1, B * T * L))
-    return max(1, min(16, N, cap))
+    if jax.default_backend() == "cpu":
+        cap = min(16, cap)
+    return max(1, min(N, cap))
 
 
 def _unsat(pred, rows, onpath, want_left):
@@ -224,10 +235,28 @@ def pad_background(z_ok, z_ung_dead, bgw, multiple: int):
     return z_ok_p, z_ung_p, bgw_p
 
 
+def _exact_dmax(pred, M: int) -> int:
+    """Static bound on the conjunction-game counts ``u + v``: a leaf's
+    relevant groups cannot exceed its on-path node count (the tree depth)
+    or the group count.  ``path_sign`` is a concrete per-fit tensor, so
+    this is a trace-time constant."""
+
+    try:
+        onpath_nodes = int(np.asarray(jnp.abs(pred.path_sign).sum(-1).max()))
+    except (jax.errors.TracerArrayConversionError,
+            jax.errors.ConcretizationTypeError):
+        # path tensors traced (caller jitted over the predictor itself):
+        # fall back to the static node-count bound — looser, so very deep
+        # trees may skip the fused kernel, never break
+        onpath_nodes = int(pred.path_sign.shape[-1])
+    return max(1, min(int(M), onpath_nodes))
+
+
 def exact_shap_from_reach(pred, X, reach, bgw, G,
                           bg_chunk: Optional[int] = None,
                           normalized: bool = False,
-                          target_chunk_elems: Optional[int] = None):
+                          target_chunk_elems: Optional[int] = None,
+                          use_pallas: Optional[bool] = None):
     """Exact phi ``(B, K, M)`` for ``X`` given precomputed background reach
     tensors (:func:`background_reach`).
 
@@ -243,7 +272,15 @@ def exact_shap_from_reach(pred, X, reach, bgw, G,
     ``normalized=True`` skips the internal weight normalisation — for
     callers that shard the background axis across devices and psum the
     partial phi (normalising a local weight shard by its local sum would
-    be wrong; they normalise globally first)."""
+    be wrong; they normalise globally first).
+
+    ``use_pallas`` (``None`` = auto: on for TPU backends) routes the
+    whole counts -> Beta weights -> reach contraction through the fused
+    VMEM kernel (:func:`~distributedkernelshap_tpu.ops.pallas_kernels.exact_tree_phi`)
+    instead of the chunked einsum path, eliminating the ~six
+    ``(B, chunk, T, L)`` HBM intermediates per background chunk.  Safe
+    under ``shard_map`` (the sharded exact path); GSPMD callers must pass
+    ``False`` — a ``pallas_call`` has no SPMD partitioning rule."""
 
     pred, head_scale = _unwrap(pred)
     X = jnp.asarray(X, jnp.float32)
@@ -268,6 +305,46 @@ def exact_shap_from_reach(pred, X, reach, bgw, G,
     x_not = (1.0 - x_ok) * onpath_g[None]       # groups x fails
 
     N = z_ok.shape[0]
+    M = int(G.shape[0])
+    from distributedkernelshap_tpu.ops.explain import resolve_use_pallas
+
+    from distributedkernelshap_tpu.ops.pallas_kernels import (
+        exact_kernel_fits,
+        exact_tree_phi,
+    )
+
+    n_slice = 256
+    K = int(leaf_val.shape[-1])
+    # an explicit bg_chunk pins the einsum slab path (the documented
+    # memory/behaviour contract of that knob) — the kernel only takes the
+    # default route; the footprint gate rejects shapes whose minimal tile
+    # Mosaic would refuse, BEFORE any tracing, for every caller
+    use_kernel = (bg_chunk is None and resolve_use_pallas(use_pallas)
+                  and exact_kernel_fits(min(N, n_slice), M, K)
+                  and _exact_dmax(pred, M) <= 64)
+    if use_kernel:
+        B = X.shape[0]
+        L = leaf_val.shape[1]
+        P = T * L
+        dmax = _exact_dmax(pred, M)
+        xo = x_only.reshape(B, P, M)
+        xn = x_not.reshape(B, P, M)
+        zo = z_ok.reshape(N, P, M)
+        zd = z_ung_dead.reshape(N, P)
+        lv = leaf_val.reshape(P, -1)
+        # the kernel holds its background slice whole in VMEM: big
+        # backgrounds are sliced host-side and partial phi summed (weights
+        # are already globally normalised, so slice sums compose exactly)
+        phi = None
+        for s0 in range(0, N, n_slice):
+            part = exact_tree_phi(xo, xn, zo[s0:s0 + n_slice],
+                                  zd[s0:s0 + n_slice],
+                                  lv, bgw[s0:s0 + n_slice], dmax=dmax)
+            phi = part if phi is None else phi + part
+        phi = phi * (pred.scale * head_scale)
+        if pred.aggregation == "mean":
+            phi = phi / T
+        return jnp.swapaxes(phi, 1, 2)          # (B, K, M)
     chunk = _bounded_bg_chunk(bg_chunk, N, X.shape[0], T, leaf_val.shape[1],
                               budget=target_chunk_elems)
     z_ok_p, z_ung_p, bgw_p = pad_background(z_ok, z_ung_dead, bgw, chunk)
@@ -283,13 +360,17 @@ def exact_shap_from_reach(pred, X, reach, bgw, G,
         dead = jnp.einsum("btlg,ntlg->bntl", x_not, 1.0 - zc)
         alive = ((dead < 0.5) & ~zu[None]).astype(jnp.float32)
         wp, wm = _beta_weights(u, v, x_only.shape[-1])   # (B, n, T, L)
-        wp = wp * alive
-        wm = wm * alive
-        phi_p = jnp.einsum("bntl,btlg,ntlg,tlk,n->bgk",
-                           wp, x_only, 1.0 - zc, leaf_val, wc)
-        phi_m = jnp.einsum("bntl,btlg,ntlg,tlk,n->bgk",
-                           wm, x_not, zc, leaf_val, wc)
-        return phi_p - phi_m
+        # hand-factored contraction (vs one 5-operand einsum): fold the
+        # background weight into the Beta weights (elementwise, fuses with
+        # the weight computation), contract the background axis into a
+        # per-group running sum, then contract paths against leaf values —
+        # two deterministic matmul-shaped steps whose only large
+        # intermediates are the (B, n, T, L) weight tensors already present
+        wp = wp * alive * wc[None, :, None, None]
+        wm = wm * alive * wc[None, :, None, None]
+        s_p = jnp.einsum("bntl,ntlg->btlg", wp, 1.0 - zc) * x_only
+        s_m = jnp.einsum("bntl,ntlg->btlg", wm, zc) * x_not
+        return jnp.einsum("btlg,tlk->bgk", s_p - s_m, leaf_val)
 
     phi = jnp.sum(jax.lax.map(one_chunk, (z_chunks, zu_chunks, w_chunks)),
                   axis=0)

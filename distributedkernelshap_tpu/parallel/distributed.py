@@ -374,7 +374,8 @@ class DistributedExplainer:
                 with jax.default_matmul_precision(precision):
                     phi_local = exact_shap_from_reach(
                         pred, Xl, r, bgw_l, G, normalized=True,
-                        target_chunk_elems=budget)
+                        target_chunk_elems=budget,
+                        use_pallas=engine.config.shap.use_pallas)
                     out = {
                         'shap_values': jax.lax.psum(phi_local, COALITION_AXIS),
                         'raw_prediction': pred(Xl),

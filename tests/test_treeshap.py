@@ -746,3 +746,89 @@ def test_backend_dispatched_weights_match_lgamma_route():
     tb = ts._interaction_weights(jnp.asarray(uu), jnp.asarray(vv), M)
     for a, b in zip(lg, tb):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=2e-6)
+
+
+# --------------------------------------------------------------------- #
+# fused Pallas exact kernel (interpret mode on CPU — same code path the
+# TPU backend runs compiled; VERDICT r3 #3)
+# --------------------------------------------------------------------- #
+
+def test_exact_pallas_kernel_matches_einsum_path(gbt_setup):
+    """The fused VMEM kernel (use_pallas=True, interpret mode here) must
+    reproduce the chunked-einsum exact path to float tolerance — grouped
+    and ungrouped, weighted background, non-divisible tile shapes."""
+
+    import jax.numpy as jnp
+
+    from distributedkernelshap_tpu.ops.treeshap import (
+        background_reach,
+        exact_shap_from_reach,
+    )
+
+    pred = gbt_setup["pred"]
+    rng = np.random.default_rng(5)
+    X = gbt_setup["X"][:13]                      # non-multiple of any tile
+    bg = gbt_setup["X"][50:127]                  # N=77, ragged
+    bgw = rng.random(77).astype(np.float32) + 0.1
+    for groups in (None, [[0, 1], [2], [3, 4]]):  # ungrouped cols in group case
+        G = groups_to_matrix(groups, 6)
+        reach = background_reach(pred, bg, G)
+        ref = np.asarray(exact_shap_from_reach(
+            pred, X, reach, bgw, G, use_pallas=False))
+        got = np.asarray(exact_shap_from_reach(
+            pred, X, reach, bgw, G, use_pallas=True))
+        np.testing.assert_allclose(got, ref, atol=2e-5, rtol=2e-5)
+    # large-N slicing path: pad the background beyond one 256-row slice
+    bg_big = np.concatenate([gbt_setup["X"][:150]] * 2, 0)   # N=300
+    bgw_big = rng.random(300).astype(np.float32) + 0.1
+    G = groups_to_matrix(None, 6)
+    reach = background_reach(pred, bg_big, G)
+    ref = np.asarray(exact_shap_from_reach(
+        pred, X, reach, bgw_big, G, use_pallas=False))
+    got = np.asarray(exact_shap_from_reach(
+        pred, X, reach, bgw_big, G, use_pallas=True))
+    np.testing.assert_allclose(got, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_exact_pallas_kernel_matches_brute_force(gbt_setup):
+    """And against the definition itself (not just the sibling path)."""
+
+    from distributedkernelshap_tpu.ops.treeshap import (
+        background_reach,
+        exact_shap_from_reach,
+    )
+
+    pred = gbt_setup["pred"]
+    X = gbt_setup["X"][:2]
+    bg = gbt_setup["X"][40:60]
+    groups = [[i] for i in range(6)]
+    G = groups_to_matrix(groups, 6)
+    reach = background_reach(pred, bg, G)
+    got = np.asarray(exact_shap_from_reach(
+        pred, X, reach, np.ones(20, np.float32), G, use_pallas=True))
+    for b in range(2):
+        want = _brute_force_phi(pred, gbt_setup["X"][b], bg.copy(), groups)
+        np.testing.assert_allclose(got[b, 0], want, atol=1e-4)
+
+
+def test_exact_pallas_binom_weights_match_f64_table():
+    """The kernel's gather-free masked-product Beta weights
+    (1/(u*C(u+v,u)), 1/(v*C(u+v,u))) must match the f64 gammaln tables to
+    f32 product tolerance over the full supported count grid."""
+
+    from distributedkernelshap_tpu.ops.treeshap import _beta_tables
+
+    dmax = 64
+    wp_t, wm_t = _beta_tables(dmax)
+    u, v = np.meshgrid(np.arange(dmax + 1), np.arange(dmax + 1),
+                       indexing="ij")
+    u = u.astype(np.float64)
+    v = v.astype(np.float64)
+    binom = np.ones_like(u)
+    for i in range(1, dmax + 1):
+        binom *= np.where(i <= u, (v + i) / i, 1.0)
+    wp = np.where(u > 0.5, 1.0 / (np.maximum(u, 1.0) * binom), 0.0)
+    wm = np.where(v > 0.5, 1.0 / (np.maximum(v, 1.0) * binom), 0.0)
+    mask = u + v <= dmax  # counts beyond dmax are unreachable by definition
+    np.testing.assert_allclose(wp[mask], wp_t[mask], rtol=5e-5, atol=1e-38)
+    np.testing.assert_allclose(wm[mask], wm_t[mask], rtol=5e-5, atol=1e-38)
