@@ -244,6 +244,21 @@ class DistributedExplainer:
                 engine.G))
         return self._dev_cache[key]
 
+    def _pad_sharded(self, X: np.ndarray):
+        """``(padded_X, original_B)``: bucket to a power of two, then to a
+        whole number of device rows — bounds jit retraces across varying
+        request sizes (same rationale as ``EngineConfig.bucket_batches`` on
+        the single-device path).  Shared by every sharded dispatch path so
+        their padding can never diverge."""
+
+        engine = self.engine
+        B = X.shape[0]
+        bucket = engine._bucket(B) if engine.config.bucket_batches else B
+        padded, _ = pad_to_multiple(max(bucket, self.n_data), self.n_data)
+        if padded != B:
+            X = np.concatenate([X, np.tile(X[-1:], (padded - B, 1))], 0)
+        return X, B
+
     def _dispatch_call(self, fn, X: np.ndarray, args):
         """Bucket-pad ``X`` to a whole number of device rows, launch ``fn``
         WITHOUT blocking (JAX dispatch is asynchronous) and return
@@ -257,15 +272,7 @@ class DistributedExplainer:
         exact paths so their padding/packing can never diverge."""
 
         engine = self.engine
-        B = X.shape[0]
-        # bucket to a power of two, then to a whole number of device rows —
-        # bounds jit retraces across varying request sizes (same rationale as
-        # EngineConfig.bucket_batches on the single-device path)
-        bucket = engine._bucket(B) if engine.config.bucket_batches else B
-        padded, _ = pad_to_multiple(max(bucket, self.n_data), self.n_data)
-        if padded != B:
-            filler = np.tile(X[-1:], (padded - B, 1))
-            X = np.concatenate([X, filler], 0)
+        X, B = self._pad_sharded(X)
         out = fn(jnp.asarray(X, jnp.float32), *args)
         # one packed D2H instead of two (tunnelled transfers are latency-bound);
         # with transfer_dtype set only the wide segment (phi + interactions)
@@ -482,6 +489,49 @@ class DistributedExplainer:
         window = resolve_window(requested, n_items=len(slabs))
         return run_pipeline(slabs, dispatch, self._fetch_sharded,
                             window=window, threaded=not multihost)
+
+    def get_importance(self, X: np.ndarray, nsamples=None) -> np.ndarray:
+        """``(K, M)`` mean |phi| over ``X`` with the reduction on the mesh.
+
+        Sharded counterpart of ``KernelExplainerEngine.get_importance``:
+        each slab's phi is abs-summed ON DEVICE (XLA inserts the
+        cross-device collectives for the replicated ``(K, M)`` partial), so
+        only ``K·M`` floats ever reach the host — the Covertype
+        global-explanation path without its ~195 MB phi D2H."""
+
+        engine = self.engine
+        if engine.config.host_eval or nsamples == 'exact':
+            values = self.get_explanation(X, nsamples=nsamples,
+                                          l1_reg=False, silent=True)
+            vals = values if isinstance(values, list) else [values]
+            return np.stack([np.abs(v).mean(0) for v in vals])
+        X = np.atleast_2d(np.asarray(X, dtype=np.float32))
+        B = X.shape[0]
+        slab = int(self.batch_size) * self.n_data if self.batch_size else 0
+        slabs = (make_batches(X, batch_size=slab)
+                 if slab and B > slab else [X])
+        plan = engine._plan(nsamples)
+        args = self._device_args(plan)
+        fn = self._sharded_fn()
+        if 'imp_reduce' not in self._jit_cache:
+            # jitted (multihost global arrays reject eager ops): mask the
+            # padded rows out instead of slicing the sharded batch axis;
+            # XLA inserts the cross-device reduction, output is replicated
+            self._jit_cache['imp_reduce'] = jax.jit(
+                lambda phi, w: jnp.einsum('bkm,b->km', jnp.abs(phi), w))
+        acc = None
+        for c in slabs:
+            Xc, Bc = self._pad_sharded(c)
+            mask = np.zeros(Xc.shape[0], np.float32)
+            mask[:Bc] = 1.0
+            out = fn(jnp.asarray(Xc, jnp.float32), *args)
+            part = self._jit_cache['imp_reduce'](out['shap_values'],
+                                                 jnp.asarray(mask))
+            # np.asarray works on the fully-REPLICATED jit output even
+            # multi-host, while an eager `+` on it would raise (not fully
+            # addressable); the partial is K*M floats — host-summing is free
+            acc = np.asarray(part) if acc is None else acc + np.asarray(part)
+        return acc / B
 
     def get_explanation(self, X: np.ndarray, **kwargs) -> Any:
         """Explain ``X``, sharded over the mesh.

@@ -590,3 +590,51 @@ def test_l1_select_batch_survives_collinear_design():
             # ambiguity), with a comparable support size
             assert rss_of(got[t], Yw[:, t]) <= rss_of(want, Yw[:, t]) * 1.5 + 1e-9
             assert abs(len(got[t]) - len(want)) <= 2
+
+
+def test_rank_features_matches_host_ranking():
+    """rank_features (device-side mean-|phi| reduction; only (K, M) floats
+    cross the wire) must reproduce rank_by_importance over a full explain
+    on the same instances — single-device, chunked, and mesh-sharded."""
+
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.kernel_shap import (
+        EngineConfig,
+        rank_by_importance,
+    )
+    from distributedkernelshap_tpu.models import LinearPredictor
+
+    rng = np.random.default_rng(0)
+    D, K, N, B = 8, 3, 16, 24
+    W = rng.normal(size=(D, K)).astype(np.float32)
+    pred = LinearPredictor(W, np.zeros(K, np.float32), activation="softmax")
+    bg = rng.normal(size=(N, D)).astype(np.float32)
+    X = rng.normal(size=(B, D)).astype(np.float32)
+    names = [f"f{i}" for i in range(D)]
+
+    def check(ex):
+        ex.fit(bg)
+        want = rank_by_importance(
+            ex.explain(X, silent=True, l1_reg=False).shap_values, names)
+        got = ex.rank_features(X)
+        assert set(got) == set(want)
+        for key in got:
+            assert got[key]["names"] == want[key]["names"]
+            np.testing.assert_allclose(got[key]["ranked_effect"],
+                                       want[key]["ranked_effect"], atol=1e-5)
+
+    check(KernelShap(pred, link="identity", feature_names=names, seed=0))
+    check(KernelShap(pred, link="identity", feature_names=names, seed=0,
+                     engine_config=EngineConfig(instance_chunk=7)))
+    check(KernelShap(pred, link="identity", feature_names=names, seed=0,
+                     distributed_opts={"n_devices": 4, "batch_size": 2}))
+
+
+def test_rank_features_requires_fit():
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.models import LinearPredictor
+
+    pred = LinearPredictor(np.eye(4, 2, dtype=np.float32),
+                           np.zeros(2, np.float32), activation="softmax")
+    with pytest.raises(TypeError, match="unfitted"):
+        KernelShap(pred, link="identity").rank_features(np.zeros((2, 4)))
