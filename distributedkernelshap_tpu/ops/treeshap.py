@@ -457,12 +457,15 @@ def exact_interactions_from_reach(pred, X, reach, bgw, G,
 
     Cost is ~``M``x the main-effect pass (one main-effect-shaped einsum set
     per group); callers should keep ``M`` modest (raises above 64 groups).
-    The per-group loop is unrolled into the jitted graph (~4 large einsums
-    per group per chunk body), so COMPILE time and program size also scale
-    linearly with ``M`` — measured (CPU backend, tiny ensemble): 1.6 s at
-    M=8, 2.5 s at M=16, 4.5 s at M=32, extrapolating to ~9 s at the M=64
-    cap — a one-time-per-fit cost that does not justify the fusion loss a
-    ``lax.map`` over a stacked group axis would introduce.
+    The per-group loop is unrolled into the jitted graph (two heavy
+    two-stage contractions per group per chunk body since round 4 — the
+    four weight terms pair with only two h-side factor products, see the
+    loop comment), so COMPILE time and program size still scale linearly
+    with ``M``; the round-3 structure (4 einsums/group) measured 1.6 s at
+    M=8 / 2.5 s at M=16 / 4.5 s at M=32 of compile on CPU, and the halved
+    body can only shrink that — a one-time-per-fit cost that does not
+    justify the fusion loss a ``lax.map`` over a stacked group axis would
+    introduce.
     """
 
     M = int(jnp.asarray(G).shape[0])
@@ -507,29 +510,29 @@ def exact_interactions_from_reach(pred, X, reach, bgw, G,
         dead = jnp.einsum("btlg,ntlg->bntl", x_not, 1.0 - zc)
         alive = ((dead < 0.5) & ~zu[None]).astype(jnp.float32)
         w_uu, w_vv, w_uv = _interaction_weights(u, v, M)
+        # fold the background weight + alive gate once (elementwise, fuses)
+        aw = alive * wc[None, :, None, None]
+        w_uu = w_uu * aw
+        w_vv = w_vv * aw
+        w_uv = w_uv * aw
+        nz = 1.0 - zc
         out = []
         # one main-effect-shaped pass per group g: the U/V membership
         # indicators factorise over (b-side, n-side), so fixing g turns the
-        # pairwise contraction into the same einsum family as the phi pass
+        # pairwise contraction into the same einsum family as the phi pass.
+        # The four weight terms pair with only TWO (h-side b-factor,
+        # h-side n-factor) products — (x_only, 1-zc) for h in U and
+        # (x_not, zc) for h in V — so merging the weights first halves the
+        # heavy contractions from four to two per group, each hand-factored
+        # into the same two-stage matmul shape as the phi pass
         for g in range(M):
-            ag_b, ag_n = x_only[..., g], (1.0 - zc)[..., g]     # a_g factors
-            cg_b, cg_n = x_not[..., g], zc[..., g]              # c_g factors
-            wu_g = w_uu * alive * ag_b[:, None] * ag_n[None]    # (B, n, T, L)
-            wv_g = w_vv * alive * cg_b[:, None] * cg_n[None]
-            wm_g = w_uv * alive
-            row = (
-                jnp.einsum("bntl,btlh,ntlh,tlk,n->bhk",
-                           wu_g, x_only, 1.0 - zc, leaf_val, wc)
-                + jnp.einsum("bntl,btlh,ntlh,tlk,n->bhk",
-                             wv_g, x_not, zc, leaf_val, wc)
-                + jnp.einsum("bntl,btlh,ntlh,tlk,n->bhk",
-                             wm_g * ag_b[:, None] * ag_n[None],
-                             x_not, zc, leaf_val, wc)
-                + jnp.einsum("bntl,btlh,ntlh,tlk,n->bhk",
-                             wm_g * cg_b[:, None] * cg_n[None],
-                             x_only, 1.0 - zc, leaf_val, wc)
-            )
-            out.append(row)
+            ag = x_only[..., g][:, None] * nz[..., g][None]     # (B, n, T, L)
+            cg = x_not[..., g][:, None] * zc[..., g][None]
+            w_p = w_uu * ag + w_uv * cg     # pairs with (x_only, 1-zc)
+            w_m = w_vv * cg + w_uv * ag     # pairs with (x_not, zc)
+            s_p = jnp.einsum("bntl,ntlh->btlh", w_p, nz) * x_only
+            s_m = jnp.einsum("bntl,ntlh->btlh", w_m, zc) * x_not
+            out.append(jnp.einsum("btlh,tlk->bhk", s_p + s_m, leaf_val))
         return jnp.stack(out, axis=1)           # (B, M, M, K): [b, g, h, k]
 
     inter = jnp.sum(jax.lax.map(one_chunk, (z_chunks, zu_chunks, w_chunks)),

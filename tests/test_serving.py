@@ -605,7 +605,7 @@ def test_watchdog_wedge_fast_errors_and_recovery(model_setup):
                 real = super().explain_batch_async(instances, split_sizes)
 
                 def finalize():
-                    self.release.wait(30)
+                    self.release.wait(120)
                     return real()
 
                 return finalize
@@ -614,13 +614,17 @@ def test_watchdog_wedge_fast_errors_and_recovery(model_setup):
     model = WedgeOnceModel(s["pred"], s["bg"], s["constructor_kwargs"],
                            s["fit_kwargs"])
     srv = ExplainerServer(model, host="127.0.0.1", port=0, max_batch_size=1,
-                          pipeline_depth=2, watchdog_timeout_s=1.0,
-                          first_batch_grace_s=1.0).start()
+                          # 5s: short enough to catch the deliberate wedge
+                          # promptly, long enough that post-recovery explains
+                          # on a loaded 1-core CI host don't re-trip it
+                          pipeline_depth=2, watchdog_timeout_s=5.0,
+                          first_batch_grace_s=5.0,
+                          device_probe_timeout_s=30.0).start()
     try:
         base = f"http://127.0.0.1:{srv.port}"
         # (a) the wedged request comes back as a fast watchdog error
         with pytest.raises(RuntimeError, match="watchdog"):
-            explain_request(f"{base}/explain", s["X"][0], timeout=30)
+            explain_request(f"{base}/explain", s["X"][0], timeout=60)
         assert srv._wedged.is_set()
         # (b) health reports the wedge; new requests fail fast with 503
         try:
@@ -634,13 +638,15 @@ def test_watchdog_wedge_fast_errors_and_recovery(model_setup):
         # (c) release the blocked RPC: its completion is the recovery
         # signal; serving resumes and health goes green again
         model.release.set()
-        deadline = __import__("time").monotonic() + 15
+        # generous: the release triggers the REAL first compile of the
+        # serving model, which on a loaded single-core host takes a while
+        deadline = __import__("time").monotonic() + 90
         while srv._wedged.is_set():
             assert __import__("time").monotonic() < deadline, "no recovery"
             __import__("time").sleep(0.05)
-        payload = explain_request(f"{base}/explain", s["X"][0], timeout=30)
+        payload = explain_request(f"{base}/explain", s["X"][0], timeout=60)
         assert json.loads(payload)["data"]["shap_values"]
-        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+        with urllib.request.urlopen(f"{base}/healthz", timeout=45) as r:
             assert r.status == 200
     finally:
         srv.stop()
