@@ -94,6 +94,32 @@ def explain_adult_slice(n_devices: int = N_DEVICES) -> np.ndarray:
     return np.stack(sv, 1)
 
 
+def rank_adult_slice(n_devices: int = N_DEVICES) -> np.ndarray:
+    """Shared recipe: the device-side global-importance reduction behind
+    ``KernelShap.rank_features`` over the mesh — the jitted masked slab
+    reduce must hold across a REAL process boundary (round 4; only K·M
+    floats reach each host).  Returns the raw ``(K, M)`` mean-|phi| matrix
+    in FEATURE order (order-insensitive: comparing the ranked serialisation
+    instead would flake whenever two near-tied features sort differently
+    across mesh layouts; the ranking structure itself is unit-test
+    territory, ``tests/test_kernel_shap.py::test_rank_features_*``)."""
+
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.utils import load_data, load_model
+
+    data = load_data()
+    clf = load_model()
+    gn, g = data["all"]["group_names"], data["all"]["groups"]
+    X = data["all"]["X"]["processed"]["test"].toarray()[:N_INSTANCES]
+    bg = data["background"]["X"]["preprocessed"]
+    ex = KernelShap(clf.predict_proba, link="logit", feature_names=gn, seed=0,
+                    distributed_opts={"n_devices": n_devices,
+                                      "batch_size": 8})
+    ex.fit(bg, group_names=gn, groups=g)
+    return np.asarray(ex._explainer.get_importance(
+        np.asarray(X, np.float32), nsamples=NSAMPLES), np.float64)
+
+
 def explain_exact_interactions_slice(n_devices: int = N_DEVICES) -> np.ndarray:
     """Shared recipe: exact TreeSHAP interaction matrices for a small GBT,
     sharded over the mesh (deterministic synthetic fit, so every process
@@ -296,6 +322,10 @@ def main() -> int:
             phi0 = run_recipe("explain_adult_slice")
             checks["phi_identical_across_processes"] = "ok"
 
+            # --- leg 2b: device-side ranking across processes ------------
+            rank0 = run_recipe("rank_adult_slice")
+            checks["ranking_identical_across_processes"] = "ok"
+
             # --- leg 3: exact TreeSHAP interactions across processes -----
             iv0 = run_recipe("explain_exact_interactions_slice")
             checks["interactions_identical_across_processes"] = "ok"
@@ -332,6 +362,8 @@ def main() -> int:
             jax.config.update("jax_num_cpu_devices", N_DEVICES)
             np.testing.assert_allclose(phi0, explain_adult_slice(), atol=1e-5)
             checks["phi_matches_single_process"] = "ok"
+            np.testing.assert_allclose(rank0, rank_adult_slice(), atol=1e-5)
+            checks["ranking_matches_single_process"] = "ok"
             np.testing.assert_allclose(iv0, explain_exact_interactions_slice(),
                                        atol=1e-5)
             checks["interactions_match_single_process"] = "ok"

@@ -832,3 +832,45 @@ def test_exact_pallas_binom_weights_match_f64_table():
     mask = u + v <= dmax  # counts beyond dmax are unreachable by definition
     np.testing.assert_allclose(wp[mask], wp_t[mask], rtol=5e-5, atol=1e-38)
     np.testing.assert_allclose(wm[mask], wm_t[mask], rtol=5e-5, atol=1e-38)
+
+
+def test_exact_kernel_gate_at_benchmark_shapes(gbt_setup, monkeypatch):
+    """The fused kernel must actually ENGAGE at Adult-GBT benchmark shapes
+    when the backend resolves to Pallas — guards the VMEM footprint model
+    against drift that would silently reroute the benchmark to the einsum
+    path (and the inverse: an oversized background must NOT engage)."""
+
+    from distributedkernelshap_tpu.ops import pallas_kernels as pk
+    from distributedkernelshap_tpu.ops import treeshap as ts
+
+    # footprint gate: benchmark-ish shapes fit (bg slices are <=256 rows);
+    # a hugely grouped problem does not
+    assert pk.exact_kernel_fits(N=100, M=13, K=1)
+    assert pk.exact_kernel_fits(N=256, M=13, K=1)
+    assert not pk.exact_kernel_fits(N=256, M=512, K=8)
+
+    # dispatch gate end-to-end: with pallas forced on, the kernel path is
+    # taken (observed via the kernel entry point), with bg_chunk pinned the
+    # einsum path is (the documented contract)
+    called = {"kernel": 0}
+    real = pk.exact_tree_phi
+
+    def spy(*a, **k):
+        called["kernel"] += 1
+        return real(*a, **k)
+
+    monkeypatch.setattr(ts, "exact_tree_phi", None, raising=False)
+    import distributedkernelshap_tpu.ops.pallas_kernels as pk_mod
+    monkeypatch.setattr(pk_mod, "exact_tree_phi", spy)
+
+    pred = gbt_setup["pred"]
+    X = gbt_setup["X"][:4]
+    bg = gbt_setup["X"][50:70]
+    G = groups_to_matrix(None, 6)
+    reach = ts.background_reach(pred, bg, G)
+    bgw = np.ones(20, np.float32)
+    ts.exact_shap_from_reach(pred, X, reach, bgw, G, use_pallas=True)
+    assert called["kernel"] == 1
+    ts.exact_shap_from_reach(pred, X, reach, bgw, G, use_pallas=True,
+                             bg_chunk=16)
+    assert called["kernel"] == 1  # explicit bg_chunk pins the einsum slab
