@@ -1096,7 +1096,8 @@ class KernelExplainerEngine:
                         out['interaction_values'] = \
                             exact_interactions_from_reach(
                                 pred, Xc, reach, bgw, G,
-                                target_chunk_elems=budget)
+                                target_chunk_elems=budget,
+                                use_pallas=use_pallas)
                     return out
 
             self._fn_cache[key] = jax.jit(fn)

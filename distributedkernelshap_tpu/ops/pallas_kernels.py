@@ -168,15 +168,19 @@ def _exact_footprint(tb: int, tp: int, N: int, M: int, K: int) -> int:
 
 
 def _exact_tile_sizes(B: int, P: int, N: int, M: int, K: int,
-                      tb: int, tp: int) -> tuple:
-    """(tb, tp) for :func:`exact_tree_phi` whose VMEM working set fits
-    (:func:`_exact_footprint`)."""
+                      tb: int, tp: int, footprint=None) -> tuple:
+    """(tb, tp) for the exact kernels whose VMEM working set fits.
 
+    ``footprint`` defaults to :func:`_exact_footprint` (the phi kernel);
+    :func:`exact_tree_inter` passes :func:`_exact_inter_footprint` — one
+    search to maintain, two cost models."""
+
+    footprint = footprint or _exact_footprint
     tb_c = min(tb, max(8, B))
     while tb_c >= 8:
         tp_c = min(tp, max(128, P))
         while tp_c >= 128:
-            if _exact_footprint(tb_c, tp_c, N, M, K) <= _VMEM_BUDGET:
+            if footprint(tb_c, tp_c, N, M, K) <= _VMEM_BUDGET:
                 return tb_c, tp_c
             tp_c = max(128, tp_c // 2) if tp_c > 128 else 64
         tb_c = max(8, tb_c // 2) if tb_c > 8 else 4
@@ -324,6 +328,169 @@ def exact_tree_phi(x_only, x_not, z_ok, z_dead, leaf_val, bgw,
         interpret=interpret,
     )(x_only_t, x_not_t, z_ok_t, z_dead_t, lv_t, bgw)
     return out[:B]
+
+
+def _exact_inter_footprint(tb: int, tp: int, N: int, M: int, K: int) -> int:
+    """Scoped-VMEM bytes of one :func:`exact_tree_inter` grid step: like
+    :func:`_exact_footprint` but the s_p/s_m carry pair is live per group
+    iteration (not per tile) and the output tile is ``(M, tb, M, K)``."""
+
+    Mp = max(8, -(-M // 8) * 8)
+    tiles = 4 * tb * Mp * tp * 4
+    z = (N * Mp * tp + N * tp) * 4
+    out = M * tb * Mp * max(K, 8) * 4
+    small = (tp * max(K, 8) + 8 * tb * tp) * 4
+    return 2 * (tiles + z + out + small)
+
+
+def exact_inter_kernel_fits(N: int, M: int, K: int) -> bool:
+    """Minimal-tile VMEM gate for :func:`exact_tree_inter` (see
+    :func:`exact_kernel_fits`)."""
+
+    return _exact_inter_footprint(8, 128, N, M, K) <= _VMEM_BUDGET
+
+
+def _exact_inter_kernel(x_only_ref, x_not_ref, z_ok_ref, z_dead_ref, lv_ref,
+                        bgw_ref, out_ref, *, N: int, M: int, dmax: int):
+    """One (tb, tp) tile of the exact pairwise-interaction contraction.
+
+    Refs as in :func:`_exact_phi_kernel` plus out ``(M, tb, M, K)``
+    (leading axis = the fixed group ``g`` of each row), accumulated over
+    the path-tile grid axis.
+
+    Math: the pairwise Shapley interaction index of the conjunction game,
+    off-diagonal part (``ops/treeshap.exact_interactions_from_reach``):
+    for each fixed g, the four weight terms pair with only two h-side
+    factor products, and all three pairwise Beta weights derive from ONE
+    masked-product binomial via
+
+        W_uu = 1/((u-1)·C(u+v-1, v))          (u >= 2)
+        W_uv = -1/(v·C(u+v-1, v))             (u, v >= 1)
+        W_vv = u/(v·(v-1)·C(u+v-1, v))        (v >= 2)
+
+    (C(u+v-1, v) = Π_{i<=u-1} (v+i)/i; algebra pinned against the f64
+    gammaln tables by
+    ``tests/test_treeshap.py::test_exact_inter_binom_weights_match_f64_table``).
+    The group loop is OUTSIDE the background loop so only one s_p/s_m
+    carry pair is live at a time; the weights are recomputed per (g, n) —
+    cheap VPU work against the HBM traffic the kernel eliminates (the
+    einsum path materialises ~six ``(B, chunk, T, L)`` tensors per group
+    per chunk)."""
+
+    x_only = x_only_ref[:]                      # (tb, M, tp)
+    x_not = x_not_ref[:]
+
+    for g in range(M):
+        xo_g = x_only[:, g, :]                  # (tb, tp)
+        xn_g = x_not[:, g, :]
+
+        def body(n, carry, xo_g=xo_g, xn_g=xn_g):
+            s_p, s_m = carry
+            z = z_ok_ref[n]                     # (M, tp)
+            zd = z_dead_ref[n]
+            nz = 1.0 - z
+            u = jnp.sum(x_only * nz[None], axis=1)
+            v = jnp.sum(x_not * z[None], axis=1)
+            dead = jnp.sum(x_not * nz[None], axis=1)
+            alive = (dead < 0.5) & (zd[None, :] < 0.5)
+
+            def bin_body(i, acc):
+                fi = jnp.asarray(i, jnp.float32)
+                return acc * jnp.where(fi <= u - 0.5, (v + fi) / fi, 1.0)
+
+            binom2 = jax.lax.fori_loop(1, dmax + 1, bin_body,
+                                       jnp.ones_like(u), unroll=True)
+            base = jnp.where(alive, bgw_ref[n] / binom2, 0.0)
+            w_uu = jnp.where(u > 1.5, base / jnp.maximum(u - 1.0, 1.0), 0.0)
+            w_uv = -jnp.where((u > 0.5) & (v > 0.5),
+                              base / jnp.maximum(v, 1.0), 0.0)
+            # u = 0 degenerates the binomial identity (C(v-1, v) = 0 but
+            # the empty product is 1): there W_vv = (v-2)!/(v-1)! directly
+            w_vv = jnp.where(v > 1.5,
+                             base * jnp.where(
+                                 u > 0.5,
+                                 u / jnp.maximum(v * (v - 1.0), 1.0),
+                                 1.0 / jnp.maximum(v - 1.0, 1.0)), 0.0)
+            ag = xo_g * nz[g][None, :]          # (tb, tp)
+            cg = xn_g * z[g][None, :]
+            w_p = w_uu * ag + w_uv * cg         # pairs with (x_only, 1-z)
+            w_m = w_vv * cg + w_uv * ag         # pairs with (x_not, z)
+            return (s_p + w_p[:, None, :] * nz[None],
+                    s_m + w_m[:, None, :] * z[None])
+
+        zeros = jnp.zeros(x_only.shape, jnp.float32)
+        s_p, s_m = jax.lax.fori_loop(0, N, body, (zeros, zeros))
+        d = s_p * x_only + s_m * x_not          # (tb, M, tp)
+        contrib = jax.lax.dot_general(
+            d, lv_ref[:], (((2,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)  # (tb, M, K)
+
+        @pl.when(pl.program_id(1) == 0)
+        def _init(g=g, contrib=contrib):
+            out_ref[g] = contrib
+
+        @pl.when(pl.program_id(1) != 0)
+        def _acc(g=g, contrib=contrib):
+            out_ref[g] += contrib
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("tb", "tp", "dmax", "interpret"))
+def exact_tree_inter(x_only, x_not, z_ok, z_dead, leaf_val, bgw,
+                     dmax: int, tb: int = 64, tp: int = 256,
+                     interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Fused exact pairwise-interaction contraction (the off-diagonal raw
+    sum of ``ops/treeshap.exact_interactions_from_reach``, flattened over
+    paths).  Same parameters as :func:`exact_tree_phi`; returns the raw
+    ``inter (B, M, M, K)`` tensor (``[b, g, h, k]``) — the caller applies
+    scale/aggregation and the shap diagonal convention."""
+
+    B, P, M = x_only.shape
+    N = z_ok.shape[0]
+    K = leaf_val.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() in ("cpu", "gpu")
+
+    tb, tp = _exact_tile_sizes(B, P, N, M, K, tb, tp,
+                               footprint=_exact_inter_footprint)
+
+    pad_b = (-B) % tb
+    pad_p = (-P) % tp
+    x_only_t = jnp.pad(jnp.transpose(x_only, (0, 2, 1)).astype(jnp.float32),
+                       ((0, pad_b), (0, 0), (0, pad_p)))
+    x_not_t = jnp.pad(jnp.transpose(x_not, (0, 2, 1)).astype(jnp.float32),
+                      ((0, pad_b), (0, 0), (0, pad_p)))
+    z_ok_t = jnp.pad(jnp.transpose(z_ok, (0, 2, 1)).astype(jnp.float32),
+                     ((0, 0), (0, 0), (0, pad_p)), constant_values=1.0)
+    z_dead_t = jnp.pad(z_dead.astype(jnp.float32), ((0, 0), (0, pad_p)))
+    lv_t = jnp.pad(leaf_val.astype(jnp.float32), ((0, pad_p), (0, 0)))
+    bgw = bgw.astype(jnp.float32)
+
+    grid = (pl.cdiv(B + pad_b, tb), pl.cdiv(P + pad_p, tp))
+    kernel = functools.partial(_exact_inter_kernel, N=N, M=M, dmax=dmax)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tb, M, tp), lambda i, j: (i, 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, M, tp), lambda i, j: (i, 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((N, M, tp), lambda i, j: (0, 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((N, tp), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tp, K), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((M, tb, M, K), lambda i, j: (0, i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((M, B + pad_b, M, K), jnp.float32),
+        interpret=interpret,
+    )(x_only_t, x_not_t, z_ok_t, z_dead_t, lv_t, bgw)
+    return jnp.transpose(out, (1, 0, 2, 3))[:B]  # (B, M, M, K)
 
 
 @functools.partial(jax.jit, static_argnames=("activation", "tb", "ts", "interpret"))

@@ -443,7 +443,8 @@ def _interaction_weights(u, v, dmax: int):
 def exact_interactions_from_reach(pred, X, reach, bgw, G,
                                   bg_chunk: Optional[int] = None,
                                   normalized: bool = False,
-                                  target_chunk_elems: Optional[int] = None):
+                                  target_chunk_elems: Optional[int] = None,
+                                  use_pallas: Optional[bool] = None):
     """Exact interventional Shapley **interaction** values ``(B, K, M, M)``
     for ``X`` given precomputed background reach tensors.
 
@@ -496,6 +497,63 @@ def exact_interactions_from_reach(pred, X, reach, bgw, G,
     x_not = (1.0 - x_ok) * onpath_g[None]
 
     N = z_ok.shape[0]
+    from distributedkernelshap_tpu.ops.explain import resolve_use_pallas
+    from distributedkernelshap_tpu.ops.pallas_kernels import (
+        exact_inter_kernel_fits,
+        exact_tree_inter,
+    )
+
+    n_slice = 256
+    K = int(leaf_val.shape[-1])
+    # same gating contract as the main-effect pass (exact_shap_from_reach)
+    use_kernel = (bg_chunk is None and resolve_use_pallas(use_pallas)
+                  and exact_inter_kernel_fits(min(N, n_slice), M, K)
+                  and _exact_dmax(pred_t, M) <= 64)
+    if use_kernel:
+        B = X.shape[0]
+        L = leaf_val.shape[1]
+        P = T * L
+        dmax = _exact_dmax(pred_t, M)
+        xo = x_only.reshape(B, P, M)
+        xn = x_not.reshape(B, P, M)
+        zo = z_ok.reshape(N, P, M)
+        zd = z_ung_dead.reshape(N, P)
+        lv = leaf_val.reshape(P, -1)
+        inter = None
+        for s0 in range(0, N, n_slice):
+            part = exact_tree_inter(xo, xn, zo[s0:s0 + n_slice],
+                                    zd[s0:s0 + n_slice],
+                                    lv, bgw[s0:s0 + n_slice], dmax=dmax)
+            inter = part if inter is None else inter + part
+    else:
+        inter = _inter_einsum_path(
+            pred_t, X, x_only, x_not, z_ok, z_ung_dead, bgw, leaf_val,
+            M, T, bg_chunk, target_chunk_elems)
+    inter = inter * (pred_t.scale * head_scale)
+    if pred_t.aggregation == "mean":
+        inter = inter / T
+    inter = jnp.moveaxis(inter, -1, 1)          # (B, K, M, M)
+    # the g-loop pairs every (g, h) including g == h; the diagonal of the
+    # pairwise index is not defined, and the shap convention replaces it
+    # with the residual main effect: off-diag I/2 each side, diag makes
+    # rows sum to phi
+    eye = jnp.eye(M, dtype=inter.dtype)
+    off = inter * (1.0 - eye) * 0.5
+    phi = exact_shap_from_reach(pred, X, reach, bgw, G, bg_chunk=bg_chunk,
+                                normalized=True,
+                                target_chunk_elems=target_chunk_elems,
+                                use_pallas=use_pallas)
+    diag = phi - jnp.sum(off, axis=-1)
+    return off + diag[..., None] * eye
+
+
+def _inter_einsum_path(pred_t, X, x_only, x_not, z_ok, z_ung_dead, bgw,
+                       leaf_val, M, T, bg_chunk, target_chunk_elems):
+    """The chunked-einsum pairwise pass (the pre-kernel formulation and
+    the fallback for shapes the kernel rejects); returns the raw
+    ``(B, M, M, K)`` off-diagonal sum before scale/aggregation."""
+
+    N = z_ok.shape[0]
     chunk = _bounded_bg_chunk(bg_chunk, N, X.shape[0], T, leaf_val.shape[1],
                               budget=target_chunk_elems)
     z_ok_p, z_ung_p, bgw_p = pad_background(z_ok, z_ung_dead, bgw, chunk)
@@ -535,23 +593,8 @@ def exact_interactions_from_reach(pred, X, reach, bgw, G,
             out.append(jnp.einsum("btlh,tlk->bhk", s_p + s_m, leaf_val))
         return jnp.stack(out, axis=1)           # (B, M, M, K): [b, g, h, k]
 
-    inter = jnp.sum(jax.lax.map(one_chunk, (z_chunks, zu_chunks, w_chunks)),
-                    axis=0)
-    inter = inter * (pred_t.scale * head_scale)
-    if pred_t.aggregation == "mean":
-        inter = inter / T
-    inter = jnp.moveaxis(inter, -1, 1)          # (B, K, M, M)
-    # the g-loop pairs every (g, h) including g == h; the diagonal of the
-    # pairwise index is not defined, and the shap convention replaces it
-    # with the residual main effect: off-diag I/2 each side, diag makes
-    # rows sum to phi
-    eye = jnp.eye(M, dtype=inter.dtype)
-    off = inter * (1.0 - eye) * 0.5
-    phi = exact_shap_from_reach(pred, X, reach, bgw, G, bg_chunk=bg_chunk,
-                                normalized=True,
-                                target_chunk_elems=target_chunk_elems)
-    diag = phi - jnp.sum(off, axis=-1)
-    return off + diag[..., None] * eye
+    return jnp.sum(jax.lax.map(one_chunk, (z_chunks, zu_chunks, w_chunks)),
+                   axis=0)
 
 
 def exact_tree_shap(pred, X, bg, bgw, G, bg_chunk: Optional[int] = None):

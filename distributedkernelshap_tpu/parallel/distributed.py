@@ -390,7 +390,8 @@ class DistributedExplainer:
                     if interactions:
                         inter_local = exact_interactions_from_reach(
                             pred, Xl, r, bgw_l, G, normalized=True,
-                            target_chunk_elems=budget)
+                            target_chunk_elems=budget,
+                            use_pallas=engine.config.shap.use_pallas)
                         out['interaction_values'] = jax.lax.psum(
                             inter_local, COALITION_AXIS)
                     return out

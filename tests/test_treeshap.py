@@ -859,7 +859,6 @@ def test_exact_kernel_gate_at_benchmark_shapes(gbt_setup, monkeypatch):
         called["kernel"] += 1
         return real(*a, **k)
 
-    monkeypatch.setattr(ts, "exact_tree_phi", None, raising=False)
     import distributedkernelshap_tpu.ops.pallas_kernels as pk_mod
     monkeypatch.setattr(pk_mod, "exact_tree_phi", spy)
 
@@ -874,3 +873,79 @@ def test_exact_kernel_gate_at_benchmark_shapes(gbt_setup, monkeypatch):
     ts.exact_shap_from_reach(pred, X, reach, bgw, G, use_pallas=True,
                              bg_chunk=16)
     assert called["kernel"] == 1  # explicit bg_chunk pins the einsum slab
+
+
+def test_exact_inter_pallas_kernel_matches_einsum_path(gbt_setup):
+    """The fused interactions kernel (use_pallas=True, interpret mode on
+    CPU) must reproduce the chunked-einsum pairwise pass end-to-end —
+    including the diagonal convention (rows sum to phi) and the weighted /
+    grouped / multi-slice background cases."""
+
+    from distributedkernelshap_tpu.ops.treeshap import (
+        background_reach,
+        exact_interactions_from_reach,
+    )
+
+    pred = gbt_setup["pred"]
+    rng = np.random.default_rng(9)
+    X = gbt_setup["X"][:5]
+    for groups, bg, wsize in (
+            (None, gbt_setup["X"][50:127], 77),          # ragged N
+            ([[0, 1], [2], [3, 4]], gbt_setup["X"][40:72], 32),  # grouped
+    ):
+        G = groups_to_matrix(groups, 6)
+        bgw = rng.random(wsize).astype(np.float32) + 0.1
+        reach = background_reach(pred, bg, G)
+        ref = np.asarray(exact_interactions_from_reach(
+            pred, X, reach, bgw, G, use_pallas=False))
+        got = np.asarray(exact_interactions_from_reach(
+            pred, X, reach, bgw, G, use_pallas=True))
+        np.testing.assert_allclose(got, ref, atol=3e-5, rtol=3e-5)
+        # rows must sum to phi under the kernel path too
+        from distributedkernelshap_tpu.ops.treeshap import (
+            exact_shap_from_reach,
+        )
+
+        phi = np.asarray(exact_shap_from_reach(
+            pred, X, reach, bgw, G, use_pallas=True))
+        np.testing.assert_allclose(got.sum(-1), phi, atol=3e-5, rtol=3e-5)
+    # large-N slicing
+    bg_big = np.concatenate([gbt_setup["X"][:150]] * 2, 0)
+    bgw_big = rng.random(300).astype(np.float32) + 0.1
+    G = groups_to_matrix(None, 6)
+    reach = background_reach(pred, bg_big, G)
+    ref = np.asarray(exact_interactions_from_reach(
+        pred, X[:2], reach, bgw_big, G, use_pallas=False))
+    got = np.asarray(exact_interactions_from_reach(
+        pred, X[:2], reach, bgw_big, G, use_pallas=True))
+    np.testing.assert_allclose(got, ref, atol=3e-5, rtol=3e-5)
+
+
+def test_exact_inter_binom_weights_match_f64_table():
+    """The interactions kernel's single-binomial closed forms
+    (W_uu = 1/((u-1)·C), W_uv = -1/(v·C), W_vv = u/(v(v-1)·C) with
+    C = C(u+v-1, v), and the u=0 degenerate W_vv = 1/(v-1)) must match the
+    f64 gammaln tables over the supported count grid."""
+
+    from distributedkernelshap_tpu.ops.treeshap import _interaction_tables
+
+    dmax = 64
+    wu_t, wv_t, wm_t = _interaction_tables(dmax)
+    u, v = np.meshgrid(np.arange(dmax + 1), np.arange(dmax + 1),
+                       indexing="ij")
+    u = u.astype(np.float64)
+    v = v.astype(np.float64)
+    binom2 = np.ones_like(u)
+    for i in range(1, dmax + 1):
+        binom2 *= np.where(i <= u - 0.5, (v + i) / i, 1.0)
+    w_uu = np.where(u > 1.5, 1.0 / (np.maximum(u - 1.0, 1.0) * binom2), 0.0)
+    w_uv = -np.where((u > 0.5) & (v > 0.5),
+                     1.0 / (np.maximum(v, 1.0) * binom2), 0.0)
+    w_vv = np.where(v > 1.5,
+                    np.where(u > 0.5,
+                             u / (np.maximum(v * (v - 1.0), 1.0) * binom2),
+                             1.0 / np.maximum(v - 1.0, 1.0)), 0.0)
+    mask = u + v <= dmax
+    np.testing.assert_allclose(w_uu[mask], wu_t[mask], rtol=5e-5, atol=1e-38)
+    np.testing.assert_allclose(w_vv[mask], wv_t[mask], rtol=5e-5, atol=1e-38)
+    np.testing.assert_allclose(w_uv[mask], wm_t[mask], rtol=5e-5, atol=1e-38)
