@@ -215,7 +215,7 @@ class ExplainerServer:
         self._metrics_lock = threading.Lock()
         self._metrics = {"requests_total": 0, "errors_total": 0,
                          "rows_total": 0, "batches_total": 0,
-                         "request_seconds_sum": 0.0}
+                         "request_seconds_sum": 0.0, "wedges_total": 0}
         self._queue: "queue.Queue[_Pending]" = queue.Queue()
         # request popped by _fill_batch that would overflow the model's
         # max_rows slot: carried into the next batch (dispatcher-only state)
@@ -300,6 +300,12 @@ class ExplainerServer:
             "# HELP dks_serve_pipeline_depth In-flight device batches.",
             "# TYPE dks_serve_pipeline_depth gauge",
             f"dks_serve_pipeline_depth {self.pipeline_depth or 0}",
+            "# HELP dks_serve_wedges_total Watchdog-declared device wedges.",
+            "# TYPE dks_serve_wedges_total counter",
+            f"dks_serve_wedges_total {m['wedges_total']}",
+            "# HELP dks_serve_wedged Whether the server is currently wedged.",
+            "# TYPE dks_serve_wedged gauge",
+            f"dks_serve_wedged {int(self._wedged.is_set())}",
         ]
         return "\n".join(lines) + "\n"
 
@@ -434,6 +440,8 @@ class ExplainerServer:
                 "%.0f s; failing them and marking the server wedged",
                 len(active), stalled_s)
             self._wedged.set()
+            with self._metrics_lock:
+                self._metrics["wedges_total"] += 1
             msg = (f"device call exceeded the {limit:.0f}s "
                    f"watchdog timeout; server marked unhealthy")
             for batch in active:

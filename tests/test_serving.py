@@ -756,3 +756,40 @@ def test_healthz_skips_probe_while_busy(model_setup):
     finally:
         srv._active.clear()
         srv.stop()
+
+
+def test_metrics_expose_wedge_counters(model_setup):
+    """/metrics must carry the fault-isolation observables: a wedge
+    increments dks_serve_wedges_total and flips the dks_serve_wedged gauge;
+    recovery clears the gauge but not the counter."""
+
+    import urllib.request
+
+    s = model_setup
+    model = KernelShapModel(s["pred"], s["bg"], s["constructor_kwargs"],
+                            s["fit_kwargs"])
+    srv = ExplainerServer(model, host="127.0.0.1", port=0,
+                          pipeline_depth=2).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+
+        def scrape():
+            with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+                return r.read().decode()
+
+        text = scrape()
+        assert "dks_serve_wedges_total 0" in text
+        assert "dks_serve_wedged 0" in text
+        # simulate the watchdog's declaration + a later recovery
+        srv._wedged.set()
+        with srv._metrics_lock:
+            srv._metrics["wedges_total"] += 1
+        text = scrape()
+        assert "dks_serve_wedges_total 1" in text
+        assert "dks_serve_wedged 1" in text
+        srv._wedged.clear()
+        text = scrape()
+        assert "dks_serve_wedges_total 1" in text
+        assert "dks_serve_wedged 0" in text
+    finally:
+        srv.stop()
