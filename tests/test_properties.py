@@ -303,3 +303,111 @@ def test_ey_linear_fallback_matches_dense_random_shapes(seed, B, S, N, M, K):
         jnp.asarray(bg), jnp.asarray(bgw), jnp.asarray(mask),
         jnp.asarray(G), chunk, use_pallas=False))
     np.testing.assert_allclose(got, ref, atol=2e-5)
+
+
+@settings(max_examples=12, deadline=None)
+@given(seed=st.integers(0, 2**20), S=st.integers(40, 150),
+       p=st.integers(3, 11), T=st.integers(1, 6),
+       crit=st.sampled_from(["aic", "bic"]))
+def test_lars_batch_matches_sklearn_property(seed, S, p, T, crit):
+    """Round-4 batched Gram-space LARS: per-target selections must equal
+    sklearn's LassoLarsIC over random (possibly correlated) designs —
+    fresh examples every fuzz run extend the fixed-seed oracle sweep."""
+
+    import warnings
+
+    from sklearn.linear_model import LassoLarsIC
+
+    from distributedkernelshap_tpu.kernel_shap import _l1_select_batch
+
+    rng = np.random.default_rng(seed)
+    mix = np.eye(p) + 0.5 * rng.normal(size=(p, p)) / np.sqrt(p)
+    Xw = rng.normal(size=(S, p)) @ mix
+    C = rng.normal(size=(p, T)) * (rng.random(size=(p, T)) < 0.5)
+    Yw = Xw @ C + 0.1 * rng.normal(size=(S, T))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        got = _l1_select_batch(Xw, Yw, crit)
+        for t in range(T):
+            want = np.nonzero(
+                LassoLarsIC(criterion=crit).fit(Xw, Yw[:, t]).coef_)[0]
+            np.testing.assert_array_equal(got[t], want)
+
+
+@settings(max_examples=20, deadline=None)
+@given(seed=st.integers(0, 2**20), n_wide=st.integers(1, 200),
+       n_narrow=st.integers(1, 40),
+       td=st.sampled_from([None, "float16", "bfloat16"]))
+def test_pack_transfer_roundtrip_property(seed, n_wide, n_narrow, td):
+    """pack/unpack_transfer: the narrow segment round-trips EXACTLY for
+    every dtype and odd segment length (the bit-packing must survive
+    misaligned boundaries); the wide segment to its dtype's precision."""
+
+    import jax.numpy as jnp
+
+    from distributedkernelshap_tpu.ops.explain import (
+        pack_transfer,
+        unpack_transfer,
+    )
+
+    rng = np.random.default_rng(seed)
+    wide = (rng.standard_normal(n_wide) * 4).astype(np.float32)
+    narrow = (rng.standard_normal(n_narrow) * 4).astype(np.float32)
+    packed = pack_transfer(jnp.asarray(wide), jnp.asarray(narrow), td)
+    w, n = unpack_transfer(np.asarray(packed), n_wide, td)
+    np.testing.assert_array_equal(n, narrow)
+    if td is None:
+        np.testing.assert_array_equal(w, wide)
+    else:
+        np.testing.assert_allclose(w, wide, rtol=2e-2, atol=1e-2)
+
+
+@settings(max_examples=6, deadline=None)
+@given(seed=st.integers(0, 2**20), n_est=st.integers(1, 5),
+       depth=st.integers(2, 4), N=st.integers(5, 40),
+       B=st.integers(1, 9), grouped=st.booleans())
+def test_exact_pallas_kernels_match_einsum_property(seed, n_est, depth, N,
+                                                    B, grouped):
+    """Round-4 fused exact kernels vs the einsum paths on random small
+    ensembles/backgrounds — main effects AND interactions, every fuzz run
+    a fresh model."""
+
+    from sklearn.ensemble import GradientBoostingRegressor
+
+    from distributedkernelshap_tpu.models import as_predictor
+    from distributedkernelshap_tpu.ops import groups_to_matrix
+    from distributedkernelshap_tpu.ops.treeshap import (
+        background_reach,
+        exact_interactions_from_reach,
+        exact_shap_from_reach,
+    )
+
+    rng = np.random.default_rng(seed)
+    D = 6
+    Xtr = rng.normal(size=(120, D))
+    y = Xtr[:, 0] * np.where(Xtr[:, 1] > 0, 1.0, -1.5) + 0.3 * Xtr[:, 2]
+    gbt = GradientBoostingRegressor(n_estimators=n_est, max_depth=depth,
+                                    random_state=seed % 1000).fit(Xtr, y)
+    pred = as_predictor(gbt.predict, example_dim=D,
+                        probe_data=Xtr[:8].astype(np.float32))
+    from distributedkernelshap_tpu.models.trees import TreeEnsemblePredictor
+
+    # a probe regression must fail the sweep loudly, not die as an opaque
+    # AttributeError inside background_reach
+    assert isinstance(pred, TreeEnsemblePredictor)
+    X = Xtr[:B].astype(np.float32)
+    bg = Xtr[50:50 + N].astype(np.float32)
+    bgw = (rng.random(N) + 0.2).astype(np.float32)
+    groups = [[0, 1], [2], [3, 4]] if grouped else None
+    G = groups_to_matrix(groups, D)
+    reach = background_reach(pred, bg, G)
+    ref = np.asarray(exact_shap_from_reach(
+        pred, X, reach, bgw, G, use_pallas=False))
+    got = np.asarray(exact_shap_from_reach(
+        pred, X, reach, bgw, G, use_pallas=True))
+    np.testing.assert_allclose(got, ref, atol=3e-5, rtol=3e-5)
+    ref_i = np.asarray(exact_interactions_from_reach(
+        pred, X, reach, bgw, G, use_pallas=False))
+    got_i = np.asarray(exact_interactions_from_reach(
+        pred, X, reach, bgw, G, use_pallas=True))
+    np.testing.assert_allclose(got_i, ref_i, atol=5e-5, rtol=5e-5)
