@@ -949,3 +949,83 @@ def test_exact_inter_binom_weights_match_f64_table():
     np.testing.assert_allclose(w_uu[mask], wu_t[mask], rtol=5e-5, atol=1e-38)
     np.testing.assert_allclose(w_vv[mask], wv_t[mask], rtol=5e-5, atol=1e-38)
     np.testing.assert_allclose(w_uv[mask], wm_t[mask], rtol=5e-5, atol=1e-38)
+
+
+def test_engine_degrades_to_einsum_on_mosaic_rejection(gbt_setup):
+    """If the fused exact kernel fails at first execution with a
+    Mosaic/Pallas-class error (uncheckable off-chip), the engine must fail
+    the batch OVER to the einsum path, produce correct values, and persist
+    the degrade so later explains (including interactions) never retry the
+    broken kernel."""
+
+    from distributedkernelshap_tpu.kernel_shap import KernelExplainerEngine
+
+    pred = gbt_setup["pred"]
+    bg = gbt_setup["X"][40:60]
+    X = gbt_setup["X"][:4]
+    eng = KernelExplainerEngine(pred, bg, link="identity", seed=0)
+    want = eng.get_explanation(X, nsamples="exact", l1_reg=False)
+
+    eng2 = KernelExplainerEngine(pred, bg, link="identity", seed=0)
+    calls = {"n": 0}
+
+    import distributedkernelshap_tpu.ops.pallas_kernels as pk
+
+    real = pk.exact_tree_phi
+
+    def broken(*a, **k):
+        calls["n"] += 1
+        raise RuntimeError("Mosaic lowering failed: vmem limit exceeded")
+
+    # force the kernel path on (CPU auto-resolves off) and make it blow up
+    # the way a real Mosaic rejection does — at execution time
+    from dataclasses import replace as _replace
+
+    eng2.config = _replace(eng2.config,
+                           shap=_replace(eng2.config.shap, use_pallas=True))
+    try:
+        pk.exact_tree_phi = broken
+        got = eng2.get_explanation(X, nsamples="exact", l1_reg=False)
+        for a, b in zip(want, got):
+            np.testing.assert_allclose(a, b, atol=1e-5)
+        assert calls["n"] >= 1                   # the kernel path WAS tried
+        assert eng2.config.shap.use_pallas is False  # degrade persisted
+        # later explains (interactions variant included) go straight to
+        # einsum — broken stays installed so a kernel retry would COUNT
+        eng2.get_explanation(X, nsamples="exact", l1_reg=False,
+                             interactions=True)
+        assert calls["n"] == 1
+    finally:
+        pk.exact_tree_phi = real
+
+
+def test_exact_sharded_with_forced_kernels_matches_single_device(gbt_setup):
+    """The configuration the TPU actually runs — shard_map over a dp×cp
+    mesh with BOTH fused exact kernels engaged (interpret mode here) and
+    psum'd background shards — must match the single-device einsum path,
+    interactions included."""
+
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.kernel_shap import EngineConfig
+    from distributedkernelshap_tpu.ops.explain import ShapConfig
+
+    gbt = gbt_setup["gbt"]
+    X = gbt_setup["X"]
+
+    ex0 = KernelShap(gbt.predict, seed=0)
+    ex0.fit(X[:16])
+    ref = ex0.explain(X[:24], silent=True, nsamples="exact").shap_values
+
+    ex = KernelShap(gbt.predict, seed=0,
+                    distributed_opts={"n_devices": 8,
+                                      "coalition_parallel": 2},
+                    engine_config=EngineConfig(
+                        shap=ShapConfig(use_pallas=True)))
+    ex.fit(X[:16])
+    res = ex.explain(X[:24], silent=True, nsamples="exact",
+                     interactions=True)
+    for a, b in zip(ref, res.shap_values):
+        np.testing.assert_allclose(a, b, atol=3e-5)
+    iv = res.data["raw"]["interaction_values"][0]
+    np.testing.assert_allclose(iv.sum(-1), np.asarray(res.shap_values[0]),
+                               atol=5e-5)
