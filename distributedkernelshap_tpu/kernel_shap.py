@@ -66,6 +66,27 @@ KERNEL_SHAP_PARAMS = [
 KERNEL_SHAP_BACKGROUND_THRESHOLD = 300
 
 
+def _async_sync_fallback(explainer, X, nsamples, l1_reg, interactions):
+    """Shared synchronous closure behind both ``get_explanation_async``
+    fallbacks (engine + DistributedExplainer): compute now on the calling
+    thread, capture the per-call state eagerly (a later dispatch must not
+    overwrite what this finalize returns), and hand back the
+    ``finalize() -> (values, info)`` contract the serving wrappers consume.
+    One implementation so the info keys can never drift between explainer
+    kinds."""
+
+    values = explainer.get_explanation(X, nsamples=nsamples, l1_reg=l1_reg,
+                                       silent=True, interactions=interactions)
+    info = {
+        'raw_prediction': explainer.last_raw_prediction,
+        'expected_value': np.atleast_1d(
+            np.asarray(explainer.expected_value, dtype=np.float32)),
+    }
+    if interactions:
+        info['interaction_values'] = explainer.last_interaction_values
+    return lambda: (values, info)
+
+
 def _fingerprint(X: np.ndarray):
     """Cheap identity for "same instances as the last explain call": guards
     the cached link-space predictions against a direct ``build_explanation``
@@ -867,20 +888,8 @@ class KernelExplainerEngine:
             # state
             # (nsamples='exact' also lands here: its jitted fn is built
             # lazily on the dispatcher thread like every other cache)
-            values = self.get_explanation(X, nsamples=nsamples,
-                                          l1_reg=l1_reg, silent=True,
-                                          interactions=interactions)
-            info = {
-                'raw_prediction': self.last_raw_prediction,
-                'expected_value': np.atleast_1d(
-                    np.asarray(self.expected_value, dtype=np.float32)),
-            }
-            if interactions:
-                # captured HERE (dispatcher thread, before the next batch's
-                # dispatch can overwrite engine state) rather than read by
-                # finalizer threads later
-                info['interaction_values'] = self.last_interaction_values
-            return lambda: (values, info)
+            return _async_sync_fallback(self, X, nsamples, l1_reg,
+                                        interactions)
 
         plan = self._plan(nsamples)
         fin = self._dispatch_array(X, plan)

@@ -442,8 +442,8 @@ class DistributedExplainer:
         B = X.shape[0]
         # same slab batching as the sampled path: batch_size bounds the per-
         # device rows per call, so exact-mode memory does not scale with B
-        slab = int(self.batch_size) * self.n_data if self.batch_size else 0
-        if slab and B > slab:
+        slab = self._slab_size()
+        if self._needs_slabs(B):
             padded, _ = pad_to_multiple(B, slab)
             if padded != B:
                 X = np.concatenate([X, np.tile(X[-1:], (padded - B, 1))], 0)
@@ -491,6 +491,17 @@ class DistributedExplainer:
         return run_pipeline(slabs, dispatch, self._fetch_sharded,
                             window=window, threaded=not multihost)
 
+    def _slab_size(self) -> int:
+        """Rows per sharded slab (``batch_size`` instances per device), or
+        0 when slabbing is off — ONE implementation for every path that
+        must agree on when a batch splits."""
+
+        return int(self.batch_size) * self.n_data if self.batch_size else 0
+
+    def _needs_slabs(self, B: int) -> bool:
+        slab = self._slab_size()
+        return bool(slab) and B > slab
+
     def get_importance(self, X: np.ndarray, nsamples=None) -> np.ndarray:
         """``(K, M)`` mean |phi| over ``X`` with the reduction on the mesh.
 
@@ -508,9 +519,8 @@ class DistributedExplainer:
             return np.stack([np.abs(v).mean(0) for v in vals])
         X = np.atleast_2d(np.asarray(X, dtype=np.float32))
         B = X.shape[0]
-        slab = int(self.batch_size) * self.n_data if self.batch_size else 0
-        slabs = (make_batches(X, batch_size=slab)
-                 if slab and B > slab else [X])
+        slabs = (make_batches(X, batch_size=self._slab_size())
+                 if self._needs_slabs(B) else [X])
         plan = engine._plan(nsamples)
         args = self._device_args(plan)
         fn = self._sharded_fn()
@@ -533,6 +543,52 @@ class DistributedExplainer:
             # addressable); the partial is K*M floats — host-summing is free
             acc = np.asarray(part) if acc is None else acc + np.asarray(part)
         return acc / B
+
+    def get_explanation_async(self, X: np.ndarray,
+                              nsamples: Union[str, int, None] = None,
+                              l1_reg: Union[str, float, int, None] = 'auto',
+                              interactions: bool = False):
+        """Asynchronous variant of :meth:`get_explanation` for the serving
+        pipeline: dispatches the sharded device work immediately and
+        returns ``finalize() -> (values, info)`` — the same contract as
+        ``KernelExplainerEngine.get_explanation_async``.
+
+        True pipelining applies on SINGLE-process meshes (the v5e serving
+        pod shape: one host, several chips), where the fetch is a plain
+        D2H copy with no collectives, so concurrent finalizes from the
+        server's threads are safe and per-request round trips overlap.
+        Multi-host meshes fall back to a synchronous closure (fetches
+        embed ``process_allgather``, whose cross-process order one
+        in-flight call at a time preserves), as do the exact path,
+        slab-split batches, and active l1 selection — mirroring the
+        engine's fallback matrix."""
+
+        X = np.atleast_2d(np.asarray(X, dtype=np.float32))
+        if (jax.process_count() > 1 or interactions or nsamples == 'exact'
+                or self._needs_slabs(X.shape[0])
+                or self.engine._l1_active(l1_reg, nsamples)):
+            from distributedkernelshap_tpu.kernel_shap import (
+                _async_sync_fallback,
+            )
+
+            return _async_sync_fallback(self, X, nsamples, l1_reg,
+                                        interactions)
+
+        dispatched = self._dispatch_sharded(X, nsamples)
+        e_val = np.atleast_1d(np.asarray(self.engine.expected_value,
+                                         dtype=np.float32))
+
+        def finalize():
+            phi, fx = self._fetch_sharded(dispatched)
+            # pure numpy from here (l1 inactive, checked above); shared
+            # engine state (last_*) is deliberately not written — finalize
+            # may run on any server thread
+            return split_shap_values(phi, self.engine.vector_out), {
+                'raw_prediction': fx,
+                'expected_value': e_val,
+            }
+
+        return finalize
 
     def get_explanation(self, X: np.ndarray, **kwargs) -> Any:
         """Explain ``X``, sharded over the mesh.
@@ -563,8 +619,8 @@ class DistributedExplainer:
 
         X = np.atleast_2d(np.asarray(X, dtype=np.float32))
         B = X.shape[0]
-        slab = int(self.batch_size) * self.n_data if self.batch_size else 0
-        if slab and B > slab:
+        slab = self._slab_size()
+        if self._needs_slabs(B):
             # pad the global batch to a whole number of equal slabs so every
             # device step reuses one compiled shape
             padded, _ = pad_to_multiple(B, slab)

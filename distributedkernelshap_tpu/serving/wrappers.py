@@ -168,18 +168,12 @@ class KernelShapModel:
         ones, overlapping the per-call D2H round trips that dominate
         small-batch latency on a tunnelled TPU."""
 
-        from distributedkernelshap_tpu.parallel.distributed import (
-            DistributedExplainer,
-        )
-
         engine = self.explainer._explainer
-        if isinstance(engine, DistributedExplainer):
-            # the mesh-sharded path must go through DistributedExplainer's
-            # own dispatch (its __getattr__ proxy would otherwise route this
-            # to the inner engine and silently compute on one device);
-            # sharded device calls are large, so pipelining matters less
-            payloads = self.explain_batch(instances, split_sizes=split_sizes)
-            return lambda: payloads
+        # both explainer kinds expose the same async contract:
+        # KernelExplainerEngine directly, DistributedExplainer since round 4
+        # (true pipelining on single-process meshes — the serving pod shape —
+        # where the sharded fetch has no collectives; multi-host falls back
+        # to a synchronous closure internally)
         fin = engine.get_explanation_async(instances, **self.explain_kwargs)
         sizes = ([1] * instances.shape[0] if split_sizes is None
                  else list(split_sizes))
