@@ -37,12 +37,20 @@ from distributedkernelshap_tpu.parallel.mesh import COALITION_AXIS, DATA_AXIS
 
 def build_coalition_sharded_fn(predictor: BasePredictor,
                                config: ShapConfig,
-                               mesh: Mesh):
+                               mesh: Mesh,
+                               replicate_results: bool = False):
     """Build the 2-D-sharded explain function over ``mesh`` (data, coalition).
 
     Same signature/outputs as ``ops.explain.build_explainer_fn``; the
     coalition row count must be divisible by the coalition axis size (the
     caller pads plans with zero-weight rows).
+
+    ``replicate_results=True`` all-gathers phi / f(x) over the data axis
+    INSIDE the jitted program, so every process holds the full result and
+    the host-side fetch is a plain local D2H with no collective — the
+    property the pipelined multi-host serving path needs (collective
+    order then equals dispatch order on every process by construction).
+    Costs one extra all-gather per call; leave off for the benchmarks.
     """
 
     link_fn = convert_to_link(config.link)
@@ -106,18 +114,25 @@ def build_coalition_sharded_fn(predictor: BasePredictor,
             rhs = jax.lax.psum(rhs_part, COALITION_AXIS)
             phi = solve_from_normal(A, rhs, fx_minus_e, config.ridge)
 
+        if replicate_results:
+            # gather over the data axis inside the program: collectives
+            # stay in dispatch order, fetches become local
+            phi = jax.lax.all_gather(phi, DATA_AXIS, axis=0, tiled=True)
+            fx = jax.lax.all_gather(fx, DATA_AXIS, axis=0, tiled=True)
+
         return {
             'shap_values': phi,
             'expected_value': expected_value,
             'raw_prediction': fx,
         }
 
+    data_spec = P() if replicate_results else P(DATA_AXIS)
     sharded = jax.shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P(DATA_AXIS), P(), P(), P(COALITION_AXIS), P(COALITION_AXIS), P()),
-        out_specs={'shap_values': P(DATA_AXIS), 'expected_value': P(),
-                   'raw_prediction': P(DATA_AXIS)},
+        out_specs={'shap_values': data_spec, 'expected_value': P(),
+                   'raw_prediction': data_spec},
         check_vma=False,
     )
 
@@ -133,7 +148,8 @@ def build_coalition_sharded_fn(predictor: BasePredictor,
 
     shard = NamedSharding(mesh, P(DATA_AXIS))
     repl = NamedSharding(mesh, P())
+    out_data = repl if replicate_results else shard
     return jax.jit(explain,
                    in_shardings=(shard, repl, repl, repl, repl, repl),
-                   out_shardings={'shap_values': shard, 'expected_value': repl,
-                                  'raw_prediction': shard})
+                   out_shardings={'shap_values': out_data, 'expected_value': repl,
+                                  'raw_prediction': out_data})

@@ -138,6 +138,12 @@ class DistributedExplainer:
                 f"partitioning must be 'shard_map' or 'gspmd', got "
                 f"{self.partitioning!r}")
         self.algorithm = opts.get('algorithm', 'kernel_shap')
+        # replicate phi/f(x) over the data axis INSIDE the jitted program:
+        # fetches become collective-free local copies, which is what lets
+        # the multi-host serving path pipeline (collective order == the
+        # deterministic dispatch order on every process).  Costs one
+        # all-gather per call — benchmarks leave it off.
+        self.replicate_results = bool(opts.get('replicate_results', False))
 
         try:
             self.mesh = device_mesh(n_devices, coalition_parallel=self.coalition_parallel)
@@ -208,11 +214,13 @@ class DistributedExplainer:
                                                 * self.n_data)))
                 shard = NamedSharding(self.mesh, P(DATA_AXIS))
                 repl = NamedSharding(self.mesh, P())
+                out_data = repl if self.replicate_results else shard
                 self._jit_cache[key] = jax.jit(
                     fn,
                     in_shardings=(shard, repl, repl, repl, repl, repl),
-                    out_shardings={'shap_values': shard, 'expected_value': repl,
-                                   'raw_prediction': shard},
+                    out_shardings={'shap_values': out_data,
+                                   'expected_value': repl,
+                                   'raw_prediction': out_data},
                 )
             else:
                 # default: shard_map over the (data, coalition) mesh.  The
@@ -229,6 +237,7 @@ class DistributedExplainer:
                     self.engine.predictor,
                     replace(self.engine.config.shap, link=self.engine.config.link),
                     self.mesh,
+                    replicate_results=self.replicate_results,
                 )
         return self._jit_cache[key]
 
@@ -259,11 +268,18 @@ class DistributedExplainer:
             X = np.concatenate([X, np.tile(X[-1:], (padded - B, 1))], 0)
         return X, B
 
-    def _dispatch_call(self, fn, X: np.ndarray, args):
+    def _dispatch_call(self, fn, X: np.ndarray, args,
+                       replicated: bool = False):
         """Bucket-pad ``X`` to a whole number of device rows, launch ``fn``
         WITHOUT blocking (JAX dispatch is asynchronous) and return
-        ``(packed_device_array, B, padded_B, has_interactions)`` for
-        :meth:`_fetch_sharded`.
+        ``(packed_device_array, B, padded_B, has_interactions, replicated)``
+        for :meth:`_fetch_sharded`.
+
+        ``replicated`` records whether THIS dispatched program replicated
+        its outputs in-program (the sampled path under
+        ``replicate_results``); the fetch keys its allgather decision on
+        the dispatched program, never on the flag alone — the exact path's
+        outputs stay data-sharded regardless of the flag.
 
         Splitting dispatch from fetch lets a multi-slab explain enqueue
         slab k+1's compute while slab k's D2H round trip (~70 ms through a
@@ -284,21 +300,22 @@ class DistributedExplainer:
         packed = pack_transfer(jnp.concatenate(wide),
                                out['raw_prediction'].ravel(),
                                engine.config.shap.transfer_dtype)
-        return packed, B, X.shape[0], has_inter
+        return packed, B, X.shape[0], has_inter, replicated
 
     def _dispatch_sharded(self, X: np.ndarray, nsamples):
         plan = self.engine._plan(nsamples)
         return self._dispatch_call(self._sharded_fn(), X,
-                                   self._device_args(plan))
+                                   self._device_args(plan),
+                                   replicated=self.replicate_results)
 
     def _fetch_sharded(self, dispatched):
         """Block on one dispatched call; returns ``(shap_values, link-space
         raw predictions)`` plus the ``(B, K, M, M)`` interaction tensor when
         the dispatched fn produced one."""
 
-        packed_dev, B, Bp, has_inter = dispatched
+        packed_dev, B, Bp, has_inter, replicated = dispatched
         engine = self.engine
-        if jax.process_count() > 1:
+        if jax.process_count() > 1 and not replicated:
             # multi-host mesh: the result spans non-addressable devices, so
             # all-gather it (over ICI/DCN) before fetching — the reference's
             # analog is results travelling back through the plasma store
@@ -564,7 +581,8 @@ class DistributedExplainer:
         engine's fallback matrix."""
 
         X = np.atleast_2d(np.asarray(X, dtype=np.float32))
-        if (jax.process_count() > 1 or interactions or nsamples == 'exact'
+        if ((jax.process_count() > 1 and not self.replicate_results)
+                or interactions or nsamples == 'exact'
                 or self._needs_slabs(X.shape[0])
                 or self.engine._l1_active(l1_reg, nsamples)):
             from distributedkernelshap_tpu.kernel_shap import (

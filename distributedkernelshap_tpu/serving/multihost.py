@@ -17,11 +17,17 @@ broadcast, and enter the identical explain call so the mesh's collectives
 line up.  Responses are built on the lead only (host-side work, no
 collectives).  Shutdown is a zero header broadcast.
 
-Pipelining note: the lock-step protocol requires one device call at a time
-in a deterministic order, so the multihost model deliberately does NOT
-expose ``explain_batch_async`` — the server then runs its synchronous
-dispatch path and ``pipeline_depth`` is forced to 1.  Within one coalesced
-batch the device work is still fully sharded across all hosts' devices.
+Pipelining: the base protocol is lock-step (one device call at a time —
+the model does not expose ``explain_batch_async``, the server dispatches
+synchronously, ``pipeline_depth`` is 1), because a sharded fetch embeds a
+``process_allgather`` whose cross-process order concurrent finalizes would
+scramble.  With ``distributed_opts['replicate_results']=True`` the
+all-gather moves INSIDE the jitted program, fetches become local, and
+:class:`PipelinedMultihostServingModel` + the follower's async dispatch
+run several broadcast+explain calls in flight at the server's pipeline
+depth — collective order equals dispatch order on every process by
+construction.  Within one batch the device work is always fully sharded
+across all hosts' devices either way.
 """
 
 import logging
@@ -86,25 +92,33 @@ class MultihostServingModel:
     # the server treats the absence of explain_batch_async as "dispatch
     # synchronously" — exactly what the lock-step protocol needs.
 
-    def explain_batch(self, stacked: np.ndarray, split_sizes=None):
+    def _broadcast_batch(self, stacked: np.ndarray) -> np.ndarray:
+        """Validate + frame + broadcast one batch (caller holds
+        ``_bcast_lock``); ONE implementation of the wire protocol so the
+        sync and pipelined dispatch paths cannot drift their framing."""
+
         stacked = np.atleast_2d(np.asarray(stacked, dtype=np.float32))
         rows = stacked.shape[0]
         if rows > self.max_rows:
             raise ValueError(
                 f"batch of {rows} rows exceeds the multihost broadcast slot "
                 f"({self.max_rows}); raise max_rows or lower max_batch_size")
+        if self._shut:
+            # a batch the dispatcher popped before stop(): fail it as a
+            # per-request error instead of broadcasting into a mesh whose
+            # followers have already exited (peerless collective =
+            # permanent hang)
+            raise RuntimeError("multihost serving mesh already shut down")
         header = np.array([_CMD_EXPLAIN, rows], np.int32)
         padded = np.zeros((self.max_rows, self._n_features), np.float32)
         padded[:rows] = stacked
+        _broadcast(header, is_source=True)
+        _broadcast(padded, is_source=True)
+        return stacked
+
+    def explain_batch(self, stacked: np.ndarray, split_sizes=None):
         with self._bcast_lock:
-            if self._shut:
-                # a batch the dispatcher popped before stop(): fail it as a
-                # per-request error instead of broadcasting into a mesh
-                # whose followers have already exited (peerless collective
-                # = permanent hang)
-                raise RuntimeError("multihost serving mesh already shut down")
-            _broadcast(header, is_source=True)
-            _broadcast(padded, is_source=True)
+            stacked = self._broadcast_batch(stacked)
             return self.model.explain_batch(stacked, split_sizes=split_sizes)
 
     def shutdown_followers(self):
@@ -132,7 +146,15 @@ def follower_loop(model, max_rows: int = 256):
 
     if jax.process_index() == 0:
         raise RuntimeError("follower_loop must not run on the lead process")
-    n_features = int(model.explainer._explainer.background.shape[1])
+    inner = model.explainer._explainer
+    n_features = int(inner.background.shape[1])
+    # pipelined protocol (replicated results): the follower only needs to
+    # ENTER each device program in broadcast order — dispatch async and
+    # drop the finalize (it fetches nothing the follower uses; buffers free
+    # once execution completes), so the loop returns to the broadcast
+    # immediately and the lead can run several calls in flight
+    pipelined = getattr(inner, 'replicate_results', False) \
+        and hasattr(inner, 'get_explanation_async')
     while True:
         header = _broadcast(np.zeros(2, np.int32), is_source=False)
         if int(header[0]) == _CMD_SHUTDOWN:
@@ -141,6 +163,15 @@ def follower_loop(model, max_rows: int = 256):
         rows = int(header[1])
         padded = _broadcast(np.zeros((max_rows, n_features), np.float32),
                             is_source=False)
+        if pipelined:
+            try:
+                inner.get_explanation_async(padded[:rows],
+                                            **model.explain_kwargs)
+            except Exception:
+                logger.exception(
+                    "follower %d: async dispatch failed; staying in loop",
+                    jax.process_index())
+            continue
         # identical DEVICE call as the lead's explain_batch (explain_batch
         # == explainer.explain + host-side response building): same bucket
         # padding, same sharded program, same collective sequence — but the
@@ -165,6 +196,39 @@ def follower_loop(model, max_rows: int = 256):
             # wiring.)
             logger.exception("follower %d: explain failed; staying in loop",
                              jax.process_index())
+
+
+class PipelinedMultihostServingModel(MultihostServingModel):
+    """Broadcast-protocol serving model whose device calls PIPELINE.
+
+    Requires the wrapped model's explainer to be a ``DistributedExplainer``
+    built with ``distributed_opts['replicate_results']=True``: phi/f(x)
+    are then all-gathered INSIDE the jitted program, so the lead's fetch
+    is a local D2H with no collective and may run on any finalizer thread
+    — collective order equals dispatch order on every process by
+    construction (all broadcasts + dispatches happen on the lead's single
+    dispatcher thread, and the follower's loop mirrors them in the same
+    order with async dispatches).  ``serve_multihost`` selects this class
+    automatically; the lock-step base class remains for explainers without
+    replicated results."""
+
+    def __init__(self, model, max_rows: int = 256):
+        super().__init__(model, max_rows=max_rows)
+        inner = model.explainer._explainer
+        if not getattr(inner, 'replicate_results', False):
+            raise ValueError(
+                "PipelinedMultihostServingModel needs "
+                "distributed_opts['replicate_results']=True (fetches must "
+                "be collective-free for pipelined finalizes)")
+
+    def explain_batch_async(self, stacked: np.ndarray, split_sizes=None):
+        with self._bcast_lock:
+            stacked = self._broadcast_batch(stacked)
+            # dispatch INSIDE the lock: broadcast->dispatch must be atomic
+            # against a concurrent shutdown broadcast, and the server's
+            # single dispatcher thread is the only explain caller anyway
+            return self.model.explain_batch_async(stacked,
+                                                  split_sizes=split_sizes)
 
 
 def follower_health_server(port: int):
@@ -210,7 +274,8 @@ def serve_multihost(predictor, background_data, constructor_kwargs,
                     fit_kwargs, distributed_opts, host: str = "0.0.0.0",
                     port: int = 8000, max_batch_size: int = 1,
                     max_rows: int = 256,
-                    explain_kwargs: Optional[dict] = None):
+                    explain_kwargs: Optional[dict] = None,
+                    pipeline_depth: Optional[int] = 4):
     """Entry point for every process of a multi-host serve deployment.
 
     On the lead process: builds the fitted model over the multi-host mesh,
@@ -243,8 +308,36 @@ def serve_multihost(predictor, background_data, constructor_kwargs,
             health.shutdown()
             health.server_close()
         return None
-    model = MultihostServingModel(base, max_rows=max_rows)
-    server = ExplainerServer(model, host=host, port=port,
-                             max_batch_size=max_batch_size,
-                             pipeline_depth=1)
+    pipelined = bool(dict(distributed_opts).get("replicate_results"))
+    if pipelined:
+        # the deployment's explain options must actually take the async
+        # fast path — otherwise every request lands in the synchronous
+        # fallback inside the broadcast lock and the per-call in-program
+        # all-gather is pure cost with no pipelining.  Detect it here and
+        # degrade loudly to the lock-step protocol.
+        inner = base.explainer._explainer
+        kw = dict(base.explain_kwargs)
+        nsamples_kw = kw.get("nsamples")
+        l1_kw = kw.get("l1_reg", "auto")
+        if (kw.get("interactions") or nsamples_kw == "exact"
+                or inner._l1_active(l1_kw, nsamples_kw)):
+            logger.warning(
+                "replicate_results=True but explain options (%r) route "
+                "every request through the synchronous fallback (exact / "
+                "interactions / active l1 selection); serving LOCK-STEP "
+                "instead — drop those options or set l1_reg=False to "
+                "pipeline.", kw)
+            pipelined = False
+    if pipelined:
+        # replicated results -> collective-free fetches -> the broadcast
+        # protocol pipelines at the server's calibrated depth
+        model = PipelinedMultihostServingModel(base, max_rows=max_rows)
+        server = ExplainerServer(model, host=host, port=port,
+                                 max_batch_size=max_batch_size,
+                                 pipeline_depth=pipeline_depth)
+    else:
+        model = MultihostServingModel(base, max_rows=max_rows)
+        server = ExplainerServer(model, host=host, port=port,
+                                 max_batch_size=max_batch_size,
+                                 pipeline_depth=1)
     return server.start()

@@ -430,3 +430,40 @@ def test_mesh_serving_pipelines_and_aligns():
                 got, np.stack([v[0] for v in want]), atol=1e-5)
     finally:
         srv.stop()
+
+
+def test_replicate_results_matches_sharded_output():
+    """distributed_opts['replicate_results']: the in-program all-gather
+    variant must produce identical phi/f(x) to the default data-sharded
+    output, on both partitioning paths, and enable async dispatch."""
+
+    import numpy as np
+
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.models import LinearPredictor
+
+    rng = np.random.default_rng(8)
+    D, K, N, B = 6, 2, 10, 12
+    W = rng.normal(size=(D, K)).astype(np.float32)
+    pred = LinearPredictor(W, np.zeros(K, np.float32), activation="softmax")
+    bg = rng.normal(size=(N, D)).astype(np.float32)
+    X = rng.normal(size=(B, D)).astype(np.float32)
+
+    def run(**extra):
+        ex = KernelShap(pred, link="identity", seed=0,
+                        distributed_opts={"n_devices": 4, **extra})
+        ex.fit(bg)
+        return ex, ex.explain(X, silent=True, nsamples=64,
+                              l1_reg=False).shap_values
+
+    _, want = run()
+    for opts in ({"replicate_results": True},
+                 {"replicate_results": True, "partitioning": "gspmd"}):
+        ex, got = run(**opts)
+        for a, b in zip(want, got):
+            np.testing.assert_allclose(a, b, atol=1e-6)
+        assert ex._explainer.replicate_results
+        values, _ = ex._explainer.get_explanation_async(
+            X, nsamples=64, l1_reg=False)()
+        for a, b in zip(want, values):
+            np.testing.assert_allclose(a, b, atol=1e-6)

@@ -208,9 +208,67 @@ initialize_multihost("127.0.0.1:" + sys.argv[2], 2, pid)
 assert jax.process_count() == 2
 
 sys.path.insert(0, {tests_dir!r})
-from test_multihost import _serve_tiny
-_serve_tiny(sys.argv[3] + "/served.npy")
+import test_multihost
+getattr(test_multihost, sys.argv[4])(sys.argv[3] + "/served.npy")
 """
+
+
+def _serve_tiny_pipelined(out_file):
+    """Pipelined broadcast-protocol serving (round 4): replicated results
+    make fetches collective-free, so the lead runs several broadcast +
+    explain calls in flight (pipeline_depth=3, uncoalesced single-row
+    requests) while followers dispatch asynchronously.  Saves served phi +
+    a direct sharded explain for comparison."""
+
+    import json as _json
+
+    import numpy as np
+
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.serving import client as cl
+    from distributedkernelshap_tpu.serving.multihost import serve_multihost
+
+    rng = np.random.default_rng(0)
+    D, K, N = 6, 3, 12
+    W = rng.normal(size=(D, K)).astype(np.float32)
+    bg = rng.normal(size=(N, D)).astype(np.float32)
+    X = rng.normal(size=(8, D)).astype(np.float32)
+
+    def pred(A):
+        import jax.numpy as jnp
+
+        z = A @ W
+        return jnp.exp(z) / jnp.exp(z).sum(-1, keepdims=True)
+
+    opts = {"n_devices": N_DEVICES, "replicate_results": True}
+    ex = KernelShap(pred, link="identity", seed=0, distributed_opts=opts)
+    ex.fit(bg)
+    direct = np.stack(
+        ex.explain(X, silent=True, nsamples=64, l1_reg=False).shap_values, 1)
+
+    srv = serve_multihost(pred, bg, {"link": "identity", "seed": 0},
+                          {}, opts, host="127.0.0.1",
+                          port=0, max_batch_size=1, max_rows=16,
+                          pipeline_depth=3,
+                          explain_kwargs={"nsamples": 64, "l1_reg": False})
+    if srv is None:
+        return None  # follower: released by the shutdown broadcast
+    try:
+        from distributedkernelshap_tpu.serving.multihost import (
+            PipelinedMultihostServingModel,
+        )
+
+        assert isinstance(srv.model, PipelinedMultihostServingModel)
+        assert srv.pipeline_depth == 3
+        payloads = cl.distribute_requests(
+            f"http://127.0.0.1:{srv.port}/explain", X, max_workers=8)
+        phi = np.stack([
+            np.asarray(_json.loads(p)["data"]["shap_values"])[:, 0]
+            for p in payloads])
+    finally:
+        srv.stop()
+        srv.model.shutdown_followers()
+    np.save(out_file, np.stack([phi, direct]))
 
 
 def test_two_process_serving_matches_direct_explain(tmp_path):
@@ -225,7 +283,28 @@ def test_two_process_serving_matches_direct_explain(tmp_path):
     worker.write_text(_SERVE_WORKER.format(
         repo=REPO, tests_dir=os.path.dirname(os.path.abspath(__file__))))
     _run_two_procs(tmp_path, lambda pid: [
-        sys.executable, str(worker), str(pid), str(port), str(tmp_path)])
+        sys.executable, str(worker), str(pid), str(port), str(tmp_path),
+        "_serve_tiny"])
+
+    served, direct = np.load(tmp_path / "served.npy")
+    np.testing.assert_allclose(served, direct, atol=1e-5)
+
+
+def test_two_process_serving_pipelined_matches_direct_explain(tmp_path):
+    """Round 4: the PIPELINED broadcast protocol (replicate_results=True,
+    depth 3, uncoalesced single-row requests, follower async dispatch)
+    must serve phi equal to a direct sharded explain — several collective
+    programs in flight across a REAL process boundary."""
+
+    import numpy as np
+
+    port = _free_port()
+    worker = tmp_path / "serve_worker.py"
+    worker.write_text(_SERVE_WORKER.format(
+        repo=REPO, tests_dir=os.path.dirname(os.path.abspath(__file__))))
+    _run_two_procs(tmp_path, lambda pid: [
+        sys.executable, str(worker), str(pid), str(port), str(tmp_path),
+        "_serve_tiny_pipelined"])
 
     served, direct = np.load(tmp_path / "served.npy")
     np.testing.assert_allclose(served, direct, atol=1e-5)

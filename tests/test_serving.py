@@ -793,3 +793,43 @@ def test_metrics_expose_wedge_counters(model_setup):
         assert "dks_serve_wedged 0" in text
     finally:
         srv.stop()
+
+
+def test_serve_multihost_pipelined_selection(model_setup):
+    """serve_multihost (single-process semantics here) must select the
+    PIPELINED broadcast model only when the deployment's explain options
+    actually take the async fast path; otherwise it degrades loudly to
+    lock-step rather than paying the in-program all-gather for nothing."""
+
+    from distributedkernelshap_tpu.serving.multihost import (
+        MultihostServingModel,
+        PipelinedMultihostServingModel,
+        serve_multihost,
+    )
+
+    s = model_setup
+    opts = {"n_devices": 4, "replicate_results": True}
+
+    srv = serve_multihost(s["pred"], s["bg"], {"link": "logit", "seed": 0},
+                          {}, opts, host="127.0.0.1", port=0, max_rows=16,
+                          pipeline_depth=3,
+                          explain_kwargs={"nsamples": 64, "l1_reg": False})
+    try:
+        assert type(srv.model) is PipelinedMultihostServingModel
+        assert srv.pipeline_depth == 3
+    finally:
+        srv.stop()
+        srv.model.shutdown_followers()
+
+    # exact-mode options route every request through the sync fallback:
+    # lock-step protocol, depth 1, no pipelined model
+    srv2 = serve_multihost(s["pred"], s["bg"], {"link": "logit", "seed": 0},
+                           {}, opts, host="127.0.0.1", port=0, max_rows=16,
+                           pipeline_depth=3,
+                           explain_kwargs={"nsamples": "exact"})
+    try:
+        assert type(srv2.model) is MultihostServingModel
+        assert srv2.pipeline_depth == 1
+    finally:
+        srv2.stop()
+        srv2.model.shutdown_followers()
