@@ -484,13 +484,16 @@ class DistributedExplainer:
         self.last_X_fingerprint = _fingerprint(X[:B])
         return split_shap_values(phi, engine.vector_out)
 
-    def _run_slabs(self, slabs, dispatch):
+    def _run_slabs(self, slabs, dispatch, fetch_is_local: bool = False):
         """Run the slab sequence through the shared bounded pipeline
         (``parallel/pipeline.py``): window resolved from the
         ``dispatch_window`` opt / env / a live RTT probe, fetches threaded
-        so their D2H round trips overlap — except on multi-host meshes,
-        where fetches embed collectives and must stay serial and
-        deterministically ordered across processes."""
+        so their D2H round trips overlap — except on multi-host meshes
+        with collective-bearing fetches, which must stay serial and
+        deterministically ordered across processes.  ``fetch_is_local``
+        is per CALL SITE (the sampled path under ``replicate_results``
+        fetches locally; the exact path's outputs stay data-sharded, so
+        its fetches embed collectives regardless of the flag)."""
 
         from distributedkernelshap_tpu.parallel.pipeline import (
             resolve_window,
@@ -506,7 +509,8 @@ class DistributedExplainer:
                      else self.engine.config.dispatch_window)
         window = resolve_window(requested, n_items=len(slabs))
         return run_pipeline(slabs, dispatch, self._fetch_sharded,
-                            window=window, threaded=not multihost)
+                            window=window,
+                            threaded=(not multihost) or fetch_is_local)
 
     def _slab_size(self) -> int:
         """Rows per sharded slab (``batch_size`` instances per device), or
@@ -561,6 +565,21 @@ class DistributedExplainer:
             acc = np.asarray(part) if acc is None else acc + np.asarray(part)
         return acc / B
 
+    def takes_async_fast_path(self, n_rows: int, nsamples=None,
+                              l1_reg='auto',
+                              interactions: bool = False) -> bool:
+        """Whether :meth:`get_explanation_async` would truly pipeline for a
+        batch of ``n_rows`` with these options, vs computing synchronously
+        in the fallback closure.  ONE implementation shared with
+        ``serve_multihost``'s pipelined-protocol selection (worst-case
+        batch = the broadcast slot) so the fallback matrix cannot drift
+        between the two."""
+
+        return not ((jax.process_count() > 1 and not self.replicate_results)
+                    or interactions or nsamples == 'exact'
+                    or self._needs_slabs(int(n_rows))
+                    or self.engine._l1_active(l1_reg, nsamples))
+
     def get_explanation_async(self, X: np.ndarray,
                               nsamples: Union[str, int, None] = None,
                               l1_reg: Union[str, float, int, None] = 'auto',
@@ -581,10 +600,9 @@ class DistributedExplainer:
         engine's fallback matrix."""
 
         X = np.atleast_2d(np.asarray(X, dtype=np.float32))
-        if ((jax.process_count() > 1 and not self.replicate_results)
-                or interactions or nsamples == 'exact'
-                or self._needs_slabs(X.shape[0])
-                or self.engine._l1_active(l1_reg, nsamples)):
+        if not self.takes_async_fast_path(X.shape[0], nsamples=nsamples,
+                                          l1_reg=l1_reg,
+                                          interactions=interactions):
             from distributedkernelshap_tpu.kernel_shap import (
                 _async_sync_fallback,
             )
@@ -656,7 +674,8 @@ class DistributedExplainer:
         # few slabs' inputs/outputs, not the whole global batch; result
         # order is preserved — no reordering machinery needed.
         results = self._run_slabs(
-            slabs, lambda s: self._dispatch_sharded(s, nsamples))
+            slabs, lambda s: self._dispatch_sharded(s, nsamples),
+            fetch_is_local=self.replicate_results)
         phi = np.concatenate([r[0] for r in results], 0)[:B]
         X = X[:B]
         self.last_raw_prediction = np.concatenate([r[1] for r in results], 0)[:B]

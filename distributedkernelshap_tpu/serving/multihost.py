@@ -317,16 +317,16 @@ def serve_multihost(predictor, background_data, constructor_kwargs,
         # degrade loudly to the lock-step protocol.
         inner = base.explainer._explainer
         kw = dict(base.explain_kwargs)
-        nsamples_kw = kw.get("nsamples")
-        l1_kw = kw.get("l1_reg", "auto")
-        if (kw.get("interactions") or nsamples_kw == "exact"
-                or inner._l1_active(l1_kw, nsamples_kw)):
+        if not inner.takes_async_fast_path(
+                max_rows, nsamples=kw.get("nsamples"),
+                l1_reg=kw.get("l1_reg", "auto"),
+                interactions=bool(kw.get("interactions"))):
             logger.warning(
                 "replicate_results=True but explain options (%r) route "
                 "every request through the synchronous fallback (exact / "
-                "interactions / active l1 selection); serving LOCK-STEP "
-                "instead — drop those options or set l1_reg=False to "
-                "pipeline.", kw)
+                "interactions / active l1 selection / slab-split batches); "
+                "serving LOCK-STEP instead — drop those options or set "
+                "l1_reg=False to pipeline.", kw)
             pipelined = False
     if pipelined:
         # replicated results -> collective-free fetches -> the broadcast
