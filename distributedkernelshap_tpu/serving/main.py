@@ -44,6 +44,11 @@ def main():
     parser.add_argument("--max_rows", default=256, type=int,
                         help="Multi-host broadcast slot (rows per stacked "
                              "batch).")
+    parser.add_argument("--replicate_results", action="store_true",
+                        help="Multi-host only: all-gather results inside "
+                             "the jitted program so the broadcast protocol "
+                             "PIPELINES device calls (serving/multihost.py) "
+                             "instead of running lock-step.")
     args = parser.parse_args()
     explain_kwargs = {"nsamples": "exact"} if args.exact else None
 
@@ -93,12 +98,15 @@ def main():
         initialize_multihost(args.coordinator, args.num_processes,
                              args.process_id)
         predictor, background, ctor_kwargs, fit_kwargs = _load_default_args()
+        opts = {"n_devices": len(jax.devices())}
+        if args.replicate_results:
+            opts["replicate_results"] = True
         server = serve_multihost(
-            predictor, background, ctor_kwargs, fit_kwargs,
-            {"n_devices": len(jax.devices())},
+            predictor, background, ctor_kwargs, fit_kwargs, opts,
             host=args.host, port=args.port,
             max_batch_size=args.max_batch_size, max_rows=args.max_rows,
             explain_kwargs=explain_kwargs,
+            pipeline_depth=args.pipeline_depth or None,
         )
         if server is None:
             logging.info("follower %d released; exiting", jax.process_index())
