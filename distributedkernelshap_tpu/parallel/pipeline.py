@@ -154,8 +154,14 @@ def resolve_window(requested: Optional[int] = None,
         # lead's resolution authoritative: broadcast once per key (the
         # broadcast is itself a blocking collective — per-call would tax
         # every explain), every process uses the same value, and a skew is
-        # a logged warning instead of a wedge.
-        cache_key = (resolved, cap)
+        # a logged warning instead of a wedge.  The key MUST be the inputs
+        # to resolution — (requested, env, cap) — not the locally-resolved
+        # value: under per-host env/config skew (the exact scenario the
+        # broadcast exists to survive) two call sites with different inputs
+        # can resolve to one value on this process but two on a peer, and a
+        # resolved-value key then yields asymmetric broadcast counts across
+        # processes — a permanent hang instead of the promised warning.
+        cache_key = (requested, os.environ.get("DKS_DISPATCH_WINDOW"), cap)
         if cache_key in _window_cache:
             return _window_cache[cache_key]
         from jax.experimental import multihost_utils

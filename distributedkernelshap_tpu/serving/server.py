@@ -231,6 +231,18 @@ class ExplainerServer:
 
     # ------------------------------------------------------------------ #
 
+    def _count_request(self, pending, error=None):
+        """Per-request counter accounting, shared by _complete's live loop
+        and the handler-side wedge claim so the two can never drift.
+        Caller MUST hold ``_metrics_lock``."""
+
+        self._metrics["requests_total"] += 1
+        self._metrics["rows_total"] += pending.array.shape[0]
+        if error is not None:
+            self._metrics["errors_total"] += 1
+        self._metrics["request_seconds_sum"] += (
+            time.monotonic() - pending.t_enqueued)
+
     def _complete(self, batch, payloads=None, error=None):
         # counters update BEFORE the response events: a client that gets
         # its answer and immediately scrapes /metrics must see itself
@@ -247,20 +259,20 @@ class ExplainerServer:
                 with self._active_lock:
                     self._active.pop(id(batch), None)
                 self._last_progress = time.monotonic()
-                if error is None and self._wedged.is_set():
-                    logger.warning("serving recovered: a previously failed "
-                                   "batch's device work completed")
-                    self._wedged.clear()
+                if error is None:
+                    # the device demonstrably finished a full batch — that is
+                    # what _ever_completed represents, so a first-batch wedge
+                    # that later recovers must graduate from the generous
+                    # first_batch_grace_s to the normal watchdog timeout
+                    self._ever_completed = True
+                    if self._wedged.is_set():
+                        logger.warning("serving recovered: a previously "
+                                       "failed batch's device work completed")
+                        self._wedged.clear()
                 return
             self._metrics["batches_total"] += 1
-            self._metrics["requests_total"] += len(live)
-            self._metrics["rows_total"] += sum(
-                p.array.shape[0] for _, p in live)
-            if error is not None:
-                self._metrics["errors_total"] += len(live)
-            now = time.monotonic()
-            self._metrics["request_seconds_sum"] += sum(
-                now - p.t_enqueued for _, p in live)
+            for _, p in live:
+                self._count_request(p, error)
         with self._active_lock:
             self._active.pop(id(batch), None)
         self._last_progress = time.monotonic()
@@ -599,6 +611,12 @@ class ExplainerServer:
                                 pending.error = (
                                     "server wedged: device made no progress "
                                     "within the watchdog timeout")
+                                # this claim bypasses _complete's live
+                                # loop, so count it via the shared helper —
+                                # error counters matter most exactly during
+                                # wedge incidents
+                                server._count_request(pending,
+                                                      pending.error)
                         if pending.error is not None:
                             break
                 if pending.error is not None:
