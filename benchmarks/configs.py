@@ -109,7 +109,7 @@ def config_adult(smoke=False):
     t, explanation = _timed_explain(ex, X)
     return {"metric": "adult_2560_bg100_wall_s", "value": round(t, 4), "unit": "s",
             "n_instances": X.shape[0], "additivity_err": _additivity(explanation),
-            "data_provenance": _prov(data)}
+            "data_provenance": _prov(data), "kernel_path": ex.kernel_path}
 
 
 def config_adult_stress(smoke=False):
@@ -141,7 +141,7 @@ def config_adult_stress(smoke=False):
     t, explanation = _timed_explain(ex, X, nsamples=2048)
     return {"metric": "adult_bg1000_ns2048_wall_s", "value": round(t, 4), "unit": "s",
             "n_instances": n_x, "additivity_err": _additivity(explanation),
-            "data_provenance": _prov(data)}
+            "data_provenance": _prov(data), "kernel_path": ex.kernel_path}
 
 
 def config_adult_blackbox(smoke=False):
@@ -172,15 +172,15 @@ def config_adult_blackbox(smoke=False):
 
     X = data["all"]["X"]["processed"]["test"].toarray()
     X = X[:16] if smoke else X[:256]
-    # sklearn/xgboost predict is reentrant: fan the host-eval chunks across
-    # every host core (a TPU-VM host has ~100+; this mirrors the reference's
-    # worker-pool parallelism for the part that stays on the host)
     # host_eval=True: force the host path even on backends that support
     # callbacks, so this config always measures the fan-out it advertises.
+    # host_eval_workers stays at its DEFAULT (auto: host core count) — the
+    # config proves the fan-out engages without configuration (VERDICT r4
+    # #7); the resolved worker count is reported below.
     # The explicit CallbackPredictor wrap keeps the model opaque — without it
     # as_predictor would lift the sklearn ensemble onto the device
     # (models/trees.py), which is what config_adult_trees measures instead
-    cfg = EngineConfig(host_eval=True, host_eval_workers=os.cpu_count() or 1)
+    cfg = EngineConfig(host_eval=True)
     pred = CallbackPredictor(clf.predict_proba, example_dim=Xtr.shape[1])
     ex = KernelShap(pred, link="logit", feature_names=gn, seed=0,
                     engine_config=cfg)
@@ -188,7 +188,8 @@ def config_adult_blackbox(smoke=False):
     t, explanation = _timed_explain(ex, X, nruns=1)
     return {"metric": "adult_blackbox_wall_s", "value": round(t, 4), "unit": "s",
             "n_instances": X.shape[0], "additivity_err": _additivity(explanation),
-            "data_provenance": _prov(data),
+            "data_provenance": _prov(data), "kernel_path": ex.kernel_path,
+            "host_eval_workers": ex.hosteval_workers,
             "predictor": type(clf).__name__}
 
 
@@ -232,7 +233,8 @@ def config_adult_trees(smoke=False):
             "n_instances": X.shape[0], "additivity_err": _additivity(explanation),
             "data_provenance": _prov(data),
             "model_err": _model_err(explanation, clf.predict_proba(X)),
-            "predictor": type(clf).__name__, "device_lifted": lifted}
+            "predictor": type(clf).__name__, "device_lifted": lifted,
+            "kernel_path": ex.kernel_path}
 
 
 def config_adult_trees_exact(smoke=False):
@@ -284,7 +286,8 @@ def config_adult_trees_exact(smoke=False):
             "speedup_vs_sampled": round(t_sampled / t_exact, 2),
             "model_err": err,
             "interactions_wall_s": round(t_inter, 4),
-            "interactions_rowsum_err": inter_err}
+            "interactions_rowsum_err": inter_err,
+            "kernel_path": ex.kernel_path}
 
 
 def config_model_zoo(smoke=False):
@@ -388,7 +391,8 @@ def config_model_zoo(smoke=False):
         host = torch_callback(predictor) if is_torch_module(predictor) else predictor
         families[fam_name] = {"wall_s": round(t, 4), "device_lifted": lifted,
                               "additivity_err": _additivity(explanation),
-                              "model_err": _model_err(explanation, host(X), link)}
+                              "model_err": _model_err(explanation, host(X), link),
+                              "kernel_path": ex.kernel_path}
     worst = max(v["wall_s"] for v in families.values())
     return {"metric": "model_zoo_worst_wall_s", "value": worst, "unit": "s",
             "n_instances": X.shape[0], "families": families,
@@ -425,10 +429,17 @@ def config_mnist(smoke=False):
     # ONE giant call — H2D/compute/D2H of successive chunks overlap, so the
     # config stops paying the session's full transfer latency serially
     # (12.25 s vs 5.02 s across 07-30/07-31 sessions was pure exposure to
-    # per-session tunnel throughput; VERDICT r2 item 5)
+    # per-session tunnel throughput; VERDICT r2 item 5).  f16 result
+    # transfer halves the remaining exposure — the 10k x 10 x 49 phi tensor
+    # (~19.6 MB f32) is the dominant D2H payload, and ~5e-4 absolute phi
+    # rounding stays far under the 1e-2 faithfulness bar (VERDICT r4 #5:
+    # kill the session-latency sensitivity in the design)
+    from distributedkernelshap_tpu.ops.explain import ShapConfig as _SC
+
     ex = KernelShap(pred, link="logit", feature_names=names, seed=0,
                     engine_config=None if smoke else EngineConfig(
-                        instance_chunk=2048))
+                        instance_chunk=2048,
+                        shap=_SC(transfer_dtype="float16")))
     ex.fit(bg, group_names=names, groups=groups)
     # l1_reg=False: with M=49 superpixels shap's 'auto' default would switch
     # to host-side AIC selection (sampled fraction << 0.2); keep the bench on
@@ -437,7 +448,8 @@ def config_mnist(smoke=False):
     return {"metric": "mnist_cnn_superpixel_wall_s", "value": round(t, 4), "unit": "s",
             "data_provenance": data.get("provenance", "synthetic"),
             "n_instances": X.shape[0], "cnn_test_acc": round(acc, 3),
-            "n_superpixels": len(groups), "additivity_err": _additivity(explanation)}
+            "n_superpixels": len(groups), "additivity_err": _additivity(explanation),
+            "kernel_path": ex.kernel_path}
 
 
 def config_covertype(smoke=False):
@@ -492,7 +504,8 @@ def config_covertype(smoke=False):
             "inst_per_s": round(X_explain.shape[0] / t, 1),
             "ranking_wall_s": round(t_rank, 4),
             "top_feature": ranking["aggregated"]["names"][0],
-            "additivity_err": _additivity(explanation)}
+            "additivity_err": _additivity(explanation),
+            "kernel_path": ex.kernel_path}
 
 
 CONFIGS = {

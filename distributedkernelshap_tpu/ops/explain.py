@@ -24,6 +24,7 @@ jits unchanged under ``jax.jit`` sharding on a device mesh (see
 ``parallel/``).
 """
 
+import contextvars
 import math
 from dataclasses import dataclass
 from typing import List, Optional, Sequence
@@ -34,6 +35,48 @@ import numpy as np
 
 from distributedkernelshap_tpu.models.predictors import ACTIVATIONS, BasePredictor
 from distributedkernelshap_tpu.ops.links import convert_to_link
+
+# ---------------------------------------------------------------------- #
+# Kernel-path recording (VERDICT r4 #2): every benchmark/A-B result must
+# say which evaluation kernel actually engaged, because the Pallas kernels
+# auto-degrade to XLA paths (Mosaic rejection, footprint gates) with only a
+# warning — a degraded run must never masquerade as a kernel measurement.
+# The choice points run at TRACE time (host Python inside jit tracing), so
+# a contextvar capture around the first dispatch records the truth about
+# what was staged, not a host-side re-derivation that could drift.
+
+_KERNEL_PATHS: contextvars.ContextVar = contextvars.ContextVar(
+    "dks_kernel_paths", default=None)
+
+
+class capture_kernel_paths:
+    """Context manager collecting ``{tag: path}`` choices made while tracing.
+
+    Tags: ``'ey'`` (sampled masked-eval), ``'exact_phi'`` /
+    ``'exact_inter'`` (closed-form TreeSHAP).  Paths: ``'pallas'`` (fused
+    kernel), ``'einsum'`` (XLA fast path), ``'masked_ey'``
+    (structure-aware predictor eval), ``'generic'`` (row-materialising
+    black-box eval).  Nothing is recorded for calls whose jitted fn was
+    already traced — callers should merge captures into persistent state
+    (``dict.update`` keeps earlier records when a capture comes back
+    empty)."""
+
+    def __enter__(self):
+        self._d: dict = {}
+        self._token = _KERNEL_PATHS.set(self._d)
+        return self._d
+
+    def __exit__(self, *exc):
+        _KERNEL_PATHS.reset(self._token)
+        return False
+
+
+def record_kernel_path(tag: str, path: str) -> None:
+    """Record a kernel choice into the active capture (no-op without one)."""
+
+    d = _KERNEL_PATHS.get()
+    if d is not None:
+        d[tag] = path
 
 
 @dataclass(frozen=True)
@@ -350,16 +393,22 @@ def build_explainer_fn(predictor: BasePredictor, config: ShapConfig = ShapConfig
         if linear is not None:
             W, b, activation = linear
             use_pallas = resolve_use_pallas(config.use_pallas)
+            # identity activation never reaches the kernel (_ey_linear
+            # collapses the N axis analytically before the pallas branch)
+            record_kernel_path('ey', 'pallas' if use_pallas
+                               and activation != 'identity' else 'einsum')
             chunk = config.coalition_chunk or _auto_chunk(S, B * N * K, config.target_chunk_elems)
             ey = _ey_linear(W, b, activation, X, bg, bgw_n, mask, G, chunk,
                             use_pallas=use_pallas)
         elif _use_masked_ey(predictor, B, N, S, mask.shape[1], config):
             # structure-aware path: split-condition / kernel sums separate
             # into instance and background halves (models/{trees,svm}.py)
+            record_kernel_path('ey', 'masked_ey')
             ey = predictor.masked_ey(X, bg, bgw_n, mask, G,
                                      config.target_chunk_elems,
                                      coalition_chunk=config.coalition_chunk)
         else:
+            record_kernel_path('ey', 'generic')
             zc = mask @ G  # (S, D) column-space masks
             chunk = config.coalition_chunk or _auto_chunk(S, B * N * D, config.target_chunk_elems)
             ey = _ey_generic(predictor, X, bg, bgw_n, zc, chunk)

@@ -322,6 +322,8 @@ def exact_shap_from_reach(pred, X, reach, bgw, G,
     use_kernel = (bg_chunk is None and resolve_use_pallas(use_pallas)
                   and exact_kernel_fits(min(N, n_slice), M, K)
                   and _exact_dmax(pred, M) <= 64)
+    from distributedkernelshap_tpu.ops.explain import record_kernel_path
+    record_kernel_path('exact_phi', 'pallas' if use_kernel else 'einsum')
     if use_kernel:
         B = X.shape[0]
         L = leaf_val.shape[1]
@@ -509,6 +511,8 @@ def exact_interactions_from_reach(pred, X, reach, bgw, G,
     use_kernel = (bg_chunk is None and resolve_use_pallas(use_pallas)
                   and exact_inter_kernel_fits(min(N, n_slice), M, K)
                   and _exact_dmax(pred_t, M) <= 64)
+    from distributedkernelshap_tpu.ops.explain import record_kernel_path
+    record_kernel_path('exact_inter', 'pallas' if use_kernel else 'einsum')
     if use_kernel:
         B = X.shape[0]
         L = leaf_val.shape[1]

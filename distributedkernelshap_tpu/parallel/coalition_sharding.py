@@ -72,8 +72,12 @@ def build_coalition_sharded_fn(predictor: BasePredictor,
         N = bg.shape[0]
         K = predictor.n_outputs
         S_local = mask_local.shape[0]
+        from distributedkernelshap_tpu.ops.explain import record_kernel_path
+
         if linear is not None:
             W, b, activation = linear
+            record_kernel_path('ey', 'pallas' if use_pallas
+                               and activation != 'identity' else 'einsum')
             chunk = config.coalition_chunk or _auto_chunk(S_local, B * N * K,
                                                           config.target_chunk_elems)
             return _ey_linear(W, b, activation, X, bg, bgw_n, mask_local, G,
@@ -82,9 +86,11 @@ def build_coalition_sharded_fn(predictor: BasePredictor,
 
         if _use_masked_ey(predictor, B, N, S_local, mask_local.shape[1], config):
             # per-shard coalition rows through the structure-aware fast path
+            record_kernel_path('ey', 'masked_ey')
             return predictor.masked_ey(X, bg, bgw_n, mask_local, G,
                                        config.target_chunk_elems,
                                        coalition_chunk=config.coalition_chunk)
+        record_kernel_path('ey', 'generic')
         zc_local = mask_local @ G
         chunk = config.coalition_chunk or _auto_chunk(S_local, B * N * D,
                                                       config.target_chunk_elems)

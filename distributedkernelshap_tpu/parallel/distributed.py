@@ -289,7 +289,11 @@ class DistributedExplainer:
 
         engine = self.engine
         X, B = self._pad_sharded(X)
-        out = fn(jnp.asarray(X, jnp.float32), *args)
+        from distributedkernelshap_tpu.ops.explain import capture_kernel_paths
+
+        with capture_kernel_paths() as kp:  # records only on first trace
+            out = fn(jnp.asarray(X, jnp.float32), *args)
+        engine._kernel_paths.update(kp)  # kernel_path proxies via __getattr__
         # one packed D2H instead of two (tunnelled transfers are latency-bound);
         # with transfer_dtype set only the wide segment (phi + interactions)
         # rides the reduced dtype — f(x) is B*K floats and stays f32
@@ -551,18 +555,24 @@ class DistributedExplainer:
             # XLA inserts the cross-device reduction, output is replicated
             self._jit_cache['imp_reduce'] = jax.jit(
                 lambda phi, w: jnp.einsum('bkm,b->km', jnp.abs(phi), w))
+        from distributedkernelshap_tpu.ops.explain import capture_kernel_paths
+
         acc = None
-        for c in slabs:
-            Xc, Bc = self._pad_sharded(c)
-            mask = np.zeros(Xc.shape[0], np.float32)
-            mask[:Bc] = 1.0
-            out = fn(jnp.asarray(Xc, jnp.float32), *args)
-            part = self._jit_cache['imp_reduce'](out['shap_values'],
-                                                 jnp.asarray(mask))
-            # np.asarray works on the fully-REPLICATED jit output even
-            # multi-host, while an eager `+` on it would raise (not fully
-            # addressable); the partial is K*M floats — host-summing is free
-            acc = np.asarray(part) if acc is None else acc + np.asarray(part)
+        with capture_kernel_paths() as kp:  # this loop traces fn directly
+            for c in slabs:
+                Xc, Bc = self._pad_sharded(c)
+                mask = np.zeros(Xc.shape[0], np.float32)
+                mask[:Bc] = 1.0
+                out = fn(jnp.asarray(Xc, jnp.float32), *args)
+                part = self._jit_cache['imp_reduce'](out['shap_values'],
+                                                     jnp.asarray(mask))
+                # np.asarray works on the fully-REPLICATED jit output even
+                # multi-host, while an eager `+` on it would raise (not fully
+                # addressable); the partial is K*M floats — host-summing is
+                # free
+                acc = np.asarray(part) if acc is None else \
+                    acc + np.asarray(part)
+        engine._kernel_paths.update(kp)
         return acc / B
 
     def takes_async_fast_path(self, n_rows: int, nsamples=None,

@@ -467,3 +467,32 @@ def test_replicate_results_matches_sharded_output():
             X, nsamples=64, l1_reg=False)()
         for a, b in zip(want, values):
             np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_kernel_path_recorded_on_sharded_paths(setup):
+    """VERDICT r4 #2 on the DISTRIBUTED paths: every trace-bearing dispatch
+    (the sharded explain AND get_importance's direct fn loop) must record
+    which evaluation kernel engaged, surfaced via the engine proxy."""
+
+    dist = DistributedExplainer(
+        {"n_devices": 8, "batch_size": None, "algorithm": "kernel_shap"},
+        KernelExplainerEngine,
+        (setup["pred"], setup["data"]),
+        {"link": "logit", "seed": 0},
+    )
+    dist.get_explanation(setup["X"], nsamples=64)
+    kp = dist.kernel_path  # proxies to the engine via __getattr__
+    assert kp.get("ey") in ("pallas", "einsum"), kp  # linear predictor path
+    assert kp["pallas_degrades"] == 0
+
+    # a fresh explainer exercising ONLY get_importance must record too
+    # (it traces fn directly, outside _dispatch_call)
+    dist2 = DistributedExplainer(
+        {"n_devices": 8, "batch_size": None, "algorithm": "kernel_shap"},
+        KernelExplainerEngine,
+        (setup["pred"], setup["data"]),
+        {"link": "logit", "seed": 0},
+    )
+    dist2.get_importance(setup["X"], nsamples=64)
+    assert dist2.kernel_path.get("ey") in ("pallas", "einsum"), \
+        dist2.kernel_path
