@@ -1,0 +1,141 @@
+"""On-chip evidence cache shared by every benchmark protocol.
+
+The TPU relay's uptime windows rarely align with the driver's end-of-round
+``bench.py`` run (rounds 1-4: rc=124, 1, 1, 1 — while committed on-chip
+sessions existed in ``results/tpu_revalidate.jsonl`` each round).  Round 4
+cached ``bench.py``'s own successes only, which left the cache empty when
+the round's on-chip sessions ran other protocols (VERDICT r4 missing #1).
+
+This module closes that hole: EVERY protocol that measures the headline
+task on a non-CPU backend (``bench.py``, ``tpu_revalidate.py``'s
+``config:adult`` step, the pool benchmark's w=1/b=2560 point, the recovery
+watcher) records its success here, labelled with the protocol, capture
+time and code version — so ONE healthy relay window anywhere in the round,
+under ANY protocol, puts an on-chip number into the driver artifact.
+
+The cache is a single JSON file (``results/bench_last_success.json``),
+written atomically; readers treat a missing/corrupt file as "no evidence".
+"""
+
+import functools
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: the one cache file every protocol feeds and ``bench.py`` attaches
+CACHE_PATH = os.path.join(REPO_ROOT, "results", "bench_last_success.json")
+
+
+@functools.lru_cache(maxsize=1)
+def code_version() -> str:
+    """Short commit hash of the code that produced a measurement (ties a
+    cached record to what was benchmarked; 'unknown' outside a checkout).
+    Cached — constant for the process lifetime, and callers emit it once
+    per record (a 24 h watch emits hundreds)."""
+
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            cwd=REPO_ROOT, timeout=10)
+        if out.returncode == 0:
+            return out.stdout.decode().strip() or "unknown"
+    except (OSError, subprocess.TimeoutExpired):
+        pass
+    return "unknown"
+
+
+def device_probe(timeout_s: float) -> Tuple[bool, str]:
+    """Probe backend init in a throwaway child; ``(ok, detail)``.
+
+    The ONE copy of the kill-a-TPU-client-safely ladder, shared by
+    ``bench.py`` and ``tpu_watch.py``: a killed TPU client can wedge the
+    tunnel relay so that backend init blocks forever (uninterruptibly, in
+    C) for every later process — probing in a child lets callers fail fast
+    with a bounded wait, and the SIGTERM→wait→SIGKILL→wait escalation
+    mirrors how a shell ``timeout`` would end it.  NB: killing a client
+    during a slow-but-progressing first init (the recovery window after a
+    wedge) can RE-wedge the relay, so callers must give ``timeout_s`` the
+    full worst-healthy-init patience (~590 s for the watcher) and never
+    probe concurrently.
+    """
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+    try:
+        _, err = proc.communicate(timeout=timeout_s)
+        if proc.returncode == 0:
+            return True, ""
+        return False, err.decode(errors="replace").strip()[-400:]
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            try:
+                proc.communicate(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass  # unkillable child: leave it behind rather than hang
+        return False, f"backend init did not complete within {timeout_s:.0f}s"
+
+
+def record_onchip_success(record: dict, protocol: str,
+                          cache_path: str = None) -> bool:
+    """Persist an on-chip headline measurement for the wedged-path artifact.
+
+    ``record`` must carry a numeric ``value`` (seconds for the headline
+    2560-instance Adult explain) and SHOULD carry ``platform`` — records
+    whose platform is ``'cpu'`` are refused (the cache exists precisely so
+    CPU fallbacks never impersonate chip evidence).  Returns True when the
+    cache was written.  Best-effort: IO errors never propagate into the
+    measuring process (the printed/logged line remains the contract there).
+    """
+
+    path = cache_path or CACHE_PATH
+    # a MISSING platform is refused too: a protocol that forgets to stamp
+    # it while running on the CPU backend would otherwise cache a CPU
+    # number as chip evidence — the exact impersonation this gate prevents
+    if record.get("platform") in (None, "cpu"):
+        return False
+    if not isinstance(record.get("value"), (int, float)):
+        return False
+    try:
+        stamped = dict(record, captured_unix=time.time(),
+                       code_version=code_version(), protocol=protocol)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        # atomic replace: a concurrently-wedging driver invocation must
+        # never read a half-written cache (that race window is exactly what
+        # this cache exists to cover)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(stamped, f)
+        os.replace(tmp, path)
+        return True
+    except OSError:
+        return False
+
+
+def load_last_onchip(cache_path: str = None) -> Optional[dict]:
+    """The most recent on-chip success (any protocol) with its age, or
+    ``None``.  The returned dict carries ``age_hours`` plus a note making
+    clear it is cached evidence, not the current invocation's measurement."""
+
+    path = cache_path or CACHE_PATH
+    try:
+        with open(path) as f:
+            last = json.load(f)
+        age_h = (time.time() - float(last.pop("captured_unix"))) / 3600.0
+        return dict(
+            last, age_hours=round(age_h, 2),
+            note="cached on-chip run from an earlier session this round; "
+                 "NOT measured by this invocation — protocol says which "
+                 "benchmark captured it, age_hours how stale, code_version "
+                 "what was benchmarked")
+    except (OSError, ValueError, KeyError, TypeError):
+        return None

@@ -1,0 +1,115 @@
+"""On-chip A/B of the fused exact-TreeSHAP Pallas kernels vs the XLA
+einsum path (VERDICT r4 #3: "make exact ≤ sampled on chip").
+
+For the Adult-GBT headline shape (B=256 instances, bg=100, M=12 groups,
+HistGradientBoostingRegressor(max_iter=50)) this measures, in ONE session:
+
+* ``nsamples='exact'`` phi with ``use_pallas=True`` and ``False``;
+* exact interaction matrices under both settings;
+* the sampled KernelSHAP baseline on the same model/instances —
+  the number exact has to beat for the round-3 directive.
+
+Every row carries ``kernel_path`` (recorded at trace time,
+``ops/explain.capture_kernel_paths``) and the engine's ``pallas_degrades``
+counter, so a Mosaic rejection that silently degrades the staged kernel to
+einsum is visible in the artifact instead of masquerading as a kernel
+measurement (VERDICT r4 #2/weak #6 — the round-4 shell A/B could not tell).
+
+Appends JSON lines to ``results/exact_ab.jsonl`` and prints them.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks._evidence import REPO_ROOT, code_version  # noqa: E402
+
+OUT = os.path.join(REPO_ROOT, "results", "exact_ab.jsonl")
+
+
+def _emit(record):
+    record = dict(record, ts=time.strftime("%Y-%m-%dT%H:%M:%S"),
+                  code_version=code_version())
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(record) + "\n")
+    print(json.dumps(record), flush=True)
+
+
+def main() -> int:
+    import jax
+    import scipy.sparse as sp
+    from sklearn.ensemble import HistGradientBoostingRegressor
+
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.kernel_shap import EngineConfig
+    from distributedkernelshap_tpu.models import TreeEnsemblePredictor
+    from distributedkernelshap_tpu.ops.explain import ShapConfig
+    from distributedkernelshap_tpu.utils import load_data
+
+    _emit({"step": "backend", "backend": jax.default_backend(),
+           "devices": [str(d) for d in jax.devices()]})
+
+    data = load_data()
+    gn, g = data["all"]["group_names"], data["all"]["groups"]
+    Xtr = data["all"]["X"]["processed"]["train"].toarray()
+    ytr = data["all"]["y"]["train"].astype(np.float64)
+    gbr = HistGradientBoostingRegressor(max_iter=50, random_state=0).fit(
+        Xtr, ytr)
+    X = data["all"]["X"]["processed"]["test"].toarray().astype(np.float32)[:256]
+    bgd = data["background"]["X"]["preprocessed"]
+    bg = bgd.toarray() if sp.issparse(bgd) else np.asarray(bgd)
+
+    for pallas in (True, False):
+        ex = KernelShap(gbr.predict, seed=0,
+                        engine_config=EngineConfig(
+                            shap=ShapConfig(use_pallas=pallas)))
+        ex.fit(bg, group_names=gn, groups=g)
+        assert isinstance(ex._explainer.predictor, TreeEnsemblePredictor)
+
+        # --- exact phi -------------------------------------------------- #
+        ex.explain(X, silent=True, nsamples="exact")  # warm/compile
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            r = ex.explain(X, silent=True, nsamples="exact")
+            ts.append(time.perf_counter() - t0)
+        total = (np.asarray(r.shap_values).sum(-1).ravel()
+                 + np.ravel(r.expected_value)[0])
+        err = float(np.abs(total - gbr.predict(X.astype(np.float64))).max())
+        _emit({"step": f"exact_phi_pallas_{pallas}",
+               "wall_s": round(float(np.median(ts)), 4), "model_err": err,
+               "kernel_path": ex.kernel_path})
+
+        # --- exact interactions ----------------------------------------- #
+        ex.explain(X, silent=True, nsamples="exact", interactions=True)
+        t0 = time.perf_counter()
+        ri = ex.explain(X, silent=True, nsamples="exact", interactions=True)
+        ti = time.perf_counter() - t0
+        iv = ri.data["raw"]["interaction_values"][0]
+        ierr = float(np.abs(iv.sum(-1) - np.asarray(ri.shap_values[0])).max())
+        _emit({"step": f"exact_inter_pallas_{pallas}",
+               "wall_s": round(ti, 4), "rowsum_err": ierr,
+               "kernel_path": ex.kernel_path})
+
+        # --- sampled baseline (the bar exact must beat on chip) ---------- #
+        if pallas:  # one measurement is enough; it shares the model
+            ex.explain(X, silent=True, l1_reg=False)  # warm
+            ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                ex.explain(X, silent=True, l1_reg=False)
+                ts.append(time.perf_counter() - t0)
+            _emit({"step": "sampled_baseline",
+                   "wall_s": round(float(np.median(ts)), 4),
+                   "kernel_path": ex.kernel_path})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

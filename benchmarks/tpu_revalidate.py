@@ -43,17 +43,43 @@ def _emit(record):
     print(json.dumps(record), flush=True)
 
 
-def _step(name, fn):
+def _step(name, fn, on_success=None):
     t0 = time.monotonic()
     try:
         result = fn()
         _emit({"step": name, "ok": True,
                "elapsed_s": round(time.monotonic() - t0, 1),
                "result": result})
+        if on_success is not None:
+            try:
+                on_success(result)
+            except Exception as e:
+                # evidence capture must never fail the sweep — but a broken
+                # feed must be VISIBLE in the log, or an empty cache at
+                # driver time is indistinguishable from 'no healthy window'
+                _emit({"step": f"{name}:evidence_capture", "ok": False,
+                       "error": f"{type(e).__name__}: {e}"})
     except Exception as e:  # keep the session going; later steps still run
         _emit({"step": name, "ok": False,
                "elapsed_s": round(time.monotonic() - t0, 1),
                "error": f"{type(e).__name__}: {e}"})
+
+
+def _cache_headline(result):
+    """Feed the shared on-chip evidence cache (benchmarks/_evidence.py) from
+    this protocol's headline-task measurement, so a wedged driver-time
+    ``bench.py`` still attaches a labelled on-chip number (VERDICT r4 #1)."""
+
+    import jax
+
+    from benchmarks._evidence import record_onchip_success
+
+    if not record_onchip_success(
+            dict(result, platform=jax.default_backend()),
+            protocol="tpu_revalidate:config:adult"):
+        # surfaces as a <step>:evidence_capture failure line in the log
+        raise RuntimeError("evidence cache refused the record "
+                           "(cpu platform, or no numeric value)")
 
 
 def main():
@@ -84,7 +110,8 @@ def main():
                  "mnist", "covertype", "model_zoo", "adult_blackbox"):
         if name in skip:
             continue
-        _step(f"config:{name}", lambda n=name: CONFIGS[n](smoke=False))
+        _step(f"config:{name}", lambda n=name: CONFIGS[n](smoke=False),
+              on_success=_cache_headline if name == "adult" else None)
 
     if "regression" not in skip:
         from benchmarks import tpu_regression_check
@@ -122,8 +149,30 @@ def main():
             run_explainer(ex, X, opts, nruns=3)
             return f"results/ray_workers_1_bsize_{batch}_actorfr_1.0.pkl"
 
+        def _cache_pool(pkl_path):
+            # the b=2560 pool point IS the headline task (all 2560 test
+            # instances, bg=100) under the reference's pool protocol — feed
+            # the shared evidence cache from its pickle
+            import pickle
+
+            import jax
+            import numpy as np
+
+            from benchmarks._evidence import record_onchip_success
+
+            with open(pkl_path, "rb") as f:
+                t = float(np.median(pickle.load(f)["t_elapsed"]))
+            if not record_onchip_success(
+                    {"metric": "adult_2560_bg100_wall_s",
+                     "value": round(t, 4), "unit": "s",
+                     "platform": jax.default_backend()},
+                    protocol="pool:w1_b2560"):
+                raise RuntimeError("evidence cache refused the record "
+                                   "(cpu platform, or no numeric value)")
+
         for batch in (320, 2560):
-            _step(f"pool:w1_b{batch}", lambda b=batch: pool_point(b))
+            _step(f"pool:w1_b{batch}", lambda b=batch: pool_point(b),
+                  on_success=_cache_pool if batch == 2560 else None)
 
     _emit({"step": "done", "ok": True})
 

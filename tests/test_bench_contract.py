@@ -251,12 +251,15 @@ def test_emit_error_attaches_cached_onchip_run(monkeypatch, tmp_path):
     wedged-path error JSON attaches the cached success, labelled with its
     age and explicitly NOT as this invocation's measurement."""
 
+    from benchmarks import _evidence
+
     cache = tmp_path / "bench_last_success.json"
     cache.write_text(json.dumps({
         "metric": bench._METRIC, "value": 0.15, "unit": "s",
         "vs_baseline": 833.7, "platform": "tpu",
+        "protocol": "tpu_revalidate:config:adult",
         "data_provenance": "uci", "captured_unix": time.time() - 7200}))
-    monkeypatch.setattr(bench, "_CACHE_PATH", str(cache))
+    monkeypatch.setattr(_evidence, "CACHE_PATH", str(cache))
     monkeypatch.setattr(bench, "_cpu_fallback", lambda t: (0.53, None))
     rc, out = _capture(bench._emit_error,
                        {"metric": bench._METRIC, "error": "wedged"},
@@ -265,16 +268,21 @@ def test_emit_error_attaches_cached_onchip_run(monkeypatch, tmp_path):
     rec = json.loads(out.strip().splitlines()[-1])
     assert rec["last_onchip"]["value"] == 0.15
     assert rec["last_onchip"]["platform"] == "tpu"
+    # the record says WHICH protocol captured it (any protocol may feed
+    # the shared cache since round 5 — benchmarks/_evidence.py)
+    assert rec["last_onchip"]["protocol"] == "tpu_revalidate:config:adult"
     assert 1.9 < rec["last_onchip"]["age_hours"] < 2.1
-    assert "NOT measured by this run" in rec["last_onchip"]["note"]
+    assert "NOT measured" in rec["last_onchip"]["note"]
     # the cached number must never migrate into the top-level value slot
     assert "value" not in rec
 
 
 def test_emit_error_ignores_corrupt_onchip_cache(monkeypatch, tmp_path):
+    from benchmarks import _evidence
+
     cache = tmp_path / "bench_last_success.json"
     cache.write_text("not json{")
-    monkeypatch.setattr(bench, "_CACHE_PATH", str(cache))
+    monkeypatch.setattr(_evidence, "CACHE_PATH", str(cache))
     monkeypatch.setattr(bench, "_cpu_fallback", lambda t: (0.53, None))
     rc, out = _capture(bench._emit_error,
                        {"metric": bench._METRIC, "error": "wedged"},
