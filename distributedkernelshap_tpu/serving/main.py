@@ -12,7 +12,6 @@ import signal
 import threading
 
 from distributedkernelshap_tpu.serving.server import serve_explainer
-from distributedkernelshap_tpu.utils import load_data, load_model
 
 logging.basicConfig(level=logging.INFO)
 
@@ -41,14 +40,21 @@ def main():
                              "(serving/multihost.py).")
     parser.add_argument("--num_processes", default=None, type=int)
     parser.add_argument("--process_id", default=None, type=int)
-    parser.add_argument("--max_rows", default=256, type=int,
+    parser.add_argument("--max_rows", default=None, type=int,
                         help="Multi-host broadcast slot (rows per stacked "
-                             "batch).")
+                             "batch); default 256.")
     parser.add_argument("--replicate_results", action="store_true",
                         help="Multi-host only: all-gather results inside "
                              "the jitted program so the broadcast protocol "
                              "PIPELINES device calls (serving/multihost.py) "
                              "instead of running lock-step.")
+    parser.add_argument("--replica_procs", default=0, type=int,
+                        help="Replica-per-chip mode: spawn this many "
+                             "crash-isolated single-device server PROCESSES "
+                             "(each pinned to one chip) behind a fan-in "
+                             "proxy on --port (serving/replicas.py) — the "
+                             "reference's num_replicas crash independence "
+                             "where the hardware allows it.")
     args = parser.parse_args()
     explain_kwargs = {"nsamples": "exact"} if args.exact else None
 
@@ -58,17 +64,36 @@ def main():
                      "(a would-be follower must never start its own server)")
 
     def _load_default_args():
-        from distributedkernelshap_tpu.utils import data_provenance
+        # ONE definition of the default Adult deployment tuple, shared with
+        # the replica workers so --replica_procs can never serve a
+        # different explainer than the single-process modes
+        from distributedkernelshap_tpu.serving.replica_worker import (
+            adult_factory,
+        )
 
-        data = load_data()
-        predictor = load_model()
-        group_names, groups = data["all"]["group_names"], data["all"]["groups"]
-        return (predictor, data["background"]["X"]["preprocessed"],
-                {"link": "logit", "feature_names": group_names, "seed": 0},
-                {"group_names": group_names, "groups": groups,
-                 "data_provenance": data_provenance(data)})
+        return adult_factory()
 
-    if args.coordinator is not None:
+    if args.replica_procs:
+        if args.coordinator is not None or args.checkpoint or args.exact \
+                or args.replicate_results or args.max_rows is not None:
+            # fail loudly, same convention as the multihost branch: a flag
+            # this mode cannot honour must never be silently dropped
+            parser.error("--replica_procs is the single-host replica-per-"
+                         "chip mode; it does not combine with "
+                         "--coordinator/--checkpoint/--exact/"
+                         "--replicate_results/--max_rows")
+        from distributedkernelshap_tpu.serving.replicas import ReplicaManager
+
+        manager = ReplicaManager(
+            args.replica_procs,
+            max_batch_size=args.max_batch_size,
+            pipeline_depth=args.pipeline_depth or None,
+        ).start(proxy_port=args.port, proxy_host=args.host)
+        banner = (f"replica-per-chip serving on "
+                  f"{manager.proxy.host}:{manager.proxy.port} "
+                  f"({args.replica_procs} worker processes)")
+        on_stop = manager.stop
+    elif args.coordinator is not None:
         # multi-host deployment: every pod runs this same entry (SPMD).
         # Followers block inside serve_multihost until the shutdown
         # broadcast; the flag combinations the branch cannot honour fail
@@ -104,7 +129,8 @@ def main():
         server = serve_multihost(
             predictor, background, ctor_kwargs, fit_kwargs, opts,
             host=args.host, port=args.port,
-            max_batch_size=args.max_batch_size, max_rows=args.max_rows,
+            max_batch_size=args.max_batch_size,
+            max_rows=args.max_rows if args.max_rows is not None else 256,
             explain_kwargs=explain_kwargs,
             pipeline_depth=args.pipeline_depth or None,
         )

@@ -1,0 +1,101 @@
+"""One replica process: a single-device :class:`ExplainerServer` built
+from a ``module:function`` factory.
+
+Spawned by :class:`~distributedkernelshap_tpu.serving.replicas.ReplicaManager`
+(one per chip; ``TPU_VISIBLE_CHIPS`` pins the device before jax imports) or
+run standalone:
+
+    python -m distributedkernelshap_tpu.serving.replica_worker \
+        --factory distributedkernelshap_tpu.serving.replica_worker:adult_factory \
+        --port 8001
+
+A factory returns ``(predictor, background_data, constructor_kwargs,
+fit_kwargs)`` — the reference's Ray Serve backend constructor tuple
+(``explainers/wrappers.py:10-37``), same shape ``serve_explainer`` takes.
+"""
+
+import argparse
+import importlib
+import logging
+import signal
+import threading
+
+
+def adult_factory():
+    """The default Adult deployment (same tuple as ``serving/main.py``)."""
+
+    from distributedkernelshap_tpu.utils import (
+        data_provenance,
+        load_data,
+        load_model,
+    )
+
+    data = load_data()
+    predictor = load_model()
+    group_names, groups = data["all"]["group_names"], data["all"]["groups"]
+    return (predictor, data["background"]["X"]["preprocessed"],
+            {"link": "logit", "feature_names": group_names, "seed": 0},
+            {"group_names": group_names, "groups": groups,
+             "data_provenance": data_provenance(data)})
+
+
+def synthetic_factory():
+    """A tiny deterministic logistic model on synthetic data — fast to fit,
+    no dataset fetch; used by the replica tests and as a smoke deployment."""
+
+    import numpy as np
+    from sklearn.linear_model import LogisticRegression
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(256, 8)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    clf = LogisticRegression(max_iter=200).fit(X, y)
+    return (clf, X[:32], {"link": "logit", "seed": 0}, {})
+
+
+def resolve_factory(spec: str):
+    mod_name, _, fn_name = spec.partition(":")
+    if not fn_name:
+        raise ValueError(f"--factory must be 'module:function', got {spec!r}")
+    return getattr(importlib.import_module(mod_name), fn_name)
+
+
+def main():
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s replica %(levelname)s %(message)s")
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--factory", required=True,
+                        help="module:function returning (predictor, "
+                             "background, ctor_kwargs, fit_kwargs)")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", required=True, type=int)
+    parser.add_argument("--max_batch_size", default=10, type=int)
+    parser.add_argument("--pipeline_depth", default=0, type=int,
+                        help="0 = self-calibrate at startup")
+    args = parser.parse_args()
+
+    factory = resolve_factory(args.factory)
+
+    # jax imports (inside serve_explainer's dependency chain) happen after
+    # the factory resolves, with TPU_VISIBLE_CHIPS already in the
+    # environment from the manager — this process initialises ONE chip.
+    from distributedkernelshap_tpu.serving.server import serve_explainer
+
+    predictor, background, ctor_kwargs, fit_kwargs = factory()
+    server = serve_explainer(
+        predictor, background, ctor_kwargs, fit_kwargs,
+        host=args.host, port=args.port,
+        max_batch_size=args.max_batch_size,
+        pipeline_depth=args.pipeline_depth or None)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    logging.info("replica serving on %s:%d", server.host, server.port)
+    stop.wait()
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()
