@@ -193,3 +193,109 @@ def test_fanin_all_dead_is_503():
         assert "no live replicas" in json.dumps(payload)
     finally:
         proxy.stop()
+
+
+# --------------------------------------------------------------------- #
+# FanInProxy routing semantics against FAKE replicas (stdlib HTTP servers,
+# no worker processes): the 503-demotion and slow-replica paths
+
+
+class _FakeReplica:
+    """A minimal /explain + /healthz server with a scripted behaviour."""
+
+    def __init__(self, mode="ok", delay_s=0.0):
+        import http.server
+
+        fake = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _go(self):
+                if fake.mode == "hang":
+                    time.sleep(fake.delay_s)
+                body = (b'{"status": "ok"}' if fake.mode != "wedged"
+                        else b'{"error": "server wedged"}')
+                code = 503 if fake.mode == "wedged" else 200
+                length = int(self.headers.get("Content-Length", 0))
+                if length:
+                    self.rfile.read(length)
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            do_GET = _go
+            do_POST = _go
+
+            def log_message(self, fmt, *args):
+                pass
+
+        self.mode = mode
+        self.delay_s = delay_s
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                     Handler)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_fanin_503_demotes_and_retries_on_healthy_replica():
+    """A replica that fast-503s (its own watchdog declared a device wedge)
+    must be demoted and the request retried on a healthy replica — a
+    wedged-but-alive worker must not permanently fail its traffic share."""
+
+    wedged, healthy = _FakeReplica("wedged"), _FakeReplica("ok")
+    proxy = FanInProxy([("127.0.0.1", wedged.port),
+                        ("127.0.0.1", healthy.port)],
+                       probe_interval_s=3600).start()
+    try:
+        for _ in range(4):  # round-robin guarantees hitting the wedged one
+            status, payload = _request(proxy.host, proxy.port)
+            assert status == 200, payload
+        assert not proxy.replicas[0].alive  # demoted, not erroring clients
+        assert proxy.replicas[1].alive
+        # the demotion is counted in its OWN metric, not as a crash
+        m = proxy._render_metrics()
+        line = [l for l in m.splitlines()
+                if l.startswith("dks_fanin_replica_503_demotions_total ")][0]
+        assert float(line.split()[-1]) >= 1
+    finally:
+        proxy.stop()
+        wedged.stop()
+        healthy.stop()
+
+
+def test_fanin_all_wedged_returns_replica_503_body():
+    wedged = _FakeReplica("wedged")
+    proxy = FanInProxy([("127.0.0.1", wedged.port)],
+                       probe_interval_s=3600).start()
+    try:
+        status, payload = _request(proxy.host, proxy.port)
+        assert status == 503
+        assert "server wedged" in json.dumps(payload)  # the replica's body
+    finally:
+        proxy.stop()
+        wedged.stop()
+
+
+def test_fanin_slow_replica_times_out_without_eviction():
+    """A replica slower than request_timeout_s earns its client a 504 but
+    stays in rotation — slow is not dead (first compiles run minutes)."""
+
+    slow = _FakeReplica("hang", delay_s=10.0)
+    proxy = FanInProxy([("127.0.0.1", slow.port)],
+                       request_timeout_s=1.5, probe_interval_s=3600).start()
+    try:
+        status, payload = _request(proxy.host, proxy.port, timeout=30)
+        assert status == 504, payload
+        assert "did not answer" in json.dumps(payload)
+        assert proxy.replicas[0].alive  # NOT evicted
+    finally:
+        proxy.stop()
+        slow.stop()
