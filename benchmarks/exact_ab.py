@@ -41,7 +41,17 @@ def _emit(record):
     print(json.dumps(record), flush=True)
 
 
-def main() -> int:
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true",
+                        help="tiny shapes + 1 timed run: validates the "
+                             "script end-to-end (e.g. on CPU) without "
+                             "burning a recovery window on a bug")
+    args = parser.parse_args(argv)
+    smoke = args.smoke
+
     import jax
     import scipy.sparse as sp
     from sklearn.ensemble import HistGradientBoostingRegressor
@@ -52,18 +62,27 @@ def main() -> int:
     from distributedkernelshap_tpu.ops.explain import ShapConfig
     from distributedkernelshap_tpu.utils import load_data
 
-    _emit({"step": "backend", "backend": jax.default_backend(),
-           "devices": [str(d) for d in jax.devices()]})
+    def emit(record):
+        # EVERY row carries the smoke marker: a tiny-shape CPU validation
+        # row must never be mistakable for a full B=256 on-chip measurement
+        _emit(dict(record, smoke=smoke))
+
+    emit({"step": "backend", "backend": jax.default_backend(),
+          "devices": [str(d) for d in jax.devices()]})
 
     data = load_data()
     gn, g = data["all"]["group_names"], data["all"]["groups"]
     Xtr = data["all"]["X"]["processed"]["train"].toarray()
     ytr = data["all"]["y"]["train"].astype(np.float64)
-    gbr = HistGradientBoostingRegressor(max_iter=50, random_state=0).fit(
-        Xtr, ytr)
-    X = data["all"]["X"]["processed"]["test"].toarray().astype(np.float32)[:256]
+    if smoke:
+        Xtr, ytr = Xtr[:4000], ytr[:4000]
+    gbr = HistGradientBoostingRegressor(max_iter=10 if smoke else 50,
+                                        random_state=0).fit(Xtr, ytr)
+    X = data["all"]["X"]["processed"]["test"].toarray().astype(np.float32)
+    X = X[:8] if smoke else X[:256]
     bgd = data["background"]["X"]["preprocessed"]
     bg = bgd.toarray() if sp.issparse(bgd) else np.asarray(bgd)
+    nruns = 1 if smoke else 3
 
     for pallas in (True, False):
         ex = KernelShap(gbr.predict, seed=0,
@@ -75,14 +94,14 @@ def main() -> int:
         # --- exact phi -------------------------------------------------- #
         ex.explain(X, silent=True, nsamples="exact")  # warm/compile
         ts = []
-        for _ in range(3):
+        for _ in range(nruns):
             t0 = time.perf_counter()
             r = ex.explain(X, silent=True, nsamples="exact")
             ts.append(time.perf_counter() - t0)
         total = (np.asarray(r.shap_values).sum(-1).ravel()
                  + np.ravel(r.expected_value)[0])
         err = float(np.abs(total - gbr.predict(X.astype(np.float64))).max())
-        _emit({"step": f"exact_phi_pallas_{pallas}",
+        emit({"step": f"exact_phi_pallas_{pallas}",
                "wall_s": round(float(np.median(ts)), 4), "model_err": err,
                "kernel_path": ex.kernel_path})
 
@@ -93,7 +112,7 @@ def main() -> int:
         ti = time.perf_counter() - t0
         iv = ri.data["raw"]["interaction_values"][0]
         ierr = float(np.abs(iv.sum(-1) - np.asarray(ri.shap_values[0])).max())
-        _emit({"step": f"exact_inter_pallas_{pallas}",
+        emit({"step": f"exact_inter_pallas_{pallas}",
                "wall_s": round(ti, 4), "rowsum_err": ierr,
                "kernel_path": ex.kernel_path})
 
@@ -101,11 +120,11 @@ def main() -> int:
         if pallas:  # one measurement is enough; it shares the model
             ex.explain(X, silent=True, l1_reg=False)  # warm
             ts = []
-            for _ in range(3):
+            for _ in range(nruns):
                 t0 = time.perf_counter()
                 ex.explain(X, silent=True, l1_reg=False)
                 ts.append(time.perf_counter() - t0)
-            _emit({"step": "sampled_baseline",
+            emit({"step": "sampled_baseline",
                    "wall_s": round(float(np.median(ts)), 4),
                    "kernel_path": ex.kernel_path})
     return 0

@@ -64,6 +64,9 @@ def run_explainer(explainer, X_explain: np.ndarray, distributed_opts: dict, nrun
         t_elapsed = timer() - t_start
         logging.info("Time elapsed: %s", t_elapsed)
         result['t_elapsed'].append(t_elapsed)
+        # recorded at trace time during the first run; a Pallas degrade
+        # mid-sweep shows up here instead of being silently absorbed
+        result['kernel_path'] = explainer.kernel_path
         with open(get_filename(workers if workers else -1, batch_size, serve=False), 'wb') as f:
             pickle.dump(result, f)
 

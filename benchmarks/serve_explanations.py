@@ -120,6 +120,9 @@ def run_config(predictor, data, X_explain, replicas: int, max_batch_size: int,
             assert len(responses) == expected
             logging.info("Time elapsed: %s", t_elapsed)
             result['t_elapsed'].append(t_elapsed)
+            # re-read per run (like pool.py): a Pallas degrade DURING a
+            # timed run must reach the pickle, not a pre-degrade snapshot
+            result['kernel_path'] = server.model.explainer.kernel_path
             fname = get_filename(replicas, max_batch_size, serve=True)
             if batch_mode != "ray":  # keep 'ray' on the reference naming
                 fname = fname.replace(".pkl", f"_mode_{batch_mode}.pkl")
