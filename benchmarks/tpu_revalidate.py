@@ -82,12 +82,32 @@ def _cache_headline(result):
                            "(cpu platform, or no numeric value)")
 
 
+#: every selectable step name (configs + the three composite steps) — the
+#: single list ``--only``/``--skip`` validate against, so a future config
+#: cannot silently slip into (or out of) a caller's hardcoded skip string
+STEP_NAMES = ("adult", "adult_stress", "adult_trees", "adult_trees_exact",
+              "mnist", "covertype", "model_zoo", "adult_blackbox",
+              "regression", "serve", "pool")
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--skip", default="",
                         help="comma-separated step names to skip")
+    parser.add_argument("--only", default="",
+                        help="comma-separated step names to run (everything "
+                             "else skipped); the positive spelling callers "
+                             "should prefer — a complement-of-skip string "
+                             "silently re-runs any step added later")
     args = parser.parse_args()
     skip = set(filter(None, args.skip.split(",")))
+    only = set(filter(None, args.only.split(",")))
+    unknown = (skip | only) - set(STEP_NAMES)
+    if unknown:
+        parser.error(f"unknown step names {sorted(unknown)}; "
+                     f"valid: {', '.join(STEP_NAMES)}")
+    if only:
+        skip |= set(STEP_NAMES) - only
 
     import jax
 

@@ -21,10 +21,12 @@ Operational rules encoded here (learned rounds 2-4, catalogued in
   short relay window still captures the headline evidence.  Every step is
   its own subprocess appending to its own artifacts; a later hang cannot
   lose earlier numbers.
-* **Evidence first.**  The first two steps (fast configs, bench.py) both
-  feed ``results/bench_last_success.json`` (benchmarks/_evidence.py), so a
-  recovery window as short as ~10 minutes already puts an on-chip headline
-  number where the driver's end-of-round ``bench.py`` will attach it.
+* **Evidence first.**  THREE steps feed ``results/bench_last_success.json``
+  (benchmarks/_evidence.py): fast configs (the ``config:adult`` row),
+  ``bench.py``, and ``serve_and_pool`` (the pool w=1/b=2560 point) — all
+  ordered before the ~80-minute zoo leg, so a recovery window as short as
+  ~10 minutes already puts an on-chip headline number where the driver's
+  end-of-round ``bench.py`` will attach it.
 * **Steps continue on failure** and their rc/duration land in
   ``results/tpu_watch.jsonl`` — the sweep's own state is an artifact.
 
@@ -67,8 +69,8 @@ def default_steps() -> List[Step]:
     reval = os.path.join(REPO_ROOT, "benchmarks", "tpu_revalidate.py")
     return [
         Step("fast_configs",
-             [py, reval, "--skip", "model_zoo,adult_blackbox,serve,pool,"
-                                   "regression"],
+             [py, reval, "--only", "adult,adult_stress,adult_trees,"
+                                   "adult_trees_exact,mnist,covertype"],
              timeout_s=5400,
              why="headline adult (feeds the evidence cache), stress, trees, "
                  "the exact A/B vs sampled, mnist (dispatch-window chunks), "
@@ -85,20 +87,23 @@ def default_steps() -> List[Step]:
              why="fused exact kernels vs einsum on real Mosaic — the "
                  "kernel_path field proves which path engaged (a Mosaic "
                  "auto-degrade can no longer masquerade as a measurement)"),
-        Step("model_zoo",
-             [py, reval, "--skip", "adult,adult_stress,adult_trees,"
-                                   "adult_trees_exact,mnist,covertype,"
-                                   "adult_blackbox,serve,pool,regression"],
-             timeout_s=7200,
-             why="the f32-oracle zoo refresh (~80 min of host model "
-                 "training) — must not starve the short steps"),
+        Step("serve_and_pool",
+             [py, reval, "--only", "serve,pool"],
+             timeout_s=3600,
+             why="serve auto/hand depth rows + the pool points — the "
+                 "w=1/b=2560 point is the pool-protocol evidence-cache "
+                 "feed, and both pickles now record kernel_path"),
         Step("blackbox_and_regression",
-             [py, reval, "--skip", "adult,adult_stress,adult_trees,"
-                                   "adult_trees_exact,mnist,covertype,"
-                                   "model_zoo,serve,pool"],
+             [py, reval, "--only", "adult_blackbox,regression"],
              timeout_s=3600,
              why="host-eval fan-out now defaults to the core count; the "
                  "fused-tree-eval regression sweep"),
+        Step("model_zoo",
+             [py, reval, "--only", "model_zoo"],
+             timeout_s=7200,
+             why="the f32-oracle zoo refresh (~80 min of host model "
+                 "training) runs LAST — it must not starve the short, "
+                 "evidence-bearing steps"),
     ]
 
 

@@ -151,3 +151,32 @@ def test_evidence_cache_missing_stamp_is_no_evidence(tmp_path, missing):
     with open(path, "w") as f:
         json.dump({"value": 1.0}, f)  # no captured_unix
     assert load_last_onchip(cache_path=path) is None
+
+
+def test_default_steps_use_only_spelling_and_validate():
+    """Steps select work with tpu_revalidate's --only (positive spelling):
+    a config added later can never silently run in several sweep steps the
+    way complement-of-skip strings allowed."""
+
+    import subprocess
+    import sys
+
+    from benchmarks.tpu_revalidate import STEP_NAMES
+
+    for s in default_steps():
+        argv = list(s.argv)
+        if "--only" in argv:
+            names = argv[argv.index("--only") + 1].split(",")
+            assert all(n in STEP_NAMES for n in names), (s.name, names)
+    # the evidence-bearing serve_and_pool step precedes the ~80-min zoo leg
+    names = [s.name for s in default_steps()]
+    assert names.index("serve_and_pool") < names.index("model_zoo")
+    # unknown names fail fast (before any backend import)
+    proc = subprocess.run(
+        [sys.executable,
+         str(__import__('pathlib').Path(__file__).parent.parent
+             / "benchmarks" / "tpu_revalidate.py"),
+         "--only", "bogus_step"],
+        capture_output=True, timeout=60)
+    assert proc.returncode == 2
+    assert b"unknown step names" in proc.stderr
