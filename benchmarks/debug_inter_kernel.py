@@ -1,0 +1,77 @@
+"""Standalone Mosaic repro for ``exact_tree_inter`` (the one kernel the
+remote compile helper rejected in the 2026-08-02 on-chip A/B, while
+``exact_tree_phi`` compiled and ran — ``results/exact_ab.jsonl``).
+
+Calls the kernel directly with ``interpret=False`` on synthetic tensors at
+the Adult-GBT shapes so the full compiler error propagates instead of being
+swallowed by the engine's auto-degrade (``kernel_shap.py`` Mosaic-rejection
+path).  ``--phi`` runs the known-good main-effect kernel first as a
+control.  Shapes default to the A/B's (B=256, M=12, K=1, N=100, P=1536,
+dmax=32); override to bisect which dimension trips the compiler.
+"""
+
+import argparse
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--B", type=int, default=256)
+    p.add_argument("--M", type=int, default=12)
+    p.add_argument("--K", type=int, default=1)
+    p.add_argument("--N", type=int, default=100)
+    p.add_argument("--P", type=int, default=1536)
+    p.add_argument("--dmax", type=int, default=32)
+    p.add_argument("--phi", action="store_true",
+                   help="run the known-good exact_tree_phi control first")
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributedkernelshap_tpu.ops.pallas_kernels import (
+        exact_tree_inter,
+        exact_tree_phi,
+    )
+
+    print("backend:", jax.default_backend(), jax.devices(), flush=True)
+    B, M, K, N, P, dmax = args.B, args.M, args.K, args.N, args.P, args.dmax
+    rng = np.random.default_rng(0)
+    xo = jnp.asarray(rng.random((B, P, M)) < 0.1, jnp.float32)
+    xn = jnp.asarray(rng.random((B, P, M)) < 0.1, jnp.float32)
+    zo = jnp.asarray(rng.random((N, P, M)) < 0.5, jnp.float32)
+    zd = jnp.asarray(rng.random((N, P)) < 0.05, jnp.float32)
+    lv = jnp.asarray(rng.standard_normal((P, K)), jnp.float32)
+    bgw = jnp.full((N,), 1.0 / N, jnp.float32)
+
+    if args.phi:
+        t0 = time.perf_counter()
+        out = exact_tree_phi(xo, xn, zo, zd, lv, bgw, dmax=dmax,
+                             interpret=False)
+        out.block_until_ready()
+        print(f"phi control OK {time.perf_counter() - t0:.2f}s "
+              f"out={out.shape}", flush=True)
+
+    t0 = time.perf_counter()
+    try:
+        out = exact_tree_inter(xo, xn, zo, zd, lv, bgw, dmax=dmax,
+                               interpret=False)
+        out.block_until_ready()
+    except Exception:
+        print(f"inter FAILED after {time.perf_counter() - t0:.2f}s",
+              flush=True)
+        traceback.print_exc()
+        return 1
+    print(f"inter OK {time.perf_counter() - t0:.2f}s out={out.shape}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
