@@ -3,13 +3,23 @@
 
 PY ?= python
 
-.PHONY: test fuzz bench configs serve sweep-pool sweep-serve analysis multihost-ci
+.PHONY: test tier1 collect fuzz bench configs serve sweep-pool sweep-serve analysis multihost-ci sched-bench
 
 multihost-ci:    ## multi-host validation: 2-proc pool/phi/interactions, 4-proc 2x2 mesh, 2-proc serve (one JSON line, rc 0/1)
 	$(PY) benchmarks/multihost_ci.py
 
 test:            ## full suite on CPU with 8 virtual devices
 	env PYTHONPATH= JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q
+
+tier1: SHELL := /bin/bash
+tier1:           ## the ROADMAP tier-1 verify command, verbatim (PIPESTATUS needs bash)
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+
+collect:         ## fast collection smoke: a conftest/import regression fails here in seconds, not behind the 870s tier-1 budget
+	env PYTHONPATH= JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q --collect-only -p no:cacheprovider
+
+sched-bench:     ## scheduling A/B: SLO scheduler + cache vs FIFO under open-loop overload (one JSON line, exits nonzero on criteria fail)
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/scheduling_bench.py --check
 
 fuzz:            ## 3x fresh-seed hypothesis property sweeps (new examples per run)
 	for i in 1 2 3; do \

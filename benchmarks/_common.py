@@ -22,7 +22,10 @@ def apply_platform(args) -> None:
         jax.config.update("jax_platforms", args.platform)
         if getattr(args, "cpu_devices", None):
             if args.platform == "cpu":
-                jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+                from distributedkernelshap_tpu.compat import \
+                    force_cpu_devices
+
+                force_cpu_devices(args.cpu_devices)
             else:
                 import logging
 

@@ -63,7 +63,8 @@ import sys
 sys.path.insert(0, sys.argv[4])
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+from distributedkernelshap_tpu.compat import force_cpu_devices
+force_cpu_devices(2)
 pid = int(sys.argv[1])
 from distributedkernelshap_tpu.parallel.mesh import initialize_multihost
 initialize_multihost("127.0.0.1:" + sys.argv[2], 2, pid)
@@ -359,7 +360,8 @@ def main() -> int:
             import jax
 
             jax.config.update("jax_platforms", "cpu")
-            jax.config.update("jax_num_cpu_devices", N_DEVICES)
+            from distributedkernelshap_tpu.compat import force_cpu_devices
+            force_cpu_devices(N_DEVICES)
             np.testing.assert_allclose(phi0, explain_adult_slice(), atol=1e-5)
             checks["phi_matches_single_process"] = "ok"
             np.testing.assert_allclose(rank0, rank_adult_slice(), atol=1e-5)

@@ -1,0 +1,338 @@
+"""Scheduling subsystem benchmark: tail latency + goodput under overload,
+SLO scheduler vs. the FIFO baseline, plus result-cache effectiveness
+(standalone, CPU backend, exits nonzero on ``--check`` fail).
+
+Three measurements, one JSON line:
+
+1. **Overload A/B** — an open-loop arrival stream (requests fired on a
+   fixed schedule regardless of completions, the honest way to measure an
+   overloaded server: closed-loop clients self-throttle and hide the
+   queueing) at ~2x measured capacity, 30% ``interactive`` requests with a
+   real deadline + 70% ``batch``, against (a) the FIFO baseline
+   (``scheduling="fifo"``, admission off — the round-4 server) and (b) the
+   SLO scheduler with admission control.  The device model is synthetic
+   (deterministic service time per batch) so the comparison isolates the
+   scheduling layer; criteria: interactive p99 strictly better under SLO,
+   nonzero 429 sheds, goodput within 10% of the FIFO arm's throughput.
+2. **Cache** — a ≥90%-duplicate workload against a REAL (small) KernelShap
+   model with the content-addressed cache enabled: ≥80% hit rate,
+   bit-identical payloads for duplicate rows, additivity intact.
+
+    JAX_PLATFORMS=cpu python benchmarks/scheduling_bench.py --check
+"""
+
+import argparse
+import http.client
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO_ROOT = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO_ROOT)
+
+
+# --------------------------------------------------------------------- #
+# synthetic device model: deterministic service time, trivial payloads
+# --------------------------------------------------------------------- #
+
+
+class SyntheticModel:
+    """Sync-only model with a deterministic cost per device batch:
+    ``base_s + per_row_s * rows`` — the scheduling layer sees exactly the
+    contention profile of a real accelerator without compile noise.
+
+    The defaults are deliberately slow (~38 rows/s at full batching): the
+    device must dominate the stdlib HTTP stack's per-request overhead
+    (~1 ms thread spawn + connection each), otherwise the A/B measures
+    Python accept-loop contention — in the device-bound regime a shed
+    frees device capacity for the backlog, so goodput tracks capacity in
+    both arms, which is the production behaviour being modelled."""
+
+    max_rows = None
+
+    def __init__(self, base_s=0.05, per_row_s=0.02):
+        self.base_s = base_s
+        self.per_row_s = per_row_s
+
+    def explain_batch(self, instances, split_sizes=None):
+        time.sleep(self.base_s + self.per_row_s * instances.shape[0])
+        sizes = split_sizes or [1] * instances.shape[0]
+        out, offset = [], 0
+        for size in sizes:
+            out.append(json.dumps({"data": {"rows": size, "offset": offset}}))
+            offset += size
+        return out
+
+
+# --------------------------------------------------------------------- #
+# open-loop load generator
+# --------------------------------------------------------------------- #
+
+
+def _post(host, port, array, headers, timeout):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("POST", "/explain",
+                     body=json.dumps({"array": array.tolist()}).encode(),
+                     headers={"Content-Type": "application/json", **headers})
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode()
+    finally:
+        conn.close()
+
+
+def open_loop(server, plan, timeout=120.0):
+    """Fire ``plan`` — ``[(t_offset_s, array, headers, tag), ...]`` — on
+    schedule, one thread per request (open loop: arrivals never wait for
+    completions).  Returns ``[(tag, status, latency_s, payload)]``."""
+
+    results = [None] * len(plan)
+    t0 = time.monotonic()
+
+    def fire(i, offset, array, headers, tag):
+        delay = t0 + offset - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        sent = time.monotonic()
+        try:
+            status, payload = _post(server.host, server.port, array,
+                                    headers, timeout)
+        except OSError:
+            status, payload = -1, ""
+        results[i] = (tag, status, time.monotonic() - sent, payload)
+
+    threads = [threading.Thread(target=fire, args=(i, *spec), daemon=True)
+               for i, spec in enumerate(plan)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout * 2)
+    return [r for r in results if r is not None]
+
+
+def percentile(values, q):
+    return float(np.percentile(np.asarray(values), q)) if values else None
+
+
+def scrape_metrics(server):
+    conn = http.client.HTTPConnection(server.host, server.port, timeout=30)
+    try:
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+    finally:
+        conn.close()
+    out = {}
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            name, value = line.rsplit(" ", 1)
+            out[name] = float(value)
+    return out
+
+
+# --------------------------------------------------------------------- #
+# phase 1: overload A/B
+# --------------------------------------------------------------------- #
+
+
+def run_overload_arm(policy, plan, n_requests, rng_seed=0):
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    kwargs = dict(host="127.0.0.1", port=0, max_batch_size=8,
+                  batch_timeout_s=0.004, scheduling=policy)
+    if policy == "fifo":
+        # the round-4 baseline: accept everything, serve in arrival order
+        kwargs["admission_control"] = False
+    else:
+        kwargs["max_queue_per_class"] = 120
+    server = ExplainerServer(SyntheticModel(), **kwargs).start()
+    try:
+        t0 = time.monotonic()
+        results = open_loop(server, plan)
+        wall = time.monotonic() - t0
+        metrics = scrape_metrics(server)
+    finally:
+        server.stop()
+
+    by_tag = {}
+    for tag, status, latency, _ in results:
+        by_tag.setdefault(tag, []).append((status, latency))
+    summary = {"wall_s": round(wall, 3)}
+    total_ok = 0
+    for tag, rs in sorted(by_tag.items()):
+        ok = [lat for status, lat in rs if status == 200]
+        total_ok += len(ok)
+        summary[tag] = {
+            "n": len(rs),
+            "ok": len(ok),
+            "shed_429": sum(1 for s, _ in rs if s == 429),
+            "expired_504": sum(1 for s, _ in rs if s == 504),
+            "p50_s": round(percentile(ok, 50), 4) if ok else None,
+            "p99_s": round(percentile(ok, 99), 4) if ok else None,
+        }
+    summary["goodput_rps"] = round(total_ok / wall, 2)
+    summary["sheds_total"] = int(sum(
+        v for k, v in metrics.items()
+        if k.startswith("dks_serve_sheds_total")))
+    return summary
+
+
+def build_overload_plan(n_requests, rate_rps, interactive_frac,
+                        interactive_deadline_ms, dim, seed=0):
+    rng = np.random.default_rng(seed)
+    plan = []
+    for i in range(n_requests):
+        offset = i / rate_rps
+        array = rng.normal(size=(1, dim)).astype(np.float32)
+        if rng.random() < interactive_frac:
+            headers = {"X-DKS-Priority": "interactive",
+                       "X-DKS-Deadline-Ms": str(interactive_deadline_ms)}
+            tag = "interactive"
+        else:
+            headers = {"X-DKS-Priority": "batch"}
+            tag = "batch"
+        plan.append((offset, array, headers, tag))
+    return plan
+
+
+# --------------------------------------------------------------------- #
+# phase 2: cache effectiveness on a real model
+# --------------------------------------------------------------------- #
+
+
+def run_cache_phase(n_requests=120, duplicate_frac=0.92, pool_size=5,
+                    seed=0):
+    from distributedkernelshap_tpu.models import LinearPredictor
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+    from distributedkernelshap_tpu.serving.wrappers import (
+        BatchKernelShapModel,
+    )
+
+    rng = np.random.default_rng(seed)
+    D, K = 6, 2
+    W = rng.normal(size=(D, K)).astype(np.float32)
+    b = rng.normal(size=(K,)).astype(np.float32)
+    bg = rng.normal(size=(12, D)).astype(np.float32)
+    pool = rng.normal(size=(pool_size, 1, D)).astype(np.float32)
+    model = BatchKernelShapModel(LinearPredictor(W, b, activation="softmax"),
+                                 bg, {"link": "logit", "seed": 0}, {})
+    server = ExplainerServer(model, host="127.0.0.1", port=0,
+                             max_batch_size=8, batch_timeout_s=0.005,
+                             pipeline_depth=2,
+                             cache_bytes=4 << 20).start()
+    payloads_by_row = {}
+    identical = True
+    additivity_ok = True
+    try:
+        plan = []
+        duplicates = 0
+        for i in range(n_requests):
+            if rng.random() < duplicate_frac:
+                row_id = int(rng.integers(pool_size))
+                duplicates += 1
+            else:
+                row_id = -(i + 1)  # novel request (-0 would alias pool row 0)
+            array = (pool[row_id] if row_id >= 0
+                     else rng.normal(size=(1, D)).astype(np.float32))
+            plan.append((i * 0.003, array, {}, row_id))
+        results = open_loop(server, plan)
+        for tag, status, _, payload in results:
+            if status != 200:
+                identical = False
+                continue
+            if tag >= 0:
+                if tag in payloads_by_row:
+                    identical &= (payload == payloads_by_row[tag])
+                else:
+                    payloads_by_row[tag] = payload
+            data = json.loads(payload)["data"]
+            total = (np.asarray(data["shap_values"]).sum(-1)
+                     + np.asarray(data["expected_value"])[:, None])
+            additivity_ok &= bool(np.allclose(
+                total, np.asarray(data["raw"]["raw_prediction"]).T,
+                atol=1e-3))
+        metrics = scrape_metrics(server)
+    finally:
+        server.stop()
+    hits = metrics.get("dks_serve_cache_hits_total", 0)
+    misses = metrics.get("dks_serve_cache_misses_total", 0)
+    return {
+        "n": n_requests,
+        "duplicate_frac": round(duplicates / n_requests, 3),
+        "hits": int(hits),
+        "misses": int(misses),
+        "hit_rate": round(hits / max(1, hits + misses), 3),
+        "bit_identical": bool(identical),
+        "additivity_ok": bool(additivity_ok),
+        "cache_bytes": int(metrics.get("dks_serve_cache_bytes", 0)),
+    }
+
+
+# --------------------------------------------------------------------- #
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--requests", type=int, default=300,
+                        help="open-loop requests per overload arm")
+    parser.add_argument("--overload", type=float, default=2.0,
+                        help="arrival rate as a multiple of capacity")
+    parser.add_argument("--interactive_frac", type=float, default=0.3)
+    # roughly four full-batch service times: tight enough that FIFO's
+    # backlog blows through it (the A/B contrast), loose enough that an
+    # EDF-prioritised request clears it even when admitted mid-batch
+    parser.add_argument("--interactive_deadline_ms", type=float, default=800)
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 unless the acceptance criteria hold")
+    args = parser.parse_args()
+
+    # measured capacity of the synthetic model at full batching:
+    # 8 rows per (base + 8*per_row) seconds
+    model = SyntheticModel()
+    capacity_rps = 8 / (model.base_s + 8 * model.per_row_s)
+    rate = capacity_rps * args.overload
+    dim = 6
+
+    plan = build_overload_plan(args.requests, rate, args.interactive_frac,
+                               args.interactive_deadline_ms, dim)
+    fifo = run_overload_arm("fifo", plan, args.requests)
+    slo = run_overload_arm("slo", plan, args.requests)
+    cache = run_cache_phase()
+
+    fifo_p99 = (fifo.get("interactive") or {}).get("p99_s")
+    slo_p99 = (slo.get("interactive") or {}).get("p99_s")
+    goodput_ratio = (slo["goodput_rps"] / fifo["goodput_rps"]
+                     if fifo["goodput_rps"] else None)
+    checks = {
+        "interactive_p99_better": (fifo_p99 is not None
+                                   and slo_p99 is not None
+                                   and slo_p99 < fifo_p99),
+        "nonzero_sheds_429": slo["sheds_total"] > 0 and (
+            slo["interactive"]["shed_429"] + slo["batch"]["shed_429"] > 0),
+        "goodput_within_10pct": (goodput_ratio is not None
+                                 and goodput_ratio >= 0.9),
+        "cache_hit_rate_ge_80pct": cache["hit_rate"] >= 0.8,
+        "cache_bit_identical": cache["bit_identical"],
+        "cache_additivity_ok": cache["additivity_ok"],
+    }
+    report = {
+        "bench": "scheduling",
+        "capacity_rps": round(capacity_rps, 1),
+        "offered_rps": round(rate, 1),
+        "fifo": fifo,
+        "slo": slo,
+        "goodput_ratio": round(goodput_ratio, 3) if goodput_ratio else None,
+        "cache": cache,
+        "checks": checks,
+        "ok": all(checks.values()),
+    }
+    print(json.dumps(report))
+    if args.check and not report["ok"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

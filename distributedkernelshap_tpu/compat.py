@@ -1,0 +1,87 @@
+"""Shims over JAX API spellings that changed across supported versions.
+
+The code targets current JAX, but CI containers pin older 0.4.x releases
+where two spellings differ:
+
+* ``jax.config.update("jax_num_cpu_devices", n)`` — the option does not
+  exist; the pre-option recipe is
+  ``XLA_FLAGS=--xla_force_host_platform_device_count=n``, honoured as
+  long as it lands before the CPU backend initialises.
+* ``jax.shard_map`` — lives at ``jax.experimental.shard_map.shard_map``
+  and spells ``check_vma`` as ``check_rep``.
+
+Keep every version-sniffing branch here so call sites stay on the modern
+spelling.
+"""
+
+import os
+import re
+
+
+def force_cpu_devices(n: int) -> None:
+    """Request ``n`` virtual CPU devices on any supported JAX.
+
+    Must run before the first device query (backend init); on new JAX a
+    too-late call raises ``RuntimeError`` exactly like
+    ``jax.config.update`` does, on old JAX it is silently ineffective.
+    """
+
+    import jax
+
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        # replace (not just append) any inherited count: multihost worker
+        # processes inherit the parent test env's =8 but need their own n
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       os.environ.get("XLA_FLAGS", ""))
+        os.environ["XLA_FLAGS"] = (
+            flags.strip() + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+
+def enable_cpu_collectives() -> None:
+    """Enable cross-process collectives on the CPU backend (gloo).
+
+    Newer JAX defaults ``jax_cpu_collectives_implementation`` to gloo; the
+    pinned 0.4.x releases ship the gloo plugin (``jaxlib.xla_extension.
+    make_gloo_tcp_collectives``) but default the option to ``None``, so a
+    multi-process CPU mesh fails at its first collective with
+    ``INVALID_ARGUMENT: ... no cross-host collectives``.  Must run before
+    the CPU backend initialises; a no-op where the option does not exist
+    and harmless on TPU (the option only configures the CPU client).
+    """
+
+    import jax
+
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError, RuntimeError):
+        pass
+
+
+def eager_concat_sums_replicas() -> bool:
+    """True on old JAX, where eagerly concatenating shard_map outputs on a
+    multi-axis mesh re-sums copies replicated over unmentioned mesh axes
+    (observed on 0.4.37: ``jnp.concatenate`` of two ``P('data')`` outputs
+    of a ``('data', 'coalition')`` mesh doubles every value, while a direct
+    ``np.asarray`` fetch of each output is correct).  Keyed on the same
+    version sniff as :func:`shard_map`."""
+
+    import jax
+
+    return not hasattr(jax, "shard_map")
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """``jax.shard_map`` with the ``check_rep`` fallback for old JAX."""
+
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distributedkernelshap_tpu import compat
 from distributedkernelshap_tpu.models.predictors import BasePredictor
 from distributedkernelshap_tpu.ops.explain import (
     ShapConfig,
@@ -133,7 +134,7 @@ def build_coalition_sharded_fn(predictor: BasePredictor,
         }
 
     data_spec = P() if replicate_results else P(DATA_AXIS)
-    sharded = jax.shard_map(
+    sharded = compat.shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P(DATA_AXIS), P(), P(), P(COALITION_AXIS), P(COALITION_AXIS), P()),

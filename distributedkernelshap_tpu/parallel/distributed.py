@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from distributedkernelshap_tpu import compat
 from distributedkernelshap_tpu.ops.explain import (
     build_explainer_fn,
     pack_transfer,
@@ -297,8 +298,27 @@ class DistributedExplainer:
         # one packed D2H instead of two (tunnelled transfers are latency-bound);
         # with transfer_dtype set only the wide segment (phi + interactions)
         # rides the reduced dtype — f(x) is B*K floats and stays f32
-        wide = [out['shap_values'].ravel()]
         has_inter = 'interaction_values' in out
+        if compat.eager_concat_sums_replicas() and jax.process_count() == 1:
+            # old JAX: eagerly concatenating shard_map outputs on the 2-axis
+            # mesh re-sums the copies replicated over the unmentioned
+            # coalition axis (op-by-op partitioner bug; direct per-array
+            # fetches assemble correctly).  Fetch now and pack on the host —
+            # the packed D2H only matters through a tunnelled TPU, which
+            # always runs a JAX new enough for the device-side pack.
+            # Single-process only: multi-host outputs span non-addressable
+            # devices, so a pre-allgather host fetch is impossible there —
+            # the device-side pack below stays correct for coalition size 1
+            # (the re-sum is over coalition replicas, and one copy sums to
+            # itself); coalition>1 on such JAX is rejected at mesh build.
+            wide = [np.asarray(out['shap_values']).ravel()]
+            if has_inter:
+                wide.append(np.asarray(out['interaction_values']).ravel())
+            packed = np.concatenate(
+                [np.concatenate(wide).astype(np.float32),
+                 np.asarray(out['raw_prediction']).ravel().astype(np.float32)])
+            return packed, B, X.shape[0], has_inter, replicated
+        wide = [out['shap_values'].ravel()]
         if has_inter:
             wide.append(out['interaction_values'].ravel())
         packed = pack_transfer(jnp.concatenate(wide),
@@ -421,7 +441,7 @@ class DistributedExplainer:
                          'raw_prediction': P(DATA_AXIS)}
             if interactions:
                 out_specs['interaction_values'] = P(DATA_AXIS)
-            sharded = jax.shard_map(
+            sharded = compat.shard_map(
                 body,
                 mesh=self.mesh,
                 in_specs=(P(DATA_AXIS), P(COALITION_AXIS), P(),

@@ -62,6 +62,12 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
             "num_processes/process_id were given without coordinator_address; "
             "all three are required for an explicit multi-host launch "
             "(omit all of them on TPU pods for auto-discovery)")
+    # CPU backends need gloo for cross-host collectives; old JAX defaults
+    # the option off (see compat) and the config must land before the
+    # backend initialises — i.e. before any device query below
+    from distributedkernelshap_tpu.compat import enable_cpu_collectives
+
+    enable_cpu_collectives()
     kwargs = {}
     if coordinator_address is not None:
         kwargs.update(coordinator_address=coordinator_address,
@@ -106,6 +112,21 @@ def device_mesh(n_devices: Optional[int] = None,
         raise ValueError(
             f"coalition_parallel={coalition_parallel} must divide the device count {n}"
         )
+    if coalition_parallel > 1 and jax.process_count() > 1:
+        from distributedkernelshap_tpu import compat
+
+        if compat.eager_concat_sums_replicas():
+            # the old partitioner re-sums coalition-replicated shard_map
+            # outputs at the eager result pack (verified exactly x
+            # coalition_parallel); single-process avoids it by packing on
+            # the host, but multi-host outputs span non-addressable devices
+            # so there is no correct assembly path on this JAX
+            raise NotImplementedError(
+                f"coalition_parallel={coalition_parallel} on a "
+                f"{jax.process_count()}-process mesh needs jax.shard_map "
+                "(JAX >= 0.6); this JAX mis-assembles coalition-replicated "
+                "results across processes. Upgrade JAX or use "
+                "coalition_parallel=1.")
     grid = np.asarray(devices).reshape(n // coalition_parallel, coalition_parallel)
     return Mesh(grid, (DATA_AXIS, COALITION_AXIS))
 

@@ -1,0 +1,163 @@
+"""Content-addressed explanation cache.
+
+KernelSHAP is deterministic here by construction: the coalition plan is a
+pure function of ``(M, nsamples, seed)`` and the solve runs in pinned-f32
+on a fixed background, so two requests carrying the same instance rows
+against the same fitted explainer produce byte-identical Explanation JSON.
+Recomputing one is pure waste — at production traffic the same handful of
+rows (dashboard entities, demo inputs, retried requests) dominates, and
+every duplicate served from host memory is a device batch slot freed for a
+novel request.
+
+Keys are content-addressed: SHA-256 over the request's instance rows
+(dtype + shape + bytes) combined with a *model fingerprint* — background
+data digest, link, grouping, seed and the deployment's pinned
+``explain_kwargs``.  Changing any of these (a refit on new background, a
+different link, new grouping) changes the fingerprint, so stale entries
+are unreachable rather than invalidated: eviction is purely LRU under a
+byte budget.
+
+The cache stores the exact JSON payload string the server would have sent,
+so a hit is bit-identical to the original response — additivity and all.
+"""
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def array_fingerprint(array: np.ndarray) -> str:
+    """SHA-256 digest of an array's dtype, shape and contents."""
+
+    a = np.ascontiguousarray(array)
+    h = hashlib.sha256()
+    h.update(str(a.dtype).encode())
+    h.update(str(a.shape).encode())
+    h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def _update_structured(h, value) -> None:
+    """Feed ``value`` into the hash with full content: ``repr`` alone is
+    unsafe for ndarrays (numpy elides the middle of large arrays with
+    ``...``, so two groupings differing only in the elided region would
+    collide) — arrays hash via :func:`array_fingerprint`, containers
+    recurse, and everything else falls back to ``repr``."""
+
+    if isinstance(value, np.ndarray):
+        h.update(b"nd:")
+        h.update(array_fingerprint(value).encode())
+    elif isinstance(value, (list, tuple)):
+        h.update(f"seq{len(value)}:".encode())
+        for item in value:
+            _update_structured(h, item)
+    elif isinstance(value, dict):
+        h.update(f"map{len(value)}:".encode())
+        for key in sorted(value, key=repr):
+            h.update(repr(key).encode())
+            _update_structured(h, value[key])
+    else:
+        h.update(repr(value).encode())
+
+
+def model_fingerprint(model, explain_kwargs: Optional[dict] = None) -> str:
+    """Fingerprint of everything besides the instance rows that determines
+    an explanation: background digest, link, grouping, seed, pinned explain
+    options and the predictor's in-process identity.
+
+    A model may pin its own ``fingerprint`` attribute (e.g. a hash of
+    checkpoint weights, so restarts share keys); otherwise the fingerprint
+    is derived by introspection.  Predictor identity falls back to
+    ``id(predictor)``, which is correct within one process — a *different*
+    predictor object can only cause misses, never wrong answers.
+    """
+
+    explicit = getattr(model, "fingerprint", None)
+    if isinstance(explicit, str) and explicit:
+        return explicit
+    h = hashlib.sha256()
+    explainer = getattr(model, "explainer", model)
+    engine = getattr(explainer, "_explainer", None)
+    background = getattr(engine, "background", None)
+    if background is not None:
+        h.update(array_fingerprint(np.asarray(background)).encode())
+    bg_weights = getattr(engine, "bg_weights", None)
+    if bg_weights is not None:
+        h.update(array_fingerprint(np.asarray(bg_weights)).encode())
+    h.update(repr(getattr(explainer, "link", None)).encode())
+    h.update(repr(getattr(explainer, "seed", None)).encode())
+    _update_structured(h, getattr(engine, "groups", None))
+    kwargs = (explain_kwargs if explain_kwargs is not None
+              else getattr(model, "explain_kwargs", None))
+    _update_structured(h, kwargs or {})
+    predictor = getattr(engine, "predictor",
+                        getattr(explainer, "predictor", None))
+    h.update(f"{type(predictor).__qualname__}:{id(predictor)}".encode())
+    return h.hexdigest()
+
+
+def request_cache_key(array: np.ndarray, model_fp: str) -> str:
+    """Key for one request: instance-rows digest x model fingerprint."""
+
+    return f"{model_fp}:{array_fingerprint(array)}"
+
+
+class ResultCache:
+    """Thread-safe LRU cache of response payload strings, bounded by an
+    approximate byte budget (UTF-8 length of the stored payloads; the JSON
+    here is ASCII so ``len(payload)`` is the byte count)."""
+
+    def __init__(self, max_bytes: int):
+        if max_bytes <= 0:
+            raise ValueError("max_bytes must be positive "
+                             "(use no cache instead of a zero-byte one)")
+        self.max_bytes = int(max_bytes)
+        self._entries: "OrderedDict[str, str]" = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
+
+    def get(self, key: str) -> Optional[str]:
+        with self._lock:
+            payload = self._entries.get(key)
+            if payload is None:
+                self._misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self._hits += 1
+            return payload
+
+    def put(self, key: str, payload: str) -> None:
+        size = len(payload)
+        if size > self.max_bytes:
+            return  # larger than the whole budget: caching it evicts all
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= len(old)
+            self._entries[key] = payload
+            self._bytes += size
+            while self._bytes > self.max_bytes and self._entries:
+                _, evicted = self._entries.popitem(last=False)
+                self._bytes -= len(evicted)
+                self._evictions += 1
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def current_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"hits": self._hits, "misses": self._misses,
+                    "evictions": self._evictions, "entries":
+                    len(self._entries), "bytes": self._bytes}

@@ -1,0 +1,257 @@
+"""SLO-aware continuous-batching scheduler.
+
+Replaces :class:`ExplainerServer`'s FIFO ``queue.Queue`` + ``_fill_batch``
+poll loop (``serving/server.py``, rounds 1-5).  The FIFO had three
+production-scale problems the ROADMAP north star ("heavy traffic from
+millions of users") runs straight into:
+
+* **No priorities or deadlines** — a 1-row interactive request parks behind
+  a 2000-row batch job; under overload every request waits and then the
+  whole queue times out together.
+* **Idle polling** — the dispatcher woke every 0.1 s to check for work, so
+  a lone request paid up to 100 ms of scheduling latency before the device
+  ever saw it.
+* **A one-slot carry** — a request deferred because it would overflow the
+  model's ``max_rows`` broadcast slot lived in a side variable the watchdog
+  drain could not see.
+
+This scheduler keeps every queued request in ONE earliest-deadline-first
+heap.  Each request carries a priority class (``interactive`` / ``batch`` /
+``best_effort``) and an optional absolute deadline; requests without an
+explicit deadline are ordered by ``enqueue_time + class budget``, so under
+contention interactive traffic sorts ahead of batch traffic *by
+construction* rather than via separate queues that need cross-queue
+starvation rules.  Batch formation pops in EDF order and packs rows up to
+the model's ``max_rows`` budget; an item that would overflow is pushed back
+into the heap with its original key, where the advancing clock makes it the
+earliest item — it leads the next batch, so deferral can never starve it.
+Wakeups are condition-variable driven: ``put`` notifies the dispatcher, so
+an idle server dispatches a lone request immediately instead of on the next
+poll tick.
+
+The gemma-on-TPU serving comparison and Podracer's centralized batcher
+(PAPERS.md) both locate exactly this layer — batch formation by deadline
+and cost — as where accelerator serving throughput comes from.
+"""
+
+import heapq
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+PRIORITY_CLASSES = ("interactive", "batch", "best_effort")
+
+# Ordering budgets (seconds): a request with no explicit deadline is
+# scheduled as if it were due ``enqueue + budget[class]``.  These are
+# *ordering* knobs only — nothing is shed for missing an implicit budget;
+# shedding applies solely to requests that declared a real deadline.
+DEFAULT_CLASS_BUDGETS_S: Dict[str, float] = {
+    "interactive": 0.5,
+    "batch": 30.0,
+    "best_effort": 120.0,
+}
+
+
+class SLOScheduler:
+    """EDF request queue with row-budget batch formation.
+
+    Items must expose ``klass`` (one of :data:`PRIORITY_CLASSES`),
+    ``deadline`` (absolute ``time.monotonic`` seconds, or ``None``),
+    ``t_enqueued`` (monotonic), ``rows`` (int) and ``done`` (bool — set by
+    whoever answers the request out-of-band, e.g. the server's wedge path;
+    done items are dropped, not dispatched).
+
+    Only one consumer thread may call :meth:`next_batch` (the server runs
+    one dispatcher); any number of producers may :meth:`put`.
+    """
+
+    def __init__(self, class_budgets: Optional[Dict[str, float]] = None,
+                 now=time.monotonic):
+        self._budgets = dict(DEFAULT_CLASS_BUDGETS_S)
+        if class_budgets:
+            self._budgets.update(class_budgets)
+        self._now = now
+        self._cond = threading.Condition()
+        self._heap: List[Tuple[float, int, object]] = []
+        self._seq = 0
+        self._depths: Dict[str, int] = {k: 0 for k in PRIORITY_CLASSES}
+        self._queued_rows = 0
+        self._stopped = False
+
+    # -- ordering hooks (FIFOScheduler overrides) ----------------------- #
+
+    def _effective_deadline(self, item) -> float:
+        if getattr(item, "deadline", None) is not None:
+            return item.deadline
+        budget = self._budgets.get(getattr(item, "klass", "batch"),
+                                   self._budgets["batch"])
+        return item.t_enqueued + budget
+
+    def _is_expired(self, item, now: float) -> bool:
+        deadline = getattr(item, "deadline", None)
+        return deadline is not None and now > deadline
+
+    # -- producer side -------------------------------------------------- #
+
+    def put(self, item) -> None:
+        with self._cond:
+            heapq.heappush(self._heap,
+                           (self._effective_deadline(item), self._seq, item))
+            self._seq += 1
+            klass = getattr(item, "klass", "batch")
+            self._depths[klass] = self._depths.get(klass, 0) + 1
+            self._queued_rows += item.rows
+            self._cond.notify()
+
+    # -- introspection (admission control, metrics) --------------------- #
+
+    def depths(self) -> Dict[str, int]:
+        with self._cond:
+            return dict(self._depths)
+
+    def queued_rows(self) -> int:
+        with self._cond:
+            return self._queued_rows
+
+    def rows_ahead(self, klass: str, deadline: Optional[float]) -> int:
+        """Rows queued that would sort AHEAD of a hypothetical request of
+        ``klass`` with ``deadline`` (absolute monotonic, or ``None`` for
+        the class budget) — the EDF-aware input to admission's
+        projected-wait gate.  Dividing the TOTAL queue by the service rate
+        would project as if the request waited behind every queued row,
+        i.e. it would shed exactly the interactive traffic this scheduler
+        dispatches first.  On :class:`FIFOScheduler` every stored key is
+        0.0, so this degrades to the whole queue — correct for FIFO, where
+        everything really is ahead."""
+
+        if deadline is None:
+            deadline = self._now() + self._budgets.get(
+                klass, self._budgets["batch"])
+        with self._cond:
+            return sum(item.rows for eff, _, item in self._heap
+                       if eff <= deadline and not getattr(item, "done",
+                                                          False))
+
+    def qsize(self) -> int:
+        with self._cond:
+            return len(self._heap)
+
+    # -- consumer side --------------------------------------------------- #
+
+    def _account_pop(self, item) -> None:
+        klass = getattr(item, "klass", "batch")
+        self._depths[klass] = max(0, self._depths.get(klass, 0) - 1)
+        self._queued_rows = max(0, self._queued_rows - item.rows)
+
+    def next_batch(self, max_batch_size: int, max_rows: Optional[int] = None,
+                   batch_timeout_s: float = 0.0,
+                   stop: Optional[threading.Event] = None,
+                   idle_wait_s: float = 0.5):
+        """Form one batch.  Returns ``(batch, expired)``.
+
+        Blocks (condition-variable wait, bounded by ``idle_wait_s`` per
+        sleep so ``stop`` is honoured) until a request arrives, then keeps
+        packing in EDF order — waking on new arrivals — until the batch is
+        full, the row budget is met, or ``batch_timeout_s`` has passed
+        since the first pop.  ``expired`` holds popped items whose explicit
+        deadline had already passed: the caller owns failing them (they
+        must not cost device work).  Returns ``(None, [])`` when stopped
+        while idle.
+        """
+
+        with self._cond:
+            while not self._heap:
+                if self._stopped or (stop is not None and stop.is_set()):
+                    return None, []
+                self._cond.wait(timeout=idle_wait_s)
+            batch: List[object] = []
+            expired: List[object] = []
+            rows = 0
+            fill_deadline = self._now() + (batch_timeout_s
+                                           if max_batch_size > 1 else 0.0)
+            while True:
+                pushback: List[Tuple[float, int, object]] = []
+                now = self._now()
+                while self._heap and len(batch) < max_batch_size:
+                    if max_rows and rows >= max_rows:
+                        # budget exactly consumed: nothing can fit, so
+                        # don't churn the rest of the heap through the
+                        # pushback path (O(n log n) per batch under
+                        # backlog, all while holding the lock)
+                        break
+                    eff, seq, item = heapq.heappop(self._heap)
+                    if getattr(item, "done", False):
+                        self._account_pop(item)
+                        continue
+                    if self._is_expired(item, now):
+                        self._account_pop(item)
+                        expired.append(item)
+                        continue
+                    if batch and max_rows and rows + item.rows > max_rows:
+                        # row-budget packing: keep scanning for items that
+                        # still fit; the overflow item keeps its original
+                        # key, so it leads a subsequent batch (no
+                        # starvation, no side-channel carry slot)
+                        pushback.append((eff, seq, item))
+                        continue
+                    self._account_pop(item)
+                    batch.append(item)
+                    rows += item.rows
+                for entry in pushback:
+                    heapq.heappush(self._heap, entry)
+                if len(batch) >= max_batch_size:
+                    break
+                if max_rows and rows >= max_rows:
+                    break
+                remaining = fill_deadline - self._now()
+                if remaining <= 0:
+                    break
+                if self._stopped or (stop is not None and stop.is_set()):
+                    break
+                # woken early by put(): loop re-scans the heap
+                self._cond.wait(timeout=remaining)
+            return batch, expired
+
+    def drain(self) -> List[object]:
+        """Remove and return every queued (not-done) item — the server's
+        wedge/shutdown path fails them so no handler thread leaks."""
+
+        with self._cond:
+            items = [item for _, _, item in self._heap
+                     if not getattr(item, "done", False)]
+            self._heap.clear()
+            self._depths = {k: 0 for k in PRIORITY_CLASSES}
+            self._queued_rows = 0
+            return items
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+
+
+class FIFOScheduler(SLOScheduler):
+    """Arrival-order baseline with no deadline semantics.
+
+    Same interface (so the server and the benchmark can swap policies with
+    one knob) but orders purely by arrival sequence and never expires
+    anything — this is the exact behaviour of the round-4 FIFO queue, kept
+    as the control arm for ``benchmarks/scheduling_bench.py``.
+    """
+
+    def _effective_deadline(self, item) -> float:
+        return 0.0  # heap tie-breaks on seq == arrival order
+
+    def _is_expired(self, item, now: float) -> bool:
+        return False
+
+
+def make_scheduler(policy: str = "slo",
+                   class_budgets: Optional[Dict[str, float]] = None,
+                   now=time.monotonic) -> SLOScheduler:
+    if policy == "slo":
+        return SLOScheduler(class_budgets=class_budgets, now=now)
+    if policy == "fifo":
+        return FIFOScheduler(class_budgets=class_budgets, now=now)
+    raise ValueError(f"unknown scheduling policy {policy!r} "
+                     f"(expected 'slo' or 'fifo')")

@@ -33,6 +33,7 @@ Stdlib-only, same as the rest of the serving stack: the proxy is a
 import http.client
 import json
 import logging
+import math
 import os
 import socket
 import subprocess
@@ -59,6 +60,24 @@ class _Replica:
         self.port = port
         self.alive = True
         self.errors_total = 0
+        # monotonic time until which this replica has declared itself
+        # saturated (it answered 429 reason=queue_full): alive, just not
+        # worth forwarding to.  Keyed by the request's priority class —
+        # the replica's queue bounds are per class, so a batch-class flood
+        # filling batch queues must not mark the replica saturated for
+        # interactive traffic it still admits.
+        self.saturated_until: Dict[str, float] = {}
+
+    def saturated_for(self, klass: str) -> float:
+        """Backoff expiry for one class (0.0 when not backed off)."""
+
+        return self.saturated_until.get(klass, 0.0)
+
+    def saturated_any(self) -> float:
+        # .copy() is a single C-level op (atomic under the GIL): handler
+        # threads insert new class keys concurrently, and iterating the
+        # live dict could raise "dictionary changed size during iteration"
+        return max(self.saturated_until.copy().values(), default=0.0)
 
     @property
     def address(self) -> str:
@@ -71,7 +90,15 @@ class FanInProxy:
     def __init__(self, targets: Sequence[Tuple[str, int]],
                  host: str = "127.0.0.1", port: int = 0,
                  request_timeout_s: float = 600.0,
-                 probe_interval_s: float = 1.0):
+                 probe_interval_s: float = 1.0,
+                 trust_client_header: bool = False):
+        #: whether a client-supplied ``X-DKS-Client`` passes through.  Off
+        #: by default: the proxy is the trust boundary, and an untrusted
+        #: client choosing its own rate-limit key defeats per-client
+        #: limiting (a fresh key per request = a fresh full token bucket).
+        #: Enable only when an authenticated edge in front of the proxy
+        #: sets the header.
+        self.trust_client_header = trust_client_header
         self.replicas = [_Replica(i, h, p) for i, (h, p) in enumerate(targets)]
         if not self.replicas:
             raise ValueError("FanInProxy needs at least one replica target")
@@ -83,7 +110,8 @@ class FanInProxy:
         self._metrics_lock = threading.Lock()
         self._metrics = {"forwarded_total": 0, "replica_errors_total": 0,
                          "retried_connects_total": 0,
-                         "replica_503_demotions_total": 0}
+                         "replica_503_demotions_total": 0,
+                         "sheds_total": 0}
         self._stop = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._threads: List[threading.Thread] = []
@@ -105,10 +133,14 @@ class FanInProxy:
 
     def _forward(self, method: str, path: str, body: bytes,
                  replica: _Replica,
-                 timeout_s: Optional[float] = None) -> Tuple[int, bytes]:
+                 timeout_s: Optional[float] = None,
+                 headers: Optional[Dict[str, str]] = None
+                 ) -> Tuple[int, bytes, Dict[str, str]]:
         """One forwarded request; raises on transport failure.  Separating
         connect from send lets the caller distinguish never-processed
-        (safe to retry) from possibly-processed (must surface)."""
+        (safe to retry) from possibly-processed (must surface).  Returns
+        ``(status, payload, response_headers)`` — the headers carry the
+        replica's ``Retry-After`` on a 429."""
 
         # short CONNECT timeout regardless of the request budget: a wedged
         # replica with a full listen backlog neither accepts nor refuses —
@@ -123,33 +155,89 @@ class FanInProxy:
             raise _ConnectFailed(replica)
         conn.sock.settimeout(timeout_s or self.request_timeout_s)
         try:
-            conn.request(method, path, body=body,
-                         headers={"Content-Type": "application/json"})
+            send_headers = {"Content-Type": "application/json"}
+            if headers:
+                send_headers.update(headers)
+            conn.request(method, path, body=body, headers=send_headers)
             resp = conn.getresponse()
-            return resp.status, resp.read()
+            return resp.status, resp.read(), dict(resp.getheaders())
         finally:
             conn.close()
 
-    def handle_explain(self, method: str, body: bytes) -> Tuple[int, bytes]:
-        """Route one /explain request; never raises."""
+    @staticmethod
+    def _retry_after_s(resp_headers: Dict[str, str], payload: bytes) -> float:
+        """Best-effort parse of a 429's backoff hint (header, else JSON
+        body); defaults to 1 s."""
+
+        value = resp_headers.get("Retry-After")
+        if value is not None:
+            try:
+                return max(0.1, float(value))
+            except ValueError:
+                pass
+        try:
+            return max(0.1, float(json.loads(payload)["retry_after_s"]))
+        except (ValueError, KeyError, TypeError, json.JSONDecodeError):
+            return 1.0
+
+    def handle_explain(self, method: str, body: bytes,
+                       headers: Optional[Dict[str, str]] = None
+                       ) -> Tuple[int, bytes, Dict[str, str]]:
+        """Route one /explain request; never raises.  ``headers`` are the
+        client's scheduling headers (priority class, deadline, client key),
+        forwarded verbatim so the replica's scheduler and admission control
+        see the same SLO the client declared."""
 
         tried: set = set()
         last_503: Optional[Tuple[int, bytes]] = None
+        last_429: Optional[Tuple[bytes, float]] = None
+        # saturation is tracked per priority class (replica queue bounds
+        # are per class).  A missing header is normalised to "interactive"
+        # — the server's default default_class — so headerless and
+        # explicitly-interactive traffic share one backoff key instead of
+        # burning a round trip each to learn the same 429.  (A deployment
+        # overriding default_class should have clients send the header.)
+        klass = "interactive"
+        for k, v in (headers or {}).items():
+            if k.lower() == "x-dks-priority":
+                klass = v.strip().lower()
+                break
         while True:
             replica = self._pick(tried)
             if replica is None:
+                if last_429 is not None:
+                    # every live replica reported saturation: shed at the
+                    # proxy with the replicas' own backoff hint instead of
+                    # queueing on a fleet that already said no
+                    payload, retry_s = last_429
+                    with self._metrics_lock:
+                        self._metrics["sheds_total"] += 1
+                    return 429, payload, {
+                        "Retry-After": str(max(1, int(math.ceil(retry_s))))}
                 if last_503 is not None:
                     # every live replica self-declared unserviceable: the
                     # most informative answer is a replica's own 503 body
-                    return last_503
+                    return last_503[0], last_503[1], {}
                 return 503, json.dumps({
                     "error": "no live replicas",
                     "replicas": {r.address: r.alive
-                                 for r in self.replicas}}).encode()
+                                 for r in self.replicas}}).encode(), {}
             tried.add(replica.index)
+            backoff = replica.saturated_for(klass)
+            if time.monotonic() < backoff:
+                # recently answered 429 for this class: skip without
+                # forwarding — early shedding costs the proxy nothing and
+                # keeps the saturated replica's handler threads free for
+                # queued work
+                if last_429 is None:
+                    last_429 = (json.dumps({
+                        "error": f"replica {replica.address} saturated",
+                        "reason": "replicas_saturated"}).encode(),
+                        backoff - time.monotonic())
+                continue
             try:
-                status, payload = self._forward(method, "/explain", body,
-                                                replica)
+                status, payload, resp_headers = self._forward(
+                    method, "/explain", body, replica, headers=headers)
             except _ConnectFailed:
                 # never reached the replica: mark dead, retry on the next —
                 # a connect failure cannot double-execute the request
@@ -174,7 +262,7 @@ class FanInProxy:
                 return 504, json.dumps({
                     "error": f"replica {replica.address} did not answer "
                              f"within {self.request_timeout_s:.0f}s",
-                    "replica": replica.index}).encode()
+                    "replica": replica.index}).encode(), {}
             except (OSError, http.client.HTTPException) as e:
                 # mid-request failure: the replica may have processed (or be
                 # processing) it — surface THIS request as that replica's
@@ -191,7 +279,37 @@ class FanInProxy:
                 return 502, json.dumps({
                     "error": f"replica {replica.address} failed "
                              f"mid-request: {e}",
-                    "replica": replica.index}).encode()
+                    "replica": replica.index}).encode(), {}
+            if status == 429:
+                retry_s = self._retry_after_s(resp_headers, payload)
+                try:
+                    reason = json.loads(payload).get("reason")
+                except (ValueError, AttributeError):
+                    reason = None
+                if reason == "rate_limited":
+                    # the replica shed THIS CLIENT, not load: the fleet has
+                    # headroom, so neither mark the replica saturated (that
+                    # would let one abusive client deny every client) nor
+                    # retry elsewhere (each replica keys its own bucket —
+                    # rotating would multiply the client's allowance xN)
+                    return 429, payload, {
+                        "Retry-After": str(max(1, int(math.ceil(retry_s))))}
+                if reason != "projected_wait":
+                    # queue_full (or unknown): a capacity signal for this
+                    # priority class — mark it saturated so same-class
+                    # requests skip it until the backoff elapses.
+                    # projected_wait is NOT marked: it depends on THIS
+                    # request's deadline (a deadline-less request would
+                    # have been admitted), so treating it as saturation
+                    # would shed traffic the replica still accepts.
+                    replica.saturated_until[klass] = (time.monotonic()
+                                                      + retry_s)
+                # either way retry a replica with more headroom (shedding
+                # is pre-dispatch, so the retry cannot double-execute); if
+                # every replica says 429 the exhausted-rotation path above
+                # sheds at the proxy with the replicas' own backoff hint
+                last_429 = (payload, retry_s)
+                continue
             if status == 503:
                 # the replica answered but DECLINED to serve (its own
                 # watchdog declared a device wedge and fast-503s, or it is
@@ -215,7 +333,7 @@ class FanInProxy:
                 continue
             with self._metrics_lock:
                 self._metrics["forwarded_total"] += 1
-            return status, payload
+            return status, payload, {}
 
     # ------------------------------------------------------------------ #
 
@@ -230,8 +348,8 @@ class FanInProxy:
                     # short dedicated timeout: a wedged-but-accepting
                     # replica must not stall the prober for the full
                     # request timeout and starve other replicas' recovery
-                    status, _ = self._forward("GET", "/healthz", b"", r,
-                                              timeout_s=5.0)
+                    status, _, _ = self._forward("GET", "/healthz", b"", r,
+                                                 timeout_s=5.0)
                 except (OSError, http.client.HTTPException):
                     # HTTPException too: a garbage health response must not
                     # kill the prober thread (that would silently disable
@@ -263,11 +381,25 @@ class FanInProxy:
             "# TYPE dks_fanin_replica_503_demotions_total counter",
             f"dks_fanin_replica_503_demotions_total "
             f"{m['replica_503_demotions_total']}",
+            "# HELP dks_fanin_sheds_total Requests shed at the proxy with "
+            "429 because every live replica reported saturation.",
+            "# TYPE dks_fanin_sheds_total counter",
+            f"dks_fanin_sheds_total {m['sheds_total']}",
             "# HELP dks_fanin_replica_up Replica liveness by index.",
             "# TYPE dks_fanin_replica_up gauge",
         ]
         lines += [f'dks_fanin_replica_up{{replica="{r.index}",'
                   f'address="{r.address}"}} {int(r.alive)}'
+                  for r in self.replicas]
+        now = time.monotonic()
+        lines += [
+            "# HELP dks_fanin_replica_saturated Replica currently "
+            "backing off after a 429.",
+            "# TYPE dks_fanin_replica_saturated gauge",
+        ]
+        lines += [f'dks_fanin_replica_saturated{{replica="{r.index}",'
+                  f'address="{r.address}"}} '
+                  f'{int(now < r.saturated_any())}'
                   for r in self.replicas]
         return "\n".join(lines) + "\n"
 
@@ -278,10 +410,13 @@ class FanInProxy:
             protocol_version = "HTTP/1.1"
 
             def _reply(self, code: int, payload: bytes,
-                       ctype: str = "application/json"):
+                       ctype: str = "application/json",
+                       headers: Optional[Dict[str, str]] = None):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(payload)))
+                for name, value in (headers or {}).items():
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(payload)
 
@@ -306,8 +441,26 @@ class FanInProxy:
                     self._reply(404, json.dumps(
                         {"error": "unknown route"}).encode())
                     return
-                code, payload = proxy.handle_explain(self.command, body)
-                self._reply(code, payload)
+                # forward the client's scheduling headers so the replica's
+                # scheduler/admission/cache see the declared SLO and key
+                sched_headers = {k: v for k, v in self.headers.items()
+                                 if k.lower().startswith("x-dks-")}
+                if not proxy.trust_client_header:
+                    # the replica would otherwise see every request from
+                    # the proxy's address (one shared bucket) — and a
+                    # client-chosen key would defeat rate limiting
+                    # entirely (fresh key = fresh full bucket), so the
+                    # proxy stamps the peer address unless an
+                    # authenticated edge is declared trusted
+                    sched_headers = {k: v for k, v in sched_headers.items()
+                                     if k.lower() != "x-dks-client"}
+                    sched_headers["X-DKS-Client"] = self.client_address[0]
+                elif not any(k.lower() == "x-dks-client"
+                             for k in sched_headers):
+                    sched_headers["X-DKS-Client"] = self.client_address[0]
+                code, payload, extra = proxy.handle_explain(
+                    self.command, body, headers=sched_headers)
+                self._reply(code, payload, headers=extra)
 
             do_GET = _handle
             do_POST = _handle

@@ -14,19 +14,50 @@ Implementation is stdlib-only (ThreadingHTTPServer + queue): the explain
 engine serialises device work anyway, so the natural architecture is one
 dispatcher thread feeding the device and N cheap HTTP threads parking on
 response events.
+
+Request flow since the scheduling subsystem landed
+(``distributedkernelshap_tpu/scheduling/``):
+
+1. the handler parses the priority class (``X-DKS-Priority``) and optional
+   deadline (``X-DKS-Deadline-Ms``), answers duplicates straight from the
+   result cache, and runs admission control — an over-capacity request is
+   shed NOW with 429 + ``Retry-After`` instead of timing out later;
+2. admitted requests enter the SLO scheduler (EDF heap, condition-variable
+   wakeups), which forms row-budget-packed batches;
+3. at dispatch, rows that became cached while queued are answered without
+   device work and identical in-batch duplicates collapse onto one
+   computation (per-batch partial-hit splitting);
+4. completed payloads populate the cache and feed the service-rate
+   estimator that admission's projected-wait shedding uses.
 """
 
 import json
 import logging
+import math
 import queue
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Optional, Tuple
 
 import numpy as np
 
+from distributedkernelshap_tpu.scheduling import (
+    PRIORITY_CLASSES,
+    AdmissionController,
+    ResultCache,
+    ServiceRateEstimator,
+    make_scheduler,
+    model_fingerprint,
+    request_cache_key,
+)
+
 logger = logging.getLogger(__name__)
+
+# Prometheus histogram bucket bounds for request latency (seconds).  Bounded
+# and few: the renderer emits one line per bucket on every scrape.
+LATENCY_BUCKETS_S = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
 
 class _HTTPServer(ThreadingHTTPServer):
@@ -38,9 +69,12 @@ class _HTTPServer(ThreadingHTTPServer):
 
 
 class _Pending:
-    __slots__ = ("array", "event", "response", "error", "t_enqueued", "done")
+    __slots__ = ("array", "event", "response", "error", "t_enqueued", "done",
+                 "klass", "deadline", "cache_key", "status_code", "cache_hit")
 
-    def __init__(self, array: np.ndarray):
+    def __init__(self, array: np.ndarray, klass: str = "interactive",
+                 deadline: Optional[float] = None,
+                 cache_key: Optional[str] = None):
         self.array = array
         self.event = threading.Event()
         self.response: Optional[str] = None
@@ -50,6 +84,22 @@ class _Pending:
         # blocked finalize may still complete it later — whoever is second
         # must not double-answer or double-count
         self.done = False
+        # scheduling metadata: priority class, absolute monotonic deadline
+        # (None = no SLO declared), content-address for the result cache
+        self.klass = klass
+        self.deadline = deadline
+        self.cache_key = cache_key
+        # HTTP status the handler should use when ``error`` is set (the
+        # watchdog/finalize failures keep the historical 500; deadline
+        # expiry answers 504)
+        self.status_code = 500
+        # answered from cache (handler fast path, dispatch recheck, or
+        # in-batch dedup) — drives the hit/miss counters
+        self.cache_hit = False
+
+    @property
+    def rows(self) -> int:
+        return self.array.shape[0]
 
 
 def calibrate_pipeline_depth(model, example_array: Optional[np.ndarray] = None,
@@ -171,6 +221,31 @@ class ExplainerServer:
         Bound on the tiny device round trip ``/healthz`` performs — a
         wedged tunnel turns the probe into a hang, which the bound converts
         into an unhealthy verdict.
+    scheduling
+        Batch-formation policy: ``"slo"`` (default — EDF over priority
+        classes + deadlines, ``scheduling/scheduler.py``) or ``"fifo"``
+        (arrival order; the pre-scheduler behaviour, kept as the benchmark
+        control arm).
+    class_budgets
+        Optional ``{class: seconds}`` overriding the EDF ordering budgets
+        for requests with no explicit deadline.
+    default_class
+        Priority class assumed when a request carries no
+        ``X-DKS-Priority`` header.
+    max_queue_per_class
+        Admission bound on queued requests per priority class (int, or a
+        per-class dict; 0/None disables).  A full class answers 429 +
+        ``Retry-After``.
+    rate_limit_per_client
+        ``(requests_per_s, burst)`` token-bucket rate limit keyed by
+        ``X-DKS-Client`` (else peer address).  ``None`` (default) disables.
+    cache_bytes
+        Byte budget for the content-addressed explanation cache
+        (``scheduling/result_cache.py``).  0 (default) disables caching.
+    admission_control
+        ``False`` disables every admission gate (queue bounds, rate
+        limits, projected-wait shedding) — the pre-scheduler accept-
+        everything behaviour, used as the benchmark control arm.
     """
 
     def __init__(self, model, host: str = "0.0.0.0", port: int = 8000,
@@ -178,7 +253,14 @@ class ExplainerServer:
                  pipeline_depth: Optional[int] = None,
                  watchdog_timeout_s: float = 120.0,
                  first_batch_grace_s: float = 600.0,
-                 device_probe_timeout_s: float = 5.0):
+                 device_probe_timeout_s: float = 5.0,
+                 scheduling: str = "slo",
+                 class_budgets: Optional[dict] = None,
+                 default_class: str = "interactive",
+                 max_queue_per_class=4096,
+                 rate_limit_per_client: Optional[Tuple[float, float]] = None,
+                 cache_bytes: int = 0,
+                 admission_control: bool = True):
         self.model = model
         self.host = host
         self.port = port
@@ -215,11 +297,47 @@ class ExplainerServer:
         self._metrics_lock = threading.Lock()
         self._metrics = {"requests_total": 0, "errors_total": 0,
                          "rows_total": 0, "batches_total": 0,
-                         "request_seconds_sum": 0.0, "wedges_total": 0}
-        self._queue: "queue.Queue[_Pending]" = queue.Queue()
-        # request popped by _fill_batch that would overflow the model's
-        # max_rows slot: carried into the next batch (dispatcher-only state)
-        self._carry: Optional[_Pending] = None
+                         "request_seconds_sum": 0.0, "wedges_total": 0,
+                         "cache_hits_total": 0, "cache_misses_total": 0}
+        # load-shed counters by reason.  The three admission reasons are
+        # refused before entering the pipeline and do NOT appear in
+        # requests_total; deadline_expired requests were admitted and
+        # answered (504), so they count in BOTH requests_total/errors_total
+        # and here — don't compute goodput as requests_total - sheds_total
+        self._sheds = {"queue_full": 0, "rate_limited": 0,
+                       "projected_wait": 0, "deadline_expired": 0}
+        # bounded request-latency histogram (cumulative counts rendered at
+        # /metrics); one extra slot for +Inf
+        self._latency_counts = [0] * (len(LATENCY_BUCKETS_S) + 1)
+        # scheduling subsystem: EDF (or FIFO-baseline) request queue,
+        # admission control fed by an EWMA of observed device throughput,
+        # optional content-addressed result cache
+        if default_class not in PRIORITY_CLASSES:
+            raise ValueError(f"default_class must be one of "
+                             f"{PRIORITY_CLASSES}, got {default_class!r}")
+        self.default_class = default_class
+        self._sched = make_scheduler(scheduling, class_budgets=class_budgets)
+        self._service_rate = ServiceRateEstimator()
+        self._admission = (AdmissionController(
+            max_queued_per_class=max_queue_per_class,
+            rate_limit_per_client=rate_limit_per_client,
+            estimator=self._service_rate) if admission_control else None)
+        self._cache = ResultCache(cache_bytes) if cache_bytes else None
+        # computed lazily on first request: fingerprinting hashes the
+        # background data, and the model may be swapped between __init__
+        # and start() in tests.  Staleness is detected by OBJECT IDENTITY:
+        # to change the served model with caching enabled, REPLACE
+        # ``self.model`` with a new object (or pin ``model.fingerprint``)
+        # — mutating the current model in place (in-place refit, swapping
+        # its predictor) is not detected, and re-hashing the background on
+        # every request to detect it would cost more than the cache saves.
+        # The pinned object also transitively keeps its predictor alive,
+        # so id(predictor) inside model_fingerprint cannot alias a new
+        # object at a recycled address while the fingerprint is cached.
+        self._model_fp: Optional[str] = None
+        self._model_fp_model = None
+        self._model_fp_lock = threading.Lock()
+        self._last_complete_t = time.monotonic()
         # (batch, finalize) pairs already dispatched to the device; bounded so
         # a slow host can't pile up unbounded in-flight device work (the
         # queue is created in start(), once the depth is known)
@@ -240,10 +358,64 @@ class ExplainerServer:
         self._metrics["rows_total"] += pending.array.shape[0]
         if error is not None:
             self._metrics["errors_total"] += 1
-        self._metrics["request_seconds_sum"] += (
-            time.monotonic() - pending.t_enqueued)
+        elif self._cache is not None:
+            key = "cache_hits_total" if pending.cache_hit \
+                else "cache_misses_total"
+            self._metrics[key] += 1
+        elapsed = time.monotonic() - pending.t_enqueued
+        self._metrics["request_seconds_sum"] += elapsed
+        for i, bound in enumerate(LATENCY_BUCKETS_S):
+            if elapsed <= bound:
+                self._latency_counts[i] += 1
+                break
+        else:
+            self._latency_counts[-1] += 1
 
-    def _complete(self, batch, payloads=None, error=None):
+    def _cache_key_for(self, array: np.ndarray) -> Optional[str]:
+        if self._cache is None:
+            return None
+        with self._model_fp_lock:
+            model = self.model
+            if self._model_fp is None or self._model_fp_model is not model:
+                self._model_fp = model_fingerprint(model)
+                self._model_fp_model = model
+            fp = self._model_fp
+        return request_cache_key(array, fp)
+
+    def _shed(self, reason: str) -> None:
+        with self._metrics_lock:
+            self._sheds[reason] = self._sheds.get(reason, 0) + 1
+
+    def _fail_request(self, pending, error: str, status: int) -> None:
+        """Fail one request outside the batch path (deadline expiry): no
+        device batch was involved, so ``batches_total`` must not move."""
+
+        with self._metrics_lock:
+            if pending.done:
+                return
+            pending.done = True
+            self._count_request(pending, error)
+        pending.error = error
+        pending.status_code = status
+        pending.event.set()
+
+    def _answer_cached(self, pending, payload: str) -> bool:
+        """Answer one request from the cache (dispatch-time recheck path).
+        Returns False if something else already claimed it."""
+
+        with self._metrics_lock:
+            if pending.done:
+                return False
+            pending.done = True
+            pending.cache_hit = True
+            self._count_request(pending)
+        pending.response = payload
+        pending.event.set()
+        return True
+
+    def _complete(self, batch, payloads=None, error=None, status: int = 500,
+                  index_map=None, device_rows: int = 0,
+                  t_dispatch: Optional[float] = None):
         # counters update BEFORE the response events: a client that gets
         # its answer and immediately scrapes /metrics must see itself
         # counted.  Claiming happens under the metrics lock so a batch the
@@ -275,9 +447,29 @@ class ExplainerServer:
                 self._count_request(p, error)
         with self._active_lock:
             self._active.pop(id(batch), None)
-        self._last_progress = time.monotonic()
+        now = time.monotonic()
+        self._last_progress = now
         if error is None:
             self._ever_completed = True
+            if device_rows:
+                # feed admission's projected-wait gate: min of the two
+                # windows is the better throughput estimate in both regimes
+                # (completion-to-completion under pipelined load, where
+                # dispatch-to-complete overcounts by the pipeline depth;
+                # dispatch-to-complete after an idle gap, where the
+                # completion gap includes the idle time)
+                # concurrent finalizers race on _last_complete_t, so the
+                # completion gap can come out negative — only fold it in
+                # when it is a plausible (positive) window, else a
+                # microscopic clamp would record millions of rows/s and
+                # blind the projected-wait gate until the EWMA decays
+                gap = now - self._last_complete_t
+                window = now - t_dispatch if t_dispatch is not None else gap
+                if 0 < gap < window:
+                    window = gap
+                if window > 0:
+                    self._service_rate.observe(device_rows, window)
+            self._last_complete_t = now
             if self._wedged.is_set():
                 # the device answered again (relay unwedged): resume serving
                 logger.warning("serving recovered: a batch completed after "
@@ -286,13 +478,19 @@ class ExplainerServer:
         for i, p in live:
             if error is not None:
                 p.error = error
+                p.status_code = status
             else:
-                p.response = payloads[i]
+                p.response = payloads[index_map[i] if index_map else i]
+                if self._cache is not None and p.cache_key is not None:
+                    self._cache.put(p.cache_key, p.response)
             p.event.set()
 
     def _render_metrics(self) -> str:
         with self._metrics_lock:
             m = dict(self._metrics)
+            sheds = dict(self._sheds)
+            latency_counts = list(self._latency_counts)
+        depths = self._sched.depths()
         lines = [
             "# HELP dks_serve_requests_total Requests answered.",
             "# TYPE dks_serve_requests_total counter",
@@ -318,47 +516,96 @@ class ExplainerServer:
             "# HELP dks_serve_wedged Whether the server is currently wedged.",
             "# TYPE dks_serve_wedged gauge",
             f"dks_serve_wedged {int(self._wedged.is_set())}",
+            "# HELP dks_serve_queue_depth Queued requests by priority class.",
+            "# TYPE dks_serve_queue_depth gauge",
         ]
+        lines += [f'dks_serve_queue_depth{{class="{k}"}} {depths.get(k, 0)}'
+                  for k in sorted(depths)]
+        lines += [
+            "# HELP dks_serve_sheds_total Requests shed before dispatch, "
+            "by reason.",
+            "# TYPE dks_serve_sheds_total counter",
+        ]
+        lines += [f'dks_serve_sheds_total{{reason="{r}"}} {sheds[r]}'
+                  for r in sorted(sheds)]
+        lines += [
+            "# HELP dks_serve_request_latency_seconds Queue+explain latency "
+            "of answered requests.",
+            "# TYPE dks_serve_request_latency_seconds histogram",
+        ]
+        cumulative = 0
+        for bound, count in zip(LATENCY_BUCKETS_S, latency_counts):
+            cumulative += count
+            lines.append(f'dks_serve_request_latency_seconds_bucket'
+                         f'{{le="{bound}"}} {cumulative}')
+        cumulative += latency_counts[-1]
+        lines += [
+            f'dks_serve_request_latency_seconds_bucket{{le="+Inf"}} '
+            f'{cumulative}',
+            f"dks_serve_request_latency_seconds_sum "
+            f"{m['request_seconds_sum']:.6f}",
+            f"dks_serve_request_latency_seconds_count {cumulative}",
+        ]
+        if self._cache is not None:
+            cache = self._cache.stats()
+            lines += [
+                "# HELP dks_serve_cache_hits_total Requests answered from "
+                "the result cache (incl. in-batch dedup).",
+                "# TYPE dks_serve_cache_hits_total counter",
+                f"dks_serve_cache_hits_total {m['cache_hits_total']}",
+                "# HELP dks_serve_cache_misses_total Requests that cost "
+                "device work.",
+                "# TYPE dks_serve_cache_misses_total counter",
+                f"dks_serve_cache_misses_total {m['cache_misses_total']}",
+                "# HELP dks_serve_cache_entries Cached explanations.",
+                "# TYPE dks_serve_cache_entries gauge",
+                f"dks_serve_cache_entries {cache['entries']}",
+                "# HELP dks_serve_cache_bytes Bytes held by the result "
+                "cache.",
+                "# TYPE dks_serve_cache_bytes gauge",
+                f"dks_serve_cache_bytes {cache['bytes']}",
+                "# HELP dks_serve_cache_evictions_total LRU evictions "
+                "under the byte budget.",
+                "# TYPE dks_serve_cache_evictions_total counter",
+                f"dks_serve_cache_evictions_total {cache['evictions']}",
+            ]
         return "\n".join(lines) + "\n"
 
-    def _fill_batch(self):
-        """Pop up to ``max_batch_size`` requests, waiting ``batch_timeout_s``
-        after the first arrival for the batch to fill.
+    def _split_batch_on_cache(self, batch):
+        """Per-batch partial-hit splitting (``scheduling/result_cache.py``):
+        answer rows that became cached while queued, collapse identical
+        in-batch duplicates onto one computation, and return
+        ``(live, leaders, index_map)`` — ``leaders`` are the requests that
+        actually cost device work, ``index_map[i]`` maps each live request
+        to its leader's payload slot."""
 
-        A model may declare ``max_rows`` (the multihost broadcast slot):
-        coalescing then also stops before the stacked row count would
-        exceed it — the item that would overflow is carried into the next
-        batch instead of failing innocent neighbours."""
-
-        max_rows = getattr(self.model, "max_rows", None)
-        if self._carry is not None:
-            first, self._carry = self._carry, None
-        else:
-            try:
-                first = self._queue.get(timeout=0.1)
-            except queue.Empty:
-                return None
-        batch = [first]
-        rows = first.array.shape[0]
-        if self.max_batch_size > 1:
-            deadline = time.monotonic() + self.batch_timeout_s
-            while len(batch) < self.max_batch_size:
-                remaining = deadline - time.monotonic()
-                try:
-                    # drain immediately-available items even past the deadline
-                    item = (self._queue.get(timeout=max(0.0, remaining))
-                            if remaining > 0 else self._queue.get_nowait())
-                except queue.Empty:
-                    break
-                if max_rows and rows + item.array.shape[0] > max_rows:
-                    self._carry = item
-                    break
-                batch.append(item)
-                rows += item.array.shape[0]
-        return batch
+        live, leaders, index_map = [], [], []
+        seen = {}
+        for p in batch:
+            if p.done:
+                # answered elsewhere (wedge handling) — no device work
+                continue
+            key = p.cache_key
+            if key is not None:
+                payload = self._cache.get(key)
+                if payload is not None:
+                    self._answer_cached(p, payload)
+                    continue
+                if key in seen:
+                    # identical request already in this batch: share its
+                    # computation (and its payload slot)
+                    p.cache_hit = True
+                    index_map.append(seen[key])
+                    live.append(p)
+                    continue
+                seen[key] = len(leaders)
+            index_map.append(len(leaders))
+            leaders.append(p)
+            live.append(p)
+        return live, leaders, index_map
 
     def _dispatch_loop(self):
-        """Coalesce queued requests and dispatch one device call per batch.
+        """Form batches via the scheduler and dispatch one device call each.
 
         Dispatch-only: the device work is launched asynchronously and the
         ``(batch, finalize)`` pair is handed to the finalizer pool, so batch
@@ -366,34 +613,52 @@ class ExplainerServer:
         is ~70ms of RPC latency on a tunnelled TPU and concurrent fetches
         overlap, so pipelining collapses the per-batch round-trip cost."""
 
-        pipelined = hasattr(self.model, "explain_batch_async")
         try:
             while not self._stop.is_set():
-                batch = self._fill_batch()
-                if batch is None:
-                    continue
-                # requests the wedge handling already answered (handler-side
-                # fail, watchdog drain) must not cost device work
-                batch = [p for p in batch if not p.done]
+                batch, expired = self._sched.next_batch(
+                    self.max_batch_size,
+                    max_rows=getattr(self.model, "max_rows", None),
+                    batch_timeout_s=self.batch_timeout_s, stop=self._stop)
+                # read after batch formation: tests may swap self.model
+                # while the dispatcher is parked in next_batch
+                pipelined = hasattr(self.model, "explain_batch_async")
+                for p in expired:
+                    # the declared SLO is already missed: answering late
+                    # would waste a device slot on a response the client
+                    # has abandoned
+                    self._shed("deadline_expired")
+                    self._fail_request(p, "deadline expired before dispatch "
+                                      "(server overloaded)", 504)
                 if not batch:
                     continue
-                sizes = [p.array.shape[0] for p in batch]
+                live, leaders, index_map = self._split_batch_on_cache(batch)
+                if not leaders:
+                    continue
+                sizes = [p.array.shape[0] for p in leaders]
                 with self._active_lock:
                     # registered BEFORE the device call so the watchdog can
                     # fail it if the call never returns
-                    self._active[id(batch)] = batch
+                    self._active[id(live)] = live
+                t_dispatch = time.monotonic()
+                device_rows = sum(sizes)
                 try:
-                    stacked = np.concatenate([p.array for p in batch], axis=0)
+                    stacked = np.concatenate([p.array for p in leaders],
+                                             axis=0)
                     if pipelined:
                         finalize = self.model.explain_batch_async(
                             stacked, split_sizes=sizes)
-                        self._inflight.put((batch, finalize))
+                        self._inflight.put((live, finalize, index_map,
+                                            device_rows, t_dispatch))
                     else:
-                        self._complete(batch, self.model.explain_batch(
-                            stacked, split_sizes=sizes))
+                        self._complete(
+                            live,
+                            self.model.explain_batch(stacked,
+                                                     split_sizes=sizes),
+                            index_map=index_map, device_rows=device_rows,
+                            t_dispatch=t_dispatch)
                 except Exception as e:  # surface errors to waiting requests
                     logger.exception("explain batch failed")
-                    self._complete(batch, error=str(e))
+                    self._complete(live, error=str(e))
         finally:
             # finalizers only exit once dispatch can no longer enqueue, so a
             # batch dispatched during shutdown is still fetched + answered
@@ -405,11 +670,14 @@ class ExplainerServer:
 
         while not (self._dispatch_done.is_set() and self._inflight.empty()):
             try:
-                batch, finalize = self._inflight.get(timeout=0.1)
+                (batch, finalize, index_map,
+                 device_rows, t_dispatch) = self._inflight.get(timeout=0.1)
             except queue.Empty:
                 continue
             try:
-                self._complete(batch, finalize())
+                self._complete(batch, finalize(), index_map=index_map,
+                               device_rows=device_rows,
+                               t_dispatch=t_dispatch)
             except Exception as e:
                 logger.exception("finalize batch failed")
                 self._complete(batch, error=str(e))
@@ -461,14 +729,9 @@ class ExplainerServer:
             # requests parked behind the wedged dispatcher never reach a
             # device call: fail them too instead of letting them wait out
             # the pod restart (new arrivals fast-503 via the handler)
-            drained = []
-            while True:
-                try:
-                    drained.append(self._queue.get_nowait())
-                except queue.Empty:
-                    break
+            drained = self._sched.drain()
             if drained:
-                self._complete(drained, error=msg)
+                self._complete(drained, error=msg, status=503)
             reset = getattr(self.model, "reset", None)
             if reset is not None:
                 try:
@@ -545,11 +808,14 @@ class ExplainerServer:
             # threads instead of spawning one per request
             protocol_version = "HTTP/1.1"
 
-            def _reply(self, code: int, body: str, ctype="application/json"):
+            def _reply(self, code: int, body: str, ctype="application/json",
+                       headers=None):
                 data = body.encode()
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                for name, value in (headers or {}).items():
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -573,6 +839,31 @@ class ExplainerServer:
                 except (KeyError, ValueError, json.JSONDecodeError) as e:
                     self._reply(400, json.dumps({"error": f"bad request: {e}"}))
                     return
+                # SLO headers (scheduling subsystem): priority class,
+                # relative deadline, rate-limit key.  Parsed after the body
+                # read so a reject never desyncs the keep-alive connection.
+                klass = (self.headers.get("X-DKS-Priority")
+                         or server.default_class).strip().lower()
+                if klass not in PRIORITY_CLASSES:
+                    self._reply(400, json.dumps({
+                        "error": f"unknown priority class {klass!r}; "
+                                 f"expected one of {list(PRIORITY_CLASSES)}"}))
+                    return
+                deadline = None
+                deadline_ms = self.headers.get("X-DKS-Deadline-Ms")
+                if deadline_ms is not None:
+                    try:
+                        deadline_ms = float(deadline_ms)
+                        if not deadline_ms > 0:
+                            raise ValueError
+                    except ValueError:
+                        self._reply(400, json.dumps({
+                            "error": "X-DKS-Deadline-Ms must be a positive "
+                                     "number of milliseconds"}))
+                        return
+                    deadline = time.monotonic() + deadline_ms / 1000.0
+                client_key = (self.headers.get("X-DKS-Client")
+                              or self.client_address[0])
                 if server._wedged.is_set():
                     # fast error instead of a socket that hangs until the
                     # pod restart: the reference's crashed-replica requests
@@ -592,25 +883,65 @@ class ExplainerServer:
                         "error": f"request of {array.shape[0]} rows exceeds "
                                  f"this deployment's max_rows={max_rows}"}))
                     return
-                pending = _Pending(array)
-                server._queue.put(pending)
+                pending = _Pending(array, klass=klass, deadline=deadline,
+                                   cache_key=server._cache_key_for(array))
+                # cache fast path: a duplicate of an already-served request
+                # is answered bit-identically without queueing at all
+                if pending.cache_key is not None:
+                    cached = server._cache.get(pending.cache_key)
+                    if cached is not None:
+                        server._answer_cached(pending, cached)
+                        self._reply(200, cached)
+                        return
+                # admission control: shed NOW (429 + Retry-After) rather
+                # than letting an unservable request time out in the queue
+                # rows_ahead is an O(queue) scan under the scheduler lock;
+                # only deadline-carrying requests need the EDF-aware
+                # projection (deadline-less ones use queued rows solely
+                # for the queue_full Retry-After hint), so the bulk of
+                # traffic pays O(1) here
+                decision = (server._admission.admit(
+                    klass, array.shape[0], client_key, deadline=deadline,
+                    queue_depth=server._sched.depths().get(klass, 0),
+                    queued_rows=(server._sched.rows_ahead(klass, deadline)
+                                 if deadline is not None
+                                 else server._sched.queued_rows()))
+                    if server._admission is not None else True)
+                if not decision:
+                    server._shed(decision.reason)
+                    retry_s = max(1, int(math.ceil(decision.retry_after_s)))
+                    self._reply(429, json.dumps({
+                        "error": f"request shed ({decision.reason}); "
+                                 f"retry after {decision.retry_after_s:.2f}s",
+                        "reason": decision.reason,
+                        "retry_after_s": round(decision.retry_after_s, 3)}),
+                        headers={"Retry-After": str(retry_s)})
+                    return
+                server._sched.put(pending)
                 # re-check shutdown/wedge periodically so in-flight requests
                 # fail fast instead of hanging on a dead dispatcher
                 while not pending.event.wait(timeout=1.0):
                     if server._stop.is_set():
-                        pending.error = pending.error or "server shutting down"
+                        if pending.error is None:
+                            pending.error = "server shutting down"
+                            pending.status_code = 503
                         break
                     if server._wedged.is_set():
-                        # catches requests the watchdog's queue drain can't
-                        # see (the dispatcher's carry slot, races with
-                        # _fill_batch); claim under the metrics lock so a
-                        # late completion can't double-answer
+                        # catches requests the watchdog's scheduler drain
+                        # can't see (races with next_batch); claim under the
+                        # metrics lock so a late completion can't
+                        # double-answer
                         with server._metrics_lock:
                             if not pending.done:
                                 pending.done = True
                                 pending.error = (
                                     "server wedged: device made no progress "
                                     "within the watchdog timeout")
+                                # 503 like the watchdog drain: this request
+                                # was never dispatched, so a fan-in proxy
+                                # can safely fail it over to a healthy
+                                # replica (500 would surface to the client)
+                                pending.status_code = 503
                                 # this claim bypasses _complete's live
                                 # loop, so count it via the shared helper —
                                 # error counters matter most exactly during
@@ -620,7 +951,8 @@ class ExplainerServer:
                         if pending.error is not None:
                             break
                 if pending.error is not None:
-                    self._reply(500, json.dumps({"error": pending.error}))
+                    self._reply(pending.status_code or 500,
+                                json.dumps({"error": pending.error}))
                 else:
                     self._reply(200, pending.response)
 
@@ -638,7 +970,7 @@ class ExplainerServer:
 
     def start(self):
         # bind + serve the socket FIRST: requests arriving during depth
-        # calibration park in self._queue (handlers wait on their response
+        # calibration park in the scheduler (handlers wait on their response
         # events) instead of getting connection-refused on an unbound port
         self._httpd = _HTTPServer((self.host, self.port), self._make_handler())
         self.port = self._httpd.server_address[1]  # resolve port 0
@@ -668,13 +1000,17 @@ class ExplainerServer:
 
     def stop(self):
         self._stop.set()
-        # fail anything still queued so no handler thread waits forever
-        while True:
-            try:
-                pending = self._queue.get_nowait()
-            except queue.Empty:
-                break
+        self._sched.stop()  # wake the dispatcher's condition wait
+        # fail anything still queued — including items deferred for row
+        # overflow, which live in the same heap — so no handler thread
+        # waits forever and nothing leaks
+        for pending in self._sched.drain():
+            with self._metrics_lock:
+                if pending.done:
+                    continue
+                pending.done = True
             pending.error = "server shutting down"
+            pending.status_code = 503
             pending.event.set()
         if self._httpd is not None:
             self._httpd.shutdown()
@@ -691,7 +1027,8 @@ def serve_explainer(predictor, background_data, constructor_kwargs, fit_kwargs,
                     host: str = "0.0.0.0", port: int = 8000,
                     max_batch_size: int = 1, batched: bool = None,
                     pipeline_depth: Optional[int] = None,
-                    explain_kwargs: Optional[dict] = None) -> ExplainerServer:
+                    explain_kwargs: Optional[dict] = None,
+                    **server_kwargs) -> ExplainerServer:
     """Build, fit and serve an explainer in one call — the analog of the
     reference's ``backend_setup`` + ``endpont_setup``
     (``serve_explanations.py:27-67``).
@@ -711,4 +1048,5 @@ def serve_explainer(predictor, background_data, constructor_kwargs, fit_kwargs,
                 explain_kwargs=explain_kwargs)
     return ExplainerServer(model, host=host, port=port,
                            max_batch_size=max_batch_size,
-                           pipeline_depth=pipeline_depth).start()
+                           pipeline_depth=pipeline_depth,
+                           **server_kwargs).start()

@@ -24,7 +24,24 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older JAX has no jax_num_cpu_devices option; the XLA flag below is the
+    # pre-option spelling and is honoured as long as it lands before the
+    # first device query initialises the CPU backend (nothing above queries
+    # devices — config.update only records values).  Mirrors
+    # compat.force_cpu_devices, which cannot be imported here: the package
+    # __init__ pulls the full interface chain, and the flag must land
+    # before ANY of that code could touch the backend.  Replace (not keep)
+    # an inherited count so an ambient XLA_FLAGS can't shrink the suite's
+    # device count.
+    import re as _re
+
+    _flags = _re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                     os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (
+        _flags.strip() + " --xla_force_host_platform_device_count=8").strip()
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

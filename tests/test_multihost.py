@@ -16,6 +16,15 @@ import sys
 
 import pytest
 
+from distributedkernelshap_tpu import compat
+
+# With gloo CPU collectives enabled (compat.enable_cpu_collectives, wired
+# into initialize_multihost) these tests run REAL cross-process programs:
+# each one compiles a sharded explain in two fresh processes, ~4-6 min
+# apiece on CI CPUs — far past the tier-1 870 s budget (ROADMAP.md), so
+# they run in `make test` / `make multihost-ci`, not `make tier1`.
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # explain recipe shared by the worker template and the in-test reference run
@@ -80,8 +89,14 @@ def _explain_adult(n_devices=N_DEVICES):
     return np.stack(sv, 1)
 
 
-@pytest.mark.parametrize("coalition_parallel", [1, 2],
-                         ids=["data4", "data2xcoalition2"])
+@pytest.mark.parametrize("coalition_parallel", [
+    1,
+    pytest.param(2, marks=pytest.mark.skipif(
+        compat.eager_concat_sums_replicas(),
+        reason="multi-process coalition_parallel>1 needs jax.shard_map; "
+               "this JAX mis-assembles coalition-replicated results "
+               "across processes (mesh.device_mesh rejects it)")),
+], ids=["data4", "data2xcoalition2"])
 def test_two_process_pool_benchmark(tmp_path, coalition_parallel):
     port = _free_port()
     texts = _run_two_procs(tmp_path, lambda pid: [
@@ -105,7 +120,8 @@ import sys
 sys.path.insert(0, {repo!r})
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+from distributedkernelshap_tpu.compat import force_cpu_devices
+force_cpu_devices(2)
 pid = int(sys.argv[1])
 from distributedkernelshap_tpu.parallel.mesh import initialize_multihost
 initialize_multihost("127.0.0.1:" + sys.argv[2], 2, pid)
@@ -201,7 +217,8 @@ import sys
 sys.path.insert(0, {repo!r})
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+from distributedkernelshap_tpu.compat import force_cpu_devices
+force_cpu_devices(2)
 pid = int(sys.argv[1])
 from distributedkernelshap_tpu.parallel.mesh import initialize_multihost
 initialize_multihost("127.0.0.1:" + sys.argv[2], 2, pid)

@@ -284,6 +284,237 @@ def test_fanin_all_wedged_returns_replica_503_body():
         wedged.stop()
 
 
+class _SchedFakeReplica:
+    """Fake replica for the scheduling-layer proxy semantics: mode
+    ``"echo"`` answers 200 with the received ``X-DKS-*`` headers in the
+    body (propagation proof); mode ``"saturated"`` answers 429 with a
+    ``Retry-After`` like a replica whose admission control shed."""
+
+    def __init__(self, mode="echo", retry_after="2"):
+        import http.server
+
+        fake = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _go(self):
+                length = int(self.headers.get("Content-Length", 0))
+                if length:
+                    self.rfile.read(length)
+                mode = fake.mode
+                if (mode == "batch_saturated"
+                        and self.headers.get("X-DKS-Priority") != "batch"):
+                    mode = "echo"  # only the batch class is over its bound
+                if mode in ("saturated", "rate_limited", "projected",
+                            "batch_saturated"):
+                    reason = {"saturated": "queue_full",
+                              "batch_saturated": "queue_full",
+                              "rate_limited": "rate_limited",
+                              "projected": "projected_wait"}[mode]
+                    body = json.dumps({"error": f"shed ({reason})",
+                                       "reason": reason,
+                                       "retry_after_s": float(
+                                           fake.retry_after)}).encode()
+                    self.send_response(429)
+                    self.send_header("Retry-After", fake.retry_after)
+                else:
+                    fake.requests += 1
+                    body = json.dumps({"seen": {
+                        k: v for k, v in self.headers.items()
+                        if k.lower().startswith("x-dks-")}}).encode()
+                    self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            do_GET = _go
+            do_POST = _go
+
+            def log_message(self, fmt, *args):
+                pass
+
+        self.mode = mode
+        self.retry_after = retry_after
+        self.requests = 0
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                     Handler)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _request_with_headers(host, port, headers, timeout=30):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("POST", "/explain", body=b'{"array": [[0.0]]}',
+                     headers={"Content-Type": "application/json", **headers})
+        resp = conn.getresponse()
+        payload = resp.read()
+        return resp.status, payload, dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+def test_fanin_propagates_scheduling_headers():
+    """Priority/deadline headers reach the replica's scheduler verbatim
+    through the proxy.  The client-key header passes through only with
+    ``trust_client_header=True`` (authenticated edge); by default the
+    proxy stamps the peer address, so an untrusted client cannot mint
+    fresh rate-limit buckets by randomizing ``X-DKS-Client``."""
+
+    replica = _SchedFakeReplica("echo")
+    sent = {"X-DKS-Priority": "interactive",
+            "X-DKS-Deadline-Ms": "250",
+            "X-DKS-Client": "alice"}
+    proxy = FanInProxy([("127.0.0.1", replica.port)],
+                       probe_interval_s=3600,
+                       trust_client_header=True).start()
+    try:
+        status, payload, _ = _request_with_headers(proxy.host, proxy.port,
+                                                   sent)
+        assert status == 200
+        seen = json.loads(payload)["seen"]
+        assert {k.lower(): v for k, v in seen.items()} == {
+            k.lower(): v for k, v in sent.items()}
+    finally:
+        proxy.stop()
+    proxy = FanInProxy([("127.0.0.1", replica.port)],
+                       probe_interval_s=3600).start()
+    try:
+        status, payload, _ = _request_with_headers(proxy.host, proxy.port,
+                                                   sent)
+        assert status == 200
+        seen = {k.lower(): v
+                for k, v in json.loads(payload)["seen"].items()}
+        assert seen["x-dks-priority"] == "interactive"  # still verbatim
+        assert seen["x-dks-client"] == "127.0.0.1"  # stamped, not alice
+    finally:
+        proxy.stop()
+        replica.stop()
+
+
+def test_fanin_rate_limited_429_passes_through_without_saturation():
+    """A ``rate_limited`` 429 is about ONE client, not replica load: the
+    proxy must return it to that client directly — not reroute (each
+    replica keys its own bucket, so rotation would multiply the client's
+    allowance) and not mark the replica saturated (that would let one
+    abusive client deny every client)."""
+
+    limited = _SchedFakeReplica("rate_limited", retry_after="3")
+    ok = _SchedFakeReplica("echo")
+    proxy = FanInProxy([("127.0.0.1", limited.port), ("127.0.0.1", ok.port)],
+                       probe_interval_s=3600).start()
+    try:
+        # round-robin starts at replica 0 (the rate limiter)
+        status, payload, headers = _request_with_headers(proxy.host,
+                                                         proxy.port, {})
+        assert status == 429
+        assert json.loads(payload)["reason"] == "rate_limited"
+        assert int(headers["Retry-After"]) >= 1
+        assert ok.requests == 0  # never rerouted
+        assert proxy.replicas[0].saturated_any() <= time.monotonic()
+        # the next pick (round-robin: replica 1) serves other clients fine
+        status, _, _ = _request_with_headers(proxy.host, proxy.port, {})
+        assert status == 200
+        assert ok.requests == 1
+    finally:
+        proxy.stop()
+        limited.stop()
+        ok.stop()
+
+
+def test_fanin_saturation_is_per_priority_class():
+    """Replica queue bounds are per class, so a queue_full 429 for batch
+    traffic must only back the replica off for batch — interactive
+    requests it still admits must keep flowing (the isolation admission
+    control exists to provide)."""
+
+    replica = _SchedFakeReplica("batch_saturated", retry_after="30")
+    proxy = FanInProxy([("127.0.0.1", replica.port)],
+                       probe_interval_s=3600).start()
+    try:
+        status, _, _ = _request_with_headers(
+            proxy.host, proxy.port, {"X-DKS-Priority": "batch"})
+        assert status == 429  # sole replica saturated for batch
+        assert proxy.replicas[0].saturated_for("batch") > time.monotonic()
+        # interactive is a different class: forwarded, not proxy-shed
+        status, _, _ = _request_with_headers(
+            proxy.host, proxy.port, {"X-DKS-Priority": "interactive"})
+        assert status == 200
+        assert replica.requests == 1
+        # and batch stays backed off without re-forwarding
+        status, _, _ = _request_with_headers(
+            proxy.host, proxy.port, {"X-DKS-Priority": "batch"})
+        assert status == 429
+        assert replica.requests == 1
+    finally:
+        proxy.stop()
+        replica.stop()
+
+
+def test_fanin_projected_wait_429_reroutes_without_saturation_mark():
+    """A ``projected_wait`` 429 depends on THIS request's deadline (a
+    deadline-less request would have been admitted), so the proxy retries
+    another replica but must NOT mark the shedding replica saturated —
+    that would deny it to traffic it still accepts."""
+
+    busy = _SchedFakeReplica("projected", retry_after="30")
+    ok = _SchedFakeReplica("echo")
+    proxy = FanInProxy([("127.0.0.1", busy.port), ("127.0.0.1", ok.port)],
+                       probe_interval_s=3600).start()
+    try:
+        status, _, _ = _request_with_headers(
+            proxy.host, proxy.port, {"X-DKS-Deadline-Ms": "100"})
+        assert status == 200  # rerouted to the replica with headroom
+        assert ok.requests == 1
+        assert proxy.replicas[0].saturated_any() <= time.monotonic()
+    finally:
+        proxy.stop()
+        busy.stop()
+        ok.stop()
+
+
+def test_fanin_429_reroutes_then_sheds_when_all_saturated():
+    """A saturated replica (429) stays alive but is skipped; when EVERY
+    live replica reports saturation the proxy sheds at its own edge with
+    429 + Retry-After instead of queueing on a fleet that said no."""
+
+    sat = _SchedFakeReplica("saturated", retry_after="2")
+    ok = _SchedFakeReplica("echo")
+    proxy = FanInProxy([("127.0.0.1", sat.port), ("127.0.0.1", ok.port)],
+                       probe_interval_s=3600).start()
+    try:
+        # hits the saturated replica first (round-robin), reroutes, serves
+        for _ in range(3):
+            status, payload, _ = _request_with_headers(proxy.host,
+                                                       proxy.port, {})
+            assert status == 200, payload
+        assert proxy.replicas[0].alive  # saturated != dead
+        assert proxy.replicas[0].saturated_any() > time.monotonic()
+        # saturate the second replica too: the proxy must now shed
+        ok.mode = "saturated"
+        status, payload, headers = _request_with_headers(proxy.host,
+                                                         proxy.port, {})
+        assert status == 429
+        assert int(headers["Retry-After"]) >= 1
+        m = proxy._render_metrics()
+        shed_line = [l for l in m.splitlines()
+                     if l.startswith("dks_fanin_sheds_total ")][0]
+        assert float(shed_line.split()[-1]) >= 1
+        # both replicas remain alive (recoverable via backoff, not probes)
+        assert all(r.alive for r in proxy.replicas)
+    finally:
+        proxy.stop()
+        sat.stop()
+        ok.stop()
+
+
 def test_fanin_slow_replica_times_out_without_eviction():
     """A replica slower than request_timeout_s earns its client a 504 but
     stays in rotation — slow is not dead (first compiles run minutes)."""

@@ -1,0 +1,676 @@
+"""Unit tests for the scheduling subsystem
+(``distributedkernelshap_tpu/scheduling/``): EDF scheduler + row-budget
+packing, admission control (bounded queues, token buckets, projected-wait
+shedding) and the content-addressed result cache — plus their integration
+into ``ExplainerServer`` (priority/deadline headers, 429 semantics, cache
+hit paths, the carried-request lifecycle).  All CPU, no device needed.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu.scheduling import (
+    AdmissionController,
+    FIFOScheduler,
+    ResultCache,
+    ServiceRateEstimator,
+    SLOScheduler,
+    TokenBucket,
+    array_fingerprint,
+    model_fingerprint,
+    request_cache_key,
+)
+from distributedkernelshap_tpu.models import LinearPredictor
+from distributedkernelshap_tpu.serving import (
+    ExplainerServer,
+    KernelShapModel,
+    distribute_requests,
+    explain_request,
+)
+
+
+class Item:
+    """Minimal scheduler item (the server's _Pending protocol)."""
+
+    def __init__(self, name, klass="batch", deadline=None, rows=1,
+                 t_enqueued=None):
+        self.name = name
+        self.klass = klass
+        self.deadline = deadline
+        self.rows = rows
+        self.t_enqueued = time.monotonic() if t_enqueued is None else t_enqueued
+        self.done = False
+
+    def __repr__(self):
+        return f"Item({self.name})"
+
+
+# --------------------------------------------------------------------- #
+# scheduler
+# --------------------------------------------------------------------- #
+
+
+def test_edf_deadline_ordering():
+    """Explicit deadlines dominate arrival order: the latest-enqueued but
+    earliest-deadline request leads the batch."""
+
+    sched = SLOScheduler()
+    now = time.monotonic()
+    sched.put(Item("late", deadline=now + 30))
+    sched.put(Item("mid", deadline=now + 10))
+    sched.put(Item("urgent", deadline=now + 5))
+    batch, expired = sched.next_batch(max_batch_size=3)
+    assert [i.name for i in batch] == ["urgent", "mid", "late"]
+    assert expired == []
+
+
+def test_class_budgets_order_implicit_deadlines():
+    """Without explicit deadlines, interactive sorts ahead of batch ahead
+    of best_effort even when enqueued last (class ordering budgets)."""
+
+    sched = SLOScheduler()
+    t0 = time.monotonic()
+    sched.put(Item("bg", klass="best_effort", t_enqueued=t0))
+    sched.put(Item("bulk", klass="batch", t_enqueued=t0))
+    sched.put(Item("ui", klass="interactive", t_enqueued=t0))
+    batch, _ = sched.next_batch(max_batch_size=3)
+    assert [i.name for i in batch] == ["ui", "bulk", "bg"]
+
+
+def test_fifo_scheduler_is_arrival_order():
+    sched = FIFOScheduler()
+    now = time.monotonic()
+    sched.put(Item("first", deadline=now + 30))
+    sched.put(Item("second", deadline=now + 1))
+    batch, _ = sched.next_batch(max_batch_size=2)
+    assert [i.name for i in batch] == ["first", "second"]
+    # FIFO never expires: a long-dead deadline still gets dispatched
+    sched.put(Item("stale", deadline=now - 10))
+    batch, expired = sched.next_batch(max_batch_size=1)
+    assert [i.name for i in batch] == ["stale"] and expired == []
+
+
+def test_row_budget_packing_and_no_starvation():
+    """Packing stops at max_rows; the overflow item is NOT dropped and NOT
+    double-dispatched — it leads the next batch even while smaller items
+    keep arriving (the EDF key is its original enqueue time)."""
+
+    sched = SLOScheduler()
+    t0 = time.monotonic()
+    big = Item("big", rows=6, t_enqueued=t0 + 0.001)
+    sched.put(Item("a", rows=3, t_enqueued=t0))
+    sched.put(big)
+    sched.put(Item("b", rows=4, t_enqueued=t0 + 0.002))
+    batch1, _ = sched.next_batch(max_batch_size=8, max_rows=8)
+    # 'big' (6 rows) would overflow 3+6 > 8, so 'b' (4 rows) packs instead
+    assert [i.name for i in batch1] == ["a", "b"]
+    assert sum(i.rows for i in batch1) <= 8
+    # queue stays hot: smaller, LATER items keep arriving — the carried
+    # item keeps its original EDF key, so it must lead the next batch
+    # (no starvation) and appear exactly once (no double dispatch)
+    sched.put(Item("c", rows=2, t_enqueued=t0 + 0.01))
+    batch2, _ = sched.next_batch(max_batch_size=8, max_rows=8)
+    assert batch2[0].name == "big"
+    names = [i.name for i in batch1 + batch2]
+    assert names.count("big") == 1  # never double-dispatched
+
+
+def test_rows_ahead_is_edf_aware():
+    """The projected-wait input counts only rows that would sort AHEAD of
+    the request under EDF — a deep batch backlog must not inflate an
+    interactive request's projection (the scheduler dispatches it first).
+    On the FIFO baseline everything queued really is ahead."""
+
+    sched = SLOScheduler()
+    now = time.monotonic()
+    for i in range(10):
+        sched.put(Item(f"bulk{i}", klass="batch", rows=5))  # eff ~now+30
+    sched.put(Item("soon", deadline=now + 0.2, rows=2))
+    # an interactive request due now+1: only 'soon' (eff now+0.2) is ahead
+    assert sched.rows_ahead("interactive", now + 1.0) == 2
+    # a request due after the batch budget window sees everything
+    assert sched.rows_ahead("batch", now + 60.0) == 52
+    fifo = FIFOScheduler()
+    for i in range(3):
+        fifo.put(Item(f"f{i}", klass="batch", rows=4))
+    assert fifo.rows_ahead("interactive", now + 0.01) == 12
+
+
+def test_expired_items_are_separated():
+    sched = SLOScheduler()
+    now = time.monotonic()
+    sched.put(Item("dead", deadline=now - 1))
+    sched.put(Item("alive", deadline=now + 60))
+    batch, expired = sched.next_batch(max_batch_size=4)
+    assert [i.name for i in batch] == ["alive"]
+    assert [i.name for i in expired] == ["dead"]
+
+
+def test_put_wakes_blocked_next_batch():
+    """Condition-variable wakeup: a dispatcher blocked on an empty queue
+    returns promptly once a request arrives (no 0.1 s poll tick)."""
+
+    sched = SLOScheduler()
+    out = {}
+
+    def consume():
+        t0 = time.monotonic()
+        out["batch"], _ = sched.next_batch(max_batch_size=1)
+        out["waited"] = time.monotonic() - t0
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.05)
+    sched.put(Item("x"))
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert [i.name for i in out["batch"]] == ["x"]
+
+
+def test_drain_returns_everything_and_resets_depths():
+    sched = SLOScheduler()
+    for i in range(3):
+        sched.put(Item(f"i{i}", klass="interactive"))
+    sched.put(Item("b", klass="batch", rows=5))
+    assert sched.depths()["interactive"] == 3
+    assert sched.queued_rows() == 8
+    drained = sched.drain()
+    assert len(drained) == 4
+    assert sched.qsize() == 0
+    assert sched.depths() == {"interactive": 0, "batch": 0, "best_effort": 0}
+    assert sched.queued_rows() == 0
+
+
+# --------------------------------------------------------------------- #
+# admission
+# --------------------------------------------------------------------- #
+
+
+def test_token_bucket_refill():
+    clock = {"t": 0.0}
+    bucket = TokenBucket(rate=2.0, burst=4.0, now=lambda: clock["t"])
+    for _ in range(4):
+        ok, _ = bucket.try_acquire()
+        assert ok
+    ok, retry = bucket.try_acquire()
+    assert not ok and retry == pytest.approx(0.5)
+    clock["t"] += 0.5  # refills exactly one token at 2/s
+    ok, _ = bucket.try_acquire()
+    assert ok
+    # burst cap: a long idle period must not accumulate unbounded tokens
+    clock["t"] += 1000.0
+    assert bucket.tokens == pytest.approx(4.0)
+
+
+def test_admission_queue_bound_per_class():
+    ctl = AdmissionController(max_queued_per_class={"interactive": 2,
+                                                    "batch": 100})
+    dec = ctl.admit("interactive", 1, "c", queue_depth=2)
+    assert not dec and dec.reason == "queue_full" and dec.retry_after_s > 0
+    # the other class has its own bound: unaffected
+    assert ctl.admit("batch", 1, "c", queue_depth=2)
+    # a class MISSING from the dict keeps the default bound (1024) rather
+    # than silently becoming unbounded
+    assert ctl.admit("best_effort", 1, "c", queue_depth=1023)
+    dec = ctl.admit("best_effort", 1, "c", queue_depth=1024)
+    assert not dec and dec.reason == "queue_full"
+    # an explicit 0 entry disables the gate for that class only
+    ctl0 = AdmissionController(max_queued_per_class={"batch": 0})
+    assert ctl0.admit("batch", 1, "c", queue_depth=10**6)
+
+
+def test_admission_rate_limit_is_per_client():
+    clock = {"t": 0.0}
+    ctl = AdmissionController(max_queued_per_class=0,
+                              rate_limit_per_client=(1.0, 2.0),
+                              now=lambda: clock["t"])
+    assert ctl.admit("batch", 1, "alice")
+    assert ctl.admit("batch", 1, "alice")
+    dec = ctl.admit("batch", 1, "alice")
+    assert not dec and dec.reason == "rate_limited"
+    assert ctl.admit("batch", 1, "bob")  # separate bucket
+    clock["t"] += 1.0
+    assert ctl.admit("batch", 1, "alice")  # refilled
+
+
+def test_admission_projected_wait_shed():
+    clock = {"t": 100.0}
+    est = ServiceRateEstimator()
+    est.observe(rows=10, seconds=1.0)  # 10 rows/s
+    ctl = AdmissionController(max_queued_per_class=0, estimator=est,
+                              now=lambda: clock["t"])
+    # 50 rows queued ahead -> ~5s wait; a 1s deadline is unservable
+    dec = ctl.admit("interactive", 1, "c", deadline=clock["t"] + 1.0,
+                    queued_rows=50)
+    assert not dec and dec.reason == "projected_wait"
+    assert dec.retry_after_s == pytest.approx(5.1, rel=0.2)
+    # a 10s deadline fits; and with no deadline the gate never sheds
+    assert ctl.admit("interactive", 1, "c", deadline=clock["t"] + 10.0,
+                     queued_rows=50)
+    assert ctl.admit("interactive", 1, "c", deadline=None, queued_rows=50)
+
+
+def test_estimator_ewma():
+    est = ServiceRateEstimator(alpha=0.5)
+    assert est.rows_per_s() is None
+    est.observe(10, 1.0)
+    assert est.rows_per_s() == pytest.approx(10.0)
+    est.observe(20, 1.0)
+    assert est.rows_per_s() == pytest.approx(15.0)
+    est.observe(0, 1.0)  # ignored
+    est.observe(10, 0.0)  # ignored
+    assert est.rows_per_s() == pytest.approx(15.0)
+
+
+# --------------------------------------------------------------------- #
+# result cache
+# --------------------------------------------------------------------- #
+
+
+def test_cache_lru_eviction_by_byte_budget():
+    cache = ResultCache(max_bytes=10)
+    cache.put("a", "xxxx")  # 4 bytes
+    cache.put("b", "yyyy")  # 8 total
+    assert cache.get("a") == "xxxx"  # refreshes a's recency
+    cache.put("c", "zzzz")  # 12 > 10: evicts LRU, which is now b
+    assert cache.get("b") is None
+    assert cache.get("a") == "xxxx" and cache.get("c") == "zzzz"
+    assert cache.current_bytes <= 10
+    stats = cache.stats()
+    assert stats["evictions"] == 1 and stats["entries"] == 2
+
+
+def test_cache_oversized_payload_not_cached():
+    cache = ResultCache(max_bytes=4)
+    cache.put("k", "way too big")
+    assert len(cache) == 0 and cache.get("k") is None
+
+
+def test_cache_replacing_key_adjusts_bytes():
+    cache = ResultCache(max_bytes=100)
+    cache.put("k", "aaaa")
+    cache.put("k", "bb")
+    assert cache.current_bytes == 2 and len(cache) == 1
+
+
+def test_fingerprints_change_with_content():
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    assert array_fingerprint(a) == array_fingerprint(a.copy())
+    assert array_fingerprint(a) != array_fingerprint(a + 1)
+    assert array_fingerprint(a) != array_fingerprint(a.reshape(3, 2))
+    assert array_fingerprint(a) != array_fingerprint(a.astype(np.float64))
+
+
+def test_structured_hash_sees_past_numpy_repr_elision():
+    """numpy's repr elides the middle of large arrays ('...'), so a
+    repr-based fingerprint would collide for groupings differing only in
+    the elided region — the structured hash must distinguish them."""
+
+    from distributedkernelshap_tpu.scheduling.result_cache import (
+        _update_structured,
+    )
+    import hashlib
+
+    def digest(value):
+        h = hashlib.sha256()
+        _update_structured(h, value)
+        return h.hexdigest()
+
+    big = np.zeros(4096, dtype=np.int64)
+    tweaked = big.copy()
+    tweaked[2048] = 1  # repr-elided middle element
+    assert repr(big) == repr(tweaked)  # the trap this guards against
+    assert digest(big) != digest(tweaked)
+    # containers recurse; scalars and strings still hash by value
+    assert digest({"groups": [big], "k": 1}) != digest(
+        {"groups": [tweaked], "k": 1})
+    assert digest({"k": 1}) != digest({"k": 2})
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    rng = np.random.default_rng(0)
+    D, K = 6, 2
+    W = rng.normal(size=(D, K)).astype(np.float32)
+    b = rng.normal(size=(K,)).astype(np.float32)
+    bg = rng.normal(size=(12, D)).astype(np.float32)
+    pred = LinearPredictor(W, b, activation="softmax")
+    model = KernelShapModel(pred, bg, {"link": "logit", "seed": 0}, {})
+    X = rng.normal(size=(8, D)).astype(np.float32)
+    return model, bg, X, pred
+
+
+def test_model_fingerprint_tracks_background_and_kwargs(small_model):
+    model, bg, X, pred = small_model
+    fp = model_fingerprint(model)
+    assert fp == model_fingerprint(model)  # stable
+    other = KernelShapModel(pred, bg + 1.0, {"link": "logit", "seed": 0}, {})
+    assert fp != model_fingerprint(other)  # background change => new keys
+    assert fp != model_fingerprint(model, explain_kwargs={"nsamples": 32})
+    # an explicit fingerprint wins (checkpoint-hash deployments)
+    model2 = KernelShapModel(pred, bg, {"link": "logit", "seed": 0}, {})
+    model2.fingerprint = "pinned"
+    assert model_fingerprint(model2) == "pinned"
+    assert request_cache_key(X[:1], fp) != request_cache_key(X[1:2], fp)
+
+
+# --------------------------------------------------------------------- #
+# server integration
+# --------------------------------------------------------------------- #
+
+
+@pytest.fixture()
+def served(small_model):
+    """Server factory with scheduler knobs; stops everything at teardown."""
+
+    model, bg, X, pred = small_model
+    servers = []
+
+    def make(**kwargs):
+        kwargs.setdefault("host", "127.0.0.1")
+        kwargs.setdefault("port", 0)
+        kwargs.setdefault("pipeline_depth", 2)
+        srv = ExplainerServer(model, **kwargs).start()
+        servers.append(srv)
+        return srv, f"http://127.0.0.1:{srv.port}"
+
+    yield make, X
+    for srv in servers:
+        srv.stop()
+
+
+def _post(url, array, headers=None, timeout=60):
+    req = urllib.request.Request(
+        url + "/explain",
+        data=json.dumps({"array": np.asarray(array).tolist()}).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, resp.read().decode(), dict(resp.getheaders())
+
+
+def test_server_cache_hit_bit_identical(served):
+    make, X = served
+    srv, base = make(max_batch_size=4, cache_bytes=1 << 20)
+    first = _post(base, X[:2])[1]
+    second = _post(base, X[:2])[1]
+    assert second == first  # bit-identical payload from cache
+    # additivity still holds in the cached payload
+    data = json.loads(second)["data"]
+    total = (np.asarray(data["shap_values"]).sum(-1)
+             + np.asarray(data["expected_value"])[:, None])
+    np.testing.assert_allclose(
+        total, np.asarray(data["raw"]["raw_prediction"]).T, atol=1e-4)
+    text = urllib.request.urlopen(f"{base}/metrics", timeout=30).read().decode()
+    assert "dks_serve_cache_hits_total 1" in text
+    assert "dks_serve_cache_misses_total 1" in text
+
+
+def test_server_cache_splits_batches(served):
+    """Per-batch partial-hit splitting: duplicates answered from cache (or
+    deduped in-batch) must not cost device rows — rows_total counts every
+    answered request, but the cache hit counter proves which were free."""
+
+    make, X = served
+    srv, base = make(max_batch_size=8, cache_bytes=1 << 20,
+                     batch_timeout_s=0.2)
+    # seed the cache
+    _post(base, X[:1])
+    # fan out 6 duplicates + 2 novel rows concurrently
+    rows = [X[:1]] * 6 + [X[1:2], X[2:3]]
+    results = [None] * len(rows)
+
+    def go(i):
+        results[i] = _post(base, rows[i])[1]
+
+    threads = [threading.Thread(target=go, args=(i,))
+               for i in range(len(rows))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results[0] == results[5]  # duplicates identical
+    metrics = urllib.request.urlopen(f"{base}/metrics",
+                                     timeout=30).read().decode()
+    hits = {line.split()[0]: float(line.split()[1])
+            for line in metrics.splitlines()
+            if line and not line.startswith("#")}
+    assert hits["dks_serve_cache_hits_total"] >= 6
+    assert hits["dks_serve_cache_misses_total"] == 3  # seed + 2 novel
+
+
+class GateModel:
+    """Sync-only model wrapper that stalls dispatch until released, so the
+    queue backs up deterministically."""
+
+    def __init__(self, model, max_rows=None, delay_s=None):
+        self.model = model
+        self.release = threading.Event()
+        self.max_rows = max_rows
+        self.delay_s = delay_s
+
+    def explain_batch(self, instances, split_sizes=None):
+        if self.delay_s is not None:
+            time.sleep(self.delay_s)
+        else:
+            self.release.wait(30)
+        return self.model.explain_batch(instances, split_sizes)
+
+
+def test_server_queue_full_sheds_429(small_model):
+    model, bg, X, pred = small_model
+    gate = GateModel(model)
+    srv = ExplainerServer(gate, host="127.0.0.1", port=0, max_batch_size=1,
+                          pipeline_depth=1, max_queue_per_class=1).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        results = []
+
+        def go():
+            try:
+                results.append(_post(base, X[:1], timeout=30)[0])
+            except urllib.error.HTTPError as e:
+                e.read()
+                results.append(e.code)
+
+        threads = [threading.Thread(target=go) for _ in range(4)]
+        for t in threads:
+            t.start()
+            time.sleep(0.1)  # let earlier requests occupy device + queue
+        got_429 = False
+        deadline = time.monotonic() + 10
+        while not got_429 and time.monotonic() < deadline:
+            try:
+                _post(base, X[:1], timeout=5)
+            except urllib.error.HTTPError as e:
+                body = e.read().decode()
+                if e.code == 429:
+                    got_429 = True
+                    assert "queue_full" in body
+                    assert int(e.headers["Retry-After"]) >= 1
+            time.sleep(0.05)
+        assert got_429, "full class queue never shed"
+        text = urllib.request.urlopen(f"{base}/metrics",
+                                      timeout=30).read().decode()
+        assert 'dks_serve_sheds_total{reason="queue_full"}' in text
+    finally:
+        gate.release.set()
+        for t in threads:
+            t.join(timeout=30)
+        srv.stop()
+
+
+def test_server_rate_limit_sheds_per_client(served):
+    make, X = served
+    srv, base = make(max_batch_size=1, rate_limit_per_client=(0.5, 2.0))
+    ok = 0
+    limited = 0
+    for _ in range(4):
+        try:
+            status, _, _ = _post(base, X[:1],
+                                 headers={"X-DKS-Client": "alice"})
+            ok += 1
+        except urllib.error.HTTPError as e:
+            assert e.code == 429
+            assert "rate_limited" in e.read().decode()
+            limited += 1
+    assert ok == 2 and limited == 2  # burst of 2, then shed
+    # a different client key is untouched
+    status, _, _ = _post(base, X[:1], headers={"X-DKS-Client": "bob"})
+    assert status == 200
+
+
+def test_server_priority_header_validation(served):
+    make, X = served
+    srv, base = make()
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(base, X[:1], headers={"X-DKS-Priority": "vip"})
+    assert e.value.code == 400 and "priority" in e.value.read().decode()
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(base, X[:1], headers={"X-DKS-Deadline-Ms": "soon"})
+    assert e.value.code == 400
+    # valid headers serve normally
+    status, payload, _ = _post(base, X[:1], headers={
+        "X-DKS-Priority": "best_effort", "X-DKS-Deadline-Ms": "60000"})
+    assert status == 200 and json.loads(payload)["data"]["shap_values"]
+
+
+def test_server_expired_deadline_answers_504(small_model):
+    model, bg, X, pred = small_model
+    srv = ExplainerServer(GateModel(model, delay_s=0.6), host="127.0.0.1",
+                          port=0, max_batch_size=1, pipeline_depth=1).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        # first request occupies the device; the second's 150 ms deadline
+        # dies in the queue and must come back 504 without device work
+        t = threading.Thread(target=lambda: _post(base, X[:1], timeout=30))
+        t.start()
+        time.sleep(0.15)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(base, X[1:2], headers={"X-DKS-Deadline-Ms": "150"},
+                  timeout=30)
+        t.join(timeout=30)
+        assert e.value.code == 504
+        assert "deadline" in e.value.read().decode()
+        text = urllib.request.urlopen(f"{base}/metrics",
+                                      timeout=30).read().decode()
+        assert 'dks_serve_sheds_total{reason="deadline_expired"} 1' in text
+    finally:
+        srv.stop()
+
+
+def test_server_metrics_queue_depth_and_histogram(served):
+    """The new observability satellites: per-class queue depth gauges and a
+    bounded latency histogram appear in /metrics and account answered
+    requests."""
+
+    make, X = served
+    srv, base = make(max_batch_size=4)
+    distribute_requests(f"{base}/explain", X[:4], max_workers=2)
+    text = urllib.request.urlopen(f"{base}/metrics", timeout=30).read().decode()
+    for klass in ("interactive", "batch", "best_effort"):
+        assert f'dks_serve_queue_depth{{class="{klass}"}} 0' in text
+    assert 'dks_serve_request_latency_seconds_bucket{le="+Inf"} 4' in text
+    assert "dks_serve_request_latency_seconds_count 4" in text
+    # cumulative: every finite-bucket count <= +Inf count
+    counts = [int(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+              if line.startswith("dks_serve_request_latency_seconds_bucket")]
+    assert counts == sorted(counts)
+
+
+def test_carry_failed_not_leaked_on_shutdown(small_model):
+    """The carried-request lifecycle on shutdown: a request deferred for
+    row overflow lives in the scheduler heap; stop() must fail it (the
+    client gets an error, promptly) rather than leak its handler thread."""
+
+    model, bg, X, pred = small_model
+    gate = GateModel(model, max_rows=3)
+    srv = ExplainerServer(gate, host="127.0.0.1", port=0, max_batch_size=8,
+                          pipeline_depth=1, batch_timeout_s=0.3).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    statuses = {}
+
+    def go(name, rows, delay):
+        time.sleep(delay)
+        try:
+            statuses[name] = _post(base, X[:rows], timeout=30)[0]
+        except urllib.error.HTTPError as e:
+            e.read()
+            statuses[name] = e.code
+        except Exception as e:  # noqa: BLE001 - shutdown may reset sockets
+            statuses[name] = type(e).__name__
+
+    # r1 (2 rows) + r2 (2 rows): r2 overflows max_rows=3 and is deferred
+    t1 = threading.Thread(target=go, args=("r1", 2, 0.0))
+    t2 = threading.Thread(target=go, args=("r2", 2, 0.05))
+    t1.start()
+    t2.start()
+    time.sleep(0.8)  # r1 dispatched (blocked in the gate), r2 queued
+    t0 = time.monotonic()
+    srv.stop()  # must fail r2 immediately; r1 unblocks via the gate
+    gate.release.set()
+    t1.join(timeout=30)
+    t2.join(timeout=30)
+    assert time.monotonic() - t0 < 20
+    assert not t2.is_alive(), "carried request leaked past shutdown"
+    assert statuses["r2"] != 200  # failed, not silently served
+
+
+def test_carry_hot_queue_not_starved_end_to_end(small_model):
+    """Satellite: with max_rows=3 and a continuous stream of small
+    requests, a 3-row request that keeps overflowing shared batches must
+    still be served exactly once (EDF ages it to the front)."""
+
+    model, bg, X, pred = small_model
+    model.max_rows = 3
+    try:
+        srv = ExplainerServer(model, host="127.0.0.1", port=0,
+                              max_batch_size=8, pipeline_depth=2,
+                              batch_timeout_s=0.05).start()
+        base = f"http://127.0.0.1:{srv.port}"
+        payloads = {}
+
+        def small(i):
+            payloads[f"s{i}"] = _post(base, X[i % 4:i % 4 + 1], timeout=60)[1]
+
+        def big():
+            payloads["big"] = _post(base, X[:3], timeout=60)[1]
+
+        threads = [threading.Thread(target=small, args=(i,))
+                   for i in range(10)]
+        threads.insert(2, threading.Thread(target=big))
+        for t in threads:
+            t.start()
+            time.sleep(0.01)
+        for t in threads:
+            t.join(timeout=60)
+        assert len(payloads) == 11
+        big_sv = np.asarray(
+            json.loads(payloads["big"])["data"]["shap_values"])
+        assert big_sv.shape[1] == 3  # served whole, exactly once
+    finally:
+        model.max_rows = None
+        srv.stop()
+
+
+def test_fifo_policy_knob_still_serves(small_model):
+    model, bg, X, pred = small_model
+    srv = ExplainerServer(model, host="127.0.0.1", port=0, max_batch_size=4,
+                          pipeline_depth=2, scheduling="fifo").start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/explain"
+        payload = explain_request(url, X[0])
+        assert json.loads(payload)["data"]["shap_values"]
+    finally:
+        srv.stop()
+
+    with pytest.raises(ValueError, match="policy"):
+        ExplainerServer(model, scheduling="lifo")
+    with pytest.raises(ValueError, match="default_class"):
+        ExplainerServer(model, default_class="vip")
