@@ -1,0 +1,379 @@
+"""Chaos benchmark: fault-tolerant serving + resumable batch runs
+(standalone, CPU backend, exits nonzero on ``--check`` fail).
+
+Two scenarios, one JSON line:
+
+1. **Serve chaos** — a 3-replica fleet of REAL worker processes
+   (``serving/replica_worker.py``, synthetic factory) behind the fan-in
+   proxy with hedging enabled, replica 2 scripted slow via the fault
+   harness (``DKS_FAULTS=slow:site=server.explain,...,replica=2``).
+   Mid-run, replica 0 is SIGKILLed; the supervisor restarts it with
+   backoff and the prober returns it to rotation.  Every request carries
+   a unique instance row, and the parent reconstructs the (seeded,
+   deterministic) model to verify each answer against ITS OWN request.
+   Criteria: every request answered exactly once (zero lost, zero
+   duplicated/mixed-up), additivity intact on every payload, the killed
+   replica restarted, and at least one hedge win against the slow
+   replica.  Client-side retries of 502/503 are part of the scenario —
+   explanations are idempotent (deterministic + content-addressed), so a
+   retry can change WHERE the answer computes, never WHAT it is.
+
+2. **Pool resume** — a sharded batch explain run in a subprocess with
+   shard journaling on (``distributed_opts['checkpoint_dir']``), killed
+   deterministically by ``DKS_FAULTS=crash:site=pool.shard,after=K``
+   (the crash lands after the K-th shard's fetch but BEFORE its journal
+   record — the worst case).  A second invocation resumes.  Criteria:
+   the journal survived with exactly K-1 shards, the resume restored
+   them and recomputed only the rest (total recomputed overlap <= 1
+   shard), and the resumed phi is BIT-IDENTICAL to an uninterrupted
+   reference run.
+
+    JAX_PLATFORMS=cpu python benchmarks/chaos_bench.py --check
+"""
+
+import argparse
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+#: worker/subprocess env: import the repo without installation, CPU-only
+BASE_ENV = {"PYTHONPATH": REPO_ROOT, "JAX_PLATFORMS": "cpu"}
+
+FACTORY = ("distributedkernelshap_tpu.serving."
+           "replica_worker:synthetic_factory")
+
+
+# --------------------------------------------------------------------- #
+# scenario 1: serve chaos (kill one replica + one slow replica)
+# --------------------------------------------------------------------- #
+
+
+def _synthetic_reference():
+    """The same deterministic model ``synthetic_factory`` builds inside
+    each worker — recomputed here so every answer can be verified against
+    its own request."""
+
+    from sklearn.linear_model import LogisticRegression
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(256, 8)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    return LogisticRegression(max_iter=200).fit(X, y)
+
+
+def _scrape(host, port, path="/metrics"):
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    try:
+        conn.request("GET", path)
+        return conn.getresponse().read().decode()
+    finally:
+        conn.close()
+
+
+def _metric(text, name):
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+def run_serve_chaos(n_requests=48, n_replicas=3, slow_delay_s=0.5,
+                    kill_after_s=1.5, client_threads=6):
+    from distributedkernelshap_tpu.resilience.hedging import HedgePolicy
+    from distributedkernelshap_tpu.resilience.supervisor import RestartPolicy
+    from distributedkernelshap_tpu.serving.client import explain_request
+    from distributedkernelshap_tpu.serving.replicas import ReplicaManager
+
+    # replica n-1 answers every /explain slow_delay_s late — a straggler,
+    # not a corpse: only hedging can cut the tail it creates
+    faults = (f"slow:site=server.explain,delay={slow_delay_s},"
+              f"replica={n_replicas - 1}")
+    manager = ReplicaManager(
+        n_replicas, factory=FACTORY, pin_devices=False, restart=True,
+        env_extra={**BASE_ENV, "DKS_FAULTS": faults},
+        max_batch_size=4, pipeline_depth=2, startup_timeout_s=300,
+        restart_policy=RestartPolicy(base_backoff_s=0.25, max_backoff_s=2.0,
+                                     jitter_frac=0.25, seed=0),
+        # aggressive hedge (median) so EVERY slow-replica request hedges:
+        # the bench demonstrates the tail cut, production would run p95
+        hedge_policy=HedgePolicy(quantile=0.5, min_delay_s=0.05,
+                                 initial_delay_s=2.0, min_samples=8))
+    rng = np.random.default_rng(7)
+    instances = rng.normal(size=(n_requests, 1, 8)).astype(np.float32)
+    answers = [None] * n_requests
+    report = {}
+    with manager:
+        proxy = manager.proxy
+        url = f"http://{proxy.host}:{proxy.port}/explain"
+
+        # warmup: compile every replica and seed the hedge latency tracker
+        for i in range(4 * n_replicas):
+            explain_request(url, instances[0], timeout=120, max_retries=6)
+
+        def fire(i):
+            # bounded retries; 502/503 retried because explains are
+            # idempotent — this is the "zero lost" mechanism under a kill
+            answers[i] = explain_request(url, instances[i], timeout=120,
+                                         max_retries=8)
+
+        t0 = time.monotonic()
+        killed = {}
+
+        def killer():
+            time.sleep(kill_after_s)
+            victim = manager.procs[0]
+            killed["pid"] = victim.pid
+            os.kill(victim.pid, signal.SIGKILL)
+
+        kt = threading.Thread(target=killer, daemon=True)
+        kt.start()
+        with ThreadPoolExecutor(max_workers=client_threads) as pool:
+            errors = []
+            futs = [pool.submit(fire, i) for i in range(n_requests)]
+            for i, f in enumerate(futs):
+                try:
+                    f.result()
+                except Exception as e:  # lost request: recorded, not fatal
+                    errors.append((i, str(e)))
+        kt.join()
+        wall = time.monotonic() - t0
+
+        # the supervisor must resurrect the victim and the prober must
+        # return it to rotation
+        all_live = False
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            health = json.loads(_scrape(proxy.host, proxy.port, "/healthz"))
+            if len(health.get("live", [])) == n_replicas:
+                all_live = True
+                break
+            time.sleep(1.0)
+        metrics = _scrape(proxy.host, proxy.port)
+        restarts = manager.supervisor.stats()["restarts_total"]
+
+    # verify every answer against ITS OWN request: additivity inside the
+    # payload, and the raw prediction against the reconstructed model —
+    # a swapped/duplicated payload fails its request's check
+    clf = _synthetic_reference()
+    lost, mismatched, additivity_bad = [], [], []
+    for i, payload in enumerate(answers):
+        if payload is None:
+            lost.append(i)
+            continue
+        try:
+            data = json.loads(payload)["data"]
+        except (ValueError, KeyError):
+            mismatched.append(i)
+            continue
+        sv = np.asarray(data["shap_values"])          # (K, 1, M)
+        e_val = np.asarray(data["expected_value"])    # (K,)
+        raw = np.asarray(data["raw"]["raw_prediction"])  # (1, K)
+        total = sv.sum(-1) + e_val[:, None]
+        if not np.allclose(total, raw.T, atol=1e-3):
+            additivity_bad.append(i)
+        p = clf.predict_proba(instances[i])[0]
+        expected_raw = np.log(p / (1.0 - p))  # logit link space
+        if not np.allclose(raw[0], expected_raw, atol=1e-2):
+            mismatched.append(i)
+
+    # a retries-exhausted request appears in BOTH errors (the raised
+    # exception) and lost (its answers slot stayed None) — count the slot
+    return {
+        "n": n_requests,
+        "wall_s": round(wall, 2),
+        "lost": len(lost),
+        "mismatched": len(mismatched),
+        "additivity_bad": len(additivity_bad),
+        "client_gave_up": [e for _, e in errors][:3],
+        "killed_pid": killed.get("pid"),
+        "supervisor_restarts": int(restarts),
+        "all_replicas_recovered": bool(all_live),
+        "hedges": int(_metric(metrics, "dks_fanin_hedges_total")),
+        "hedge_wins": int(_metric(metrics, "dks_fanin_hedge_wins_total")),
+        "proxy_502s": int(_metric(metrics, "dks_fanin_replica_errors_total")),
+    }
+
+
+# --------------------------------------------------------------------- #
+# scenario 2: killed-then-resumed pool run
+# --------------------------------------------------------------------- #
+
+POOL_INSTANCES = 64
+POOL_BATCH = 8       # x 1 device -> 8 shards of 8 rows
+POOL_NSAMPLES = 64
+CRASH_AFTER = 4      # skip 4 shard completions; crash on the 5th shard's
+                     # fetch, before its journal record — so the killed
+                     # run computed CRASH_AFTER + 1 shards and durably
+                     # recorded CRASH_AFTER
+
+
+def pool_run(checkpoint_dir: str, out_path: str) -> dict:
+    """One (possibly resumed) journaled pool explain — the subprocess
+    body.  Deterministic end to end: seeded data, fixed shard layout,
+    l1_reg off."""
+
+    from distributedkernelshap_tpu import DenseData
+    from distributedkernelshap_tpu.kernel_shap import KernelExplainerEngine
+    from distributedkernelshap_tpu.models import LinearPredictor
+    from distributedkernelshap_tpu.parallel.distributed import (
+        DistributedExplainer,
+    )
+
+    rng = np.random.default_rng(3)
+    D, K = 11, 2
+    groups = [[0], [1], [2, 3, 4], [5, 6], [7, 8, 9, 10]]
+    names = ["a", "b", "c", "d", "e"]
+    W = rng.normal(size=(D, K)).astype(np.float32)
+    b = rng.normal(size=(K,)).astype(np.float32)
+    bg = rng.normal(size=(20, D)).astype(np.float32)
+    X = rng.normal(size=(POOL_INSTANCES, D)).astype(np.float32)
+    dist = DistributedExplainer(
+        {"n_devices": 1, "batch_size": POOL_BATCH,
+         "checkpoint_dir": checkpoint_dir},
+        KernelExplainerEngine,
+        (LinearPredictor(W, b, activation="softmax"),
+         DenseData(bg, names, groups)),
+        {"link": "logit", "seed": 0})
+    sv = dist.get_explanation(X, nsamples=POOL_NSAMPLES, l1_reg=False)
+    np.save(out_path, np.stack(sv if isinstance(sv, list) else [sv]))
+    return dist.last_journal_stats
+
+
+def _spawn_pool_run(checkpoint_dir: str, out_path: str, faults: str = ""):
+    env = {**os.environ, **BASE_ENV, "DKS_DISPATCH_WINDOW": "1"}
+    env.pop("DKS_FAULTS", None)
+    if faults:
+        env["DKS_FAULTS"] = faults
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--pool-run",
+         "--checkpoint-dir", checkpoint_dir, "--out", out_path],
+        env=env, capture_output=True, text=True, timeout=900)
+    stats = None
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith("{"):
+            stats = json.loads(line)
+            break
+    return proc.returncode, stats, proc.stderr[-2000:]
+
+
+def _journal_records(checkpoint_dir: str) -> int:
+    names = [n for n in os.listdir(checkpoint_dir)
+             if n.endswith(".journal")]
+    if len(names) != 1:
+        return -1
+    with open(os.path.join(checkpoint_dir, names[0])) as fh:
+        return max(0, len(fh.read().splitlines()) - 1)  # minus header
+
+
+def run_pool_resume():
+    from distributedkernelshap_tpu.resilience.faults import CRASH_EXIT_CODE
+
+    n_shards = POOL_INSTANCES // POOL_BATCH
+    with tempfile.TemporaryDirectory() as tmp:
+        ref_dir = os.path.join(tmp, "ref")
+        res_dir = os.path.join(tmp, "resume")
+        ref_phi = os.path.join(tmp, "ref.npy")
+        res_phi = os.path.join(tmp, "resume.npy")
+
+        rc_ref, ref_stats, err = _spawn_pool_run(ref_dir, ref_phi)
+        if rc_ref != 0:
+            return {"error": f"reference run failed rc={rc_ref}: {err}"}
+
+        rc_kill, _, _ = _spawn_pool_run(
+            res_dir, res_phi,
+            faults=f"crash:site=pool.shard,after={CRASH_AFTER}")
+        survived = _journal_records(res_dir)
+
+        rc_res, res_stats, err = _spawn_pool_run(res_dir, res_phi)
+        if rc_res != 0:
+            return {"error": f"resume run failed rc={rc_res}: {err}"}
+
+        phi_ref = np.load(ref_phi)
+        phi_res = np.load(res_phi)
+        # shards the killed run computed (CRASH_AFTER + 1: the fault fires
+        # on the following hit) plus shards the resume computed, minus
+        # the total = work done twice — the in-flight shard, at most
+        recomputed_overlap = (CRASH_AFTER + 1 + res_stats["computed"]
+                              - n_shards)
+        return {
+            "n_shards": n_shards,
+            "crash_rc": rc_kill,
+            "crash_exit_code_expected": CRASH_EXIT_CODE,
+            "journal_shards_after_kill": survived,
+            "resume_restored": res_stats["restored"],
+            "resume_computed": res_stats["computed"],
+            "recomputed_overlap_shards": int(recomputed_overlap),
+            "bit_identical_phi": bool(np.array_equal(phi_ref, phi_res)),
+            "reference_computed": ref_stats["computed"],
+        }
+
+
+# --------------------------------------------------------------------- #
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 unless the acceptance criteria hold")
+    parser.add_argument("--serve-only", action="store_true")
+    parser.add_argument("--pool-only", action="store_true")
+    parser.add_argument("--requests", type=int, default=48)
+    # subprocess mode (internal): one journaled pool run
+    parser.add_argument("--pool-run", action="store_true",
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--checkpoint-dir", help=argparse.SUPPRESS)
+    parser.add_argument("--out", help=argparse.SUPPRESS)
+    args = parser.parse_args()
+
+    if args.pool_run:
+        stats = pool_run(args.checkpoint_dir, args.out)
+        print(json.dumps(stats))
+        return 0
+
+    report = {"bench": "chaos"}
+    checks = {}
+    if not args.pool_only:
+        serve = run_serve_chaos(n_requests=args.requests)
+        report["serve"] = serve
+        checks.update({
+            "zero_lost": serve["lost"] == 0,
+            "zero_duplicated_or_mixed": serve["mismatched"] == 0,
+            "additivity_ok": serve["additivity_bad"] == 0,
+            "killed_replica_restarted": serve["supervisor_restarts"] >= 1,
+            "all_replicas_recovered": serve["all_replicas_recovered"],
+            "hedge_beat_slow_replica": serve["hedge_wins"] >= 1,
+        })
+    if not args.serve_only:
+        pool = run_pool_resume()
+        report["pool"] = pool
+        checks.update({
+            "crash_was_injected": pool.get("crash_rc")
+            == pool.get("crash_exit_code_expected"),
+            "journal_survived_kill": pool.get("journal_shards_after_kill")
+            == CRASH_AFTER,
+            "resume_recomputes_le_1_shard":
+                0 <= pool.get("recomputed_overlap_shards", 99) <= 1,
+            "bit_identical_phi": pool.get("bit_identical_phi", False),
+        })
+    report["checks"] = checks
+    report["ok"] = bool(checks) and all(checks.values())
+    print(json.dumps(report))
+    if args.check and not report["ok"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,7 @@ TPU-native replacement for the reference's Ray actor-pool orchestration
 dispatcher, citing ``explainers/distributed.py:11-82``.
 """
 
+import json
 import logging
 from dataclasses import replace
 from functools import partial
@@ -103,6 +104,17 @@ class DistributedExplainer:
         # default) resolves via parallel/pipeline.resolve_window — env
         # override or a live RTT probe — replacing round 2's hand-set 3
         self.dispatch_window = opts.get('dispatch_window')
+        # shard-granular checkpoint/resume (resilience/journal.py): with a
+        # checkpoint_dir set, every multi-call explain journals completed
+        # slabs so a killed run resumes recomputing only in-flight work.
+        # 'journal_fingerprint' pins the run key explicitly (recommended
+        # for predictors whose parameters content-hashing cannot see —
+        # docs/RESILIENCE.md).
+        self.checkpoint_dir = opts.get('checkpoint_dir')
+        self._pinned_journal_fp = opts.get('journal_fingerprint')
+        #: stats of the most recent journaled run ({'path', 'completed',
+        #: 'restored', 'computed'}); None when checkpointing is off
+        self.last_journal_stats: Optional[Dict[str, Any]] = None
         cp = opts.get('coalition_parallel')
         frac = opts.get('actor_cpu_fraction')
         cp_from_fraction = False
@@ -493,8 +505,11 @@ class DistributedExplainer:
             slabs = [X]
 
         fn, args = self._exact_sharded_fn(interactions=interactions)
+        journal = self._journal_for(slabs, 'exact', 'exact',
+                                    interactions=interactions)
         results = self._run_slabs(
-            slabs, lambda s: self._dispatch_call(fn, s, args))
+            slabs, lambda s: self._dispatch_call(fn, s, args),
+            journal=journal)
 
         phi = np.concatenate([r[0] for r in results], 0)[:B]
         self.last_raw_prediction = np.concatenate(
@@ -508,7 +523,60 @@ class DistributedExplainer:
         self.last_X_fingerprint = _fingerprint(X[:B])
         return split_shap_values(phi, engine.vector_out)
 
-    def _run_slabs(self, slabs, dispatch, fetch_is_local: bool = False):
+    def _journal_for(self, slabs, kind: str, nsamples,
+                     interactions: bool = False):
+        """A :class:`ShardJournal` for this run, or ``None`` with
+        checkpointing off.  The run key covers everything that determines
+        a slab's bytes — model fingerprint, the exact (padded) input, the
+        shard layout and the explain options — so the invalidation
+        contract is structural: any change produces a different journal
+        file / a mismatching header, never a partially reused one."""
+
+        if not self.checkpoint_dir:
+            return None
+        if jax.process_count() > 1:
+            # each process journals locally, so two processes could
+            # restore DIFFERENT shard subsets and desync the collective
+            # order embedded in sharded fetches — a permanent hang, not a
+            # resume.  Warn-and-degrade (package convention).
+            logger.warning("checkpoint_dir is single-process only; "
+                           "ignoring it on this multi-host mesh")
+            return None
+        import hashlib
+
+        from distributedkernelshap_tpu.resilience.journal import (
+            ShardJournal,
+            journal_fingerprint,
+            run_journal_path,
+        )
+        from distributedkernelshap_tpu.scheduling.result_cache import (
+            array_fingerprint,
+        )
+
+        fp = self._pinned_journal_fp or journal_fingerprint(self.engine)
+        # slab-by-slab input digest: equally stable as hashing the
+        # concatenated batch (the slab split is part of the key via
+        # n_shards) without materialising a second full copy of it
+        slab_digest = hashlib.sha256()
+        for s in slabs:
+            slab_digest.update(array_fingerprint(s).encode())
+        meta = {
+            "fingerprint": fp,
+            "input": slab_digest.hexdigest(),
+            "n_shards": len(slabs),
+            "kind": kind,
+            "nsamples": repr(nsamples),
+            "interactions": bool(interactions),
+            "transfer_dtype": repr(self.engine.config.shap.transfer_dtype),
+            "mesh": [int(self.n_data), int(self.coalition_parallel)],
+        }
+        run_digest = hashlib.sha256(
+            json.dumps(meta, sort_keys=True).encode()).hexdigest()
+        path = run_journal_path(self.checkpoint_dir, fp, run_digest)
+        return ShardJournal(path, meta)
+
+    def _run_slabs(self, slabs, dispatch, fetch_is_local: bool = False,
+                   journal=None):
         """Run the slab sequence through the shared bounded pipeline
         (``parallel/pipeline.py``): window resolved from the
         ``dispatch_window`` opt / env / a live RTT probe, fetches threaded
@@ -532,9 +600,19 @@ class DistributedExplainer:
                      if self.dispatch_window is not None
                      else self.engine.config.dispatch_window)
         window = resolve_window(requested, n_items=len(slabs))
-        return run_pipeline(slabs, dispatch, self._fetch_sharded,
-                            window=window,
-                            threaded=(not multihost) or fetch_is_local)
+        try:
+            return run_pipeline(slabs, dispatch, self._fetch_sharded,
+                                window=window,
+                                threaded=(not multihost) or fetch_is_local,
+                                journal=journal)
+        finally:
+            if journal is not None:
+                self.last_journal_stats = journal.stats()
+                journal.close()
+            else:
+                # a non-journaled run must not leave a previous journaled
+                # run's stats behind (the attribute contract is "this run")
+                self.last_journal_stats = None
 
     def _slab_size(self) -> int:
         """Rows per sharded slab (``batch_size`` instances per device), or
@@ -703,9 +781,11 @@ class DistributedExplainer:
         # pipeline.  The window is bounded so peak device residency is a
         # few slabs' inputs/outputs, not the whole global batch; result
         # order is preserved — no reordering machinery needed.
+        journal = self._journal_for(slabs, 'sampled', nsamples)
         results = self._run_slabs(
             slabs, lambda s: self._dispatch_sharded(s, nsamples),
-            fetch_is_local=self.replicate_results)
+            fetch_is_local=self.replicate_results,
+            journal=journal)
         phi = np.concatenate([r[0] for r in results], 0)[:B]
         X = X[:B]
         self.last_raw_prediction = np.concatenate([r[1] for r in results], 0)[:B]

@@ -189,7 +189,8 @@ def run_pipeline(items: Iterable[Any],
                  dispatch: Callable[[Any], Any],
                  fetch: Callable[[Any], Any],
                  window: int,
-                 threaded: bool = True) -> List[Any]:
+                 threaded: bool = True,
+                 journal=None) -> List[Any]:
     """``[fetch(dispatch(item)) for item in items]`` with bounded overlap.
 
     ``dispatch`` runs on the calling thread, in order (it may populate jit
@@ -201,6 +202,15 @@ def run_pipeline(items: Iterable[Any],
     meshes, where fetches embed collectives that must stay ordered) keeps
     the round-2 serial sliding window.
 
+    ``journal`` (a :class:`~distributedkernelshap_tpu.resilience.journal.
+    ShardJournal`) makes the loop restartable: items whose index is
+    already journaled are restored from disk without dispatching ANY
+    device work, and each fresh fetch is durably recorded before the loop
+    moves on — a killed run recomputes only the shards in flight when it
+    died.  The chaos site ``pool.shard`` fires between fetch and record,
+    so an injected ``crash:site=pool.shard,after=K`` loses exactly the
+    K-th shard's work — the worst case a resume must absorb.
+
     A fetch/dispatch exception propagates to the caller after in-flight
     work drains (the executor joins on exit), matching the serial path's
     fail-fast behaviour closely enough for callers that treat any failure
@@ -209,30 +219,64 @@ def run_pipeline(items: Iterable[Any],
 
     items = list(items)
     window = max(1, int(window))
+    # the pool.shard chaos site exists ONLY on journaled slab loops: its
+    # contract is "fetch done, journal record not yet written", and firing
+    # it from the engine's internal per-chunk pipelines would make an
+    # after=K kill count unrelated hits (and let a fleet-wide DKS_FAULTS
+    # pool spec crash serving workers through their in-server pipelines)
+    injector = None
+    if journal is not None:
+        from distributedkernelshap_tpu.resilience.faults import env_injector
+
+        injector = env_injector()
+
+    def finish(index, handle):
+        result = fetch(handle)
+        if injector is not None:
+            injector.fire("pool.shard")
+        if journal is not None:
+            journal.put(index, result)
+        return result
+
+    if journal is not None:
+        restored = {i: journal.get(i) for i in range(len(items))}
+        restored = {i: r for i, r in restored.items() if r is not None}
+    else:
+        restored = {}
+
     if not threaded or window <= 1 or len(items) <= 1:
         pending: deque = deque()
-        results = []
-        for it in items:
-            pending.append(dispatch(it))
+        results: List[Any] = [None] * len(items)
+        for i, it in enumerate(items):
+            if i in restored:
+                results[i] = restored[i]
+                continue
+            pending.append((i, dispatch(it)))
             if len(pending) >= window:
-                results.append(fetch(pending.popleft()))
+                j, handle = pending.popleft()
+                results[j] = finish(j, handle)
         while pending:
-            results.append(fetch(pending.popleft()))
+            j, handle = pending.popleft()
+            results[j] = finish(j, handle)
         return results
 
     sem = threading.BoundedSemaphore(window)
     failed = threading.Event()  # fail fast: stop dispatching once a fetch dies
+    results = [None] * len(items)
     with ThreadPoolExecutor(max_workers=min(window, MAX_WINDOW)) as pool:
         futures = []
-        for it in items:
+        for i, it in enumerate(items):
+            if i in restored:
+                results[i] = restored[i]
+                continue
             sem.acquire()  # bounds dispatched-but-unfetched slabs
             if failed.is_set():
                 break  # don't burn device work after a fatal fetch error
             handle = dispatch(it)
 
-            def _fetch(handle=handle):
+            def _fetch(i=i, handle=handle):
                 try:
-                    return fetch(handle)
+                    results[i] = finish(i, handle)
                 except BaseException:
                     failed.set()
                     raise
@@ -240,4 +284,6 @@ def run_pipeline(items: Iterable[Any],
                     sem.release()
 
             futures.append(pool.submit(_fetch))
-        return [f.result() for f in futures]
+        for f in futures:
+            f.result()
+        return results

@@ -1,0 +1,42 @@
+"""Fault injection and fault tolerance for the serving + pool layers.
+
+The reference inherited its fault story from Ray (actor restarts, Serve
+replica respawn, object-store lineage).  The jax_graft port replaced Ray
+with hand-rolled HTTP replicas and an in-process sharded pool, so every
+piece of that story has to be rebuilt explicitly:
+
+* :mod:`~distributedkernelshap_tpu.resilience.faults` — a deterministic,
+  seedable fault-injection harness (crash / hang / slow / connection drop /
+  corrupt payload) wired into the REAL serving and pool code paths via
+  environment or constructor hooks, so chaos tests exercise production
+  failure handling rather than mocks;
+* :mod:`~distributedkernelshap_tpu.resilience.supervisor` — replica
+  process supervision with crash-loop exponential backoff + jitter,
+  feeding liveness into the fan-in proxy;
+* :mod:`~distributedkernelshap_tpu.resilience.journal` — shard-granular
+  checkpoint/resume for long batch runs, keyed by the scheduling layer's
+  model fingerprint (fingerprint change ⇒ journal ignored);
+* :mod:`~distributedkernelshap_tpu.resilience.hedging` — tail-latency
+  request hedging with per-class streaming quantile tracking.
+
+See ``docs/RESILIENCE.md`` for the failure model and knob reference.
+"""
+
+from distributedkernelshap_tpu.resilience.faults import (  # noqa: F401
+    FaultInjector,
+    FaultSpec,
+    from_env,
+    parse_faults,
+)
+from distributedkernelshap_tpu.resilience.hedging import (  # noqa: F401
+    HedgePolicy,
+    LatencyQuantiles,
+)
+from distributedkernelshap_tpu.resilience.journal import (  # noqa: F401
+    ShardJournal,
+    journal_fingerprint,
+)
+from distributedkernelshap_tpu.resilience.supervisor import (  # noqa: F401
+    ReplicaSupervisor,
+    RestartPolicy,
+)

@@ -1,0 +1,188 @@
+"""Replica process supervision: restart-on-crash with crash-loop backoff.
+
+The reference's replicas were Ray Serve actors with ``restartPolicy:
+Always`` behind them; a crashed backend respawned and rejoined routing
+automatically.  The jax_graft port's ``ReplicaManager`` grew a minimal
+restart loop (fixed 1 s backoff, no proxy integration); this module is
+its grown-up replacement:
+
+* **crash-loop protection** — a replica that dies immediately after
+  every start (poisoned model file, bad device) is restarted with
+  exponential backoff + jitter instead of hot-looping spawn/crash cycles
+  that burn a CPU and spam logs; an incarnation that stays up
+  ``healthy_reset_s`` resets the backoff.
+* **membership agreement** — the supervisor marks a dead replica out of
+  the fan-in proxy's rotation the moment the process exits, instead of
+  letting clients discover the corpse via failed connects; recovery
+  stays owned by the proxy's ``/healthz`` prober, so exactly one
+  component (the prober) ever declares a replica live, and exactly one
+  (the supervisor or a failed connect) declares it dead.
+
+Used by ``serving/replicas.ReplicaManager``; standalone-usable for any
+list of worker ``Popen`` objects plus a spawn function.
+"""
+
+import logging
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class RestartPolicy:
+    """Exponential backoff with jitter for crash-looping replicas.
+
+    ``delay(n)`` for the n-th CONSECUTIVE crash (n >= 1) is
+    ``base_backoff_s * 2**(n-1)`` capped at ``max_backoff_s``, plus
+    uniform jitter of ``jitter_frac`` of the delay (jitter decorrelates a
+    fleet that all crashed on the same poisoned input, so the restarts
+    don't stampede the shared model store / device pool).  Seedable for
+    deterministic tests.
+    """
+
+    def __init__(self, base_backoff_s: float = 0.5,
+                 max_backoff_s: float = 30.0,
+                 jitter_frac: float = 0.25,
+                 healthy_reset_s: float = 60.0,
+                 seed: Optional[int] = None):
+        if base_backoff_s <= 0 or max_backoff_s < base_backoff_s:
+            raise ValueError("need 0 < base_backoff_s <= max_backoff_s")
+        if not 0.0 <= jitter_frac <= 1.0:
+            raise ValueError("jitter_frac must be in [0, 1]")
+        self.base_backoff_s = float(base_backoff_s)
+        self.max_backoff_s = float(max_backoff_s)
+        self.jitter_frac = float(jitter_frac)
+        self.healthy_reset_s = float(healthy_reset_s)
+        self._rng = random.Random(seed)
+
+    def delay(self, consecutive_crashes: int) -> float:
+        n = max(1, int(consecutive_crashes))
+        base = min(self.max_backoff_s,
+                   self.base_backoff_s * (2.0 ** (n - 1)))
+        return base * (1.0 + self.jitter_frac * self._rng.random())
+
+
+class ReplicaSupervisor:
+    """Monitors worker processes and restarts exited ones.
+
+    Parameters
+    ----------
+    procs
+        The SHARED list of worker ``Popen`` objects — restarts replace
+        entries in place, so the owner (``ReplicaManager``) always sees
+        the live incarnation.
+    spawn
+        ``spawn(index) -> Popen`` relaunching one worker.
+    proxy
+        Optional ``FanInProxy``: on process exit the replica is marked
+        out of rotation immediately (``alive = False``); the proxy's own
+        prober re-admits it once ``/healthz`` answers.
+    policy
+        :class:`RestartPolicy`; defaults are production-shaped.
+    lock
+        Optional externally owned lock serialising respawn against the
+        owner's shutdown sweep (``ReplicaManager`` passes its procs
+        lock); an internal lock is created otherwise.
+    """
+
+    def __init__(self, procs: List, spawn: Callable[[int], object],
+                 proxy=None, policy: Optional[RestartPolicy] = None,
+                 poll_interval_s: float = 0.5,
+                 lock: Optional[threading.Lock] = None):
+        self.procs = procs
+        self.spawn = spawn
+        self.proxy = proxy
+        self.policy = policy or RestartPolicy()
+        self.poll_interval_s = float(poll_interval_s)
+        self.lock = lock or threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # per-replica crash bookkeeping
+        self._consecutive: Dict[int, int] = {}
+        self._last_start: Dict[int, float] = {}
+        self._respawn_at: Dict[int, float] = {}
+        self.restarts_total = 0
+        self.crash_loops_backing_off = 0
+
+    # ------------------------------------------------------------------ #
+
+    def _mark_down(self, index: int) -> None:
+        if self.proxy is None:
+            return
+        try:
+            replica = self.proxy.replicas[index]
+        except IndexError:
+            return
+        if replica.alive:
+            replica.alive = False
+            logger.warning("supervisor: replica %d exited; removed from "
+                           "rotation pending restart", index)
+
+    def _tick(self) -> None:
+        now = time.monotonic()
+        for i, proc in enumerate(self.procs):
+            if proc is None or proc.poll() is None:
+                continue
+            # dead: the proxy must stop routing to the corpse NOW — the
+            # prober only recovers, the supervisor (and failed connects)
+            # declare death
+            self._mark_down(i)
+            due = self._respawn_at.get(i)
+            if due is None:
+                lived = now - self._last_start.get(i, 0.0)
+                if lived >= self.policy.healthy_reset_s:
+                    self._consecutive[i] = 1
+                else:
+                    self._consecutive[i] = self._consecutive.get(i, 0) + 1
+                delay = self.policy.delay(self._consecutive[i])
+                self._respawn_at[i] = now + delay
+                if self._consecutive[i] > 1:
+                    self.crash_loops_backing_off += 1
+                logger.warning(
+                    "supervisor: replica %d exited rc=%s (consecutive "
+                    "crash #%d); restarting in %.2fs",
+                    i, proc.returncode, self._consecutive[i], delay)
+                continue
+            if now < due:
+                continue
+            with self.lock:
+                if self._stop.is_set():
+                    return  # shutdown won the race: never respawn
+                self.procs[i] = self.spawn(i)
+            self._last_start[i] = time.monotonic()
+            self._respawn_at.pop(i, None)
+            self.restarts_total += 1
+            logger.info("supervisor: replica %d respawned "
+                        "(restart #%d)", i, self.restarts_total)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self._tick()
+            except Exception:
+                # the supervisor dying silently would turn every later
+                # crash into a permanent outage — log and keep running
+                logger.exception("supervisor tick failed")
+
+    # ------------------------------------------------------------------ #
+
+    def start(self) -> "ReplicaSupervisor":
+        now = time.monotonic()
+        for i in range(len(self.procs)):
+            self._last_start[i] = now
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop restarting.  The owner then sweeps/terminates the procs
+        under :attr:`lock`, which this stop flag guarantees no respawn
+        can interleave with."""
+
+        self._stop.set()
+
+    def stats(self) -> Dict[str, int]:
+        return {"restarts_total": self.restarts_total,
+                "crash_loops_backing_off": self.crash_loops_backing_off}

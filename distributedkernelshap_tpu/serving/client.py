@@ -14,14 +14,25 @@ thread churn starves the GIL the explain pipeline needs.
 
 import http.client
 import json
+import random
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence
 from urllib.parse import urlparse
 
 import numpy as np
 
 _tls = threading.local()
+
+#: ceiling on any single backoff sleep, whatever the server's hint says —
+#: a buggy/adversarial ``Retry-After: 86400`` must not park a client thread
+#: for a day
+MAX_BACKOFF_S = 30.0
+
+#: base for the exponential backoff used when the server gave no hint
+#: (connection failures, 502/503 without Retry-After)
+BASE_BACKOFF_S = 0.25
 
 
 def _get_connection(scheme: str, netloc: str,
@@ -48,23 +59,98 @@ def _drop_connection(scheme: str, netloc: str) -> None:
         conn.close()
 
 
-def explain_request(url: str, instance: np.ndarray, timeout: float = 300.0) -> str:
+def parse_retry_after(headers, payload) -> Optional[float]:
+    """A 429's backoff hint: ``Retry-After`` header, else ``retry_after_s``
+    in the JSON body; ``None`` when absent or garbled.  The ONE parser of
+    this wire hint — the fan-in proxy layers its own floor/default on
+    top (``FanInProxy._retry_after_s``)."""
+
+    value = headers.get("Retry-After") if headers else None
+    if value is not None:
+        try:
+            return max(0.0, float(value))
+        except ValueError:
+            pass
+    try:
+        return max(0.0, float(json.loads(payload)["retry_after_s"]))
+    except (ValueError, KeyError, TypeError):
+        return None
+
+
+def explain_request(url: str, instance: np.ndarray, timeout: float = 300.0,
+                    max_retries: int = 4,
+                    extra_headers: Optional[dict] = None,
+                    _sleep: Callable[[float], None] = time.sleep,
+                    _rng: Optional[random.Random] = None) -> str:
     """POST one instance (or minibatch) to the explanation endpoint and
-    return the JSON payload, reusing this thread's connection."""
+    return the JSON payload, reusing this thread's connection.
+
+    Retriable failures are retried within a bounded budget
+    (``max_retries`` beyond the first attempt), with capped, jittered
+    backoff:
+
+    * **429** — the server's explicit backpressure.  The ``Retry-After``
+      hint is HONOURED (capped at :data:`MAX_BACKOFF_S`, with up to 25%
+      added jitter so a shed burst doesn't resynchronise into a retry
+      stampede at exactly hint seconds).
+    * **502 / 503** — a crashed-mid-request or self-declared-unserviceable
+      replica behind a fan-in.  Explanations are deterministic and
+      content-addressed, so re-sending is idempotent: a duplicate
+      execution produces a bit-identical payload (and on a cache-enabled
+      server costs no second device call).  Exponential backoff from
+      :data:`BASE_BACKOFF_S`.
+    * **connection failures** — retried through a fresh connection (the
+      request may never have been sent).
+    * **undecodable payloads** — a response body that is not valid UTF-8
+      was corrupted on the wire; a re-fetch is idempotent and returns the
+      clean (bit-identical) answer.
+
+    NOT retried: timeouts (the request may still be queued server-side —
+    re-sending duplicates load on an already-struggling server; the 504
+    status a proxy synthesises for a slow replica is equally terminal
+    here), and any other HTTP error (4xx/500 are answers, not outages).
+    ``_sleep``/``_rng`` are test seams.
+    """
 
     parsed = urlparse(url)
     path = parsed.path or "/"
     body = json.dumps({"array": np.asarray(instance).tolist()}).encode()
-    headers = {"Content-Type": "application/json"}
-    for attempt in (0, 1):  # one retry through a fresh connection
+    headers = {"Content-Type": "application/json", **(extra_headers or {})}
+    rng = _rng or random.Random()
+    attempt = 0
+    while True:
         conn = _get_connection(parsed.scheme or "http", parsed.netloc, timeout)
+        backoff = None
         try:
             conn.request("POST", path, body=body, headers=headers)
             resp = conn.getresponse()
-            payload = resp.read().decode()
-            if resp.status != 200:
-                raise RuntimeError(f"HTTP {resp.status}: {payload}")
-            return payload
+            raw = resp.read()
+            try:
+                payload = raw.decode()
+            except UnicodeDecodeError:
+                # corrupted on the wire (bit-rot, an injected garble):
+                # idempotency makes a re-fetch safe, so spend a retry on a
+                # clean copy instead of surfacing garbage — but only for
+                # statuses that are retriable anyway; a garbled 400/500 is
+                # still an answer the server would deterministically repeat
+                if resp.status not in (200, 429, 502, 503) \
+                        or attempt >= max_retries:
+                    raise RuntimeError(
+                        f"HTTP {resp.status}: undecodable (corrupt) payload "
+                        f"of {len(raw)} bytes")
+                payload = None
+                backoff = BASE_BACKOFF_S * (2.0 ** attempt)
+            if payload is not None:
+                if resp.status == 200:
+                    return payload
+                if resp.status == 429:
+                    hint = parse_retry_after(resp.headers, payload)
+                    backoff = hint if hint is not None else \
+                        BASE_BACKOFF_S * (2.0 ** attempt)
+                elif resp.status in (502, 503):
+                    backoff = BASE_BACKOFF_S * (2.0 ** attempt)
+                if backoff is None or attempt >= max_retries:
+                    raise RuntimeError(f"HTTP {resp.status}: {payload}")
         except TimeoutError:
             # a timed-out request may still be queued server-side; re-sending
             # it would duplicate work on an already-overloaded server
@@ -72,9 +158,12 @@ def explain_request(url: str, instance: np.ndarray, timeout: float = 300.0) -> s
             raise
         except (http.client.HTTPException, ConnectionError, OSError):
             _drop_connection(parsed.scheme or "http", parsed.netloc)
-            if attempt:
+            if attempt >= max_retries:
                 raise
-    raise AssertionError("unreachable")
+            backoff = BASE_BACKOFF_S * (2.0 ** attempt)
+        attempt += 1
+        # jitter INSIDE the cap: MAX_BACKOFF_S is a hard ceiling
+        _sleep(min(MAX_BACKOFF_S, backoff * (1.0 + 0.25 * rng.random())))
 
 
 def distribute_requests(url: str,

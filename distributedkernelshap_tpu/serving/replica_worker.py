@@ -77,6 +77,14 @@ def main():
 
     factory = resolve_factory(args.factory)
 
+    # fault injection (chaos harness): resolved from DKS_FAULTS before the
+    # heavyweight imports so a bad spec fails the worker loudly at startup.
+    # Specs carrying replica=K are filtered against DKS_REPLICA_INDEX, so
+    # one fleet-wide env value scripts per-replica behaviour.
+    from distributedkernelshap_tpu.resilience.faults import from_env
+
+    fault_injector = from_env()
+
     # jax imports (inside serve_explainer's dependency chain) happen after
     # the factory resolves, with TPU_VISIBLE_CHIPS already in the
     # environment from the manager — this process initialises ONE chip.
@@ -87,7 +95,8 @@ def main():
         predictor, background, ctor_kwargs, fit_kwargs,
         host=args.host, port=args.port,
         max_batch_size=args.max_batch_size,
-        pipeline_depth=args.pipeline_depth or None)
+        pipeline_depth=args.pipeline_depth or None,
+        fault_injector=fault_injector)
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())

@@ -35,13 +35,24 @@ import json
 import logging
 import math
 import os
+import queue
 import socket
 import subprocess
 import sys
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence, Tuple
+
+from distributedkernelshap_tpu.resilience.hedging import (
+    HedgePolicy,
+    LatencyQuantiles,
+)
+from distributedkernelshap_tpu.resilience.supervisor import (
+    ReplicaSupervisor,
+    RestartPolicy,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -91,7 +102,8 @@ class FanInProxy:
                  host: str = "127.0.0.1", port: int = 0,
                  request_timeout_s: float = 600.0,
                  probe_interval_s: float = 1.0,
-                 trust_client_header: bool = False):
+                 trust_client_header: bool = False,
+                 hedge_policy: Optional[HedgePolicy] = None):
         #: whether a client-supplied ``X-DKS-Client`` passes through.  Off
         #: by default: the proxy is the trust boundary, and an untrusted
         #: client choosing its own rate-limit key defeats per-client
@@ -111,7 +123,22 @@ class FanInProxy:
         self._metrics = {"forwarded_total": 0, "replica_errors_total": 0,
                          "retried_connects_total": 0,
                          "replica_503_demotions_total": 0,
-                         "sheds_total": 0}
+                         "sheds_total": 0,
+                         "hedges_total": 0, "hedge_wins_total": 0}
+        #: tail-latency hedging (``resilience/hedging.py``).  ``None``
+        #: (default) disables it — behaviour is then byte-identical to the
+        #: pre-hedging proxy.  Safe to enable because /explain is
+        #: idempotent (deterministic, content-addressed): the proxy
+        #: returns exactly one answer and discards the hedge loser, whose
+        #: payload would have been bit-identical anyway.
+        self.hedge_policy = hedge_policy
+        self._latency = LatencyQuantiles()
+        # shared pool for racing passes (workers spawn lazily on submit):
+        # hedging must not pay a thread create/teardown per request on
+        # top of the server's handler thread
+        self._hedge_pool = (ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix="dks-hedge")
+            if hedge_policy is not None else None)
         self._stop = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._threads: List[threading.Thread] = []
@@ -166,19 +193,27 @@ class FanInProxy:
 
     @staticmethod
     def _retry_after_s(resp_headers: Dict[str, str], payload: bytes) -> float:
-        """Best-effort parse of a 429's backoff hint (header, else JSON
-        body); defaults to 1 s."""
+        """A 429's backoff hint via the shared wire parser
+        (``client.parse_retry_after``), floored at 0.1 s, 1 s default."""
 
-        value = resp_headers.get("Retry-After")
-        if value is not None:
-            try:
-                return max(0.1, float(value))
-            except ValueError:
-                pass
-        try:
-            return max(0.1, float(json.loads(payload)["retry_after_s"]))
-        except (ValueError, KeyError, TypeError, json.JSONDecodeError):
-            return 1.0
+        from distributedkernelshap_tpu.serving.client import parse_retry_after
+
+        hint = parse_retry_after(resp_headers, payload)
+        return max(0.1, hint) if hint is not None else 1.0
+
+    @staticmethod
+    def _priority_class(headers: Optional[Dict[str, str]]) -> str:
+        # saturation/hedging state is tracked per priority class (replica
+        # queue bounds are per class).  A missing header is normalised to
+        # "interactive" — the server's default default_class — so
+        # headerless and explicitly-interactive traffic share one backoff
+        # key instead of burning a round trip each to learn the same 429.
+        # (A deployment overriding default_class should have clients send
+        # the header.)
+        for k, v in (headers or {}).items():
+            if k.lower() == "x-dks-priority":
+                return v.strip().lower()
+        return "interactive"
 
     def handle_explain(self, method: str, body: bytes,
                        headers: Optional[Dict[str, str]] = None
@@ -186,22 +221,113 @@ class FanInProxy:
         """Route one /explain request; never raises.  ``headers`` are the
         client's scheduling headers (priority class, deadline, client key),
         forwarded verbatim so the replica's scheduler and admission control
-        see the same SLO the client declared."""
+        see the same SLO the client declared.  With :attr:`hedge_policy`
+        set, a request still unanswered past the class's latency quantile
+        is re-dispatched to a second replica and the first answer wins
+        (see ``resilience/hedging.py`` for why that is safe here)."""
 
-        tried: set = set()
+        klass = self._priority_class(headers)
+        if self.hedge_policy is None:
+            t0 = time.monotonic()
+            result = self._route_explain(method, body, headers, klass)
+            if result[0] == 200:
+                self._latency.observe(klass, time.monotonic() - t0)
+            return result
+        return self._handle_hedged(method, body, headers, klass)
+
+    def _handle_hedged(self, method: str, body: bytes,
+                       headers: Optional[Dict[str, str]], klass: str
+                       ) -> Tuple[int, bytes, Dict[str, str]]:
+        """Hedged routing: dispatch the primary, wait the policy delay,
+        then race one hedge on a replica the primary has not touched.
+
+        The proxy returns exactly ONE answer; the loser's response is
+        discarded.  Double execution cannot double-count or diverge:
+        explanations are deterministic and content-addressed (the PR-1
+        result-cache key), so both copies produce bit-identical payloads
+        and `forwarded_total` moves once per CLIENT request (inside
+        ``_route_explain``, for whichever copy returns its answer)."""
+
+        results: "queue.Queue" = queue.Queue()
+        primary_tried: List[int] = []  # list: atomic appends, safe snapshot
+
+        def run(slot: str, exclude):
+            t0 = time.monotonic()
+            # forward_sink defers the forwarded_total increment to the
+            # winner below: the counter must move once per CLIENT request
+            # (counting the answer the client actually received), never
+            # once per racing copy
+            fwd: List[int] = []
+            try:
+                res = self._route_explain(
+                    method, body, headers, klass, tried=set(exclude),
+                    record=primary_tried if slot == "primary" else None,
+                    forward_sink=fwd)
+            except Exception as e:
+                # a dead racing pass MUST still report in: both passes
+                # dying silently would park this handler on an untimed
+                # results.get() forever
+                logger.exception("hedged routing pass failed")
+                res = (500, json.dumps(
+                    {"error": f"proxy routing failure: {e}"}).encode(), {})
+            results.put((slot, res, time.monotonic() - t0, bool(fwd)))
+
+        self._hedge_pool.submit(run, "primary", ())
+        delay = self.hedge_policy.delay_for(self._latency, klass)
+        hedged = False
+        try:
+            slot, res, lat, fwd = results.get(timeout=delay)
+        except queue.Empty:
+            exclude = list(primary_tried)
+            if not any(r.alive and r.index not in exclude
+                       for r in self.replicas):
+                # nowhere to hedge onto: just wait the primary out
+                slot, res, lat, fwd = results.get()
+            else:
+                hedged = True
+                with self._metrics_lock:
+                    self._metrics["hedges_total"] += 1
+                self._hedge_pool.submit(run, "hedge", exclude)
+                slot, res, lat, fwd = results.get()
+                if res[0] != 200:
+                    # first answer is an error while the other copy is
+                    # still in flight: prefer a 200, else a genuine
+                    # replica answer over a proxy-synthesized error (the
+                    # more informative of two failures).  Bounded:
+                    # _route_explain's transport timeouts guarantee the
+                    # second answer arrives.
+                    try:
+                        slot2, res2, lat2, fwd2 = results.get(
+                            timeout=self.request_timeout_s + 10.0)
+                        if res2[0] == 200 or (fwd2 and not fwd):
+                            slot, res, lat, fwd = slot2, res2, lat2, fwd2
+                    except queue.Empty:
+                        pass
+        with self._metrics_lock:
+            if fwd:  # a replica answered the winning copy (any status)
+                self._metrics["forwarded_total"] += 1
+            if hedged and slot == "hedge" and res[0] == 200:
+                self._metrics["hedge_wins_total"] += 1
+        if res[0] == 200:
+            self._latency.observe(klass, lat)
+        return res
+
+    def _route_explain(self, method: str, body: bytes,
+                       headers: Optional[Dict[str, str]], klass: str,
+                       tried: Optional[set] = None,
+                       record: Optional[List[int]] = None,
+                       forward_sink: Optional[List[int]] = None
+                       ) -> Tuple[int, bytes, Dict[str, str]]:
+        """One routing pass over the rotation (failover loop); ``tried``
+        seeds replicas to skip (the hedge path excludes the primary's),
+        ``record`` collects the indices this pass touches.  A terminal
+        replica answer normally counts in ``forwarded_total``; with
+        ``forward_sink`` set it is appended there instead, so the hedged
+        caller (racing two passes) counts once per client request."""
+
+        tried = set() if tried is None else tried
         last_503: Optional[Tuple[int, bytes]] = None
         last_429: Optional[Tuple[bytes, float]] = None
-        # saturation is tracked per priority class (replica queue bounds
-        # are per class).  A missing header is normalised to "interactive"
-        # — the server's default default_class — so headerless and
-        # explicitly-interactive traffic share one backoff key instead of
-        # burning a round trip each to learn the same 429.  (A deployment
-        # overriding default_class should have clients send the header.)
-        klass = "interactive"
-        for k, v in (headers or {}).items():
-            if k.lower() == "x-dks-priority":
-                klass = v.strip().lower()
-                break
         while True:
             replica = self._pick(tried)
             if replica is None:
@@ -223,6 +349,8 @@ class FanInProxy:
                     "replicas": {r.address: r.alive
                                  for r in self.replicas}}).encode(), {}
             tried.add(replica.index)
+            if record is not None:
+                record.append(replica.index)
             backoff = replica.saturated_for(klass)
             if time.monotonic() < backoff:
                 # recently answered 429 for this class: skip without
@@ -331,8 +459,11 @@ class FanInProxy:
                                replica.address)
                 last_503 = (status, payload)
                 continue
-            with self._metrics_lock:
-                self._metrics["forwarded_total"] += 1
+            if forward_sink is not None:
+                forward_sink.append(replica.index)
+            else:
+                with self._metrics_lock:
+                    self._metrics["forwarded_total"] += 1
             return status, payload, {}
 
     # ------------------------------------------------------------------ #
@@ -385,6 +516,14 @@ class FanInProxy:
             "429 because every live replica reported saturation.",
             "# TYPE dks_fanin_sheds_total counter",
             f"dks_fanin_sheds_total {m['sheds_total']}",
+            "# HELP dks_fanin_hedges_total Requests re-dispatched to a "
+            "second replica after the hedge delay.",
+            "# TYPE dks_fanin_hedges_total counter",
+            f"dks_fanin_hedges_total {m['hedges_total']}",
+            "# HELP dks_fanin_hedge_wins_total Hedged requests whose "
+            "hedge answered first with a success.",
+            "# TYPE dks_fanin_hedge_wins_total counter",
+            f"dks_fanin_hedge_wins_total {m['hedge_wins_total']}",
             "# HELP dks_fanin_replica_up Replica liveness by index.",
             "# TYPE dks_fanin_replica_up gauge",
         ]
@@ -489,6 +628,10 @@ class FanInProxy:
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
+        if self._hedge_pool is not None:
+            # wait=False: a pass stuck in a transport timeout must not
+            # stall shutdown; its thread is bounded by those timeouts
+            self._hedge_pool.shutdown(wait=False)
 
     def __enter__(self):
         return self.start()
@@ -511,9 +654,14 @@ class ReplicaManager:
     (``replica_worker.py``) and their fan-in proxy.
 
     The in-process analog of the reference's Ray autorestart
-    (``cluster/ray_cluster.yaml:63``): an exited worker is relaunched
-    (bounded backoff), re-probed, and returns to the proxy's rotation via
-    the proxy's own health prober."""
+    (``cluster/ray_cluster.yaml:63``): an exited worker is relaunched by a
+    :class:`~distributedkernelshap_tpu.resilience.supervisor.
+    ReplicaSupervisor` (crash-loop exponential backoff + jitter, dead
+    replicas marked straight out of the proxy's rotation), re-probed, and
+    returned to rotation by the proxy's own health prober.
+
+    ``restart_policy`` tunes the backoff; ``hedge_policy`` enables
+    tail-latency hedging at the fan-in (``resilience/hedging.py``)."""
 
     def __init__(self, n_replicas: int,
                  factory: str = "distributedkernelshap_tpu.serving."
@@ -524,7 +672,9 @@ class ReplicaManager:
                  pin_devices: bool = True,
                  restart: bool = True,
                  env_extra: Optional[Dict[str, str]] = None,
-                 startup_timeout_s: float = 300.0):
+                 startup_timeout_s: float = 300.0,
+                 restart_policy: Optional[RestartPolicy] = None,
+                 hedge_policy: Optional[HedgePolicy] = None):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         self.n_replicas = n_replicas
@@ -534,6 +684,8 @@ class ReplicaManager:
         self.pipeline_depth = pipeline_depth
         self.pin_devices = pin_devices
         self.restart = restart
+        self.restart_policy = restart_policy
+        self.hedge_policy = hedge_policy
         self.env_extra = dict(env_extra or {})
         self.startup_timeout_s = startup_timeout_s
         self.ports: List[int] = []
@@ -544,7 +696,7 @@ class ReplicaManager:
         # as stop() runs can be respawned AFTER stop() already swept the
         # proc list, leaking a server process (and its chip) past shutdown
         self._procs_lock = threading.Lock()
-        self._supervisor: Optional[threading.Thread] = None
+        self.supervisor: Optional[ReplicaSupervisor] = None
 
     # ------------------------------------------------------------------ #
 
@@ -567,11 +719,13 @@ class ReplicaManager:
 
     def _spawn(self, index: int) -> subprocess.Popen:
         env = dict(os.environ, **self.env_extra)
+        # always stamped (not only under pin_devices): the fault harness
+        # filters replica=K specs on it, and logs/metrics want it too
+        env["DKS_REPLICA_INDEX"] = str(index)
         if self.pin_devices:
             # one chip per worker on TPU hosts; harmless elsewhere.  The
             # worker re-checks this before importing jax.
             env["TPU_VISIBLE_CHIPS"] = str(index)
-            env["DKS_REPLICA_INDEX"] = str(index)
         argv = [sys.executable, "-m",
                 "distributedkernelshap_tpu.serving.replica_worker",
                 "--factory", self.factory,
@@ -603,21 +757,6 @@ class ReplicaManager:
             time.sleep(0.5)
         return False
 
-    def _supervise(self):
-        """Restart exited workers (1 s backoff); the proxy's prober returns
-        them to rotation once /healthz answers."""
-
-        while not self._stop.wait(1.0):
-            for i, proc in enumerate(self.procs):
-                if proc.poll() is None:
-                    continue
-                with self._procs_lock:
-                    if self._stop.is_set():
-                        return  # shutdown won the race: never respawn
-                    logger.warning("replica %d exited rc=%s; restarting",
-                                   i, proc.returncode)
-                    self.procs[i] = self._spawn(i)
-
     # ------------------------------------------------------------------ #
 
     def start(self, proxy_port: int = 0,
@@ -648,18 +787,22 @@ class ReplicaManager:
                            sum(ok), self.n_replicas)
         self.proxy = FanInProxy(
             [(self.host, p) for p in self.ports],
-            host=proxy_host or self.host, port=proxy_port).start()
+            host=proxy_host or self.host, port=proxy_port,
+            hedge_policy=self.hedge_policy).start()
         for i, o in enumerate(ok):
             if not o:
                 self.proxy.replicas[i].alive = False
         if self.restart:
-            self._supervisor = threading.Thread(target=self._supervise,
-                                                daemon=True)
-            self._supervisor.start()
+            self.supervisor = ReplicaSupervisor(
+                self.procs, self._spawn, proxy=self.proxy,
+                policy=self.restart_policy,
+                lock=self._procs_lock).start()
         return self
 
     def stop(self):
         self._stop.set()
+        if self.supervisor is not None:
+            self.supervisor.stop()
         if self.proxy is not None:
             self.proxy.stop()
         with self._procs_lock:  # no respawn may interleave with the sweep

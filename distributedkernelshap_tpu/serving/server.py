@@ -246,6 +246,13 @@ class ExplainerServer:
         ``False`` disables every admission gate (queue bounds, rate
         limits, projected-wait shedding) — the pre-scheduler accept-
         everything behaviour, used as the benchmark control arm.
+    fault_injector
+        Optional :class:`~distributedkernelshap_tpu.resilience.faults.
+        FaultInjector` consulted at the ``server.accept`` (post-parse,
+        pre-admission) and ``server.explain`` (pre-success-reply)
+        sites — the chaos harness's hook into the REAL request path.
+        ``replica_worker`` wires this from the ``DKS_FAULTS`` env;
+        ``None`` (the default) is zero-overhead.
     """
 
     def __init__(self, model, host: str = "0.0.0.0", port: int = 8000,
@@ -260,7 +267,8 @@ class ExplainerServer:
                  max_queue_per_class=4096,
                  rate_limit_per_client: Optional[Tuple[float, float]] = None,
                  cache_bytes: int = 0,
-                 admission_control: bool = True):
+                 admission_control: bool = True,
+                 fault_injector=None):
         self.model = model
         self.host = host
         self.port = port
@@ -323,6 +331,7 @@ class ExplainerServer:
             rate_limit_per_client=rate_limit_per_client,
             estimator=self._service_rate) if admission_control else None)
         self._cache = ResultCache(cache_bytes) if cache_bytes else None
+        self._faults = fault_injector
         # computed lazily on first request: fingerprinting hashes the
         # background data, and the model may be swapped between __init__
         # and start() in tests.  Staleness is detected by OBJECT IDENTITY:
@@ -819,6 +828,34 @@ class ExplainerServer:
                 self.end_headers()
                 self.wfile.write(data)
 
+            def _reply_explain_ok(self, body: str):
+                """Success reply for /explain, routed through the chaos
+                site ``server.explain``: crash/hang/slow happen inside
+                ``fire``; ``drop`` closes the socket without replying
+                (mid-request connection loss); ``corrupt`` garbles the
+                payload bytes under an intact Content-Length."""
+
+                action = (server._faults.fire("server.explain")
+                          if server._faults is not None else None)
+                if action == "drop":
+                    self.close_connection = True
+                    return
+                if action != "corrupt":
+                    self._reply(200, body)
+                    return
+                from distributedkernelshap_tpu.resilience.faults import (
+                    corrupt_payload,
+                )
+
+                # raw-bytes variant of _reply: the garbled payload is not
+                # valid text, so it cannot round-trip through str
+                data = corrupt_payload(body.encode())
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
             def _handle(self):
                 route = self.path.rstrip("/")
                 if route == "/healthz":
@@ -838,6 +875,13 @@ class ExplainerServer:
                     array = np.atleast_2d(np.asarray(payload["array"], dtype=np.float32))
                 except (KeyError, ValueError, json.JSONDecodeError) as e:
                     self._reply(400, json.dumps({"error": f"bad request: {e}"}))
+                    return
+                # chaos harness site: body parsed, nothing dispatched yet
+                # (crash/hang/slow before any device work; a drop here is a
+                # pre-dispatch connection loss — safe for the proxy to retry)
+                if server._faults is not None and \
+                        server._faults.fire("server.accept") == "drop":
+                    self.close_connection = True
                     return
                 # SLO headers (scheduling subsystem): priority class,
                 # relative deadline, rate-limit key.  Parsed after the body
@@ -891,7 +935,7 @@ class ExplainerServer:
                     cached = server._cache.get(pending.cache_key)
                     if cached is not None:
                         server._answer_cached(pending, cached)
-                        self._reply(200, cached)
+                        self._reply_explain_ok(cached)
                         return
                 # admission control: shed NOW (429 + Retry-After) rather
                 # than letting an unservable request time out in the queue
@@ -954,7 +998,7 @@ class ExplainerServer:
                     self._reply(pending.status_code or 500,
                                 json.dumps({"error": pending.error}))
                 else:
-                    self._reply(200, pending.response)
+                    self._reply_explain_ok(pending.response)
 
             # the reference clients issue GETs with a JSON body
             # (serve_explanations.py:111); accept both verbs

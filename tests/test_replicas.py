@@ -203,7 +203,7 @@ def test_fanin_all_dead_is_503():
 class _FakeReplica:
     """A minimal /explain + /healthz server with a scripted behaviour."""
 
-    def __init__(self, mode="ok", delay_s=0.0):
+    def __init__(self, mode="ok", delay_s=0.0, port=0):
         import http.server
 
         fake = self
@@ -234,7 +234,7 @@ class _FakeReplica:
 
         self.mode = mode
         self.delay_s = delay_s
-        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port),
                                                      Handler)
         self.port = self.httpd.server_address[1]
         threading.Thread(target=self.httpd.serve_forever,
@@ -243,6 +243,48 @@ class _FakeReplica:
     def stop(self):
         self.httpd.shutdown()
         self.httpd.server_close()
+
+
+def test_probe_loop_returns_recovered_replica_to_rotation():
+    """Down -> up recovery through ``_probe_loop``: the replica dies (its
+    requests mark it out of rotation), comes back on the SAME port, and
+    the prober's next /healthz 200 readmits it — traffic resumes with no
+    manual intervention.  This is the half of the liveness loop the
+    supervisor relies on after every restart; previously untested."""
+
+    fake = _FakeReplica("ok")
+    port = fake.port
+    proxy = FanInProxy([("127.0.0.1", port)], probe_interval_s=0.2).start()
+    revived = None
+    try:
+        status, _ = _request(proxy.host, proxy.port)
+        assert status == 200
+
+        # replica dies: the next request's connect fails, marking it dead
+        fake.stop()
+        status, payload = _request(proxy.host, proxy.port)
+        assert status == 503
+        assert "no live replicas" in json.dumps(payload)
+        assert not proxy.replicas[0].alive
+
+        # while it is down the prober must keep NOT readmitting it
+        time.sleep(0.6)
+        assert not proxy.replicas[0].alive
+
+        # replica returns on the same address; the prober readmits it
+        revived = _FakeReplica("ok", port=port)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not proxy.replicas[0].alive:
+            time.sleep(0.05)
+        assert proxy.replicas[0].alive, "prober never readmitted the replica"
+
+        # and traffic actually flows again
+        status, _ = _request(proxy.host, proxy.port)
+        assert status == 200
+    finally:
+        proxy.stop()
+        if revived is not None:
+            revived.stop()
 
 
 def test_fanin_503_demotes_and_retries_on_healthy_replica():

@@ -1,0 +1,792 @@
+"""Resilience subsystem (``distributedkernelshap_tpu/resilience/``):
+fault injection, shard checkpoint/resume, hedging, replica supervision,
+and the client's bounded-retry behaviour.
+
+Unit tests here are tier-1 (fake replicas / scripted HTTP servers /
+trivial subprocesses — no worker-process spawns, no model fits).  The
+end-to-end fault-injection tests that DO spawn real replica workers are
+marked ``chaos`` + ``slow``; the full scenario lives in
+``benchmarks/chaos_bench.py --check``.
+"""
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu.resilience.faults import (
+    FaultInjector,
+    corrupt_payload,
+    from_env,
+    parse_faults,
+)
+from distributedkernelshap_tpu.resilience.hedging import (
+    HedgePolicy,
+    LatencyQuantiles,
+)
+from distributedkernelshap_tpu.resilience.journal import (
+    ShardJournal,
+    journal_fingerprint,
+)
+from distributedkernelshap_tpu.resilience.supervisor import (
+    ReplicaSupervisor,
+    RestartPolicy,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER_ENV = {"PYTHONPATH": REPO_ROOT, "JAX_PLATFORMS": "cpu"}
+FACTORY = ("distributedkernelshap_tpu.serving."
+           "replica_worker:synthetic_factory")
+
+
+# --------------------------------------------------------------------- #
+# faults: spec grammar + deterministic triggering
+# --------------------------------------------------------------------- #
+
+
+def test_parse_faults_grammar():
+    specs = parse_faults("crash:site=pool.shard,after=3;"
+                         "slow:site=server.explain,delay=0.4,replica=2;"
+                         "drop:site=x,p=0.5,seed=7,times=2")
+    assert [s.kind for s in specs] == ["crash", "slow", "drop"]
+    assert specs[0].site == "pool.shard" and specs[0].after == 3
+    assert specs[1].delay_s == 0.4 and specs[1].replica == 2
+    assert specs[2].p == 0.5 and specs[2].seed == 7 and specs[2].times == 2
+
+
+@pytest.mark.parametrize("bad", [
+    "explode:site=x",          # unknown kind
+    "crash:after=1",           # missing site
+    "crash:site=x,bogus=1",    # unknown field
+    "crash:site=x,p=2.0",      # p out of range
+])
+def test_parse_faults_rejects_bad_specs(bad):
+    with pytest.raises(ValueError):
+        parse_faults(bad)
+
+
+def test_injector_after_and_times_counting():
+    inj = FaultInjector(parse_faults("drop:site=s,after=2,times=2"))
+    # hits 1-2 armed-but-skipped, 3-4 fire, then the times budget is spent
+    assert [inj.fire("s") for _ in range(6)] == [
+        None, None, "drop", "drop", None, None]
+    assert inj.fire("other") is None  # site-scoped
+
+
+def test_injector_probabilistic_fire_is_seeded():
+    spec = "drop:site=s,p=0.5,seed=123"
+    seq1 = [FaultInjector(parse_faults(spec)).fire("s") is not None
+            for _ in range(1)]
+    a = FaultInjector(parse_faults(spec))
+    b = FaultInjector(parse_faults(spec))
+    seq_a = [a.fire("s") for _ in range(32)]
+    seq_b = [b.fire("s") for _ in range(32)]
+    assert seq_a == seq_b                      # replayable
+    assert set(seq_a) == {None, "drop"}        # actually probabilistic
+    del seq1
+
+
+def test_injector_slow_sleeps_and_continues():
+    inj = FaultInjector(parse_faults("slow:site=s,delay=0.05,times=1"))
+    t0 = time.monotonic()
+    assert inj.fire("s") == "slow"
+    assert time.monotonic() - t0 >= 0.05
+    assert inj.fire("s") is None
+
+
+def test_from_env_filters_on_replica_index(monkeypatch):
+    env = {"DKS_FAULTS": "slow:site=s,replica=2;drop:site=s"}
+    inj = from_env({**env, "DKS_REPLICA_INDEX": "0"})
+    assert [s.kind for s in inj.specs] == ["drop"]
+    inj = from_env({**env, "DKS_REPLICA_INDEX": "2"})
+    assert [s.kind for s in inj.specs] == ["slow", "drop"]
+    assert from_env({"DKS_FAULTS": ""}) is None
+    # replica-scoped specs with no index in the env never activate
+    assert from_env({"DKS_FAULTS": "slow:site=s,replica=1"}) is None
+
+
+def test_corrupt_payload_preserves_length_and_breaks_json():
+    payload = json.dumps({"data": list(range(50))}).encode()
+    garbled = corrupt_payload(payload)
+    assert len(garbled) == len(payload)
+    assert garbled != payload
+    with pytest.raises(ValueError):
+        json.loads(garbled)
+
+
+# --------------------------------------------------------------------- #
+# shard journal
+# --------------------------------------------------------------------- #
+
+
+def test_journal_roundtrip_bit_identical(tmp_path):
+    meta = {"fingerprint": "fp", "input": "in", "n_shards": 4}
+    path = str(tmp_path / "run.journal")
+    arrays = (np.arange(12, dtype=np.float32).reshape(3, 4),
+              np.asarray([1.5, -2.5], np.float16))
+    with ShardJournal(path, meta) as j:
+        j.put(0, arrays)
+        j.put(2, (np.zeros((2, 2), np.float64),))
+    j2 = ShardJournal(path, meta)
+    restored = j2.get(0)
+    assert restored[0].dtype == np.float32 and restored[1].dtype == np.float16
+    assert all(np.array_equal(a, b) for a, b in zip(restored, arrays))
+    assert j2.get(1) is None and j2.completed == 2
+    assert j2.stats()["restored"] == 1
+
+
+def test_journal_fingerprint_change_invalidates(tmp_path):
+    path = str(tmp_path / "run.journal")
+    with ShardJournal(path, {"fingerprint": "A"}) as j:
+        j.put(0, (np.ones(3),))
+    j2 = ShardJournal(path, {"fingerprint": "B"})  # refit => new fp
+    assert j2.completed == 0                        # ignored, restarted
+    j2.close()
+    # and the old entries are durably GONE (no partial reuse later)
+    assert ShardJournal(path, {"fingerprint": "A"}).completed == 0
+
+
+def test_journal_torn_tail_record_is_dropped(tmp_path):
+    meta = {"fingerprint": "fp"}
+    path = str(tmp_path / "run.journal")
+    with ShardJournal(path, meta) as j:
+        j.put(0, (np.ones(3),))
+    with open(path, "a") as fh:  # simulate a crash mid-append
+        fh.write('{"index": 1, "digest": "x", "payload": "AAA')
+    j2 = ShardJournal(path, meta)
+    assert j2.completed == 1            # shard 0 intact
+    assert j2.get(1) is None            # shard 1 recomputes
+
+
+def test_journal_fingerprint_is_restart_stable_and_content_sensitive():
+    from distributedkernelshap_tpu.models import LinearPredictor
+
+    rng = np.random.default_rng(0)
+    W = rng.normal(size=(4, 2)).astype(np.float32)
+    b = rng.normal(size=(2,)).astype(np.float32)
+
+    class EngineLike:
+        def __init__(self, W, bg_scale=1.0):
+            self.background = np.ones((5, 4), np.float32) * bg_scale
+            self.bg_weights = np.ones(5, np.float32)
+            self.groups = [[0], [1, 2], [3]]
+            self.predictor = LinearPredictor(W, b)
+
+    # two separate constructions (fresh object ids, fresh device arrays)
+    # hash identically — unlike model_fingerprint's id() fallback
+    assert (journal_fingerprint(EngineLike(W))
+            == journal_fingerprint(EngineLike(W.copy())))
+    assert (journal_fingerprint(EngineLike(W))
+            != journal_fingerprint(EngineLike(W + 1.0)))
+    assert (journal_fingerprint(EngineLike(W))
+            != journal_fingerprint(EngineLike(W, bg_scale=2.0)))
+    # a pinned fingerprint wins outright
+    e = EngineLike(W)
+    e.fingerprint = "pinned"
+    assert journal_fingerprint(e) == "pinned"
+
+
+# --------------------------------------------------------------------- #
+# run_pipeline + journal integration
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("threaded", [False, True])
+def test_run_pipeline_restores_journaled_items(tmp_path, threaded):
+    from distributedkernelshap_tpu.parallel.pipeline import run_pipeline
+
+    meta = {"fingerprint": "fp", "n_shards": 5}
+    path = str(tmp_path / "p.journal")
+    with ShardJournal(path, meta) as seed:
+        seed.put(1, (np.asarray([10.0]),))
+        seed.put(3, (np.asarray([30.0]),))
+
+    dispatched = []
+
+    def dispatch(i):
+        dispatched.append(i)
+        return i
+
+    def fetch(i):
+        return (np.asarray([float(i)]),)
+
+    journal = ShardJournal(path, meta)
+    results = run_pipeline(list(range(5)), dispatch, fetch, window=2,
+                           threaded=threaded, journal=journal)
+    journal.close()
+    assert dispatched == [0, 2, 4]  # journaled shards never dispatch
+    got = [float(r[0][0]) for r in results]
+    assert got == [0.0, 10.0, 2.0, 30.0, 4.0]  # order preserved
+    # the fresh fetches were recorded: a rerun restores everything
+    j2 = ShardJournal(path, meta)
+    assert j2.completed == 5
+
+
+def test_distributed_explainer_checkpoint_resume(tmp_path, adult_like_data):
+    """A journaled sharded run resumed from disk recomputes nothing and
+    returns bit-identical phi — the resume contract end to end."""
+
+    from distributedkernelshap_tpu import DenseData
+    from distributedkernelshap_tpu.kernel_shap import KernelExplainerEngine
+    from distributedkernelshap_tpu.models import LinearPredictor
+    from distributedkernelshap_tpu.parallel.distributed import (
+        DistributedExplainer,
+    )
+
+    d = adult_like_data
+    pred = LinearPredictor(d["W"], d["b"], activation="softmax")
+    data = DenseData(d["background"], [f"g{i}" for i in range(len(d["groups"]))],
+                     d["groups"])
+    X = np.tile(d["X"], (3, 1))  # 24 rows -> 3 slabs at batch_size=1 x 8
+    opts = {"n_devices": 8, "batch_size": 1,
+            "checkpoint_dir": str(tmp_path)}
+    d1 = DistributedExplainer(opts, KernelExplainerEngine, (pred, data),
+                              {"link": "logit", "seed": 0})
+    sv1 = d1.get_explanation(X, nsamples=32, l1_reg=False)
+    stats1 = d1.last_journal_stats
+    assert stats1["computed"] == 3 and stats1["restored"] == 0
+
+    d2 = DistributedExplainer(opts, KernelExplainerEngine, (pred, data),
+                              {"link": "logit", "seed": 0})
+    sv2 = d2.get_explanation(X, nsamples=32, l1_reg=False)
+    stats2 = d2.last_journal_stats
+    assert stats2["computed"] == 0 and stats2["restored"] == 3
+    assert all(np.array_equal(a, b) for a, b in zip(sv1, sv2))
+
+    # different nsamples => different run key => nothing reused
+    d3 = DistributedExplainer(opts, KernelExplainerEngine, (pred, data),
+                              {"link": "logit", "seed": 0})
+    d3.get_explanation(X, nsamples=64, l1_reg=False)
+    assert d3.last_journal_stats["restored"] == 0
+
+
+# --------------------------------------------------------------------- #
+# hedging: tracker, policy, proxy integration (fake replicas)
+# --------------------------------------------------------------------- #
+
+
+def test_latency_quantiles_windowed():
+    t = LatencyQuantiles(window=8)
+    assert t.quantile("interactive", 0.95) is None
+    for v in [1.0] * 8:
+        t.observe("interactive", v)
+    for v in [0.1] * 8:  # window slides: old 1.0s samples age out
+        t.observe("interactive", v)
+    assert t.quantile("interactive", 0.95) == pytest.approx(0.1)
+    assert t.count("batch") == 0  # per-class isolation
+
+
+def test_hedge_policy_delay_resolution():
+    policy = HedgePolicy(quantile=0.9, min_delay_s=0.05, max_delay_s=1.0,
+                         initial_delay_s=0.7, min_samples=4)
+    t = LatencyQuantiles()
+    assert policy.delay_for(t, "interactive") == 0.7  # cold: initial
+    for v in [0.2, 0.2, 0.2, 5.0]:
+        t.observe("interactive", v)
+    assert policy.delay_for(t, "interactive") == 1.0  # q90=5.0 clamped
+    for _ in range(40):
+        t.observe("interactive", 0.01)
+    assert policy.delay_for(t, "interactive") == 0.05  # floor
+
+
+def _proxy_request(proxy, timeout=30):
+    conn = http.client.HTTPConnection(proxy.host, proxy.port,
+                                      timeout=timeout)
+    try:
+        conn.request("POST", "/explain", body=b'{"array": [[0.0]]}',
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def test_fanin_hedges_around_slow_replica():
+    """A straggler past the hedge delay gets raced by a second dispatch;
+    the fast replica's answer is returned well before the straggler's,
+    and exactly one answer reaches the client."""
+
+    from tests.test_replicas import _FakeReplica
+    from distributedkernelshap_tpu.serving.replicas import FanInProxy
+
+    slow = _FakeReplica("hang", delay_s=1.5)
+    fast = _FakeReplica("ok")
+    proxy = FanInProxy(
+        [("127.0.0.1", slow.port), ("127.0.0.1", fast.port)],
+        probe_interval_s=3600,
+        hedge_policy=HedgePolicy(initial_delay_s=0.2, min_delay_s=0.05,
+                                 min_samples=100)).start()
+    try:
+        t0 = time.monotonic()
+        status, payload = _proxy_request(proxy)
+        elapsed = time.monotonic() - t0
+        assert status == 200, payload
+        assert elapsed < 1.2  # did not wait out the straggler
+        m = proxy._render_metrics()
+        assert "dks_fanin_hedges_total 1" in m
+        assert "dks_fanin_hedge_wins_total 1" in m
+        # once the LOSER's in-flight copy completes too, the client
+        # request must still have been counted exactly once
+        time.sleep(1.5 - elapsed + 0.5)
+        assert "dks_fanin_forwarded_total 1" in proxy._render_metrics()
+    finally:
+        proxy.stop()
+        slow.stop()
+        fast.stop()
+
+
+def test_fanin_no_hedge_when_primary_is_fast():
+    from tests.test_replicas import _FakeReplica
+    from distributedkernelshap_tpu.serving.replicas import FanInProxy
+
+    fast = _FakeReplica("ok")
+    proxy = FanInProxy(
+        [("127.0.0.1", fast.port)], probe_interval_s=3600,
+        hedge_policy=HedgePolicy(initial_delay_s=2.0)).start()
+    try:
+        for _ in range(3):
+            status, _ = _proxy_request(proxy)
+            assert status == 200
+        assert "dks_fanin_hedges_total 0" in proxy._render_metrics()
+    finally:
+        proxy.stop()
+        fast.stop()
+
+
+class _DyingReplica:
+    """Accepts /explain, waits ``delay_s``, then severs the connection
+    without replying — a replica killed mid-request, as the proxy sees
+    it (502)."""
+
+    def __init__(self, delay_s=0.5):
+        import http.server
+
+        fake = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                if length:
+                    self.rfile.read(length)
+                time.sleep(fake.delay_s)
+                self.close_connection = True
+
+            do_GET = do_POST
+
+            def log_message(self, fmt, *args):
+                pass
+
+        self.delay_s = delay_s
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                     Handler)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_fanin_hedge_prefers_success_over_first_error():
+    """The primary dies mid-request (502) AFTER the hedge was dispatched
+    but BEFORE the hedge answers: the proxy must wait for the hedge's
+    200 instead of surfacing the error that merely arrived first."""
+
+    from tests.test_replicas import _FakeReplica
+    from distributedkernelshap_tpu.serving.replicas import FanInProxy
+
+    dying = _DyingReplica(delay_s=0.4)        # 502 at ~0.4s
+    slowish = _FakeReplica("hang", delay_s=1.0)  # 200 at ~1.0s
+    proxy = FanInProxy(
+        [("127.0.0.1", dying.port), ("127.0.0.1", slowish.port)],
+        probe_interval_s=3600, request_timeout_s=10.0,
+        hedge_policy=HedgePolicy(initial_delay_s=0.1, min_delay_s=0.05,
+                                 min_samples=100)).start()
+    try:
+        status, payload = _proxy_request(proxy, timeout=30)
+        assert status == 200, payload
+        m = proxy._render_metrics()
+        assert "dks_fanin_hedges_total 1" in m
+    finally:
+        proxy.stop()
+        dying.stop()
+        slowish.stop()
+
+
+# --------------------------------------------------------------------- #
+# supervisor: restart policy + process restarts
+# --------------------------------------------------------------------- #
+
+
+def test_restart_policy_backoff_grows_and_caps():
+    p = RestartPolicy(base_backoff_s=0.5, max_backoff_s=4.0,
+                      jitter_frac=0.0, seed=0)
+    assert [p.delay(n) for n in (1, 2, 3, 4, 5)] == [0.5, 1.0, 2.0, 4.0, 4.0]
+    jittered = RestartPolicy(base_backoff_s=1.0, max_backoff_s=8.0,
+                             jitter_frac=0.5, seed=0)
+    d = jittered.delay(1)
+    assert 1.0 <= d <= 1.5
+    # seeded: two policies with the same seed produce the same jitter
+    assert d == RestartPolicy(base_backoff_s=1.0, max_backoff_s=8.0,
+                              jitter_frac=0.5, seed=0).delay(1)
+
+
+def _sleeper():
+    return subprocess.Popen([sys.executable, "-c",
+                             "import time; time.sleep(600)"])
+
+
+def test_supervisor_restarts_killed_process_and_marks_proxy():
+    from distributedkernelshap_tpu.serving.replicas import FanInProxy
+
+    procs = [_sleeper()]
+    proxy = FanInProxy([("127.0.0.1", 1)])  # never started: just state
+    sup = ReplicaSupervisor(
+        procs, lambda i: _sleeper(), proxy=proxy,
+        policy=RestartPolicy(base_backoff_s=0.1, max_backoff_s=0.5,
+                             jitter_frac=0.0, seed=0),
+        poll_interval_s=0.05).start()
+    try:
+        first = procs[0]
+        os.kill(first.pid, signal.SIGKILL)
+        first.wait(timeout=10)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if sup.restarts_total >= 1 and procs[0] is not first \
+                    and procs[0].poll() is None:
+                break
+            time.sleep(0.05)
+        assert sup.restarts_total >= 1
+        assert procs[0] is not first and procs[0].poll() is None
+        # liveness fed into the proxy the moment the corpse was seen
+        assert proxy.replicas[0].alive is False
+    finally:
+        sup.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+
+
+def test_supervisor_crash_loop_backs_off():
+    """A worker that dies instantly every time is restarted with growing
+    delays, not hot-looped: within a short window the restart count stays
+    far below what a fixed tiny backoff would produce."""
+
+    def crasher(_i=None):
+        return subprocess.Popen([sys.executable, "-c", "raise SystemExit(1)"])
+
+    procs = [crasher()]
+    sup = ReplicaSupervisor(
+        procs, crasher,
+        policy=RestartPolicy(base_backoff_s=0.2, max_backoff_s=5.0,
+                             jitter_frac=0.0, healthy_reset_s=60.0, seed=0),
+        poll_interval_s=0.02).start()
+    try:
+        time.sleep(1.5)
+        # fixed 0.02s polling would allow ~75 restarts; exponential
+        # backoff (0.2 + 0.4 + 0.8 + ...) admits at most a handful
+        assert 1 <= sup.restarts_total <= 4
+        assert sup.stats()["crash_loops_backing_off"] >= 1
+    finally:
+        sup.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+
+
+# --------------------------------------------------------------------- #
+# server-side fault sites (in-process ExplainerServer, no workers)
+# --------------------------------------------------------------------- #
+
+
+class _TrivialModel:
+    max_rows = None
+
+    def explain_batch(self, instances, split_sizes=None):
+        sizes = split_sizes or [1] * instances.shape[0]
+        return [json.dumps({"data": {"ok": True, "rows": s}})
+                for s in sizes]
+
+
+def _server_request(server, timeout=30):
+    conn = http.client.HTTPConnection(server.host, server.port,
+                                      timeout=timeout)
+    try:
+        conn.request("POST", "/explain", body=b'{"array": [[1.0, 2.0]]}',
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def test_server_corrupt_fault_garbles_one_response():
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    inj = FaultInjector(parse_faults(
+        "corrupt:site=server.explain,after=1,times=1"))
+    srv = ExplainerServer(_TrivialModel(), host="127.0.0.1", port=0,
+                          max_batch_size=1, pipeline_depth=1,
+                          fault_injector=inj).start()
+    try:
+        status, payload = _server_request(srv)
+        assert status == 200 and json.loads(payload)["data"]["ok"]
+        status, payload = _server_request(srv)   # fault fires here
+        assert status == 200
+        with pytest.raises(ValueError):
+            json.loads(payload)
+        status, payload = _server_request(srv)   # budget spent: clean again
+        assert json.loads(payload)["data"]["ok"]
+    finally:
+        srv.stop()
+
+
+def test_server_drop_fault_severs_connection():
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    inj = FaultInjector(parse_faults("drop:site=server.explain,times=1"))
+    srv = ExplainerServer(_TrivialModel(), host="127.0.0.1", port=0,
+                          max_batch_size=1, pipeline_depth=1,
+                          fault_injector=inj).start()
+    try:
+        with pytest.raises((http.client.HTTPException, ConnectionError,
+                            OSError)):
+            _server_request(srv)
+        status, _ = _server_request(srv)  # server itself is healthy
+        assert status == 200
+    finally:
+        srv.stop()
+
+
+# --------------------------------------------------------------------- #
+# client retry budget + Retry-After honouring
+# --------------------------------------------------------------------- #
+
+
+class _ScriptedServer:
+    """Answers /explain from a scripted list of (status, body, headers);
+    repeats the last entry once the script is exhausted."""
+
+    def __init__(self, script):
+        import http.server
+
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                if length:
+                    self.rfile.read(length)
+                i = min(outer.calls, len(outer.script) - 1)
+                outer.calls += 1
+                status, body, headers = outer.script[i]
+                if status is None:  # sever the connection instead
+                    self.close_connection = True
+                    return
+                data = body if isinstance(body, bytes) else body.encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                for k, v in headers.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, fmt, *args):
+                pass
+
+        self.script = script
+        self.calls = 0
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                     Handler)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_client_honors_retry_after_with_cap_and_jitter():
+    from distributedkernelshap_tpu.serving import client
+    from distributedkernelshap_tpu.serving.client import explain_request
+
+    srv = _ScriptedServer([
+        (429, json.dumps({"reason": "queue_full", "retry_after_s": 2.0}),
+         {"Retry-After": "2"}),
+        (429, json.dumps({"reason": "queue_full"}),
+         {"Retry-After": "9999"}),   # hostile hint: must be capped
+        (200, json.dumps({"data": "fine"}), {}),
+    ])
+    sleeps = []
+    try:
+        payload = explain_request(
+            f"http://127.0.0.1:{srv.port}/explain", np.zeros((1, 2)),
+            timeout=10, _sleep=sleeps.append)
+        assert json.loads(payload)["data"] == "fine"
+    finally:
+        srv.stop()
+    assert len(sleeps) == 2
+    assert 2.0 <= sleeps[0] <= 2.0 * 1.25     # hint + jitter
+    assert sleeps[1] <= client.MAX_BACKOFF_S  # hard ceiling, jitter inside
+
+
+def test_client_retries_retriable_statuses_within_budget():
+    from distributedkernelshap_tpu.serving.client import explain_request
+
+    srv = _ScriptedServer([
+        (503, json.dumps({"error": "wedged"}), {}),
+        (502, json.dumps({"error": "replica died mid-request"}), {}),
+        (200, json.dumps({"data": "ok"}), {}),
+    ])
+    sleeps = []
+    try:
+        payload = explain_request(
+            f"http://127.0.0.1:{srv.port}/explain", np.zeros((1, 2)),
+            timeout=10, _sleep=sleeps.append)
+        assert json.loads(payload)["data"] == "ok"
+        assert srv.calls == 3 and len(sleeps) == 2
+        assert sleeps[1] > sleeps[0]  # exponential between hintless retries
+    finally:
+        srv.stop()
+
+
+def test_client_retry_budget_is_bounded():
+    from distributedkernelshap_tpu.serving.client import explain_request
+
+    srv = _ScriptedServer([(503, json.dumps({"error": "down"}), {})])
+    try:
+        with pytest.raises(RuntimeError, match="HTTP 503"):
+            explain_request(f"http://127.0.0.1:{srv.port}/explain",
+                            np.zeros((1, 2)), timeout=10, max_retries=2,
+                            _sleep=lambda s: None)
+        assert srv.calls == 3  # initial + 2 retries, then gave up
+    finally:
+        srv.stop()
+
+
+def test_client_does_not_retry_client_errors():
+    from distributedkernelshap_tpu.serving.client import explain_request
+
+    srv = _ScriptedServer([(400, json.dumps({"error": "bad"}), {})])
+    try:
+        with pytest.raises(RuntimeError, match="HTTP 400"):
+            explain_request(f"http://127.0.0.1:{srv.port}/explain",
+                            np.zeros((1, 2)), timeout=10,
+                            _sleep=lambda s: None)
+        assert srv.calls == 1
+    finally:
+        srv.stop()
+
+
+def test_client_refetches_corrupted_payload():
+    """A 200 whose body was garbled on the wire (invalid UTF-8) is
+    re-fetched — idempotency makes the retry safe — instead of surfacing
+    garbage or crashing on the decode."""
+
+    from distributedkernelshap_tpu.serving.client import explain_request
+
+    clean = json.dumps({"data": "ok"})
+    srv = _ScriptedServer([
+        (200, corrupt_payload(clean.encode()), {}),
+        (200, clean, {}),
+    ])
+    try:
+        payload = explain_request(
+            f"http://127.0.0.1:{srv.port}/explain", np.zeros((1, 2)),
+            timeout=10, _sleep=lambda s: None)
+        assert json.loads(payload)["data"] == "ok"
+        assert srv.calls == 2
+    finally:
+        srv.stop()
+
+
+def test_client_corrupted_payload_exhausts_budget():
+    from distributedkernelshap_tpu.serving.client import explain_request
+
+    srv = _ScriptedServer([(200, b"\xff\xfe garbage \xff", {})])
+    try:
+        with pytest.raises(RuntimeError, match="undecodable"):
+            explain_request(f"http://127.0.0.1:{srv.port}/explain",
+                            np.zeros((1, 2)), timeout=10, max_retries=1,
+                            _sleep=lambda s: None)
+        assert srv.calls == 2
+    finally:
+        srv.stop()
+
+
+def test_client_retries_severed_connection():
+    from distributedkernelshap_tpu.serving.client import explain_request
+
+    srv = _ScriptedServer([
+        (None, "", {}),  # connection dropped mid-request
+        (200, json.dumps({"data": "ok"}), {}),
+    ])
+    try:
+        payload = explain_request(
+            f"http://127.0.0.1:{srv.port}/explain", np.zeros((1, 2)),
+            timeout=10, _sleep=lambda s: None)
+        assert json.loads(payload)["data"] == "ok"
+    finally:
+        srv.stop()
+
+
+# --------------------------------------------------------------------- #
+# end-to-end fault injection through REAL replica workers (chaos tier)
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_injected_crash_is_survived_by_supervised_fleet():
+    """DKS_FAULTS crashes a real worker mid-reply; the supervisor
+    respawns it and the fleet keeps answering — the full loop the chaos
+    bench measures, minimally."""
+
+    from distributedkernelshap_tpu.resilience.supervisor import RestartPolicy
+    from distributedkernelshap_tpu.serving.client import explain_request
+    from distributedkernelshap_tpu.serving.replicas import ReplicaManager
+
+    m = ReplicaManager(
+        1, factory=FACTORY, pin_devices=False, restart=True,
+        env_extra={**WORKER_ENV,
+                   "DKS_FAULTS": "crash:site=server.explain,after=2"},
+        max_batch_size=4, pipeline_depth=2, startup_timeout_s=240,
+        restart_policy=RestartPolicy(base_backoff_s=0.25, max_backoff_s=1.0,
+                                     jitter_frac=0.0, seed=0))
+    rng = np.random.default_rng(0)
+    with m:
+        url = f"http://{m.proxy.host}:{m.proxy.port}/explain"
+        for _ in range(2):  # hits 1-2: armed, not fired
+            payload = explain_request(url, rng.normal(size=(1, 8)),
+                                      timeout=120)
+            assert json.loads(payload)["meta"]["name"] == "KernelShap"
+        # hit 3 crashes the worker mid-reply; the bounded retry budget
+        # rides through the 502 + respawn window
+        deadline = time.monotonic() + 240
+        ok = False
+        while time.monotonic() < deadline:
+            try:
+                payload = explain_request(url, rng.normal(size=(1, 8)),
+                                          timeout=120, max_retries=8)
+                ok = True
+                break
+            except RuntimeError:
+                time.sleep(1.0)
+        assert ok, "fleet never recovered from the injected crash"
+        assert m.supervisor.restarts_total >= 1
