@@ -83,14 +83,22 @@ def _scrape(host, port, path="/metrics"):
 
 
 def _metric(text, name):
-    for line in text.splitlines():
-        if line.startswith(name + " "):
-            return float(line.rsplit(" ", 1)[1])
-    return 0.0
+    """Sum of one family's samples, via the registry's own exposition
+    parser (one parser for the whole tree; handles labeled series too)."""
+
+    from distributedkernelshap_tpu.observability.metrics import (
+        parse_exposition,
+    )
+
+    family = parse_exposition(text).get(name)
+    if not family:
+        return 0.0
+    return sum(v for sample_name, _, v in family["samples"]
+               if sample_name == name)
 
 
 def run_serve_chaos(n_requests=48, n_replicas=3, slow_delay_s=0.5,
-                    kill_after_s=1.5, client_threads=6):
+                    kill_after_s=1.5, client_threads=6, trace_dir=None):
     from distributedkernelshap_tpu.resilience.hedging import HedgePolicy
     from distributedkernelshap_tpu.resilience.supervisor import RestartPolicy
     from distributedkernelshap_tpu.serving.client import explain_request
@@ -100,9 +108,15 @@ def run_serve_chaos(n_requests=48, n_replicas=3, slow_delay_s=0.5,
     # not a corpse: only hedging can cut the tail it creates
     faults = (f"slow:site=server.explain,delay={slow_delay_s},"
               f"replica={n_replicas - 1}")
+    env_extra = {**BASE_ENV, "DKS_FAULTS": faults}
+    if trace_dir:
+        # workers sink every finished span to <trace_dir>/spans-<pid>.jsonl
+        # (flushed per span, so the SIGKILLed replica's spans survive);
+        # the parent merges them with its own client+proxy spans
+        env_extra.update({"DKS_TRACE": "1", "DKS_TRACE_DIR": trace_dir})
     manager = ReplicaManager(
         n_replicas, factory=FACTORY, pin_devices=False, restart=True,
-        env_extra={**BASE_ENV, "DKS_FAULTS": faults},
+        env_extra=env_extra,
         max_batch_size=4, pipeline_depth=2, startup_timeout_s=300,
         restart_policy=RestartPolicy(base_backoff_s=0.25, max_backoff_s=2.0,
                                      jitter_frac=0.25, seed=0),
@@ -251,11 +265,17 @@ def pool_run(checkpoint_dir: str, out_path: str) -> dict:
     return dist.last_journal_stats
 
 
-def _spawn_pool_run(checkpoint_dir: str, out_path: str, faults: str = ""):
+def _spawn_pool_run(checkpoint_dir: str, out_path: str, faults: str = "",
+                    flightrec_dir: str = ""):
     env = {**os.environ, **BASE_ENV, "DKS_DISPATCH_WINDOW": "1"}
     env.pop("DKS_FAULTS", None)
+    env.pop("DKS_FLIGHTREC_DIR", None)
     if faults:
         env["DKS_FAULTS"] = faults
+    if flightrec_dir:
+        # the injected crash dumps the flight recorder here before
+        # os._exit — the black box the --check assertions read back
+        env["DKS_FLIGHTREC_DIR"] = flightrec_dir
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--pool-run",
          "--checkpoint-dir", checkpoint_dir, "--out", out_path],
@@ -291,10 +311,21 @@ def run_pool_resume():
         if rc_ref != 0:
             return {"error": f"reference run failed rc={rc_ref}: {err}"}
 
+        flightrec_dir = os.path.join(tmp, "flightrec")
         rc_kill, _, _ = _spawn_pool_run(
             res_dir, res_phi,
-            faults=f"crash:site=pool.shard,after={CRASH_AFTER}")
+            faults=f"crash:site=pool.shard,after={CRASH_AFTER}",
+            flightrec_dir=flightrec_dir)
         survived = _journal_records(res_dir)
+        # the injected crash must leave its black box: a flight-recorder
+        # dump whose timeline includes the fired fault
+        dump_events = None
+        dumps = (sorted(os.listdir(flightrec_dir))
+                 if os.path.isdir(flightrec_dir) else [])
+        if dumps:
+            with open(os.path.join(flightrec_dir, dumps[0])) as fh:
+                dump = json.load(fh)
+            dump_events = [e["kind"] for e in dump.get("events", [])]
 
         rc_res, res_stats, err = _spawn_pool_run(res_dir, res_phi)
         if rc_res != 0:
@@ -311,6 +342,8 @@ def run_pool_resume():
             "n_shards": n_shards,
             "crash_rc": rc_kill,
             "crash_exit_code_expected": CRASH_EXIT_CODE,
+            "flightrec_dumps": len(dumps),
+            "flightrec_dump_kinds": dump_events,
             "journal_shards_after_kill": survived,
             "resume_restored": res_stats["restored"],
             "resume_computed": res_stats["computed"],
@@ -318,6 +351,63 @@ def run_pool_resume():
             "bit_identical_phi": bool(np.array_equal(phi_ref, phi_res)),
             "reference_computed": ref_stats["computed"],
         }
+
+
+# --------------------------------------------------------------------- #
+# trace merging (--trace-out)
+# --------------------------------------------------------------------- #
+
+
+def merge_trace(trace_dir: str, trace_out: str) -> dict:
+    """Merge the parent's client+proxy spans with every worker's sink file
+    into one JSONL + a Perfetto conversion, and check the end-to-end
+    criterion: at least one trace id must carry the full client → proxy
+    (incl. a pass span) → replica admission/queue/schedule/device/finalize
+    chain, with the Perfetto conversion round-tripping losslessly."""
+
+    from distributedkernelshap_tpu.observability import tracing
+
+    spans = tracing.tracer().spans()
+    for name in sorted(os.listdir(trace_dir)) if os.path.isdir(trace_dir) \
+            else []:
+        if name.startswith("spans-") and name.endswith(".jsonl"):
+            spans.extend(tracing.read_jsonl(os.path.join(trace_dir, name)))
+    os.makedirs(os.path.dirname(os.path.abspath(trace_out)), exist_ok=True)
+    with open(trace_out, "w", encoding="utf-8") as fh:
+        for s in spans:
+            fh.write(json.dumps(s.to_dict()) + "\n")
+    perfetto = trace_out + ".perfetto.json"
+    tracing.write_chrome_trace(spans, perfetto)
+    back = tracing.read_chrome_trace(perfetto)
+    round_trips = (
+        len(back) == len(spans)
+        and {(s.name, s.trace_id, s.span_id, s.parent_id) for s in back}
+        == {(s.name, s.trace_id, s.span_id, s.parent_id) for s in spans})
+
+    # end-to-end followability: group span names by trace id
+    by_trace = {}
+    for s in spans:
+        by_trace.setdefault(s.trace_id, set()).add(s.name)
+    required = {"client.request", "proxy.request", "proxy.pass",
+                "server.request", "server.admission", "server.queue_wait",
+                "server.schedule", "server.device_explain",
+                "server.finalize"}
+    complete = [t for t, names in by_trace.items() if required <= names]
+    hedged_traces = [t for t, names in by_trace.items()
+                     if "proxy.pass" in names
+                     and any(s.trace_id == t and s.name == "proxy.pass"
+                             and s.attrs.get("slot") == "hedge"
+                             for s in spans)]
+    return {
+        "spans": len(spans),
+        "jsonl": trace_out,
+        "perfetto": perfetto,
+        "perfetto_round_trips": bool(round_trips),
+        "traces": len(by_trace),
+        "end_to_end_traces": len(complete),
+        "hedged_pass_traces": len(hedged_traces),
+        "phases": tracing.phase_breakdown(spans),
+    }
 
 
 # --------------------------------------------------------------------- #
@@ -330,6 +420,12 @@ def main():
     parser.add_argument("--serve-only", action="store_true")
     parser.add_argument("--pool-only", action="store_true")
     parser.add_argument("--requests", type=int, default=48)
+    parser.add_argument(
+        "--trace-out", default="",
+        help="enable end-to-end tracing for the serve scenario and write "
+             "the merged client+proxy+replica span trace here as JSONL "
+             "(plus <path>.perfetto.json); with --check, also asserts one "
+             "request is followable end to end by shared trace id")
     # subprocess mode (internal): one journaled pool run
     parser.add_argument("--pool-run", action="store_true",
                         help=argparse.SUPPRESS)
@@ -344,8 +440,15 @@ def main():
 
     report = {"bench": "chaos"}
     checks = {}
+    trace_dir = None
+    if args.trace_out:
+        from distributedkernelshap_tpu.observability import tracing
+
+        tracing.tracer().enable()
+        trace_dir = tempfile.mkdtemp(prefix="dks-trace-")
     if not args.pool_only:
-        serve = run_serve_chaos(n_requests=args.requests)
+        serve = run_serve_chaos(n_requests=args.requests,
+                                trace_dir=trace_dir)
         report["serve"] = serve
         checks.update({
             "zero_lost": serve["lost"] == 0,
@@ -355,12 +458,28 @@ def main():
             "all_replicas_recovered": serve["all_replicas_recovered"],
             "hedge_beat_slow_replica": serve["hedge_wins"] >= 1,
         })
+        if args.trace_out:
+            trace = merge_trace(trace_dir, args.trace_out)
+            report["trace"] = trace
+            checks.update({
+                # one client request followable end to end by trace id,
+                # hedged passes visible as distinct pass spans, and the
+                # Perfetto conversion round-tripping the JSONL
+                "trace_end_to_end": trace["end_to_end_traces"] >= 1,
+                "trace_hedged_pass_tagged":
+                    trace["hedged_pass_traces"] >= 1,
+                "perfetto_round_trips": trace["perfetto_round_trips"],
+            })
     if not args.serve_only:
         pool = run_pool_resume()
         report["pool"] = pool
         checks.update({
             "crash_was_injected": pool.get("crash_rc")
             == pool.get("crash_exit_code_expected"),
+            # the injected crash left its flight-recorder black box, with
+            # the fired fault on the timeline
+            "flightrec_dump_on_crash": pool.get("flightrec_dumps", 0) >= 1
+            and "fault_injected" in (pool.get("flightrec_dump_kinds") or []),
             "journal_survived_kill": pool.get("journal_shards_after_kill")
             == CRASH_AFTER,
             "resume_recomputes_le_1_shard":

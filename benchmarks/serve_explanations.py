@@ -132,6 +132,29 @@ def run_config(predictor, data, X_explain, replicas: int, max_batch_size: int,
         server.stop()
 
 
+def emit_trace(trace_out: str) -> None:
+    """Export the tracer ring: JSONL at ``trace_out``, a Perfetto
+    ``trace_event`` conversion next to it, and a per-phase breakdown on
+    stdout (one JSON line) — the "where did the time go" artifact the
+    sweep produces when tracing is on."""
+
+    import json
+
+    from distributedkernelshap_tpu.observability import tracing
+
+    spans = tracing.tracer().spans()
+    tracing.tracer().export_jsonl(trace_out)
+    perfetto = trace_out + ".perfetto.json"
+    tracing.write_chrome_trace(spans, perfetto)
+    print(json.dumps({"trace": {
+        "spans": len(spans),
+        "dropped": tracing.tracer().dropped_total,
+        "jsonl": trace_out,
+        "perfetto": perfetto,
+        "phases": tracing.phase_breakdown(spans),
+    }}))
+
+
 def main():
     nruns = args.nruns if args.benchmark else 1
     batch_sizes = [int(elem) for elem in args.batch]
@@ -153,6 +176,8 @@ def main():
             run_config(predictor, data, X_explain, replicas, max_batch_size,
                        args.host, args.port, nruns, batch_mode=args.batch_mode,
                        model=model)
+    if args.trace_out:
+        emit_trace(args.trace_out)
 
 
 if __name__ == '__main__':
@@ -176,7 +201,18 @@ if __name__ == '__main__':
              "k8s driver's modes, k8s_serve_explanations.py:181-184).")
     parser.add_argument("--host", default="0.0.0.0", type=str)
     parser.add_argument("--port", default=8000, type=int)
+    parser.add_argument(
+        "--trace-out", default="", type=str,
+        help="Enable end-to-end tracing and write the span ring here as "
+             "JSONL (plus <path>.perfetto.json for chrome://tracing / "
+             "Perfetto) with a per-phase breakdown on stdout.  Client, "
+             "server and engine-phase spans share trace ids, so one "
+             "request is followable end to end.")
     add_platform_flag(parser)
     args = parser.parse_args()
     apply_platform(args)
+    if args.trace_out:
+        from distributedkernelshap_tpu.observability import tracing
+
+        tracing.tracer().enable()
     main()

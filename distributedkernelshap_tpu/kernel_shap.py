@@ -951,11 +951,17 @@ class KernelExplainerEngine:
             return _async_sync_fallback(self, X, nsamples, l1_reg,
                                         interactions)
 
-        plan = self._plan(nsamples)
+        with profiler().phase('coalition_plan'):
+            plan = self._plan(nsamples)
         fin = self._dispatch_array(X, plan)
 
         def finalize():
-            r = fin()
+            # in the pipelined path the device time materialises here, at
+            # the blocking fetch — the phase timer (and, under tracing,
+            # its phase.device_explain child span on the adopted request
+            # context) lands on the finalizer thread that pays it
+            with profiler().phase('device_explain'):
+                r = fin()
             # l1 is inactive here (checked above), so this is pure numpy
             phi = r['shap_values']
             return split_shap_values(phi, self.vector_out), r

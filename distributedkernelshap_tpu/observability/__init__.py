@@ -1,0 +1,50 @@
+"""End-to-end observability for the serving + pool stack.
+
+Three pieces, all stdlib-only (nothing here may import jax/numpy — the
+fan-in proxy and the replica workers import this before the heavyweight
+stack comes up):
+
+* :mod:`~distributedkernelshap_tpu.observability.metrics` — the central
+  thread-safe metrics registry (Counter/Gauge/Histogram with labels) and
+  the ONE Prometheus text renderer every ``/metrics`` endpoint uses,
+  plus the exposition-format parser/validator behind the compliance test
+  and ``make obs-check``;
+* :mod:`~distributedkernelshap_tpu.observability.tracing` — spans with
+  W3C-style context propagation over ``X-DKS-Trace``, a bounded ring
+  buffer, JSONL export and a Chrome/Perfetto ``trace_event`` converter;
+* :mod:`~distributedkernelshap_tpu.observability.flightrec` — a flight
+  recorder: the last N structured events (sheds, hedges, restarts,
+  journal invalidations, wedges, fault injections), queryable at
+  ``/debugz`` and dumped to disk on an injected crash.
+
+See ``docs/OBSERVABILITY.md`` for the metric catalog, trace header
+format, ``/debugz`` schema and Perfetto how-to.
+"""
+
+# NOTE: the ``flightrec()`` accessor function is deliberately NOT
+# re-exported here — it shares its name with its submodule, and binding it
+# on the package would shadow ``observability.flightrec`` for module-path
+# imports.  Import it from the submodule:
+# ``from distributedkernelshap_tpu.observability.flightrec import flightrec``.
+from distributedkernelshap_tpu.observability.flightrec import (  # noqa: F401
+    FlightRecorder,
+)
+from distributedkernelshap_tpu.observability.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    parse_exposition,
+    validate_exposition,
+)
+from distributedkernelshap_tpu.observability.tracing import (  # noqa: F401
+    TRACE_HEADER,
+    Span,
+    SpanContext,
+    Tracer,
+    current_context,
+    format_trace_header,
+    parse_trace_header,
+    tracer,
+    use_context,
+)

@@ -40,6 +40,8 @@ from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Iterable, List, Optional
 
+import distributedkernelshap_tpu.observability.tracing as _tracing
+
 logger = logging.getLogger(__name__)
 
 #: fixed window used whenever a measured one would be unsafe or unavailable
@@ -230,8 +232,19 @@ def run_pipeline(items: Iterable[Any],
 
         injector = env_injector()
 
-    def finish(index, handle):
+    # per-shard spans: journaled loops are the batch runs the trace
+    # criterion names (each shard's dispatch→fetch interval, restored
+    # shards tagged as such); a loop running under a request's adopted
+    # context (the server's device call) parents its shards to it instead
+    tr = _tracing.tracer()
+    trace_parent = _tracing.current_context() if tr.enabled else None
+    traced = tr.enabled and (journal is not None or trace_parent is not None)
+
+    def finish(index, handle, t_disp):
         result = fetch(handle)
+        if traced:
+            tr.record_mono("pool.shard", t_disp, time.monotonic(),
+                           parent=trace_parent, index=index)
         if injector is not None:
             injector.fire("pool.shard")
         if journal is not None:
@@ -241,6 +254,11 @@ def run_pipeline(items: Iterable[Any],
     if journal is not None:
         restored = {i: journal.get(i) for i in range(len(items))}
         restored = {i: r for i, r in restored.items() if r is not None}
+        if traced and restored:
+            now = time.monotonic()
+            for i in restored:
+                tr.record_mono("pool.shard", now, now, parent=trace_parent,
+                               index=i, restored=True)
     else:
         restored = {}
 
@@ -251,13 +269,14 @@ def run_pipeline(items: Iterable[Any],
             if i in restored:
                 results[i] = restored[i]
                 continue
-            pending.append((i, dispatch(it)))
+            t_disp = time.monotonic()
+            pending.append((i, dispatch(it), t_disp))
             if len(pending) >= window:
-                j, handle = pending.popleft()
-                results[j] = finish(j, handle)
+                j, handle, t_disp = pending.popleft()
+                results[j] = finish(j, handle, t_disp)
         while pending:
-            j, handle = pending.popleft()
-            results[j] = finish(j, handle)
+            j, handle, t_disp = pending.popleft()
+            results[j] = finish(j, handle, t_disp)
         return results
 
     sem = threading.BoundedSemaphore(window)
@@ -272,11 +291,12 @@ def run_pipeline(items: Iterable[Any],
             sem.acquire()  # bounds dispatched-but-unfetched slabs
             if failed.is_set():
                 break  # don't burn device work after a fatal fetch error
+            t_disp = time.monotonic()
             handle = dispatch(it)
 
-            def _fetch(i=i, handle=handle):
+            def _fetch(i=i, handle=handle, t_disp=t_disp):
                 try:
-                    results[i] = finish(i, handle)
+                    results[i] = finish(i, handle, t_disp)
                 except BaseException:
                     failed.set()
                     raise

@@ -8,29 +8,71 @@ construction / device explain / host eval / solve / build-explanation) and a
 ``jax.profiler`` trace hook producing TensorBoard-compatible device
 flamegraphs.
 
-Enable with ``DKS_PROFILE=1`` (or ``profiler().enable()``); phase summaries
-accumulate in-process and are cheap enough to leave on in benchmarks.
+Enable with ``DKS_PROFILE=1`` (or ``profiler().enable()``).  Memory is
+bounded: per-phase ``count`` and ``total_s`` are exact accumulators, while
+the raw samples live in a rolling window of the most recent
+:data:`DEFAULT_WINDOW` durations — enough for the windowed p50/p99 in
+``summary()`` without the unbounded list the original kept, which grew one
+float per device call for the life of a serving process ("cheap enough to
+leave on in benchmarks" was false for long serving runs).
+
+Phase timers also feed the observability layer twice over:
+
+* when request tracing is active (``DKS_TRACE=1``) and the current thread
+  carries a span context (the server adopts a request's context around its
+  device calls), each phase is ALSO recorded as a ``phase.<name>`` child
+  span — the engine's internal phases appear inside the request's trace;
+* the server surfaces ``profiler().summary()`` as the
+  ``dks_phase_seconds_total``/``dks_phase_count`` series on ``/metrics``
+  (callback-sourced), so device-phase time is scrapeable without enabling
+  full tracing.
 """
 
 import contextlib
 import logging
+import math
 import os
 import threading
 import time
-from collections import defaultdict
-from typing import Dict, List, Optional
+from collections import deque
+from typing import Dict, Optional
+
+import distributedkernelshap_tpu.observability.tracing as _tracing
 
 logger = logging.getLogger(__name__)
+
+#: rolling-window bound on retained per-phase samples; count/total stay
+#: exact beyond it, percentiles become window-local (recent behaviour is
+#: exactly what a serving dashboard wants anyway)
+DEFAULT_WINDOW = 512
+
+
+class _PhaseStats:
+    __slots__ = ("count", "total_s", "window")
+
+    def __init__(self, window: int):
+        self.count = 0
+        self.total_s = 0.0
+        self.window: deque = deque(maxlen=window)
+
+
+def _percentile(ordered, q: float) -> float:
+    """Nearest-rank percentile of a pre-sorted non-empty sequence."""
+
+    rank = max(1, int(math.ceil(q * len(ordered))))
+    return ordered[rank - 1]
 
 
 class Profiler:
     """Per-phase wall-clock accumulator + device trace hook."""
 
-    def __init__(self, enabled: Optional[bool] = None):
+    def __init__(self, enabled: Optional[bool] = None,
+                 window: int = DEFAULT_WINDOW):
         if enabled is None:
             enabled = os.environ.get("DKS_PROFILE", "0") not in ("", "0", "false")
         self.enabled = enabled
-        self._times: Dict[str, List[float]] = defaultdict(list)
+        self.window = max(1, int(window))
+        self._phases: Dict[str, _PhaseStats] = {}
         self._lock = threading.Lock()
 
     def enable(self):
@@ -45,9 +87,17 @@ class Profiler:
     def phase(self, name: str, sync: bool = False):
         """Time a named phase.  ``sync=True`` blocks on outstanding device
         work before reading the clock (JAX dispatch is async; without a sync
-        the time lands in whichever phase first blocks)."""
+        the time lands in whichever phase first blocks).
 
-        if not self.enabled:
+        When the process tracer is enabled and this thread carries a span
+        context, the phase is also recorded as a ``phase.<name>`` child
+        span — even with the profiler itself disabled, so serving requests
+        get device-phase children without turning accumulation on."""
+
+        tracer = _tracing.tracer()
+        trace_parent = (_tracing.current_context() if tracer.enabled
+                        else None)
+        if not self.enabled and trace_parent is None:
             yield
             return
         t0 = time.perf_counter()
@@ -62,8 +112,18 @@ class Profiler:
                 except Exception:
                     pass
             dt = time.perf_counter() - t0
-            with self._lock:
-                self._times[name].append(dt)
+            if self.enabled:
+                with self._lock:
+                    st = self._phases.get(name)
+                    if st is None:
+                        st = self._phases[name] = _PhaseStats(self.window)
+                    st.count += 1
+                    st.total_s += dt
+                    st.window.append(dt)
+            if trace_parent is not None:
+                t1_mono = time.monotonic()
+                tracer.record_mono(f"phase.{name}", t1_mono - dt, t1_mono,
+                                   parent=trace_parent)
 
     @contextlib.contextmanager
     def trace(self, logdir: str = "/tmp/dks_trace"):
@@ -79,27 +139,40 @@ class Profiler:
             logger.info("device trace written to %s", logdir)
 
     def summary(self) -> Dict[str, Dict[str, float]]:
-        """Per-phase {count, total_s, mean_s, last_s}."""
+        """Per-phase ``{count, total_s, mean_s, last_s, p50_s, p99_s}``.
+
+        ``count``/``total_s``/``mean_s`` are exact over the phase's whole
+        history; ``last_s`` and the percentiles come from the rolling
+        window of the most recent :attr:`window` samples."""
 
         with self._lock:
-            return {
-                name: {
-                    "count": len(v),
-                    "total_s": sum(v),
-                    "mean_s": sum(v) / len(v),
-                    "last_s": v[-1],
+            out = {}
+            for name, st in self._phases.items():
+                if not st.count:
+                    continue
+                ordered = sorted(st.window)
+                out[name] = {
+                    "count": st.count,
+                    "total_s": st.total_s,
+                    "mean_s": st.total_s / st.count,
+                    "last_s": st.window[-1],
+                    "p50_s": _percentile(ordered, 0.50),
+                    "p99_s": _percentile(ordered, 0.99),
                 }
-                for name, v in self._times.items() if v
-            }
+            return out
 
     def reset(self):
         with self._lock:
-            self._times.clear()
+            self._phases.clear()
 
     def report(self) -> str:
-        lines = [f"{'phase':<24}{'count':>7}{'total_s':>10}{'mean_s':>10}"]
-        for name, s in sorted(self.summary().items(), key=lambda kv: -kv[1]["total_s"]):
-            lines.append(f"{name:<24}{s['count']:>7}{s['total_s']:>10.3f}{s['mean_s']:>10.4f}")
+        lines = [f"{'phase':<24}{'count':>7}{'total_s':>10}{'mean_s':>10}"
+                 f"{'p50_s':>10}{'p99_s':>10}"]
+        for name, s in sorted(self.summary().items(),
+                              key=lambda kv: -kv[1]["total_s"]):
+            lines.append(f"{name:<24}{s['count']:>7}{s['total_s']:>10.3f}"
+                         f"{s['mean_s']:>10.4f}{s['p50_s']:>10.4f}"
+                         f"{s['p99_s']:>10.4f}")
         return "\n".join(lines)
 
 

@@ -194,7 +194,22 @@ class FaultInjector:
             return None
         logger.warning("fault injection: firing %s at site %s",
                        spec.kind, site)
+        # the flight recorder is the chaos run's shared timeline: every
+        # fired fault lands on it, and a crash dumps the whole ring to
+        # $DKS_FLIGHTREC_DIR before the process dies — one artifact
+        # instead of log archaeology.  Imported lazily: faults must parse
+        # specs at worker startup before anything heavier loads.
+        from distributedkernelshap_tpu.observability.flightrec import (
+            flightrec,
+        )
+
+        flightrec().record("fault_injected", fault=spec.kind, site=site,
+                           delay_s=spec.delay_s)
         if spec.kind == "crash":
+            # the dump happens HERE because nothing after os._exit does:
+            # no atexit, no flush — an injected crash is the one fault
+            # that can still leave its black box behind
+            flightrec().dump_crash(reason=f"injected crash at {site}")
             # os._exit, not sys.exit: a real crash skips atexit handlers,
             # response flushing, everything — that is the point
             os._exit(CRASH_EXIT_CODE)

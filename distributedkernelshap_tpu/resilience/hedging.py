@@ -55,7 +55,7 @@ class LatencyQuantiles:
             if not dq:
                 return None
             ordered = sorted(dq)
-        # nearest-rank; bisect keeps the hot path allocation-free
+        # nearest-rank over the (<=window)-sample sort — exact and cheap
         rank = min(len(ordered) - 1,
                    max(0, int(round(q * (len(ordered) - 1)))))
         return ordered[rank]
@@ -98,4 +98,9 @@ class HedgePolicy:
             delay = self.initial_delay_s
         else:
             delay = tracker.quantile(klass, self.quantile)
+            if delay is None:
+                # min_samples=0 with an empty window: there is no quantile
+                # to trust yet — fall back like the cold-start path instead
+                # of crashing the proxy handler on max(float, None)
+                delay = self.initial_delay_s
         return min(self.max_delay_s, max(self.min_delay_s, delay))

@@ -36,6 +36,7 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+from distributedkernelshap_tpu.observability.flightrec import flightrec
 from distributedkernelshap_tpu.scheduling.result_cache import (
     array_fingerprint,
 )
@@ -199,6 +200,11 @@ class ShardJournal:
                     "shard journal %s belongs to a different run "
                     "(fingerprint/input/layout changed); ignoring it",
                     self.path)
+                # invalidations are exactly what a resume post-mortem
+                # needs on the flight-recorder timeline: "why did this
+                # run recompute everything?"
+                flightrec().record("journal_invalidated", path=self.path,
+                                   records=max(0, len(lines) - 1))
             self._write_header()
             return
         for line in lines[1:]:
@@ -213,12 +219,15 @@ class ShardJournal:
                 # shard simply recomputes
                 logger.warning("dropping undecodable record in %s",
                                self.path)
+                flightrec().record("journal_torn_record", path=self.path)
                 continue
             self._entries[index] = arrays
             self._done.add(index)
         if self._entries:
             logger.info("shard journal %s: resuming with %d completed "
                         "shard(s)", self.path, len(self._entries))
+            flightrec().record("journal_resume", path=self.path,
+                               restored_shards=len(self._entries))
 
     def _write_header(self) -> None:
         directory = os.path.dirname(self.path)

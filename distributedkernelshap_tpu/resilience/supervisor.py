@@ -28,6 +28,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from distributedkernelshap_tpu.observability.flightrec import flightrec
+
 logger = logging.getLogger(__name__)
 
 
@@ -144,6 +146,10 @@ class ReplicaSupervisor:
                     "supervisor: replica %d exited rc=%s (consecutive "
                     "crash #%d); restarting in %.2fs",
                     i, proc.returncode, self._consecutive[i], delay)
+                flightrec().record("replica_exit", replica=i,
+                                   returncode=proc.returncode,
+                                   consecutive_crashes=self._consecutive[i],
+                                   restart_in_s=round(delay, 3))
                 continue
             if now < due:
                 continue
@@ -156,6 +162,8 @@ class ReplicaSupervisor:
             self.restarts_total += 1
             logger.info("supervisor: replica %d respawned "
                         "(restart #%d)", i, self.restarts_total)
+            flightrec().record("replica_restart", replica=i,
+                               restarts_total=self.restarts_total)
 
     def _loop(self) -> None:
         while not self._stop.wait(self.poll_interval_s):

@@ -51,6 +51,12 @@ DEFAULT_CLASS_BUDGETS_S: Dict[str, float] = {
     "best_effort": 120.0,
 }
 
+# queue-wait histogram bounds (seconds): finer than the request-latency
+# buckets at the low end — queue wait is the scheduler's own contribution
+# to latency and the interactive budget is 0.5 s
+QUEUE_WAIT_BUCKETS_S = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                        1.0, 2.5, 5.0, 10.0, 30.0)
+
 
 class SLOScheduler:
     """EDF request queue with row-budget batch formation.
@@ -77,6 +83,36 @@ class SLOScheduler:
         self._depths: Dict[str, int] = {k: 0 for k in PRIORITY_CLASSES}
         self._queued_rows = 0
         self._stopped = False
+        # observability (attach_metrics): None until the owner attaches a
+        # registry — the scheduler is also used standalone in unit tests
+        self._m_enqueued = None
+        self._m_queue_wait = None
+        self._m_expired = None
+        self._m_pushbacks = None
+
+    def attach_metrics(self, registry) -> None:
+        """Register this scheduler's ``dks_sched_*`` series on the
+        owner's :class:`~distributedkernelshap_tpu.observability.metrics.
+        MetricsRegistry` — the server calls this so queue behaviour
+        (wait, expiries, packing pushback) renders on the same ``/metrics``
+        page as the serving counters.  Queue DEPTH stays the server-owned
+        ``dks_serve_queue_depth`` gauge (pre-existing name, preserved)."""
+
+        self._m_enqueued = registry.counter(
+            "dks_sched_enqueued_total",
+            "Requests accepted into the scheduler queue.",
+            labelnames=("class",)).seed(*[(k,) for k in PRIORITY_CLASSES])
+        self._m_queue_wait = registry.histogram(
+            "dks_sched_queue_wait_seconds",
+            "Time from enqueue to batch claim.",
+            buckets=QUEUE_WAIT_BUCKETS_S, labelnames=("class",))
+        self._m_expired = registry.counter(
+            "dks_sched_expired_total",
+            "Requests whose explicit deadline passed while queued.",
+            labelnames=("class",)).seed(*[(k,) for k in PRIORITY_CLASSES])
+        self._m_pushbacks = registry.counter(
+            "dks_sched_row_budget_pushbacks_total",
+            "Items deferred by row-budget packing to a later batch.")
 
     # -- ordering hooks (FIFOScheduler overrides) ----------------------- #
 
@@ -102,6 +138,8 @@ class SLOScheduler:
             self._depths[klass] = self._depths.get(klass, 0) + 1
             self._queued_rows += item.rows
             self._cond.notify()
+        if self._m_enqueued is not None:
+            self._m_enqueued.inc(**{"class": klass})
 
     # -- introspection (admission control, metrics) --------------------- #
 
@@ -166,6 +204,7 @@ class SLOScheduler:
                 self._cond.wait(timeout=idle_wait_s)
             batch: List[object] = []
             expired: List[object] = []
+            counted_pushback: set = set()
             rows = 0
             fill_deadline = self._now() + (batch_timeout_s
                                            if max_batch_size > 1 else 0.0)
@@ -186,6 +225,9 @@ class SLOScheduler:
                     if self._is_expired(item, now):
                         self._account_pop(item)
                         expired.append(item)
+                        if self._m_expired is not None:
+                            self._m_expired.inc(**{
+                                "class": getattr(item, "klass", "batch")})
                         continue
                     if batch and max_rows and rows + item.rows > max_rows:
                         # row-budget packing: keep scanning for items that
@@ -195,10 +237,24 @@ class SLOScheduler:
                         pushback.append((eff, seq, item))
                         continue
                     self._account_pop(item)
+                    if self._m_queue_wait is not None:
+                        self._m_queue_wait.observe(
+                            max(0.0, now - item.t_enqueued),
+                            **{"class": getattr(item, "klass", "batch")})
                     batch.append(item)
                     rows += item.rows
                 for entry in pushback:
                     heapq.heappush(self._heap, entry)
+                if pushback and self._m_pushbacks is not None:
+                    # once per item per next_batch call: the inner loop
+                    # rescans the heap on every wakeup before the fill
+                    # deadline, and re-counting the same deferred item per
+                    # scan would overstate pushback by the wakeup count
+                    fresh = [e for e in pushback
+                             if id(e[2]) not in counted_pushback]
+                    counted_pushback.update(id(e[2]) for e in fresh)
+                    if fresh:
+                        self._m_pushbacks.inc(len(fresh))
                 if len(batch) >= max_batch_size:
                     break
                 if max_rows and rows >= max_rows:

@@ -23,6 +23,8 @@ from urllib.parse import urlparse
 
 import numpy as np
 
+import distributedkernelshap_tpu.observability.tracing as _tracing
+
 _tls = threading.local()
 
 #: ceiling on any single backoff sleep, whatever the server's hint says —
@@ -110,6 +112,12 @@ def explain_request(url: str, instance: np.ndarray, timeout: float = 300.0,
     status a proxy synthesises for a slow replica is equally terminal
     here), and any other HTTP error (4xx/500 are answers, not outages).
     ``_sleep``/``_rng`` are test seams.
+
+    Tracing (``DKS_TRACE=1``): the client MINTS the trace id — one
+    ``client.request`` root span per call, one ``client.attempt`` child
+    span per wire attempt (retries get distinct span ids), and the
+    attempt's context rides the ``X-DKS-Trace`` header so proxy and
+    replica spans downstream share the trace id.
     """
 
     parsed = urlparse(url)
@@ -117,53 +125,84 @@ def explain_request(url: str, instance: np.ndarray, timeout: float = 300.0,
     body = json.dumps({"array": np.asarray(instance).tolist()}).encode()
     headers = {"Content-Type": "application/json", **(extra_headers or {})}
     rng = _rng or random.Random()
+    tr = _tracing.tracer()
+    root = None
+    if tr.enabled:
+        # an explicit X-DKS-Trace in extra_headers adopts the caller's
+        # trace (batch drivers stamping one trace across a fan-out)
+        root = tr.begin("client.request",
+                        parent=_tracing.parse_trace_header(
+                            _tracing.header_get(headers)),
+                        rows=int(np.asarray(instance).reshape(
+                            -1, np.asarray(instance).shape[-1]).shape[0]))
     attempt = 0
-    while True:
-        conn = _get_connection(parsed.scheme or "http", parsed.netloc, timeout)
-        backoff = None
-        try:
-            conn.request("POST", path, body=body, headers=headers)
-            resp = conn.getresponse()
-            raw = resp.read()
+    last_status = None
+    try:
+        while True:
+            conn = _get_connection(parsed.scheme or "http", parsed.netloc,
+                                   timeout)
+            backoff = None
+            aspan = None
+            if root is not None:
+                aspan = tr.begin("client.attempt", parent=root.context,
+                                 attempt=attempt)
+                headers = {k: v for k, v in headers.items()
+                           if k.lower() != _tracing.TRACE_HEADER.lower()}
+                headers[_tracing.TRACE_HEADER] = \
+                    _tracing.format_trace_header(aspan.context)
             try:
-                payload = raw.decode()
-            except UnicodeDecodeError:
-                # corrupted on the wire (bit-rot, an injected garble):
-                # idempotency makes a re-fetch safe, so spend a retry on a
-                # clean copy instead of surfacing garbage — but only for
-                # statuses that are retriable anyway; a garbled 400/500 is
-                # still an answer the server would deterministically repeat
-                if resp.status not in (200, 429, 502, 503) \
-                        or attempt >= max_retries:
-                    raise RuntimeError(
-                        f"HTTP {resp.status}: undecodable (corrupt) payload "
-                        f"of {len(raw)} bytes")
-                payload = None
-                backoff = BASE_BACKOFF_S * (2.0 ** attempt)
-            if payload is not None:
-                if resp.status == 200:
-                    return payload
-                if resp.status == 429:
-                    hint = parse_retry_after(resp.headers, payload)
-                    backoff = hint if hint is not None else \
-                        BASE_BACKOFF_S * (2.0 ** attempt)
-                elif resp.status in (502, 503):
+                conn.request("POST", path, body=body, headers=headers)
+                resp = conn.getresponse()
+                raw = resp.read()
+                last_status = resp.status
+                tr.end(aspan, status=resp.status)
+                try:
+                    payload = raw.decode()
+                except UnicodeDecodeError:
+                    # corrupted on the wire (bit-rot, an injected garble):
+                    # idempotency makes a re-fetch safe, so spend a retry
+                    # on a clean copy instead of surfacing garbage — but
+                    # only for statuses that are retriable anyway; a
+                    # garbled 400/500 is still an answer the server would
+                    # deterministically repeat
+                    if resp.status not in (200, 429, 502, 503) \
+                            or attempt >= max_retries:
+                        raise RuntimeError(
+                            f"HTTP {resp.status}: undecodable (corrupt) "
+                            f"payload of {len(raw)} bytes")
+                    payload = None
                     backoff = BASE_BACKOFF_S * (2.0 ** attempt)
-                if backoff is None or attempt >= max_retries:
-                    raise RuntimeError(f"HTTP {resp.status}: {payload}")
-        except TimeoutError:
-            # a timed-out request may still be queued server-side; re-sending
-            # it would duplicate work on an already-overloaded server
-            _drop_connection(parsed.scheme or "http", parsed.netloc)
-            raise
-        except (http.client.HTTPException, ConnectionError, OSError):
-            _drop_connection(parsed.scheme or "http", parsed.netloc)
-            if attempt >= max_retries:
+                if payload is not None:
+                    if resp.status == 200:
+                        return payload
+                    if resp.status == 429:
+                        hint = parse_retry_after(resp.headers, payload)
+                        backoff = hint if hint is not None else \
+                            BASE_BACKOFF_S * (2.0 ** attempt)
+                    elif resp.status in (502, 503):
+                        backoff = BASE_BACKOFF_S * (2.0 ** attempt)
+                    if backoff is None or attempt >= max_retries:
+                        raise RuntimeError(f"HTTP {resp.status}: {payload}")
+            except TimeoutError:
+                # a timed-out request may still be queued server-side;
+                # re-sending it would duplicate work on an
+                # already-overloaded server
+                tr.end(aspan, outcome="timeout")
+                _drop_connection(parsed.scheme or "http", parsed.netloc)
                 raise
-            backoff = BASE_BACKOFF_S * (2.0 ** attempt)
-        attempt += 1
-        # jitter INSIDE the cap: MAX_BACKOFF_S is a hard ceiling
-        _sleep(min(MAX_BACKOFF_S, backoff * (1.0 + 0.25 * rng.random())))
+            except (http.client.HTTPException, ConnectionError, OSError):
+                tr.end(aspan, outcome="connection_failed")
+                _drop_connection(parsed.scheme or "http", parsed.netloc)
+                if attempt >= max_retries:
+                    raise
+                backoff = BASE_BACKOFF_S * (2.0 ** attempt)
+            attempt += 1
+            # jitter INSIDE the cap: MAX_BACKOFF_S is a hard ceiling
+            _sleep(min(MAX_BACKOFF_S,
+                       backoff * (1.0 + 0.25 * rng.random())))
+    finally:
+        if root is not None:
+            tr.end(root, attempts=attempt + 1, status=last_status)
 
 
 def distribute_requests(url: str,

@@ -45,6 +45,9 @@ from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import distributedkernelshap_tpu.observability.tracing as _tracing
+from distributedkernelshap_tpu.observability.flightrec import flightrec
+from distributedkernelshap_tpu.observability.metrics import MetricsRegistry
 from distributedkernelshap_tpu.resilience.hedging import (
     HedgePolicy,
     LatencyQuantiles,
@@ -70,7 +73,6 @@ class _Replica:
         self.host = host
         self.port = port
         self.alive = True
-        self.errors_total = 0
         # monotonic time until which this replica has declared itself
         # saturated (it answered 429 reason=queue_full): alive, just not
         # worth forwarding to.  Keyed by the request's priority class —
@@ -119,12 +121,56 @@ class FanInProxy:
         self.probe_interval_s = probe_interval_s
         self._rr_lock = threading.Lock()
         self._rr = 0
-        self._metrics_lock = threading.Lock()
-        self._metrics = {"forwarded_total": 0, "replica_errors_total": 0,
-                         "retried_connects_total": 0,
-                         "replica_503_demotions_total": 0,
-                         "sheds_total": 0,
-                         "hedges_total": 0, "hedge_wins_total": 0}
+        # every dks_fanin_* series lives on the shared registry (one
+        # renderer; per-metric locks make increments from hedge/handler
+        # threads atomic — these used to be bare dict/int updates)
+        self.metrics = MetricsRegistry()
+        self._flight = flightrec()
+        self._tracer = _tracing.tracer()
+        reg = self.metrics
+        self._m_forwarded = reg.counter(
+            "dks_fanin_forwarded_total",
+            "Requests forwarded to a replica and answered.")
+        self._m_replica_errors = reg.counter(
+            "dks_fanin_replica_errors_total",
+            "Requests surfaced as a replica's mid-request failure.")
+        self._m_retried_connects = reg.counter(
+            "dks_fanin_retried_connects_total",
+            "Connect failures retried on another replica.")
+        self._m_503_demotions = reg.counter(
+            "dks_fanin_replica_503_demotions_total",
+            "Replicas demoted after answering 503 (alive but "
+            "self-declared unserviceable).")
+        self._m_sheds = reg.counter(
+            "dks_fanin_sheds_total",
+            "Requests shed at the proxy with 429 because every live "
+            "replica reported saturation.")
+        self._m_hedges = reg.counter(
+            "dks_fanin_hedges_total",
+            "Requests re-dispatched to a second replica after the hedge "
+            "delay.")
+        self._m_hedge_wins = reg.counter(
+            "dks_fanin_hedge_wins_total",
+            "Hedged requests whose hedge answered first with a success.")
+        reg.gauge("dks_fanin_replica_up", "Replica liveness by index.",
+                  labelnames=("replica", "address")).set_function(
+            lambda: {(str(r.index), r.address): int(r.alive)
+                     for r in self.replicas})
+        reg.gauge("dks_fanin_replica_saturated",
+                  "Replica currently backing off after a 429.",
+                  labelnames=("replica", "address")).set_function(
+            lambda: {(str(r.index), r.address):
+                     int(time.monotonic() < r.saturated_any())
+                     for r in self.replicas})
+        # per-replica failure attribution (timeouts, mid-request failures,
+        # 503 demotions) — previously a bare int += on _Replica racing
+        # across hedge threads
+        self._m_replica_failures = reg.counter(
+            "dks_fanin_replica_failures_total",
+            "Failures attributed to one replica (timeouts, mid-request "
+            "failures, 503 demotions).",
+            labelnames=("replica", "address")).seed(
+            *[(str(r.index), r.address) for r in self.replicas])
         #: tail-latency hedging (``resilience/hedging.py``).  ``None``
         #: (default) disables it — behaviour is then byte-identical to the
         #: pre-hedging proxy.  Safe to enable because /explain is
@@ -227,17 +273,36 @@ class FanInProxy:
         (see ``resilience/hedging.py`` for why that is safe here)."""
 
         klass = self._priority_class(headers)
-        if self.hedge_policy is None:
-            t0 = time.monotonic()
-            result = self._route_explain(method, body, headers, klass)
-            if result[0] == 200:
-                self._latency.observe(klass, time.monotonic() - t0)
+        tr = self._tracer
+        root = None
+        if tr.enabled:
+            # the proxy's root span parents to the client's context (if it
+            # sent X-DKS-Trace) so one trace id follows the request from
+            # client through proxy into the replica
+            root = tr.begin(
+                "proxy.request",
+                parent=_tracing.parse_trace_header(
+                    _tracing.header_get(headers)),
+                klass=klass)
+        result: Tuple[int, bytes, Dict[str, str]] = (500, b"", {})
+        try:
+            if self.hedge_policy is None:
+                t0 = time.monotonic()
+                result = self._route_explain(method, body, headers, klass,
+                                             span_parent=root)
+                if result[0] == 200:
+                    self._latency.observe(klass, time.monotonic() - t0)
+            else:
+                result = self._handle_hedged(method, body, headers, klass,
+                                             root=root)
             return result
-        return self._handle_hedged(method, body, headers, klass)
+        finally:
+            if root is not None:
+                tr.end(root, status=result[0])
 
     def _handle_hedged(self, method: str, body: bytes,
-                       headers: Optional[Dict[str, str]], klass: str
-                       ) -> Tuple[int, bytes, Dict[str, str]]:
+                       headers: Optional[Dict[str, str]], klass: str,
+                       root=None) -> Tuple[int, bytes, Dict[str, str]]:
         """Hedged routing: dispatch the primary, wait the policy delay,
         then race one hedge on a replica the primary has not touched.
 
@@ -262,7 +327,7 @@ class FanInProxy:
                 res = self._route_explain(
                     method, body, headers, klass, tried=set(exclude),
                     record=primary_tried if slot == "primary" else None,
-                    forward_sink=fwd)
+                    forward_sink=fwd, span_parent=root, slot=slot)
             except Exception as e:
                 # a dead racing pass MUST still report in: both passes
                 # dying silently would park this handler on an untimed
@@ -285,8 +350,9 @@ class FanInProxy:
                 slot, res, lat, fwd = results.get()
             else:
                 hedged = True
-                with self._metrics_lock:
-                    self._metrics["hedges_total"] += 1
+                self._m_hedges.inc()
+                self._flight.record("hedge", klass=klass,
+                                    excluded=list(exclude))
                 self._hedge_pool.submit(run, "hedge", exclude)
                 slot, res, lat, fwd = results.get()
                 if res[0] != 200:
@@ -303,28 +369,61 @@ class FanInProxy:
                             slot, res, lat, fwd = slot2, res2, lat2, fwd2
                     except queue.Empty:
                         pass
-        with self._metrics_lock:
-            if fwd:  # a replica answered the winning copy (any status)
-                self._metrics["forwarded_total"] += 1
-            if hedged and slot == "hedge" and res[0] == 200:
-                self._metrics["hedge_wins_total"] += 1
+        if fwd:  # a replica answered the winning copy (any status)
+            self._m_forwarded.inc()
+        if hedged and slot == "hedge" and res[0] == 200:
+            self._m_hedge_wins.inc()
+            self._flight.record("hedge_win", klass=klass)
         if res[0] == 200:
             self._latency.observe(klass, lat)
         return res
+
+    def _replica_failed(self, replica: _Replica) -> None:
+        """Per-replica failure attribution on the registry's atomic
+        counters (these used to be bare ``int +=`` racing across hedge
+        threads)."""
+
+        self._m_replica_failures.inc(replica=str(replica.index),
+                                     address=replica.address)
 
     def _route_explain(self, method: str, body: bytes,
                        headers: Optional[Dict[str, str]], klass: str,
                        tried: Optional[set] = None,
                        record: Optional[List[int]] = None,
-                       forward_sink: Optional[List[int]] = None
+                       forward_sink: Optional[List[int]] = None,
+                       span_parent=None, slot: str = "primary"
                        ) -> Tuple[int, bytes, Dict[str, str]]:
         """One routing pass over the rotation (failover loop); ``tried``
         seeds replicas to skip (the hedge path excludes the primary's),
         ``record`` collects the indices this pass touches.  A terminal
         replica answer normally counts in ``forwarded_total``; with
         ``forward_sink`` set it is appended there instead, so the hedged
-        caller (racing two passes) counts once per client request."""
+        caller (racing two passes) counts once per client request.
 
+        Tracing: each pass gets its own ``proxy.pass`` span (so the
+        primary and its hedge carry DISTINCT span ids under one trace),
+        and each forward attempt inside a pass gets a ``proxy.forward``
+        span whose context is stamped onto the ``X-DKS-Trace`` header the
+        replica sees — a retried/failed-over request's replica spans
+        parent to the exact attempt that reached them."""
+
+        tr = self._tracer
+        pass_span = (tr.begin("proxy.pass", parent=span_parent, slot=slot)
+                     if tr.enabled else None)
+        result: Tuple[int, bytes, Dict[str, str]] = (500, b"", {})
+        try:
+            result = self._route_explain_pass(
+                method, body, headers, klass, tried, record, forward_sink,
+                pass_span, slot)
+            return result
+        finally:
+            if pass_span is not None:
+                tr.end(pass_span, status=result[0])
+
+    def _route_explain_pass(self, method, body, headers, klass, tried,
+                            record, forward_sink, pass_span, slot
+                            ) -> Tuple[int, bytes, Dict[str, str]]:
+        tr = self._tracer
         tried = set() if tried is None else tried
         last_503: Optional[Tuple[int, bytes]] = None
         last_429: Optional[Tuple[bytes, float]] = None
@@ -336,8 +435,10 @@ class FanInProxy:
                     # proxy with the replicas' own backoff hint instead of
                     # queueing on a fleet that already said no
                     payload, retry_s = last_429
-                    with self._metrics_lock:
-                        self._metrics["sheds_total"] += 1
+                    self._m_sheds.inc()
+                    self._flight.record("shed", component="proxy",
+                                        reason="replicas_saturated",
+                                        klass=klass)
                     return 429, payload, {
                         "Retry-After": str(max(1, int(math.ceil(retry_s))))}
                 if last_503 is not None:
@@ -363,108 +464,149 @@ class FanInProxy:
                         "reason": "replicas_saturated"}).encode(),
                         backoff - time.monotonic())
                 continue
+            fwd_headers = headers
+            fspan = None
+            if tr.enabled:
+                fspan = tr.begin(
+                    "proxy.forward",
+                    parent=pass_span.context if pass_span is not None
+                    else None,
+                    replica=replica.index, address=replica.address,
+                    slot=slot)
+                # the replica parents its server.request span to THIS
+                # forward attempt, not to whatever the client minted
+                fwd_headers = {k: v for k, v in (headers or {}).items()
+                               if k.lower() != _tracing.TRACE_HEADER.lower()}
+                fwd_headers[_tracing.TRACE_HEADER] = \
+                    _tracing.format_trace_header(fspan.context)
+            outcome = "unknown"
             try:
-                status, payload, resp_headers = self._forward(
-                    method, "/explain", body, replica, headers=headers)
-            except _ConnectFailed:
-                # never reached the replica: mark dead, retry on the next —
-                # a connect failure cannot double-execute the request
-                logger.warning("replica %s refused connection; removed from "
-                               "rotation", replica.address)
-                replica.alive = False
-                with self._metrics_lock:
-                    self._metrics["retried_connects_total"] += 1
-                continue
-            except socket.timeout:
-                # slow, not dead: a legitimately long request (first compile
-                # of a new bucket shape runs 40-140 s through a tunnel; the
-                # worker's own first_batch_grace_s is 600 s) must not evict
-                # a healthy replica from rotation.  This client gets a 504;
-                # liveness stays governed by connection state and the
-                # /healthz prober (a truly wedged replica fails those).
-                replica.errors_total += 1
-                with self._metrics_lock:
-                    self._metrics["replica_errors_total"] += 1
-                logger.warning("replica %s exceeded request_timeout_s=%.0f",
-                               replica.address, self.request_timeout_s)
-                return 504, json.dumps({
-                    "error": f"replica {replica.address} did not answer "
-                             f"within {self.request_timeout_s:.0f}s",
-                    "replica": replica.index}).encode(), {}
-            except (OSError, http.client.HTTPException) as e:
-                # mid-request failure: the replica may have processed (or be
-                # processing) it — surface THIS request as that replica's
-                # error, exactly like the reference's died-with-its-actor
-                # requests; new requests route elsewhere.  HTTPException
-                # covers a replica killed after sending headers but before
-                # the body (IncompleteRead/BadStatusLine) — not an OSError
-                replica.alive = False
-                replica.errors_total += 1
-                with self._metrics_lock:
-                    self._metrics["replica_errors_total"] += 1
-                logger.warning("replica %s failed mid-request: %s",
-                               replica.address, e)
-                return 502, json.dumps({
-                    "error": f"replica {replica.address} failed "
-                             f"mid-request: {e}",
-                    "replica": replica.index}).encode(), {}
-            if status == 429:
-                retry_s = self._retry_after_s(resp_headers, payload)
                 try:
-                    reason = json.loads(payload).get("reason")
-                except (ValueError, AttributeError):
-                    reason = None
-                if reason == "rate_limited":
-                    # the replica shed THIS CLIENT, not load: the fleet has
-                    # headroom, so neither mark the replica saturated (that
-                    # would let one abusive client deny every client) nor
-                    # retry elsewhere (each replica keys its own bucket —
-                    # rotating would multiply the client's allowance xN)
-                    return 429, payload, {
-                        "Retry-After": str(max(1, int(math.ceil(retry_s))))}
-                if reason != "projected_wait":
-                    # queue_full (or unknown): a capacity signal for this
-                    # priority class — mark it saturated so same-class
-                    # requests skip it until the backoff elapses.
-                    # projected_wait is NOT marked: it depends on THIS
-                    # request's deadline (a deadline-less request would
-                    # have been admitted), so treating it as saturation
-                    # would shed traffic the replica still accepts.
-                    replica.saturated_until[klass] = (time.monotonic()
-                                                      + retry_s)
-                # either way retry a replica with more headroom (shedding
-                # is pre-dispatch, so the retry cannot double-execute); if
-                # every replica says 429 the exhausted-rotation path above
-                # sheds at the proxy with the replicas' own backoff hint
-                last_429 = (payload, retry_s)
-                continue
-            if status == 503:
-                # the replica answered but DECLINED to serve (its own
-                # watchdog declared a device wedge and fast-503s, or it is
-                # shutting down).  It refused before dispatch, so a retry
-                # cannot double-execute — demote it (the prober re-admits
-                # it when /healthz answers 200 again) and try the next
-                # replica; without this a wedged-but-alive worker would
-                # permanently fail its share of the traffic.
-                replica.alive = False
-                replica.errors_total += 1
-                with self._metrics_lock:
+                    status, payload, resp_headers = self._forward(
+                        method, "/explain", body, replica,
+                        headers=fwd_headers)
+                except _ConnectFailed:
+                    # never reached the replica: mark dead, retry on the
+                    # next — a connect failure cannot double-execute the
+                    # request
+                    outcome = "connect_failed"
+                    logger.warning("replica %s refused connection; removed "
+                                   "from rotation", replica.address)
+                    replica.alive = False
+                    self._m_retried_connects.inc()
+                    self._flight.record("replica_dead",
+                                        replica=replica.index,
+                                        address=replica.address,
+                                        cause="connect_failed")
+                    continue
+                except socket.timeout:
+                    # slow, not dead: a legitimately long request (first
+                    # compile of a new bucket shape runs 40-140 s through a
+                    # tunnel; the worker's own first_batch_grace_s is 600 s)
+                    # must not evict a healthy replica from rotation.  This
+                    # client gets a 504; liveness stays governed by
+                    # connection state and the /healthz prober (a truly
+                    # wedged replica fails those).
+                    outcome = "timeout"
+                    self._replica_failed(replica)
+                    self._m_replica_errors.inc()
+                    logger.warning(
+                        "replica %s exceeded request_timeout_s=%.0f",
+                        replica.address, self.request_timeout_s)
+                    return 504, json.dumps({
+                        "error": f"replica {replica.address} did not answer "
+                                 f"within {self.request_timeout_s:.0f}s",
+                        "replica": replica.index}).encode(), {}
+                except (OSError, http.client.HTTPException) as e:
+                    # mid-request failure: the replica may have processed
+                    # (or be processing) it — surface THIS request as that
+                    # replica's error, exactly like the reference's
+                    # died-with-its-actor requests; new requests route
+                    # elsewhere.  HTTPException covers a replica killed
+                    # after sending headers but before the body
+                    # (IncompleteRead/BadStatusLine) — not an OSError
+                    outcome = "mid_request_failure"
+                    replica.alive = False
+                    self._replica_failed(replica)
+                    self._m_replica_errors.inc()
+                    self._flight.record("replica_dead",
+                                        replica=replica.index,
+                                        address=replica.address,
+                                        cause="mid_request_failure")
+                    logger.warning("replica %s failed mid-request: %s",
+                                   replica.address, e)
+                    return 502, json.dumps({
+                        "error": f"replica {replica.address} failed "
+                                 f"mid-request: {e}",
+                        "replica": replica.index}).encode(), {}
+                outcome = str(status)
+                if status == 429:
+                    retry_s = self._retry_after_s(resp_headers, payload)
+                    try:
+                        reason = json.loads(payload).get("reason")
+                    except (ValueError, AttributeError):
+                        reason = None
+                    if reason == "rate_limited":
+                        # the replica shed THIS CLIENT, not load: the fleet
+                        # has headroom, so neither mark the replica
+                        # saturated (that would let one abusive client deny
+                        # every client) nor retry elsewhere (each replica
+                        # keys its own bucket — rotating would multiply the
+                        # client's allowance xN)
+                        return 429, payload, {
+                            "Retry-After":
+                                str(max(1, int(math.ceil(retry_s))))}
+                    if reason != "projected_wait":
+                        # queue_full (or unknown): a capacity signal for
+                        # this priority class — mark it saturated so
+                        # same-class requests skip it until the backoff
+                        # elapses.  projected_wait is NOT marked: it
+                        # depends on THIS request's deadline (a
+                        # deadline-less request would have been admitted),
+                        # so treating it as saturation would shed traffic
+                        # the replica still accepts.
+                        replica.saturated_until[klass] = (time.monotonic()
+                                                          + retry_s)
+                    # either way retry a replica with more headroom
+                    # (shedding is pre-dispatch, so the retry cannot
+                    # double-execute); if every replica says 429 the
+                    # exhausted-rotation path above sheds at the proxy with
+                    # the replicas' own backoff hint
+                    last_429 = (payload, retry_s)
+                    continue
+                if status == 503:
+                    # the replica answered but DECLINED to serve (its own
+                    # watchdog declared a device wedge and fast-503s, or it
+                    # is shutting down).  It refused before dispatch, so a
+                    # retry cannot double-execute — demote it (the prober
+                    # re-admits it when /healthz answers 200 again) and try
+                    # the next replica; without this a wedged-but-alive
+                    # worker would permanently fail its share of the
+                    # traffic.
+                    replica.alive = False
+                    self._replica_failed(replica)
                     # its OWN counter: an operator must be able to tell
                     # alive-but-wedged (device-level, this one) from
                     # crashing-at-connect (process-level) — the two call
                     # for opposite remediations
-                    self._metrics["replica_503_demotions_total"] += 1
-                logger.warning("replica %s answered 503 (self-declared "
-                               "unserviceable); removed from rotation",
-                               replica.address)
-                last_503 = (status, payload)
-                continue
-            if forward_sink is not None:
-                forward_sink.append(replica.index)
-            else:
-                with self._metrics_lock:
-                    self._metrics["forwarded_total"] += 1
-            return status, payload, {}
+                    self._m_503_demotions.inc()
+                    self._flight.record("replica_dead",
+                                        replica=replica.index,
+                                        address=replica.address,
+                                        cause="503_demotion")
+                    logger.warning("replica %s answered 503 (self-declared "
+                                   "unserviceable); removed from rotation",
+                                   replica.address)
+                    last_503 = (status, payload)
+                    continue
+                if forward_sink is not None:
+                    forward_sink.append(replica.index)
+                else:
+                    self._m_forwarded.inc()
+                return status, payload, {}
+            finally:
+                if fspan is not None:
+                    tr.end(fspan, outcome=outcome)
 
     # ------------------------------------------------------------------ #
 
@@ -490,57 +632,13 @@ class FanInProxy:
                     logger.info("replica %s recovered; back in rotation",
                                 r.address)
                     r.alive = True
+                    self._flight.record("replica_recovered",
+                                        replica=r.index, address=r.address)
 
     def _render_metrics(self) -> str:
-        with self._metrics_lock:
-            m = dict(self._metrics)
-        lines = [
-            "# HELP dks_fanin_forwarded_total Requests forwarded to a "
-            "replica and answered.",
-            "# TYPE dks_fanin_forwarded_total counter",
-            f"dks_fanin_forwarded_total {m['forwarded_total']}",
-            "# HELP dks_fanin_replica_errors_total Requests surfaced as a "
-            "replica's mid-request failure.",
-            "# TYPE dks_fanin_replica_errors_total counter",
-            f"dks_fanin_replica_errors_total {m['replica_errors_total']}",
-            "# HELP dks_fanin_retried_connects_total Connect failures "
-            "retried on another replica.",
-            "# TYPE dks_fanin_retried_connects_total counter",
-            f"dks_fanin_retried_connects_total {m['retried_connects_total']}",
-            "# HELP dks_fanin_replica_503_demotions_total Replicas demoted "
-            "after answering 503 (alive but self-declared unserviceable).",
-            "# TYPE dks_fanin_replica_503_demotions_total counter",
-            f"dks_fanin_replica_503_demotions_total "
-            f"{m['replica_503_demotions_total']}",
-            "# HELP dks_fanin_sheds_total Requests shed at the proxy with "
-            "429 because every live replica reported saturation.",
-            "# TYPE dks_fanin_sheds_total counter",
-            f"dks_fanin_sheds_total {m['sheds_total']}",
-            "# HELP dks_fanin_hedges_total Requests re-dispatched to a "
-            "second replica after the hedge delay.",
-            "# TYPE dks_fanin_hedges_total counter",
-            f"dks_fanin_hedges_total {m['hedges_total']}",
-            "# HELP dks_fanin_hedge_wins_total Hedged requests whose "
-            "hedge answered first with a success.",
-            "# TYPE dks_fanin_hedge_wins_total counter",
-            f"dks_fanin_hedge_wins_total {m['hedge_wins_total']}",
-            "# HELP dks_fanin_replica_up Replica liveness by index.",
-            "# TYPE dks_fanin_replica_up gauge",
-        ]
-        lines += [f'dks_fanin_replica_up{{replica="{r.index}",'
-                  f'address="{r.address}"}} {int(r.alive)}'
-                  for r in self.replicas]
-        now = time.monotonic()
-        lines += [
-            "# HELP dks_fanin_replica_saturated Replica currently "
-            "backing off after a 429.",
-            "# TYPE dks_fanin_replica_saturated gauge",
-        ]
-        lines += [f'dks_fanin_replica_saturated{{replica="{r.index}",'
-                  f'address="{r.address}"}} '
-                  f'{int(now < r.saturated_any())}'
-                  for r in self.replicas]
-        return "\n".join(lines) + "\n"
+        # rendered SOLELY by the shared registry (declarations live in
+        # __init__; the catalog in docs/OBSERVABILITY.md)
+        return self.metrics.render()
 
     def _make_handler(self):
         proxy = self
@@ -575,6 +673,10 @@ class FanInProxy:
                 if route == "/metrics":
                     self._reply(200, proxy._render_metrics().encode(),
                                 ctype="text/plain; version=0.0.4")
+                    return
+                if route == "/debugz":
+                    self._reply(200, json.dumps(
+                        proxy._flight.to_payload()).encode())
                     return
                 if route != "/explain":
                     self._reply(404, json.dumps(

@@ -42,6 +42,10 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+import distributedkernelshap_tpu.observability.tracing as _tracing
+from distributedkernelshap_tpu.observability.flightrec import flightrec
+from distributedkernelshap_tpu.observability.metrics import MetricsRegistry
+from distributedkernelshap_tpu.profiling import profiler
 from distributedkernelshap_tpu.scheduling import (
     PRIORITY_CLASSES,
     AdmissionController,
@@ -70,11 +74,13 @@ class _HTTPServer(ThreadingHTTPServer):
 
 class _Pending:
     __slots__ = ("array", "event", "response", "error", "t_enqueued", "done",
-                 "klass", "deadline", "cache_key", "status_code", "cache_hit")
+                 "klass", "deadline", "cache_key", "status_code", "cache_hit",
+                 "trace")
 
     def __init__(self, array: np.ndarray, klass: str = "interactive",
                  deadline: Optional[float] = None,
-                 cache_key: Optional[str] = None):
+                 cache_key: Optional[str] = None,
+                 trace: Optional[_tracing.SpanContext] = None):
         self.array = array
         self.event = threading.Event()
         self.response: Optional[str] = None
@@ -96,6 +102,10 @@ class _Pending:
         # answered from cache (handler fast path, dispatch recheck, or
         # in-batch dedup) — drives the hit/miss counters
         self.cache_hit = False
+        # the request's server-side root span context (None when tracing
+        # is off); the dispatcher/finalizer threads parent queue-wait /
+        # device / finalize spans to it
+        self.trace = trace
 
     @property
     def rows(self) -> int:
@@ -299,24 +309,13 @@ class ExplainerServer:
         self._probe_thread: Optional[threading.Thread] = None
         self._probe_done: Optional[threading.Event] = None
         self._probe_started = 0.0
-        # serving metrics (Prometheus text format at /metrics — beyond the
-        # reference, which exposes no metrics at all, SURVEY.md §5.5); one
-        # lock guards the counters (updated per completed request)
+        # the claim lock: pending.done transitions (watchdog-vs-finalize
+        # races) and their counter updates happen under it, so a request
+        # can never be double-answered or double-counted.  The counters
+        # themselves live in the shared observability registry (each
+        # metric has its own lock; nesting is safe because registry locks
+        # never acquire this one).
         self._metrics_lock = threading.Lock()
-        self._metrics = {"requests_total": 0, "errors_total": 0,
-                         "rows_total": 0, "batches_total": 0,
-                         "request_seconds_sum": 0.0, "wedges_total": 0,
-                         "cache_hits_total": 0, "cache_misses_total": 0}
-        # load-shed counters by reason.  The three admission reasons are
-        # refused before entering the pipeline and do NOT appear in
-        # requests_total; deadline_expired requests were admitted and
-        # answered (504), so they count in BOTH requests_total/errors_total
-        # and here — don't compute goodput as requests_total - sheds_total
-        self._sheds = {"queue_full": 0, "rate_limited": 0,
-                       "projected_wait": 0, "deadline_expired": 0}
-        # bounded request-latency histogram (cumulative counts rendered at
-        # /metrics); one extra slot for +Inf
-        self._latency_counts = [0] * (len(LATENCY_BUCKETS_S) + 1)
         # scheduling subsystem: EDF (or FIFO-baseline) request queue,
         # admission control fed by an EWMA of observed device throughput,
         # optional content-addressed result cache
@@ -332,6 +331,15 @@ class ExplainerServer:
             estimator=self._service_rate) if admission_control else None)
         self._cache = ResultCache(cache_bytes) if cache_bytes else None
         self._faults = fault_injector
+        # observability: every dks_serve_* series is registered here and
+        # /metrics is rendered solely by the registry (one renderer for
+        # the whole process — SURVEY.md §5.5; docs/OBSERVABILITY.md holds
+        # the catalog).  Per-instance, not global: tests run several
+        # servers per process.
+        self.metrics = MetricsRegistry()
+        self._flight = flightrec()
+        self._tracer = _tracing.tracer()
+        self._register_metrics()
         # computed lazily on first request: fingerprinting hashes the
         # background data, and the model may be swapped between __init__
         # and start() in tests.  Staleness is detected by OBJECT IDENTITY:
@@ -358,27 +366,103 @@ class ExplainerServer:
 
     # ------------------------------------------------------------------ #
 
+    def _register_metrics(self) -> None:
+        """Declare every dks_serve_* series on the shared registry.  The
+        names, label sets and HELP strings are byte-compatible with the
+        pre-registry hand-rolled renderer; render order is registration
+        order."""
+
+        reg = self.metrics
+        self._m_requests = reg.counter(
+            "dks_serve_requests_total", "Requests answered.")
+        self._m_errors = reg.counter(
+            "dks_serve_errors_total", "Requests answered with an error.")
+        self._m_rows = reg.counter(
+            "dks_serve_rows_total", "Instance rows explained.")
+        self._m_batches = reg.counter(
+            "dks_serve_batches_total", "Coalesced device batches.")
+        self._m_request_seconds = reg.counter(
+            "dks_serve_request_seconds_sum", "Total queue+explain time.")
+        reg.gauge("dks_serve_pipeline_depth",
+                  "In-flight device batches.").set_function(
+            lambda: self.pipeline_depth or 0)
+        self._m_wedges = reg.counter(
+            "dks_serve_wedges_total", "Watchdog-declared device wedges.")
+        reg.gauge("dks_serve_wedged",
+                  "Whether the server is currently wedged.").set_function(
+            lambda: int(self._wedged.is_set()))
+        reg.gauge("dks_serve_queue_depth",
+                  "Queued requests by priority class.",
+                  labelnames=("class",)).set_function(
+            lambda: {(k,): v
+                     for k, v in sorted(self._sched.depths().items())})
+        # the three admission reasons are refused before entering the
+        # pipeline and do NOT appear in requests_total; deadline_expired
+        # requests were admitted and answered (504), so they count in BOTH
+        # requests_total/errors_total and here — don't compute goodput as
+        # requests_total - sheds_total
+        self._m_sheds = reg.counter(
+            "dks_serve_sheds_total",
+            "Requests shed before dispatch, by reason.",
+            labelnames=("reason",)).seed(
+            "deadline_expired", "projected_wait", "queue_full",
+            "rate_limited")
+        self._m_latency = reg.histogram(
+            "dks_serve_request_latency_seconds",
+            "Queue+explain latency of answered requests.",
+            buckets=LATENCY_BUCKETS_S)
+        if self._cache is not None:
+            self._m_cache_hits = reg.counter(
+                "dks_serve_cache_hits_total",
+                "Requests answered from the result cache (incl. in-batch "
+                "dedup).")
+            self._m_cache_misses = reg.counter(
+                "dks_serve_cache_misses_total",
+                "Requests that cost device work.")
+            reg.gauge("dks_serve_cache_entries",
+                      "Cached explanations.").set_function(
+                lambda: self._cache.stats()["entries"])
+            reg.gauge("dks_serve_cache_bytes",
+                      "Bytes held by the result cache.").set_function(
+                lambda: self._cache.stats()["bytes"])
+            reg.counter("dks_serve_cache_evictions_total",
+                        "LRU evictions under the byte budget.").set_function(
+                lambda: self._cache.stats()["evictions"])
+        # the scheduler registers its own dks_sched_* series (queue wait,
+        # expiries) on the same registry so one page carries everything
+        attach = getattr(self._sched, "attach_metrics", None)
+        if attach is not None:
+            attach(reg)
+        # device-phase time from the per-process profiler, surfaced
+        # without enabling full tracing (callback-sourced: the profiler
+        # owns the truth, the registry renders it)
+        reg.counter("dks_phase_seconds_total",
+                    "Total seconds per engine profiling phase "
+                    "(DKS_PROFILE=1).",
+                    labelnames=("phase",)).set_function(
+            lambda: {(name,): s["total_s"]
+                     for name, s in profiler().summary().items()})
+        reg.counter("dks_phase_count",
+                    "Completed engine profiling phases (DKS_PROFILE=1).",
+                    labelnames=("phase",)).set_function(
+            lambda: {(name,): s["count"]
+                     for name, s in profiler().summary().items()})
+
     def _count_request(self, pending, error=None):
         """Per-request counter accounting, shared by _complete's live loop
         and the handler-side wedge claim so the two can never drift.
         Caller MUST hold ``_metrics_lock``."""
 
-        self._metrics["requests_total"] += 1
-        self._metrics["rows_total"] += pending.array.shape[0]
+        self._m_requests.inc()
+        self._m_rows.inc(pending.array.shape[0])
         if error is not None:
-            self._metrics["errors_total"] += 1
+            self._m_errors.inc()
         elif self._cache is not None:
-            key = "cache_hits_total" if pending.cache_hit \
-                else "cache_misses_total"
-            self._metrics[key] += 1
+            (self._m_cache_hits if pending.cache_hit
+             else self._m_cache_misses).inc()
         elapsed = time.monotonic() - pending.t_enqueued
-        self._metrics["request_seconds_sum"] += elapsed
-        for i, bound in enumerate(LATENCY_BUCKETS_S):
-            if elapsed <= bound:
-                self._latency_counts[i] += 1
-                break
-        else:
-            self._latency_counts[-1] += 1
+        self._m_request_seconds.inc(elapsed)
+        self._m_latency.observe(elapsed)
 
     def _cache_key_for(self, array: np.ndarray) -> Optional[str]:
         if self._cache is None:
@@ -392,8 +476,8 @@ class ExplainerServer:
         return request_cache_key(array, fp)
 
     def _shed(self, reason: str) -> None:
-        with self._metrics_lock:
-            self._sheds[reason] = self._sheds.get(reason, 0) + 1
+        self._m_sheds.inc(reason=reason)
+        self._flight.record("shed", component="server", reason=reason)
 
     def _fail_request(self, pending, error: str, status: int) -> None:
         """Fail one request outside the batch path (deadline expiry): no
@@ -424,7 +508,8 @@ class ExplainerServer:
 
     def _complete(self, batch, payloads=None, error=None, status: int = 500,
                   index_map=None, device_rows: int = 0,
-                  t_dispatch: Optional[float] = None):
+                  t_dispatch: Optional[float] = None,
+                  t_fetch: Optional[float] = None):
         # counters update BEFORE the response events: a client that gets
         # its answer and immediately scrapes /metrics must see itself
         # counted.  Claiming happens under the metrics lock so a batch the
@@ -450,8 +535,10 @@ class ExplainerServer:
                         logger.warning("serving recovered: a previously "
                                        "failed batch's device work completed")
                         self._wedged.clear()
+                        self._flight.record("wedge_recovered",
+                                            component="server")
                 return
-            self._metrics["batches_total"] += 1
+            self._m_batches.inc()
             for _, p in live:
                 self._count_request(p, error)
         with self._active_lock:
@@ -484,6 +571,8 @@ class ExplainerServer:
                 logger.warning("serving recovered: a batch completed after "
                                "the watchdog declared a wedge")
                 self._wedged.clear()
+                self._flight.record("wedge_recovered", component="server")
+        tr = self._tracer
         for i, p in live:
             if error is not None:
                 p.error = error
@@ -492,93 +581,24 @@ class ExplainerServer:
                 p.response = payloads[index_map[i] if index_map else i]
                 if self._cache is not None and p.cache_key is not None:
                     self._cache.put(p.cache_key, p.response)
+            if tr.enabled and p.trace is not None and t_dispatch is not None:
+                # per-request copies of the batch's device/finalize
+                # timings: a batch can mix trace ids, so each request gets
+                # children under ITS root rather than one orphan batch span
+                end_fetch = t_fetch if t_fetch is not None else now
+                tr.record_mono("server.device_explain", t_dispatch,
+                               end_fetch, parent=p.trace,
+                               batch_rows=device_rows,
+                               error=error is not None)
+                tr.record_mono("server.finalize", end_fetch,
+                               time.monotonic(), parent=p.trace)
             p.event.set()
 
     def _render_metrics(self) -> str:
-        with self._metrics_lock:
-            m = dict(self._metrics)
-            sheds = dict(self._sheds)
-            latency_counts = list(self._latency_counts)
-        depths = self._sched.depths()
-        lines = [
-            "# HELP dks_serve_requests_total Requests answered.",
-            "# TYPE dks_serve_requests_total counter",
-            f"dks_serve_requests_total {m['requests_total']}",
-            "# HELP dks_serve_errors_total Requests answered with an error.",
-            "# TYPE dks_serve_errors_total counter",
-            f"dks_serve_errors_total {m['errors_total']}",
-            "# HELP dks_serve_rows_total Instance rows explained.",
-            "# TYPE dks_serve_rows_total counter",
-            f"dks_serve_rows_total {m['rows_total']}",
-            "# HELP dks_serve_batches_total Coalesced device batches.",
-            "# TYPE dks_serve_batches_total counter",
-            f"dks_serve_batches_total {m['batches_total']}",
-            "# HELP dks_serve_request_seconds_sum Total queue+explain time.",
-            "# TYPE dks_serve_request_seconds_sum counter",
-            f"dks_serve_request_seconds_sum {m['request_seconds_sum']:.6f}",
-            "# HELP dks_serve_pipeline_depth In-flight device batches.",
-            "# TYPE dks_serve_pipeline_depth gauge",
-            f"dks_serve_pipeline_depth {self.pipeline_depth or 0}",
-            "# HELP dks_serve_wedges_total Watchdog-declared device wedges.",
-            "# TYPE dks_serve_wedges_total counter",
-            f"dks_serve_wedges_total {m['wedges_total']}",
-            "# HELP dks_serve_wedged Whether the server is currently wedged.",
-            "# TYPE dks_serve_wedged gauge",
-            f"dks_serve_wedged {int(self._wedged.is_set())}",
-            "# HELP dks_serve_queue_depth Queued requests by priority class.",
-            "# TYPE dks_serve_queue_depth gauge",
-        ]
-        lines += [f'dks_serve_queue_depth{{class="{k}"}} {depths.get(k, 0)}'
-                  for k in sorted(depths)]
-        lines += [
-            "# HELP dks_serve_sheds_total Requests shed before dispatch, "
-            "by reason.",
-            "# TYPE dks_serve_sheds_total counter",
-        ]
-        lines += [f'dks_serve_sheds_total{{reason="{r}"}} {sheds[r]}'
-                  for r in sorted(sheds)]
-        lines += [
-            "# HELP dks_serve_request_latency_seconds Queue+explain latency "
-            "of answered requests.",
-            "# TYPE dks_serve_request_latency_seconds histogram",
-        ]
-        cumulative = 0
-        for bound, count in zip(LATENCY_BUCKETS_S, latency_counts):
-            cumulative += count
-            lines.append(f'dks_serve_request_latency_seconds_bucket'
-                         f'{{le="{bound}"}} {cumulative}')
-        cumulative += latency_counts[-1]
-        lines += [
-            f'dks_serve_request_latency_seconds_bucket{{le="+Inf"}} '
-            f'{cumulative}',
-            f"dks_serve_request_latency_seconds_sum "
-            f"{m['request_seconds_sum']:.6f}",
-            f"dks_serve_request_latency_seconds_count {cumulative}",
-        ]
-        if self._cache is not None:
-            cache = self._cache.stats()
-            lines += [
-                "# HELP dks_serve_cache_hits_total Requests answered from "
-                "the result cache (incl. in-batch dedup).",
-                "# TYPE dks_serve_cache_hits_total counter",
-                f"dks_serve_cache_hits_total {m['cache_hits_total']}",
-                "# HELP dks_serve_cache_misses_total Requests that cost "
-                "device work.",
-                "# TYPE dks_serve_cache_misses_total counter",
-                f"dks_serve_cache_misses_total {m['cache_misses_total']}",
-                "# HELP dks_serve_cache_entries Cached explanations.",
-                "# TYPE dks_serve_cache_entries gauge",
-                f"dks_serve_cache_entries {cache['entries']}",
-                "# HELP dks_serve_cache_bytes Bytes held by the result "
-                "cache.",
-                "# TYPE dks_serve_cache_bytes gauge",
-                f"dks_serve_cache_bytes {cache['bytes']}",
-                "# HELP dks_serve_cache_evictions_total LRU evictions "
-                "under the byte budget.",
-                "# TYPE dks_serve_cache_evictions_total counter",
-                f"dks_serve_cache_evictions_total {cache['evictions']}",
-            ]
-        return "\n".join(lines) + "\n"
+        # rendered SOLELY by the shared registry (one renderer for the
+        # whole process; the per-metric declarations live in
+        # _register_metrics and the catalog in docs/OBSERVABILITY.md)
+        return self.metrics.render()
 
     def _split_batch_on_cache(self, batch):
         """Per-batch partial-hit splitting (``scheduling/result_cache.py``):
@@ -631,11 +651,16 @@ class ExplainerServer:
                 # read after batch formation: tests may swap self.model
                 # while the dispatcher is parked in next_batch
                 pipelined = hasattr(self.model, "explain_batch_async")
+                tr = self._tracer
+                t_claim = time.monotonic()
                 for p in expired:
                     # the declared SLO is already missed: answering late
                     # would waste a device slot on a response the client
                     # has abandoned
                     self._shed("deadline_expired")
+                    if tr.enabled and p.trace is not None:
+                        tr.record_mono("server.queue_wait", p.t_enqueued,
+                                       t_claim, parent=p.trace, expired=True)
                     self._fail_request(p, "deadline expired before dispatch "
                                       "(server overloaded)", 504)
                 if not batch:
@@ -650,21 +675,39 @@ class ExplainerServer:
                     self._active[id(live)] = live
                 t_dispatch = time.monotonic()
                 device_rows = sum(sizes)
+                if tr.enabled:
+                    for p in live:
+                        if p.trace is not None:
+                            tr.record_mono("server.queue_wait", p.t_enqueued,
+                                           t_claim, parent=p.trace)
+                            tr.record_mono("server.schedule", t_claim,
+                                           t_dispatch, parent=p.trace,
+                                           batch_requests=len(live))
+                # engine profiling phases fired during the device call
+                # parent to one traced request of the batch (attrs carry
+                # the batch size; a batch can mix trace ids)
+                batch_ctx = next((p.trace for p in leaders
+                                  if p.trace is not None), None) \
+                    if tr.enabled else None
                 try:
                     stacked = np.concatenate([p.array for p in leaders],
                                              axis=0)
                     if pipelined:
-                        finalize = self.model.explain_batch_async(
-                            stacked, split_sizes=sizes)
+                        with _tracing.use_context(batch_ctx):
+                            finalize = self.model.explain_batch_async(
+                                stacked, split_sizes=sizes)
                         self._inflight.put((live, finalize, index_map,
-                                            device_rows, t_dispatch))
+                                            device_rows, t_dispatch,
+                                            batch_ctx))
                     else:
+                        with _tracing.use_context(batch_ctx):
+                            payloads = self.model.explain_batch(
+                                stacked, split_sizes=sizes)
                         self._complete(
-                            live,
-                            self.model.explain_batch(stacked,
-                                                     split_sizes=sizes),
+                            live, payloads,
                             index_map=index_map, device_rows=device_rows,
-                            t_dispatch=t_dispatch)
+                            t_dispatch=t_dispatch,
+                            t_fetch=time.monotonic())
                 except Exception as e:  # surface errors to waiting requests
                     logger.exception("explain batch failed")
                     self._complete(live, error=str(e))
@@ -679,14 +722,17 @@ class ExplainerServer:
 
         while not (self._dispatch_done.is_set() and self._inflight.empty()):
             try:
-                (batch, finalize, index_map,
-                 device_rows, t_dispatch) = self._inflight.get(timeout=0.1)
+                (batch, finalize, index_map, device_rows,
+                 t_dispatch, batch_ctx) = self._inflight.get(timeout=0.1)
             except queue.Empty:
                 continue
             try:
-                self._complete(batch, finalize(), index_map=index_map,
+                with _tracing.use_context(batch_ctx):
+                    payloads = finalize()
+                self._complete(batch, payloads, index_map=index_map,
                                device_rows=device_rows,
-                               t_dispatch=t_dispatch)
+                               t_dispatch=t_dispatch,
+                               t_fetch=time.monotonic())
             except Exception as e:
                 logger.exception("finalize batch failed")
                 self._complete(batch, error=str(e))
@@ -729,8 +775,10 @@ class ExplainerServer:
                 "%.0f s; failing them and marking the server wedged",
                 len(active), stalled_s)
             self._wedged.set()
-            with self._metrics_lock:
-                self._metrics["wedges_total"] += 1
+            self._m_wedges.inc()
+            self._flight.record("wedge", component="server",
+                                stalled_s=round(stalled_s, 1),
+                                in_flight_batches=len(active))
             msg = (f"device call exceeded the {limit:.0f}s "
                    f"watchdog timeout; server marked unhealthy")
             for batch in active:
@@ -819,6 +867,11 @@ class ExplainerServer:
 
             def _reply(self, code: int, body: str, ctype="application/json",
                        headers=None):
+                # the request's root span (set only on the /explain route)
+                # ends with the reply, whatever branch produced it
+                span = self.__dict__.pop("_dks_root", None)
+                if span is not None:
+                    server._tracer.end(span, status=code)
                 data = body.encode()
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
@@ -838,6 +891,9 @@ class ExplainerServer:
                 action = (server._faults.fire("server.explain")
                           if server._faults is not None else None)
                 if action == "drop":
+                    span = self.__dict__.pop("_dks_root", None)
+                    if span is not None:
+                        server._tracer.end(span, status=0, dropped=True)
                     self.close_connection = True
                     return
                 if action != "corrupt":
@@ -847,6 +903,9 @@ class ExplainerServer:
                     corrupt_payload,
                 )
 
+                span = self.__dict__.pop("_dks_root", None)
+                if span is not None:
+                    server._tracer.end(span, status=200, corrupt=True)
                 # raw-bytes variant of _reply: the garbled payload is not
                 # valid text, so it cannot round-trip through str
                 data = corrupt_payload(body.encode())
@@ -866,6 +925,11 @@ class ExplainerServer:
                     self._reply(200, server._render_metrics(),
                                 ctype="text/plain; version=0.0.4")
                     return
+                if route == "/debugz":
+                    # the flight recorder's ring: bounded, thread-safe, the
+                    # first artifact to pull when a chaos run goes sideways
+                    self._reply(200, json.dumps(server._flight.to_payload()))
+                    return
                 if route != "/explain":
                     self._reply(404, json.dumps({"error": "unknown route"}))
                     return
@@ -876,6 +940,16 @@ class ExplainerServer:
                 except (KeyError, ValueError, json.JSONDecodeError) as e:
                     self._reply(400, json.dumps({"error": f"bad request: {e}"}))
                     return
+                tr = server._tracer
+                if tr.enabled:
+                    # the request's root span, parented to whatever the
+                    # client/proxy minted (X-DKS-Trace); ends in _reply
+                    self._dks_root = tr.begin(
+                        "server.request",
+                        parent=_tracing.parse_trace_header(
+                            self.headers.get(_tracing.TRACE_HEADER)),
+                        rows=int(array.shape[0]))
+                t_admit0 = time.monotonic()
                 # chaos harness site: body parsed, nothing dispatched yet
                 # (crash/hang/slow before any device work; a drop here is a
                 # pre-dispatch connection loss — safe for the proxy to retry)
@@ -927,8 +1001,11 @@ class ExplainerServer:
                         "error": f"request of {array.shape[0]} rows exceeds "
                                  f"this deployment's max_rows={max_rows}"}))
                     return
+                root = self.__dict__.get("_dks_root")
                 pending = _Pending(array, klass=klass, deadline=deadline,
-                                   cache_key=server._cache_key_for(array))
+                                   cache_key=server._cache_key_for(array),
+                                   trace=root.context if root is not None
+                                   else None)
                 # cache fast path: a duplicate of an already-served request
                 # is answered bit-identically without queueing at all
                 if pending.cache_key is not None:
@@ -961,6 +1038,12 @@ class ExplainerServer:
                         "retry_after_s": round(decision.retry_after_s, 3)}),
                         headers={"Retry-After": str(retry_s)})
                     return
+                if root is not None:
+                    # header parse + wedge/size checks + admission gates,
+                    # i.e. everything between body parse and enqueue
+                    tr.record_mono("server.admission", t_admit0,
+                                   time.monotonic(), parent=root.context,
+                                   klass=klass)
                 server._sched.put(pending)
                 # re-check shutdown/wedge periodically so in-flight requests
                 # fail fast instead of hanging on a dead dispatcher

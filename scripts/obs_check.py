@@ -1,0 +1,172 @@
+"""Observability drift linter (``make obs-check``).
+
+New metrics must not drift undocumented and must not bypass the central
+registry.  Three checks, exit 1 on any failure:
+
+1. **Catalog diff** — the live registries' self-description (every
+   ``dks_*`` series the server, fan-in proxy, scheduler and profiler
+   register) must match the metric catalog table in
+   ``docs/OBSERVABILITY.md`` exactly: same names, same types, same label
+   sets, both directions.
+2. **Literal scan** — every metric-shaped string literal
+   (``dks_serve_*`` / ``dks_fanin_*`` / ``dks_sched_*`` / ``dks_phase_*``)
+   anywhere in the repo's Python sources must name a registered metric
+   (benchmarks and tests may READ metrics by name; they must not invent
+   series the registry doesn't own).
+3. **Renderer scan** — no Prometheus exposition rendering (``# HELP`` /
+   ``# TYPE`` string literals) outside ``observability/metrics.py``: the
+   registry is the ONE renderer.
+
+Run ``python scripts/obs_check.py --print-catalog`` to emit the markdown
+table for the docs after adding a metric.
+"""
+
+import argparse
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+DOCS_PATH = os.path.join(REPO_ROOT, "docs", "OBSERVABILITY.md")
+
+#: metric-shaped literals; deliberately NOT bare ``dks_`` — env knobs
+#: (DKS_TRACE), header names and file paths share the prefix
+_LITERAL_RE = re.compile(r"dks_(?:serve|fanin|sched|phase)_[a-z0-9_]+")
+
+#: directories never scanned for literals/renderers
+_SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "results", "data",
+              "assets", "images"}
+
+
+def live_catalog():
+    """Instantiate the real components and collect their registries'
+    self-description — the ground truth the docs are diffed against."""
+
+    from distributedkernelshap_tpu.serving.replicas import FanInProxy
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    class _StubModel:
+        pass
+
+    # cache enabled so the conditional cache series register; neither
+    # component is start()ed — registration happens in __init__
+    server = ExplainerServer(_StubModel(), cache_bytes=1024)
+    proxy = FanInProxy([("127.0.0.1", 1)])
+    described = server.metrics.describe() + proxy.metrics.describe()
+    return {d["name"]: d for d in described}
+
+
+def docs_catalog():
+    """Parse the metric catalog table out of docs/OBSERVABILITY.md:
+    ``| name | type | labels | help |`` rows."""
+
+    if not os.path.exists(DOCS_PATH):
+        return None
+    catalog = {}
+    with open(DOCS_PATH, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line.startswith("| `dks_"):
+                continue
+            cells = [c.strip() for c in line.strip("|").split("|")]
+            if len(cells) < 3:
+                continue
+            name = cells[0].strip("`")
+            labels = [] if cells[2] in ("", "—", "-") else \
+                [c.strip().strip("`") for c in cells[2].split(",")]
+            catalog[name] = {"name": name, "type": cells[1],
+                             "labels": labels}
+    return catalog
+
+
+def iter_py_files():
+    for dirpath, dirnames, filenames in os.walk(REPO_ROOT):
+        dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+        for name in filenames:
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def sample_names(catalog):
+    """Registered series names plus the derived histogram sample names."""
+
+    names = set(catalog)
+    for name, d in catalog.items():
+        if d["type"] == "histogram":
+            names.update({name + s for s in ("_bucket", "_sum", "_count")})
+    return names
+
+
+def check(verbose=True):
+    problems = []
+    live = live_catalog()
+
+    docs = docs_catalog()
+    if docs is None:
+        problems.append(f"missing {DOCS_PATH}")
+    else:
+        for name, d in sorted(live.items()):
+            doc = docs.get(name)
+            if doc is None:
+                problems.append(f"undocumented metric: {name} "
+                                f"(add it to docs/OBSERVABILITY.md)")
+            elif doc["type"] != d["type"]:
+                problems.append(f"{name}: docs say type {doc['type']}, "
+                                f"registry says {d['type']}")
+            elif doc["labels"] != list(d["labels"]):
+                problems.append(f"{name}: docs say labels {doc['labels']}, "
+                                f"registry says {list(d['labels'])}")
+        for name in sorted(set(docs) - set(live)):
+            problems.append(f"documented but not registered: {name} "
+                            f"(stale docs/OBSERVABILITY.md row?)")
+
+    legal = sample_names(live)
+    this_file = os.path.abspath(__file__)
+    for path in iter_py_files():
+        if os.path.abspath(path) == this_file:
+            continue
+        rel = os.path.relpath(path, REPO_ROOT)
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            source = fh.read()
+        for m in sorted(set(_LITERAL_RE.findall(source))):
+            if m not in legal:
+                problems.append(f"{rel}: dks_ literal {m!r} is not a "
+                                f"registered metric (emit it through the "
+                                f"observability registry)")
+        if "observability" not in rel.replace(os.sep, "/"):
+            if "# HELP" in source or "# TYPE" in source:
+                problems.append(f"{rel}: hand-rolled exposition rendering "
+                                f"('# HELP'/'# TYPE' literal) outside the "
+                                f"registry")
+    if verbose:
+        for p in problems:
+            print(f"obs-check: {p}")
+        print(f"obs-check: {len(live)} registered metrics, "
+              f"{len(problems)} problem(s)")
+    return problems
+
+
+def print_catalog():
+    live = live_catalog()
+    print("| metric | type | labels | description |")
+    print("| --- | --- | --- | --- |")
+    for name, d in sorted(live.items()):
+        labels = ", ".join(f"`{ln}`" for ln in d["labels"]) or "—"
+        print(f"| `{name}` | {d['type']} | {labels} | {d['help']} |")
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--print-catalog", action="store_true",
+                        help="emit the docs markdown table and exit")
+    args = parser.parse_args()
+    if args.print_catalog:
+        print_catalog()
+        return 0
+    return 1 if check() else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

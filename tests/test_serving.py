@@ -782,8 +782,7 @@ def test_metrics_expose_wedge_counters(model_setup):
         assert "dks_serve_wedged 0" in text
         # simulate the watchdog's declaration + a later recovery
         srv._wedged.set()
-        with srv._metrics_lock:
-            srv._metrics["wedges_total"] += 1
+        srv._m_wedges.inc()
         text = scrape()
         assert "dks_serve_wedges_total 1" in text
         assert "dks_serve_wedged 1" in text
