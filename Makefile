@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test tier1 collect fuzz bench configs serve sweep-pool sweep-serve analysis multihost-ci sched-bench chaos-bench obs-check
+.PHONY: test tier1 collect fuzz bench configs serve sweep-pool sweep-serve analysis multihost-ci sched-bench chaos-bench obs-check health-check perf-gate
 
 multihost-ci:    ## multi-host validation: 2-proc pool/phi/interactions, 4-proc 2x2 mesh, 2-proc serve (one JSON line, rc 0/1)
 	$(PY) benchmarks/multihost_ci.py
@@ -26,6 +26,12 @@ chaos-bench:     ## chaos scenario: kill-one-replica + slow-replica serving (zer
 
 obs-check:       ## observability drift lint: registry vs docs/OBSERVABILITY.md catalog, stray dks_ literals, ad-hoc exposition renderers
 	env JAX_PLATFORMS=cpu $(PY) scripts/obs_check.py
+
+health-check:    ## alert-engine golden test: replay the committed SLO fixture, assert pending->firing->resolved at the golden timestamps
+	env JAX_PLATFORMS=cpu $(PY) scripts/health_check.py
+
+perf-gate:       ## perf-regression gate: newest recorded benchmark runs vs their trailing same-config baselines (results/perf_history.jsonl)
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/regression_gate.py --check
 
 fuzz:            ## 3x fresh-seed hypothesis property sweeps (new examples per run)
 	for i in 1 2 3; do \

@@ -426,6 +426,11 @@ def main():
              "the merged client+proxy+replica span trace here as JSONL "
              "(plus <path>.perfetto.json); with --check, also asserts one "
              "request is followable end to end by shared trace id")
+    parser.add_argument("--history", default=None,
+                        help="perf-history JSONL this run appends to "
+                             "(default: results/perf_history.jsonl)")
+    parser.add_argument("--no-record", action="store_true",
+                        help="skip the perf-history self-record")
     # subprocess mode (internal): one journaled pool run
     parser.add_argument("--pool-run", action="store_true",
                         help=argparse.SUPPRESS)
@@ -488,6 +493,22 @@ def main():
         })
     report["checks"] = checks
     report["ok"] = bool(checks) and all(checks.values())
+    if not args.no_record and "serve" in report:
+        # perf-history self-record (benchmarks/regression_gate.py): the
+        # serve scenario's wall clock is this bench's headline number
+        from benchmarks.regression_gate import DEFAULT_HISTORY, record_run
+
+        entry = record_run(
+            args.history or DEFAULT_HISTORY, bench="chaos",
+            # traced runs pay span-recording + JSONL-flush overhead in
+            # their wall clock — a different measurement, so a
+            # different fingerprint (and baseline)
+            config={"requests": args.requests, "scenario": "serve_chaos",
+                    "traced": bool(args.trace_out)},
+            metrics={"wall_s": report["serve"]["wall_s"]},
+            extra={"checks_ok": report["ok"]})
+        report["perf_history"] = {"git_sha": entry["git_sha"],
+                                  "config_fp": entry["config_fp"]}
     print(json.dumps(report))
     if args.check and not report["ok"]:
         return 1

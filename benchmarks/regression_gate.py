@@ -1,0 +1,288 @@
+"""Perf-regression gate over an append-only benchmark history.
+
+The paper's contribution IS a wall-clock number (2560-instance Adult:
+1736.89 s sequential → 125.05 s on 32 workers), and every serving PR
+ships with a measured benchmark — but until now nothing compared one
+run against the last: a commit could quietly regress `scheduling_bench`
+by 25% and every later run would just re-print the new, slower number.
+This module closes the loop:
+
+* **history** — every measured run appends one JSON line to
+  ``results/perf_history.jsonl``: benchmark name, git SHA, a
+  **config fingerprint** (sha256 over the canonical JSON of the knobs
+  that shape the measurement — request counts, overload factor, batch
+  sizes), and the run's headline metrics (wall seconds, p99s, goodput).
+  ``scheduling_bench`` and ``chaos_bench`` self-record on every measured
+  run, so the history accretes without anyone remembering to write it.
+* **gate** — ``python benchmarks/regression_gate.py --check`` compares
+  the newest run of each benchmark against a **trailing baseline**: the
+  median of the last N prior runs with the SAME benchmark AND config
+  fingerprint (a config change starts a fresh baseline instead of
+  producing a false regression).  The gate fails when the newest run's
+  wall time exceeds the baseline median by more than
+  ``--max-wall-regression`` (default 20%) or any ``*p99_s`` metric by
+  ``--max-p99-regression`` (default 50% — a p99 over a few dozen
+  requests is one order statistic and noisy).  Lower-is-better only: a
+  run that got FASTER never fails, it just tightens the next baseline.
+
+First runs (no baseline yet) pass with a note — a gate that fails on an
+empty history would block the first measurement forever.
+
+    python benchmarks/regression_gate.py --check
+    python benchmarks/regression_gate.py --record '{"bench": ...}'
+    make perf-gate
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_HISTORY = os.path.join(REPO_ROOT, "results", "perf_history.jsonl")
+
+#: default regression thresholds (fractions over the baseline median);
+#: wall time is tight, p99 deliberately loose — a p99 over a few dozen
+#: open-loop requests is a single order statistic (measured run-to-run
+#: spread ~±30%), so a tight p99 gate would page on noise
+MAX_WALL_REGRESSION = 0.20
+MAX_P99_REGRESSION = 0.50
+
+#: trailing runs folded into the baseline median
+BASELINE_N = 5
+
+
+def config_fingerprint(config: Dict) -> str:
+    """Stable hash of the measurement-shaping knobs: runs are only
+    comparable when these match."""
+
+    canonical = json.dumps(config, sort_keys=True, separators=(",", ":"),
+                           default=repr)
+    return hashlib.sha256(canonical.encode()).hexdigest()[:16]
+
+
+def git_sha() -> str:
+    env = os.environ.get("DKS_GIT_SHA")
+    if env:
+        return env
+    try:
+        out = subprocess.run(["git", "rev-parse", "--short=12", "HEAD"],
+                             cwd=REPO_ROOT, capture_output=True, text=True,
+                             timeout=10)
+        if out.returncode == 0:
+            return out.stdout.strip()
+    except (OSError, subprocess.TimeoutExpired):
+        pass
+    return "unknown"
+
+
+def record_run(history_path: str, bench: str, config: Dict,
+               metrics: Dict[str, float],
+               extra: Optional[Dict] = None) -> Dict:
+    """Append one run to the history (fsync'd, one JSON line) and return
+    the entry.  ``metrics`` should carry ``wall_s`` plus any ``*p99_s``
+    series the gate should watch."""
+
+    entry = {
+        "ts": time.time(),
+        "bench": bench,
+        "git_sha": git_sha(),
+        "config": config,
+        "config_fp": config_fingerprint(config),
+        "metrics": {k: float(v) for k, v in metrics.items()
+                    if v is not None},
+    }
+    if extra:
+        entry["extra"] = extra
+    os.makedirs(os.path.dirname(os.path.abspath(history_path)),
+                exist_ok=True)
+    with open(history_path, "a", encoding="utf-8") as fh:
+        fh.write(json.dumps(entry) + "\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+    return entry
+
+
+def load_history(history_path: str) -> List[Dict]:
+    """All parseable entries, file order (== chronological for an
+    append-only file).  A torn trailing line — a run killed mid-append —
+    is skipped, like the shard journal's torn-tail rule."""
+
+    if not os.path.exists(history_path):
+        return []
+    entries = []
+    with open(history_path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(doc, dict) and "bench" in doc \
+                    and "metrics" in doc:
+                entries.append(doc)
+    return entries
+
+
+def _median(values: List[float]) -> float:
+    ordered = sorted(values)
+    n = len(ordered)
+    mid = n // 2
+    return (ordered[mid] if n % 2
+            else (ordered[mid - 1] + ordered[mid]) / 2.0)
+
+
+def _threshold_for(metric: str, max_wall: float,
+                   max_p99: float) -> Optional[float]:
+    if metric == "wall_s":
+        return max_wall
+    if metric.endswith("p99_s"):
+        return max_p99
+    return None  # informational metric: recorded, never gated
+
+
+def gate_bench(entries: List[Dict], newest: Optional[Dict] = None,
+               max_wall: float = MAX_WALL_REGRESSION,
+               max_p99: float = MAX_P99_REGRESSION,
+               baseline_n: int = BASELINE_N) -> Dict:
+    """Gate one run (default: the benchmark's newest entry) against the
+    median of the last ``baseline_n`` PRIOR runs sharing its config
+    fingerprint.  ``entries`` are one benchmark's runs, chronological."""
+
+    if newest is None:
+        newest = entries[-1]
+    prior = entries[:entries.index(newest)]
+    # a run whose OWN acceptance checks failed (timeouts, lost requests)
+    # carries an inflated wall — folding it into the median would shift
+    # the baseline up and mask a later genuine regression, so failed
+    # runs are recorded (history stays honest) but never baseline
+    baseline_pool = [
+        e for e in prior
+        if e.get("config_fp") == newest.get("config_fp")
+        and e.get("extra", {}).get("checks_ok") is not False]
+    baseline = baseline_pool[-baseline_n:]
+    result = {
+        "bench": newest["bench"],
+        "git_sha": newest.get("git_sha"),
+        "config_fp": newest.get("config_fp"),
+        "baseline_runs": len(baseline),
+        "comparisons": {},
+        "ok": True,
+    }
+    if not baseline:
+        result["note"] = ("no prior run with this config fingerprint — "
+                          "recorded as the new baseline")
+        return result
+    for metric, value in sorted(newest["metrics"].items()):
+        threshold = _threshold_for(metric, max_wall, max_p99)
+        if threshold is None:
+            continue
+        base_values = [e["metrics"][metric] for e in baseline
+                       if metric in e["metrics"]]
+        if not base_values:
+            continue
+        base = _median(base_values)
+        if base <= 0:
+            continue
+        ratio = value / base
+        regressed = ratio > 1.0 + threshold
+        result["comparisons"][metric] = {
+            "value": round(value, 4), "baseline_median": round(base, 4),
+            "ratio": round(ratio, 4), "threshold": 1.0 + threshold,
+            "regressed": regressed,
+        }
+        if regressed:
+            result["ok"] = False
+    return result
+
+
+def gate(history_path: str, bench: Optional[str] = None,
+         max_wall: float = MAX_WALL_REGRESSION,
+         max_p99: float = MAX_P99_REGRESSION,
+         baseline_n: int = BASELINE_N, recent_n: int = 10) -> Dict:
+    """Gate every benchmark in the history (or just ``bench``): for each
+    benchmark, the newest run of EVERY config fingerprint appearing in
+    its trailing ``recent_n`` entries is gated — gating only the single
+    newest entry would let one differently-configured run (a fresh
+    fingerprint, hence a free pass) bury a recorded regression in the
+    run just before it.  ``ok`` is the AND across all gated runs; an
+    empty history passes with a note (nothing measured yet = nothing
+    regressed)."""
+
+    entries = load_history(history_path)
+    if bench is not None:
+        entries = [e for e in entries if e["bench"] == bench]
+    by_bench: Dict[str, List[Dict]] = {}
+    for e in entries:
+        by_bench.setdefault(e["bench"], []).append(e)
+    results = []
+    for _, runs in sorted(by_bench.items()):
+        newest_per_fp: Dict[str, Dict] = {}
+        for e in runs[-recent_n:]:
+            newest_per_fp[e.get("config_fp")] = e
+        for e in sorted(newest_per_fp.values(), key=runs.index):
+            results.append(gate_bench(runs, newest=e, max_wall=max_wall,
+                                      max_p99=max_p99,
+                                      baseline_n=baseline_n))
+    report = {
+        "history": history_path,
+        "entries": len(entries),
+        "benches": results,
+        "ok": all(r["ok"] for r in results),
+    }
+    if not entries:
+        report["note"] = "empty history: nothing to gate"
+    return report
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--history", default=DEFAULT_HISTORY,
+                        help="perf-history JSONL path")
+    parser.add_argument("--bench", default=None,
+                        help="gate only this benchmark name")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 on any regression")
+    parser.add_argument("--record", default=None, metavar="JSON",
+                        help="append one entry: a JSON object with "
+                             "bench/config/metrics keys (synthetic "
+                             "entries for testing the gate)")
+    parser.add_argument("--max-wall-regression", type=float,
+                        default=MAX_WALL_REGRESSION,
+                        help="allowed wall_s increase over baseline "
+                             "median (fraction)")
+    parser.add_argument("--max-p99-regression", type=float,
+                        default=MAX_P99_REGRESSION,
+                        help="allowed *p99_s increase over baseline "
+                             "median (fraction)")
+    parser.add_argument("--baseline-n", type=int, default=BASELINE_N,
+                        help="trailing runs in the baseline median")
+    args = parser.parse_args()
+
+    if args.record is not None:
+        doc = json.loads(args.record)
+        entry = record_run(args.history, doc["bench"],
+                           doc.get("config", {}), doc["metrics"],
+                           extra=doc.get("extra"))
+        print(json.dumps(entry))
+        return 0
+
+    report = gate(args.history, bench=args.bench,
+                  max_wall=args.max_wall_regression,
+                  max_p99=args.max_p99_regression,
+                  baseline_n=args.baseline_n)
+    print(json.dumps(report))
+    if args.check and not report["ok"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

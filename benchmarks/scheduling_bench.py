@@ -1,8 +1,10 @@
 """Scheduling subsystem benchmark: tail latency + goodput under overload,
-SLO scheduler vs. the FIFO baseline, plus result-cache effectiveness
-(standalone, CPU backend, exits nonzero on ``--check`` fail).
+SLO scheduler vs. the FIFO baseline, result-cache effectiveness, the SLO
+health engine's burn-rate alert under a real flood, and the sampler's
+serve-path overhead (standalone, CPU backend, exits nonzero on
+``--check`` fail).
 
-Three measurements, one JSON line:
+Five measurements, one JSON line:
 
 1. **Overload A/B** — an open-loop arrival stream (requests fired on a
    fixed schedule regardless of completions, the honest way to measure an
@@ -17,6 +19,18 @@ Three measurements, one JSON line:
 2. **Cache** — a ≥90%-duplicate workload against a REAL (small) KernelShap
    model with the content-addressed cache enabled: ≥80% hit rate,
    bit-identical payloads for duplicate rows, additivity intact.
+3. **SLO alert lifecycle** — the same flood against a FIFO server with a
+   fast-window interactive-latency SLO: the burn-rate alert must go
+   pending → firing during the flood and resolve after it, visible on
+   ``/statusz``, on the flight-recorder timeline, and as
+   ``dks_alerts_firing`` on ``/metrics``.
+4. **Sampler overhead** — identical closed-loop serial runs with the
+   health sampler off vs on (drift-symmetric off/on/on/off order,
+   best-of-two per arm); the sampler must cost ≤1% wall time on the
+   serve path.
+5. Every measured run **self-records** into the perf history
+   (``benchmarks/regression_gate.py``; disable with ``--no-record``),
+   so ``make perf-gate`` can fail a commit that regresses this bench.
 
     JAX_PLATFORMS=cpu python benchmarks/scheduling_bench.py --check
 """
@@ -198,6 +212,185 @@ def build_overload_plan(n_requests, rate_rps, interactive_frac,
 
 
 # --------------------------------------------------------------------- #
+# phase 3: SLO burn-rate alert lifecycle under a real flood
+# --------------------------------------------------------------------- #
+
+
+def _get(server, path):
+    conn = http.client.HTTPConnection(server.host, server.port, timeout=30)
+    try:
+        conn.request("GET", path)
+        return conn.getresponse().read().decode()
+    finally:
+        conn.close()
+
+
+def run_slo_alert_phase(n_requests=220, overload=2.0, poll_s=0.15,
+                        resolve_timeout_s=30.0):
+    """Flood a FIFO server carrying a fast-window interactive-latency SLO
+    and watch the burn-rate alert's full lifecycle from the outside:
+    ``/statusz?format=json`` polls, ``/metrics`` gauge polls, and the
+    flight-recorder timeline at ``/debugz``.
+
+    The windows are deliberately short (8 s long / 2 s short, for 0.4 s,
+    keep-firing 1 s) so the lifecycle fits a benchmark run; production
+    defaults live in ``observability/slo.py``."""
+
+    from distributedkernelshap_tpu.observability.alerts import slo_burn_rule
+    from distributedkernelshap_tpu.observability.slo import (
+        BurnRateWindow,
+        LatencySLO,
+    )
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    model = SyntheticModel()
+    capacity_rps = 8 / (model.base_s + 8 * model.per_row_s)
+    slo = LatencySLO(
+        "interactive_latency_fast",
+        histogram="dks_serve_class_latency_seconds",
+        labels={"class": "interactive"}, threshold_s=0.5, target=0.9,
+        windows=(BurnRateWindow(long_s=8.0, short_s=2.0, factor=2.0),),
+        description="bench-fast interactive latency SLO")
+    rule = slo_burn_rule(slo, for_s=0.4, keep_firing_s=1.0)
+    server = ExplainerServer(
+        model, host="127.0.0.1", port=0, max_batch_size=8,
+        batch_timeout_s=0.004, scheduling="fifo", admission_control=False,
+        health_interval_s=0.2, slos=[slo], alert_rules=[rule]).start()
+
+    statusz_states, gauge_values = [], []
+    stop_poll = threading.Event()
+    gauge_name = f'dks_alerts_firing{{rule="{rule.name}"}}'
+
+    def poll():
+        while not stop_poll.is_set():
+            try:
+                doc = json.loads(_get(server, "/statusz?format=json"))
+                statusz_states.append(doc["alerts"][0]["state"])
+                gauge_values.append(
+                    scrape_metrics(server).get(gauge_name, 0.0))
+            except (OSError, http.client.HTTPException, ValueError,
+                    KeyError, IndexError):
+                # a torn response under the deliberate flood must not
+                # kill the poller (and with it the lifecycle checks)
+                pass
+            time.sleep(poll_s)
+
+    poller = threading.Thread(target=poll, daemon=True)
+    poller.start()
+    try:
+        plan = build_overload_plan(n_requests, capacity_rps * overload,
+                                   0.4, 800, 6, seed=1)
+        t0 = time.monotonic()
+        open_loop(server, plan)
+        flood_wall = time.monotonic() - t0
+        # the alert must now resolve: the short window drains, the
+        # condition goes false, keep_firing elapses
+        deadline = time.monotonic() + resolve_timeout_s
+        resolved = False
+        while time.monotonic() < deadline:
+            try:
+                doc = json.loads(_get(server, "/statusz?format=json"))
+                state = doc["alerts"][0]["state"]
+            except (OSError, http.client.HTTPException, ValueError,
+                    KeyError, IndexError):
+                # a torn response while the flood drains must fail the
+                # resolve CHECK at worst, never crash the bench
+                state = None
+            if state == "inactive":
+                resolved = True
+                break
+            time.sleep(poll_s)
+        stop_poll.set()
+        poller.join(timeout=5)
+        debug = json.loads(_get(server, "/debugz"))
+        statusz_json = json.loads(_get(server, "/statusz?format=json"))
+        # the gauge AFTER resolution (the poller's last sample predates it)
+        gauge_final = scrape_metrics(server).get(gauge_name, 0.0)
+    finally:
+        stop_poll.set()
+        server.stop()
+
+    flight_states = [e["state"] for e in debug["events"]
+                     if e["kind"] == "alert" and e.get("rule") == rule.name]
+    return {
+        "flood_wall_s": round(flood_wall, 2),
+        "statusz_states_seen": sorted(set(statusz_states)),
+        "flightrec_transitions": flight_states,
+        "gauge_max": max(gauge_values, default=0.0),
+        "gauge_final": gauge_final,
+        "resolved_after_flood": resolved,
+        "final_budget_remaining": statusz_json["slos"][0][
+            "budget_remaining"],
+    }
+
+
+# --------------------------------------------------------------------- #
+# phase 4: health-sampler overhead on the serve path
+# --------------------------------------------------------------------- #
+
+
+def run_sampler_overhead(n_requests=36, rows_per_request=10, warmup=6):
+    """Identical closed-loop serial runs (deterministic device time:
+    ``n_requests`` batches of ``base + rows*per_row`` seconds) with the
+    sampler off vs on; the sampler must cost ≤1% wall time on the serve
+    path.  The sampler is one thread copying ~20 metric dicts per tick,
+    so its true cost is microseconds — the measurement discipline exists
+    to keep host noise from swamping that: the compared statistic is the
+    MEDIAN per-request latency (a run's wall clock is dominated by a few
+    scheduler-hiccup outliers unrelated to the sampler), each arm runs
+    twice in drift-symmetric order (off,on,on,off) taking the better
+    median, a throwaway run warms the process first, and per-run warmup
+    requests warm each server."""
+
+    import statistics
+
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    def one_run(interval: float):
+        # max_batch_size=1: serial closed-loop traffic never coalesces,
+        # and a batch size of 1 skips the fill wait entirely — the run is
+        # sleep-dominated (device model) instead of timer-jitter-dominated
+        server = ExplainerServer(
+            SyntheticModel(), host="127.0.0.1", port=0,
+            max_batch_size=1, scheduling="slo", admission_control=False,
+            health_interval_s=interval).start()
+        try:
+            rng = np.random.default_rng(7)
+            arrays = rng.normal(
+                size=(warmup + n_requests, rows_per_request, 6)).astype(
+                np.float32)
+            latencies = []
+            for i in range(warmup + n_requests):
+                t0 = time.monotonic()
+                status, _ = _post(server.host, server.port, arrays[i],
+                                  {}, timeout=60)
+                assert status == 200, status
+                if i >= warmup:
+                    latencies.append(time.monotonic() - t0)
+            return statistics.median(latencies), sum(latencies)
+        finally:
+            server.stop()
+
+    one_run(0.0)  # throwaway: the first server in a process runs slow
+    meds = {"off": [], "on": []}
+    walls = {"off": [], "on": []}
+    for label, interval in (("off", 0.0), ("on", 0.5),
+                            ("on", 0.5), ("off", 0.0)):
+        med, wall = one_run(interval)
+        meds[label].append(med)
+        walls[label].append(wall)
+    med_off, med_on = min(meds["off"]), min(meds["on"])
+    overhead = max(0.0, (med_on - med_off) / med_off)
+    return {
+        "wall_off_s": round(min(walls["off"]), 3),
+        "wall_on_s": round(min(walls["on"]), 3),
+        "median_request_off_s": round(med_off, 5),
+        "median_request_on_s": round(med_on, 5),
+        "overhead_frac": round(overhead, 4),
+    }
+
+
+# --------------------------------------------------------------------- #
 # phase 2: cache effectiveness on a real model
 # --------------------------------------------------------------------- #
 
@@ -286,6 +479,12 @@ def main():
     parser.add_argument("--interactive_deadline_ms", type=float, default=800)
     parser.add_argument("--check", action="store_true",
                         help="exit 1 unless the acceptance criteria hold")
+    parser.add_argument("--history", default=None,
+                        help="perf-history JSONL this run appends to "
+                             "(default: benchmarks/regression_gate.py's "
+                             "results/perf_history.jsonl)")
+    parser.add_argument("--no-record", action="store_true",
+                        help="skip the perf-history self-record")
     args = parser.parse_args()
 
     # measured capacity of the synthetic model at full batching:
@@ -300,11 +499,14 @@ def main():
     fifo = run_overload_arm("fifo", plan, args.requests)
     slo = run_overload_arm("slo", plan, args.requests)
     cache = run_cache_phase()
+    alert = run_slo_alert_phase()
+    sampler = run_sampler_overhead()
 
     fifo_p99 = (fifo.get("interactive") or {}).get("p99_s")
     slo_p99 = (slo.get("interactive") or {}).get("p99_s")
     goodput_ratio = (slo["goodput_rps"] / fifo["goodput_rps"]
                      if fifo["goodput_rps"] else None)
+    flight = alert["flightrec_transitions"]
     checks = {
         "interactive_p99_better": (fifo_p99 is not None
                                    and slo_p99 is not None
@@ -316,6 +518,18 @@ def main():
         "cache_hit_rate_ge_80pct": cache["hit_rate"] >= 0.8,
         "cache_bit_identical": cache["bit_identical"],
         "cache_additivity_ok": cache["additivity_ok"],
+        # SLO alert lifecycle (phase 3): full pending→firing→resolved
+        # on the flight-recorder timeline, firing visible to a /statusz
+        # poller, the dks_alerts_firing gauge raised during the flood
+        # and cleared after resolution
+        "alert_pending_firing_resolved": flight == ["pending", "firing",
+                                                    "resolved"],
+        "alert_firing_on_statusz": "firing" in alert["statusz_states_seen"],
+        "alert_gauge_fired": alert["gauge_max"] == 1.0,
+        "alert_resolved_after_flood": (alert["resolved_after_flood"]
+                                       and alert["gauge_final"] == 0.0),
+        # sampler overhead (phase 4)
+        "sampler_overhead_le_1pct": sampler["overhead_frac"] <= 0.01,
     }
     report = {
         "bench": "scheduling",
@@ -325,9 +539,29 @@ def main():
         "slo": slo,
         "goodput_ratio": round(goodput_ratio, 3) if goodput_ratio else None,
         "cache": cache,
+        "slo_alert": alert,
+        "sampler_overhead": sampler,
         "checks": checks,
         "ok": all(checks.values()),
     }
+    if not args.no_record:
+        # perf-history self-record: make perf-gate compares this run
+        # against the trailing baseline for the same config fingerprint
+        from benchmarks.regression_gate import DEFAULT_HISTORY, record_run
+
+        entry = record_run(
+            args.history or DEFAULT_HISTORY, bench="scheduling",
+            config={"requests": args.requests, "overload": args.overload,
+                    "interactive_frac": args.interactive_frac,
+                    "interactive_deadline_ms": args.interactive_deadline_ms,
+                    "model": {"base_s": model.base_s,
+                              "per_row_s": model.per_row_s}},
+            metrics={"wall_s": slo["wall_s"],
+                     "interactive_p99_s": slo_p99,
+                     "goodput_rps": slo["goodput_rps"]},
+            extra={"checks_ok": report["ok"]})
+        report["perf_history"] = {"git_sha": entry["git_sha"],
+                                  "config_fp": entry["config_fp"]}
     print(json.dumps(report))
     if args.check and not report["ok"]:
         return 1

@@ -15,10 +15,26 @@ stack comes up):
 * :mod:`~distributedkernelshap_tpu.observability.flightrec` — a flight
   recorder: the last N structured events (sheds, hedges, restarts,
   journal invalidations, wedges, fault injections), queryable at
-  ``/debugz`` and dumped to disk on an injected crash.
+  ``/debugz`` and dumped to disk on an injected crash;
+* :mod:`~distributedkernelshap_tpu.observability.timeseries` — a bounded
+  in-process time-series store (fixed-interval ring per series) fed by a
+  background sampler over the live registries, with windowed ``rate`` /
+  ``quantile`` / ``avg_over`` queries and JSONL export/replay;
+* :mod:`~distributedkernelshap_tpu.observability.slo` — declarative SLOs
+  (availability, latency-threshold, staleness) evaluated as multi-window
+  multi-burn-rate conditions over the store, with per-priority-class
+  latency targets;
+* :mod:`~distributedkernelshap_tpu.observability.alerts` — the alert
+  rules engine (pending → firing → resolved, for/keep-firing durations,
+  dedup, silences) with pluggable sinks (log, flight recorder, webhook,
+  ``dks_alerts_firing`` gauge);
+* :mod:`~distributedkernelshap_tpu.observability.statusz` — the
+  :class:`HealthEngine` bundling sampler + SLOs + alerts behind the
+  ``/statusz`` endpoint both serving components expose.
 
 See ``docs/OBSERVABILITY.md`` for the metric catalog, trace header
-format, ``/debugz`` schema and Perfetto how-to.
+format, SLO/alert semantics, ``/statusz`` schema, ``/debugz`` schema and
+Perfetto how-to.
 """
 
 # NOTE: the ``flightrec()`` accessor function is deliberately NOT
@@ -26,8 +42,38 @@ format, ``/debugz`` schema and Perfetto how-to.
 # on the package would shadow ``observability.flightrec`` for module-path
 # imports.  Import it from the submodule:
 # ``from distributedkernelshap_tpu.observability.flightrec import flightrec``.
+from distributedkernelshap_tpu.observability.alerts import (  # noqa: F401
+    AlertManager,
+    AlertRule,
+    CollectSink,
+    FlightRecorderSink,
+    LogSink,
+    Silence,
+    WebhookSink,
+    slo_burn_rule,
+)
 from distributedkernelshap_tpu.observability.flightrec import (  # noqa: F401
     FlightRecorder,
+)
+from distributedkernelshap_tpu.observability.slo import (  # noqa: F401
+    AvailabilitySLO,
+    BurnRateWindow,
+    LatencySLO,
+    SLO,
+    StalenessSLO,
+    default_proxy_slos,
+    default_server_slos,
+)
+from distributedkernelshap_tpu.observability.statusz import (  # noqa: F401
+    HealthEngine,
+    render_statusz_html,
+    statusz_response,
+)
+from distributedkernelshap_tpu.observability.timeseries import (  # noqa: F401
+    RegistrySampler,
+    TimeSeriesStore,
+    load_jsonl,
+    sparkline,
 )
 from distributedkernelshap_tpu.observability.metrics import (  # noqa: F401
     Counter,

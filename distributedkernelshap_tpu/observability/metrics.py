@@ -149,6 +149,14 @@ class _Metric:
         return {"name": self.name, "type": self.kind,
                 "labels": list(self.labelnames), "help": self.help}
 
+    def collect(self) -> Dict[str, object]:
+        """Structured snapshot for programmatic consumers (the time-series
+        sampler): ``{"name", "type", "labelnames", "series"}`` where
+        ``series`` maps label-value tuples to the current value."""
+
+        return {"name": self.name, "type": self.kind,
+                "labelnames": self.labelnames, "series": self._sampled()}
+
 
 class Counter(_Metric):
     """Monotone counter.  ``inc`` is atomic under the metric lock, so
@@ -245,6 +253,18 @@ class Histogram(_Metric):
                 return {"count": 0, "sum": 0.0}
             return {"count": state[2], "sum": state[1]}
 
+    def collect(self) -> Dict[str, object]:
+        """Histogram snapshot for the time-series sampler: each series is
+        ``(per-bucket counts incl. the +Inf slot, sum, count)`` plus the
+        shared bucket bounds."""
+
+        with self._lock:
+            series = {k: (tuple(v[0]), v[1], v[2])
+                      for k, v in self._series.items()}
+        return {"name": self.name, "type": self.kind,
+                "labelnames": self.labelnames, "buckets": self.buckets,
+                "series": series}
+
     def render(self) -> List[str]:
         lines = [f"# HELP {self.name} {_escape_help(self.help)}",
                  f"# TYPE {self.name} {self.kind}"]
@@ -322,6 +342,14 @@ class MetricsRegistry:
     def describe(self) -> List[Dict[str, object]]:
         with self._lock:
             return [m.describe() for m in self._metrics.values()]
+
+    def collect(self) -> List[Dict[str, object]]:
+        """Snapshot every metric's current series (see
+        :meth:`_Metric.collect`) — the time-series sampler's read path."""
+
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return [m.collect() for m in metrics]
 
 
 # --------------------------------------------------------------------- #

@@ -48,6 +48,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import distributedkernelshap_tpu.observability.tracing as _tracing
 from distributedkernelshap_tpu.observability.flightrec import flightrec
 from distributedkernelshap_tpu.observability.metrics import MetricsRegistry
+from distributedkernelshap_tpu.observability.slo import default_proxy_slos
+from distributedkernelshap_tpu.observability.statusz import (
+    HealthEngine,
+    statusz_response,
+)
 from distributedkernelshap_tpu.resilience.hedging import (
     HedgePolicy,
     LatencyQuantiles,
@@ -105,7 +110,9 @@ class FanInProxy:
                  request_timeout_s: float = 600.0,
                  probe_interval_s: float = 1.0,
                  trust_client_header: bool = False,
-                 hedge_policy: Optional[HedgePolicy] = None):
+                 hedge_policy: Optional[HedgePolicy] = None,
+                 health_interval_s: float = 1.0,
+                 slos=None, alert_rules=None, alert_sinks=None):
         #: whether a client-supplied ``X-DKS-Client`` passes through.  Off
         #: by default: the proxy is the trust boundary, and an untrusted
         #: client choosing its own rate-limit key defeats per-client
@@ -171,6 +178,20 @@ class FanInProxy:
             "failures, 503 demotions).",
             labelnames=("replica", "address")).seed(
             *[(str(r.index), r.address) for r in self.replicas])
+        # SLO health engine behind /statusz (same shape as the server's;
+        # built here so dks_slo_*/dks_alerts_* register with the rest)
+        self.health = HealthEngine(
+            reg, component="proxy",
+            slos=default_proxy_slos() if slos is None else slos,
+            rules=alert_rules, sinks=alert_sinks, flight=self._flight,
+            interval_s=health_interval_s,
+            spark_names=("dks_fanin_forwarded_total",
+                         "dks_fanin_replica_errors_total",
+                         "dks_fanin_hedges_total",
+                         "dks_fanin_sheds_total"))
+        # replica supervisor, when a ReplicaManager runs one: its restart
+        # stats join the /statusz replica-liveness block
+        self._supervisor = None
         #: tail-latency hedging (``resilience/hedging.py``).  ``None``
         #: (default) disables it — behaviour is then byte-identical to the
         #: pre-hedging proxy.  Safe to enable because /explain is
@@ -640,6 +661,38 @@ class FanInProxy:
         # __init__; the catalog in docs/OBSERVABILITY.md)
         return self.metrics.render()
 
+    def attach_supervisor(self, supervisor) -> None:
+        """Let ``/statusz`` show the replica supervisor's restart stats
+        next to the liveness it already tracks (``ReplicaManager`` calls
+        this once the supervisor is up)."""
+
+        self._supervisor = supervisor
+
+    def _statusz_detail(self) -> Dict:
+        """Proxy-specific ``/statusz`` block: replica liveness (the
+        rotation's own view), saturation backoffs, supervisor restart
+        stats when one is attached."""
+
+        now = time.monotonic()
+        replicas = []
+        for r in self.replicas:
+            backoff = r.saturated_any()
+            replicas.append({
+                "index": r.index, "address": r.address,
+                "alive": bool(r.alive),
+                # remaining backoff, counting DOWN to readmission (0 =
+                # not saturated) — named for what it measures
+                "saturation_expires_in_s": round(max(0.0, backoff - now),
+                                                 2),
+            })
+        sup = self._supervisor
+        return {
+            "replicas": replicas,
+            "live_replicas": sum(1 for r in self.replicas if r.alive),
+            "hedging": self.hedge_policy is not None,
+            "supervisor": sup.stats() if sup is not None else None,
+        }
+
     def _make_handler(self):
         proxy = self
 
@@ -658,9 +711,15 @@ class FanInProxy:
                 self.wfile.write(payload)
 
             def _handle(self):
-                route = self.path.rstrip("/")
+                path_only, _, query = self.path.partition("?")
+                route = path_only.rstrip("/")
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else b""
+                if route == "/statusz":
+                    ctype, page = statusz_response(
+                        proxy.health, query, detail=proxy._statusz_detail())
+                    self._reply(200, page.encode(), ctype=ctype)
+                    return
                 if route == "/healthz":
                     live = [r.address for r in proxy.replicas if r.alive]
                     code = 200 if live else 503
@@ -720,6 +779,7 @@ class FanInProxy:
         t_probe = threading.Thread(target=self._probe_loop, daemon=True)
         t_http.start()
         t_probe.start()
+        self.health.start()
         self._threads = [t_http, t_probe]
         logger.info("fan-in proxy on %s:%d over %d replicas",
                     self.host, self.port, len(self.replicas))
@@ -727,6 +787,7 @@ class FanInProxy:
 
     def stop(self):
         self._stop.set()
+        self.health.stop()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -899,6 +960,8 @@ class ReplicaManager:
                 self.procs, self._spawn, proxy=self.proxy,
                 policy=self.restart_policy,
                 lock=self._procs_lock).start()
+            # restart stats join the proxy's /statusz replica block
+            self.proxy.attach_supervisor(self.supervisor)
         return self
 
     def stop(self):

@@ -45,6 +45,11 @@ import numpy as np
 import distributedkernelshap_tpu.observability.tracing as _tracing
 from distributedkernelshap_tpu.observability.flightrec import flightrec
 from distributedkernelshap_tpu.observability.metrics import MetricsRegistry
+from distributedkernelshap_tpu.observability.slo import default_server_slos
+from distributedkernelshap_tpu.observability.statusz import (
+    HealthEngine,
+    statusz_response,
+)
 from distributedkernelshap_tpu.profiling import profiler
 from distributedkernelshap_tpu.scheduling import (
     PRIORITY_CLASSES,
@@ -263,6 +268,17 @@ class ExplainerServer:
         sites — the chaos harness's hook into the REAL request path.
         ``replica_worker`` wires this from the ``DKS_FAULTS`` env;
         ``None`` (the default) is zero-overhead.
+    health_interval_s
+        Sampling/alert-evaluation period of the SLO health engine behind
+        ``/statusz`` (``observability/statusz.py``).  The sampler is one
+        daemon thread snapshotting the metrics registry — nothing on the
+        request path.  ``0`` disables the background thread; ``/statusz``
+        still serves (cold page).
+    slos, alert_rules, alert_sinks
+        Override the health engine's SLO set (default
+        :func:`~distributedkernelshap_tpu.observability.slo.
+        default_server_slos`), alert rules (default: one burn-rate rule
+        per SLO) and sinks (default: log + flight recorder).
     """
 
     def __init__(self, model, host: str = "0.0.0.0", port: int = 8000,
@@ -278,7 +294,9 @@ class ExplainerServer:
                  rate_limit_per_client: Optional[Tuple[float, float]] = None,
                  cache_bytes: int = 0,
                  admission_control: bool = True,
-                 fault_injector=None):
+                 fault_injector=None,
+                 health_interval_s: float = 1.0,
+                 slos=None, alert_rules=None, alert_sinks=None):
         self.model = model
         self.host = host
         self.port = port
@@ -340,6 +358,20 @@ class ExplainerServer:
         self._flight = flightrec()
         self._tracer = _tracing.tracer()
         self._register_metrics()
+        # SLO health engine (observability/statusz.py): samples the
+        # registry into a bounded time-series store, evaluates burn-rate
+        # SLOs + alert rules on the same tick, serves /statusz.  Built in
+        # __init__ (not start()) so the dks_slo_*/dks_alerts_* series
+        # register alongside the rest and obs-check sees them.
+        self.health = HealthEngine(
+            self.metrics, component="server",
+            slos=default_server_slos() if slos is None else slos,
+            rules=alert_rules, sinks=alert_sinks, flight=self._flight,
+            interval_s=health_interval_s,
+            spark_names=("dks_serve_requests_total",
+                         "dks_serve_errors_total",
+                         "dks_serve_queue_depth",
+                         "dks_serve_sheds_total"))
         # computed lazily on first request: fingerprinting hashes the
         # background data, and the model may be swapped between __init__
         # and start() in tests.  Staleness is detected by OBJECT IDENTITY:
@@ -411,6 +443,27 @@ class ExplainerServer:
             "dks_serve_request_latency_seconds",
             "Queue+explain latency of answered requests.",
             buckets=LATENCY_BUCKETS_S)
+        # per-priority-class latency: the input the per-class latency
+        # SLOs (observability/slo.py CLASS_LATENCY_TARGETS) burn against.
+        # A separate family — adding a label to the unlabeled histogram
+        # above would break every dashboard scraping it.
+        self._m_class_latency = reg.histogram(
+            "dks_serve_class_latency_seconds",
+            "Queue+explain latency of answered requests by priority "
+            "class.",
+            buckets=LATENCY_BUCKETS_S, labelnames=("class",))
+        # the watchdog's progress view, made continuous for the staleness
+        # SLO: seconds since dispatched work last progressed, 0 when idle
+        # (an idle server is not stalling)
+        def _stall_age():
+            with self._active_lock:
+                busy = bool(self._active)
+            return (time.monotonic() - self._last_progress) if busy else 0.0
+
+        reg.gauge("dks_serve_last_progress_age_seconds",
+                  "Seconds since in-flight device work last progressed "
+                  "(0 when nothing is dispatched).").set_function(
+            _stall_age)
         if self._cache is not None:
             self._m_cache_hits = reg.counter(
                 "dks_serve_cache_hits_total",
@@ -463,6 +516,7 @@ class ExplainerServer:
         elapsed = time.monotonic() - pending.t_enqueued
         self._m_request_seconds.inc(elapsed)
         self._m_latency.observe(elapsed)
+        self._m_class_latency.observe(elapsed, **{"class": pending.klass})
 
     def _cache_key_for(self, array: np.ndarray) -> Optional[str]:
         if self._cache is None:
@@ -599,6 +653,25 @@ class ExplainerServer:
         # whole process; the per-metric declarations live in
         # _register_metrics and the catalog in docs/OBSERVABILITY.md)
         return self.metrics.render()
+
+    def _statusz_detail(self) -> dict:
+        """Server-specific block of the ``/statusz`` payload: liveness
+        state plus the queue/cache views an operator triages with."""
+
+        detail = {
+            "wedged": self._wedged.is_set(),
+            "ever_completed": self._ever_completed,
+            "scheduling": type(self._sched).__name__,
+            "queue_depths": dict(sorted(self._sched.depths().items())),
+            "pipeline_depth": self.pipeline_depth or 0,
+            "max_batch_size": self.max_batch_size,
+            "admission_control": self._admission is not None,
+        }
+        with self._active_lock:
+            detail["in_flight_batches"] = len(self._active)
+        if self._cache is not None:
+            detail["cache"] = self._cache.stats()
+        return detail
 
     def _split_batch_on_cache(self, batch):
         """Per-batch partial-hit splitting (``scheduling/result_cache.py``):
@@ -916,7 +989,10 @@ class ExplainerServer:
                 self.wfile.write(data)
 
             def _handle(self):
-                route = self.path.rstrip("/")
+                # query string split off so /statusz?format=json routes
+                # (other routes ignore their query, as before)
+                path_only, _, query = self.path.partition("?")
+                route = path_only.rstrip("/")
                 if route == "/healthz":
                     code, payload = server._health()
                     self._reply(code, json.dumps(payload))
@@ -929,6 +1005,14 @@ class ExplainerServer:
                     # the flight recorder's ring: bounded, thread-safe, the
                     # first artifact to pull when a chaos run goes sideways
                     self._reply(200, json.dumps(server._flight.to_payload()))
+                    return
+                if route == "/statusz":
+                    # the interpreted health page: SLO budgets, alert
+                    # states, queue depths, recent timeline (html; stable
+                    # JSON schema under ?format=json)
+                    ctype, body = statusz_response(
+                        server.health, query, detail=server._statusz_detail())
+                    self._reply(200, body, ctype=ctype)
                     return
                 if route != "/explain":
                     self._reply(404, json.dumps({"error": "unknown route"}))
@@ -1120,6 +1204,9 @@ class ExplainerServer:
             t.start()
         t_dog = threading.Thread(target=self._watchdog_loop, daemon=True)
         t_dog.start()
+        # SLO health sampler/alert evaluator (no-op when
+        # health_interval_s == 0)
+        self.health.start()
         self._threads = [t_http, t_disp, t_dog, *t_fin]
         logger.info("ExplainerServer listening on %s:%d/explain (max_batch_size=%d)",
                     self.host, self.port, self.max_batch_size)
@@ -1127,6 +1214,7 @@ class ExplainerServer:
 
     def stop(self):
         self._stop.set()
+        self.health.stop()
         self._sched.stop()  # wake the dispatcher's condition wait
         # fail anything still queued — including items deferred for row
         # overflow, which live in the same heap — so no handler thread

@@ -1,0 +1,138 @@
+"""Alert-engine golden test (``make health-check``).
+
+Replays the committed time-series fixture
+``tests/fixtures/slo_replay.jsonl`` — 120 s of sampled
+``dks_serve_requests_total`` (steady 10 req/s) and
+``dks_serve_errors_total`` (a 5 err/s burst between t=30 and t=60) —
+through the real SLO + alert stack and asserts the burn-rate alert's
+transitions match the golden timeline:
+
+* ``pending``  at t≈31 (condition true, ``for`` running),
+* ``firing``   at t≈36 (condition held for ``for_s=5``),
+* ``resolved`` at t≈74 (burst over at 60, the 5 s short window clears
+  by ~66, ``keep_firing_s=10`` elapses).
+
+Any drift in the store's windowed math, the SLO burn-rate evaluation or
+the alert state machine moves (or loses) a transition and fails the
+check.  Exit 0 on match, 1 on mismatch; one JSON report line either way.
+
+Regenerate the fixture (after a DELIBERATE semantic change) with::
+
+    python scripts/health_check.py --write-fixture
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+FIXTURE = os.path.join(REPO_ROOT, "tests", "fixtures", "slo_replay.jsonl")
+
+#: golden transition timeline: (state, expected_ts, tolerance_s).  The
+#: tolerance absorbs boundary-sample inclusion changes, not semantics.
+GOLDEN = (("pending", 31.0, 2.0),
+          ("firing", 36.0, 2.0),
+          ("resolved", 74.0, 2.0))
+
+
+def build_fixture_store():
+    """The synthetic incident, as a store: steady traffic, a 30 s error
+    burst.  Sampled at 1 Hz like the default RegistrySampler."""
+
+    from distributedkernelshap_tpu.observability.timeseries import (
+        TimeSeriesStore,
+    )
+
+    store = TimeSeriesStore(capacity=4096)
+    requests, errors = 0.0, 0.0
+    for t in range(0, 121):
+        if t > 0:
+            requests += 10.0
+            if 30 < t <= 60:
+                errors += 5.0
+        store.add("dks_serve_requests_total", float(t), requests,
+                  kind="counter")
+        store.add("dks_serve_errors_total", float(t), errors,
+                  kind="counter")
+    return store
+
+
+def make_rule():
+    from distributedkernelshap_tpu.observability.alerts import slo_burn_rule
+    from distributedkernelshap_tpu.observability.slo import (
+        AvailabilitySLO,
+        BurnRateWindow,
+    )
+
+    slo = AvailabilitySLO(
+        "availability", total="dks_serve_requests_total",
+        bad="dks_serve_errors_total", target=0.99,
+        windows=(BurnRateWindow(long_s=20.0, short_s=5.0, factor=2.0),),
+        description="health-check replay SLO")
+    return slo_burn_rule(slo, for_s=5.0, keep_firing_s=10.0)
+
+
+def run_check(fixture_path: str = FIXTURE) -> dict:
+    """Replay the fixture through the alert engine; returns the report
+    dict (``ok`` = golden match)."""
+
+    from distributedkernelshap_tpu.observability.alerts import (
+        AlertManager,
+        CollectSink,
+    )
+    from distributedkernelshap_tpu.observability.timeseries import (
+        iter_jsonl_times,
+        load_jsonl,
+    )
+
+    store = load_jsonl(fixture_path)
+    sink = CollectSink()
+    manager = AlertManager(store, [make_rule()], sinks=[sink],
+                           component="health-check")
+    for t in iter_jsonl_times(store):
+        manager.evaluate(now=t)
+    transitions = [{"state": e["state"], "ts": e["ts"]}
+                   for e in sink.events]
+    problems = []
+    if len(transitions) != len(GOLDEN):
+        problems.append(f"expected {len(GOLDEN)} transitions "
+                        f"({[g[0] for g in GOLDEN]}), got "
+                        f"{[t['state'] for t in transitions]}")
+    else:
+        for got, (state, expected_ts, tol) in zip(transitions, GOLDEN):
+            if got["state"] != state:
+                problems.append(f"expected {state}, got {got['state']}")
+            elif abs(got["ts"] - expected_ts) > tol:
+                problems.append(
+                    f"{state} at t={got['ts']:.1f}, expected "
+                    f"{expected_ts:.1f}±{tol:.0f}")
+    return {"fixture": os.path.relpath(fixture_path, REPO_ROOT),
+            "transitions": transitions,
+            "golden": [list(g) for g in GOLDEN],
+            "problems": problems,
+            "final_state": manager.states(),
+            "ok": not problems}
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--fixture", default=FIXTURE)
+    parser.add_argument("--write-fixture", action="store_true",
+                        help="regenerate the committed fixture JSONL "
+                             "(after a deliberate semantic change)")
+    args = parser.parse_args()
+    if args.write_fixture:
+        store = build_fixture_store()
+        n = store.export_jsonl(args.fixture)
+        print(json.dumps({"wrote": args.fixture, "samples": n}))
+        return 0
+    report = run_check(args.fixture)
+    print(json.dumps(report))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

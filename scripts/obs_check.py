@@ -32,8 +32,11 @@ sys.path.insert(0, REPO_ROOT)
 DOCS_PATH = os.path.join(REPO_ROOT, "docs", "OBSERVABILITY.md")
 
 #: metric-shaped literals; deliberately NOT bare ``dks_`` — env knobs
-#: (DKS_TRACE), header names and file paths share the prefix
-_LITERAL_RE = re.compile(r"dks_(?:serve|fanin|sched|phase)_[a-z0-9_]+")
+#: (DKS_TRACE), header names and file paths share the prefix.  ``slo``
+#: and ``alerts`` joined when the health engine landed its
+#: ``dks_slo_*``/``dks_alerts_*`` series.
+_LITERAL_RE = re.compile(
+    r"dks_(?:serve|fanin|sched|phase|slo|alerts)_[a-z0-9_]+")
 
 #: directories never scanned for literals/renderers
 _SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "results", "data",

@@ -192,6 +192,56 @@ def test_resolve_window_multihost_broadcasts_lead_value(monkeypatch):
     assert seen["local"] == 7
 
 
+def test_resolve_window_multihost_cache_key_symmetric_under_env_skew(
+        monkeypatch):
+    """The multihost broadcast cache must key on the resolution INPUTS
+    ``(requested, DKS_DISPATCH_WINDOW, cap)``, never the locally resolved
+    value: under per-host env skew, a value key can collapse two call
+    sites into ONE cache entry on one host while the peer keeps TWO —
+    asymmetric broadcast (collective) counts across processes, i.e. a
+    permanent hang instead of the promised skew warning (ADVICE round 4).
+    This simulates both peers' key sequences for the same call-site
+    sequence and asserts they perform the same number of broadcasts."""
+
+    import jax
+    from jax.experimental import multihost_utils
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(
+        pl, "device_round_trip_s",
+        lambda **kw: pytest.fail("probe must not run multihost"))
+
+    # two call sites: an unconfigured loop and an explicit request of 5.
+    # With DKS_DISPATCH_WINDOW=5 both RESOLVE to 5 (the collision a
+    # value-key turns into one cache entry); unset, they resolve to
+    # DETERMINISTIC_WINDOW and 5 (two entries either way).
+    call_sites = (None, 5)
+
+    def simulate_host(env_value):
+        monkeypatch.setattr(pl, "_window_cache", {})
+        if env_value is None:
+            monkeypatch.delenv("DKS_DISPATCH_WINDOW", raising=False)
+        else:
+            monkeypatch.setenv("DKS_DISPATCH_WINDOW", env_value)
+        broadcasts = []
+
+        def fake_broadcast(value, **kw):
+            broadcasts.append(int(value))
+            return int(value)
+
+        monkeypatch.setattr(multihost_utils, "broadcast_one_to_all",
+                            fake_broadcast)
+        for requested in call_sites:
+            pl.resolve_window(requested)
+            pl.resolve_window(requested)  # repeats must hit the cache
+        return len(broadcasts)
+
+    skewed = simulate_host("5")   # env pins 5: both sites resolve to 5
+    clean = simulate_host(None)
+    assert skewed == len(call_sites)  # a value key would give 1 here
+    assert skewed == clean  # symmetric collective counts across peers
+
+
 def test_resolve_window_non_positive_request_warns_and_degrades(monkeypatch, caplog):
     """Explicit dispatch_window=0 is not 'unset': it warns and falls through
     to env/probe resolution instead of being swallowed by truthiness

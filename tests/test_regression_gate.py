@@ -1,0 +1,186 @@
+"""Tests for the perf-regression gate: history recording, trailing-median
+baselines keyed by config fingerprint, pass on identical runs, fail on a
+synthetic +30% wall-time entry, torn-tail tolerance, CLI round trip."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from benchmarks.regression_gate import (
+    config_fingerprint,
+    gate,
+    load_history,
+    record_run,
+)
+
+
+@pytest.fixture()
+def history(tmp_path):
+    return str(tmp_path / "perf_history.jsonl")
+
+
+CFG = {"requests": 300, "overload": 2.0}
+
+
+def test_first_run_passes_with_note(history):
+    record_run(history, "scheduling", CFG, {"wall_s": 10.0})
+    report = gate(history)
+    assert report["ok"]
+    assert "no prior run" in report["benches"][0]["note"]
+
+
+def test_two_identical_runs_pass(history):
+    record_run(history, "scheduling", CFG,
+               {"wall_s": 10.0, "interactive_p99_s": 0.5})
+    record_run(history, "scheduling", CFG,
+               {"wall_s": 10.1, "interactive_p99_s": 0.52})
+    report = gate(history)
+    assert report["ok"]
+    comp = report["benches"][0]["comparisons"]
+    assert not comp["wall_s"]["regressed"]
+    assert not comp["interactive_p99_s"]["regressed"]
+
+
+def test_synthetic_plus_30pct_wall_fails(history):
+    for _ in range(3):
+        record_run(history, "scheduling", CFG, {"wall_s": 10.0})
+    record_run(history, "scheduling", CFG, {"wall_s": 13.0})
+    report = gate(history)
+    assert not report["ok"]
+    comp = report["benches"][0]["comparisons"]["wall_s"]
+    assert comp["regressed"] and comp["ratio"] == pytest.approx(1.3)
+
+
+def test_faster_run_never_fails(history):
+    record_run(history, "scheduling", CFG, {"wall_s": 10.0})
+    record_run(history, "scheduling", CFG, {"wall_s": 5.0})
+    assert gate(history)["ok"]
+
+
+def test_p99_threshold_is_looser_than_wall(history):
+    record_run(history, "scheduling", CFG,
+               {"wall_s": 10.0, "interactive_p99_s": 0.5})
+    # +30% p99 passes (50% threshold), +30% wall would not (20%)
+    record_run(history, "scheduling", CFG,
+               {"wall_s": 10.0, "interactive_p99_s": 0.65})
+    assert gate(history)["ok"]
+    record_run(history, "scheduling", CFG,
+               {"wall_s": 10.0, "interactive_p99_s": 0.9})
+    assert not gate(history)["ok"]
+
+
+def test_config_change_starts_fresh_baseline(history):
+    record_run(history, "scheduling", CFG, {"wall_s": 10.0})
+    record_run(history, "scheduling", {"requests": 600, "overload": 2.0},
+               {"wall_s": 30.0})  # 3x slower but a DIFFERENT measurement
+    report = gate(history)
+    assert report["ok"]
+    assert report["benches"][0]["baseline_runs"] == 0
+
+
+def test_benches_gate_independently(history):
+    record_run(history, "scheduling", CFG, {"wall_s": 10.0})
+    record_run(history, "chaos", {"requests": 48}, {"wall_s": 20.0})
+    record_run(history, "scheduling", CFG, {"wall_s": 10.0})
+    record_run(history, "chaos", {"requests": 48}, {"wall_s": 40.0})
+    report = gate(history)
+    assert not report["ok"]
+    by_bench = {r["bench"]: r for r in report["benches"]}
+    assert by_bench["scheduling"]["ok"]
+    assert not by_bench["chaos"]["ok"]
+    # --bench filter gates one benchmark only
+    assert gate(history, bench="scheduling")["ok"]
+
+
+def test_baseline_is_median_of_trailing_n(history):
+    # one slow outlier must not poison the baseline
+    for wall in (10.0, 10.2, 30.0, 10.1, 10.0):
+        record_run(history, "scheduling", CFG, {"wall_s": wall})
+    record_run(history, "scheduling", CFG, {"wall_s": 11.0})
+    report = gate(history)
+    assert report["ok"]
+    assert report["benches"][0]["comparisons"]["wall_s"][
+        "baseline_median"] == pytest.approx(10.1)
+
+
+def test_empty_history_and_torn_tail(history):
+    report = gate(history)
+    assert report["ok"] and "empty history" in report["note"]
+    record_run(history, "scheduling", CFG, {"wall_s": 10.0})
+    with open(history, "a") as fh:
+        fh.write('{"bench": "scheduling", "met')  # torn mid-append
+    assert len(load_history(history)) == 1
+
+
+def test_informational_metrics_are_recorded_not_gated(history):
+    record_run(history, "scheduling", CFG,
+               {"wall_s": 10.0, "goodput_rps": 40.0})
+    record_run(history, "scheduling", CFG,
+               {"wall_s": 10.0, "goodput_rps": 10.0})  # 4x worse
+    report = gate(history)
+    assert report["ok"]
+    assert "goodput_rps" not in report["benches"][0]["comparisons"]
+
+
+def test_newer_different_config_run_cannot_mask_a_regression(history):
+    """Gating only the single newest entry would hand a fresh config
+    fingerprint a free 'new baseline' pass that buries the regressed
+    run recorded just before it; every fingerprint in the recent window
+    is gated on its own."""
+
+    for _ in range(3):
+        record_run(history, "scheduling", CFG, {"wall_s": 10.0})
+    record_run(history, "scheduling", CFG, {"wall_s": 13.0})  # +30%
+    record_run(history, "scheduling", {"requests": 50}, {"wall_s": 2.0})
+    report = gate(history)
+    assert not report["ok"]
+    regressed = [r for r in report["benches"]
+                 if r["comparisons"].get("wall_s", {}).get("regressed")]
+    assert len(regressed) == 1
+
+
+def test_failed_runs_never_enter_the_baseline(history):
+    """A run whose own checks failed (inflated wall from timeouts) is
+    recorded for history but excluded from the baseline median — it must
+    not mask a later genuine regression."""
+
+    record_run(history, "scheduling", CFG, {"wall_s": 10.0},
+               extra={"checks_ok": True})
+    record_run(history, "scheduling", CFG, {"wall_s": 30.0},
+               extra={"checks_ok": False})  # flaky run, 3x wall
+    record_run(history, "scheduling", CFG, {"wall_s": 13.0},
+               extra={"checks_ok": True})
+    report = gate(history)
+    comp = report["benches"][0]["comparisons"]["wall_s"]
+    assert comp["baseline_median"] == pytest.approx(10.0)
+    assert not report["ok"]  # +30% vs the honest baseline
+
+
+def test_config_fingerprint_is_order_insensitive():
+    assert config_fingerprint({"a": 1, "b": 2}) == \
+        config_fingerprint({"b": 2, "a": 1})
+    assert config_fingerprint({"a": 1}) != config_fingerprint({"a": 2})
+
+
+def test_cli_record_and_check_round_trip(history):
+    argv = [sys.executable, "benchmarks/regression_gate.py",
+            "--history", history]
+    entry = {"bench": "cli", "config": {"n": 1}, "metrics": {"wall_s": 2.0}}
+    out = subprocess.run(argv + ["--record", json.dumps(entry)],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout)["bench"] == "cli"
+    out = subprocess.run(argv + ["--check"], capture_output=True,
+                         text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    # synthetic +30% wall over the CLI fails --check (acceptance demo)
+    entry["metrics"]["wall_s"] = 2.6
+    subprocess.run(argv + ["--record", json.dumps(entry)],
+                   capture_output=True, text=True, timeout=60)
+    out = subprocess.run(argv + ["--check"], capture_output=True,
+                         text=True, timeout=60)
+    assert out.returncode == 1
+    report = json.loads(out.stdout)
+    assert not report["ok"]

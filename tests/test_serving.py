@@ -670,6 +670,120 @@ def test_watchdog_reset_drops_device_state(model_setup):
         np.testing.assert_allclose(a, b, atol=1e-6)
 
 
+def test_handler_side_wedge_claim_counts_in_metrics():
+    """A request claimed by the HANDLER-side wedge check (one the
+    watchdog's scheduler drain cannot see) must still count in
+    requests_total/errors_total via the shared completion-accounting
+    helper — error counters must not go dark exactly during wedge
+    incidents (ADVICE round 4)."""
+
+    import threading
+    import time
+
+    class BlockingModel:
+        max_rows = None
+
+        def __init__(self):
+            self.release = threading.Event()
+
+        def explain_batch(self, instances, split_sizes=None):
+            self.release.wait(30)
+            sizes = split_sizes or [1] * instances.shape[0]
+            return [json.dumps({"data": {"i": i}})
+                    for i in range(len(sizes))]
+
+    model = BlockingModel()
+    # watchdog effectively off: the wedge is declared MANUALLY below, so
+    # the only path that can fail the queued request is the handler claim
+    srv = ExplainerServer(model, host="127.0.0.1", port=0,
+                          max_batch_size=1, watchdog_timeout_s=3600.0,
+                          first_batch_grace_s=3600.0,
+                          health_interval_s=0).start()
+    try:
+        X = np.ones((1, 4), dtype=np.float32)
+        results = {}
+
+        def fire(key):
+            try:
+                results[key] = ("ok", explain_request(
+                    f"http://127.0.0.1:{srv.port}/explain", X, timeout=60,
+                    max_retries=0))
+            except Exception as e:
+                results[key] = ("err", str(e))
+
+        t1 = threading.Thread(target=fire, args=("first",), daemon=True)
+        t1.start()
+        # wait until the first request is inside the blocking device call
+        deadline = time.monotonic() + 10
+        while not srv._active:
+            assert time.monotonic() < deadline, "dispatch never started"
+            time.sleep(0.01)
+        t2 = threading.Thread(target=fire, args=("second",), daemon=True)
+        t2.start()
+        deadline = time.monotonic() + 10
+        while srv._sched.qsize() == 0:
+            assert time.monotonic() < deadline, "second request not queued"
+            time.sleep(0.01)
+        # declare the wedge: BOTH handlers (the queued request and the
+        # one whose batch is held by the blocked device call) claim their
+        # requests (503) and run the shared counter accounting
+        srv._wedged.set()
+        t2.join(timeout=15)
+        t1.join(timeout=15)
+        for key in ("first", "second"):
+            assert results[key][0] == "err"
+            assert "503" in results[key][1]
+        assert srv._m_requests.value() == 2
+        assert srv._m_errors.value() == 2
+        assert srv._m_rows.value() == 2
+        # release the device: the late completion hits _complete's
+        # already-claimed recovery branch — it must clear the wedge and
+        # NOT recount the claimed request (totals stay at 2/2)
+        model.release.set()
+        deadline = time.monotonic() + 15
+        while srv._wedged.is_set():
+            assert time.monotonic() < deadline, "wedge never recovered"
+            time.sleep(0.02)
+        assert srv._ever_completed
+        assert srv._m_requests.value() == 2
+        assert srv._m_errors.value() == 2
+    finally:
+        model.release.set()
+        srv.stop()
+
+
+def test_recovered_wedge_batch_sets_ever_completed():
+    """A watchdog-failed FIRST batch whose device work later completes
+    must set ``_ever_completed``: the next stall is judged against
+    ``watchdog_timeout_s``, not the generous ``first_batch_grace_s``
+    (ADVICE round 4).  An errored late completion must NOT graduate."""
+
+    from distributedkernelshap_tpu.serving.server import _Pending
+
+    class _Stub:
+        pass
+
+    srv = ExplainerServer(_Stub(), health_interval_s=0)  # never started
+    p = _Pending(np.ones((1, 2), dtype=np.float32))
+    p.done = True  # the watchdog already failed it
+    batch = [p]
+    srv._active[id(batch)] = batch
+    srv._wedged.set()
+    assert not srv._ever_completed
+    srv._complete(batch, payloads=["{}"])  # late success: recovery signal
+    assert srv._ever_completed
+    assert not srv._wedged.is_set()
+    assert id(batch) not in srv._active
+
+    srv2 = ExplainerServer(_Stub(), health_interval_s=0)
+    p2 = _Pending(np.ones((1, 2), dtype=np.float32))
+    p2.done = True
+    batch2 = [p2]
+    srv2._active[id(batch2)] = batch2
+    srv2._complete(batch2, error="device still broken")
+    assert not srv2._ever_completed
+
+
 def test_follower_health_listener():
     """Follower pods answer /healthz (process liveness only) so a kubelet
     liveness probe does not kill a healthy follower that correctly serves

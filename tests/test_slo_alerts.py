@@ -1,0 +1,335 @@
+"""Tests for the SLO layer (availability / latency / staleness burn
+rates, multi-window breach logic, priority-class sync) and the alert
+rules engine (pending→firing→resolved state machine, for/keep-firing
+durations, dedup, silences, sinks) plus the committed health-check
+replay fixture."""
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from distributedkernelshap_tpu.observability.alerts import (
+    AlertManager,
+    AlertRule,
+    CollectSink,
+    FlightRecorderSink,
+    WebhookSink,
+    slo_burn_rule,
+)
+from distributedkernelshap_tpu.observability.flightrec import FlightRecorder
+from distributedkernelshap_tpu.observability.metrics import MetricsRegistry
+from distributedkernelshap_tpu.observability.slo import (
+    AvailabilitySLO,
+    BurnRateWindow,
+    LatencySLO,
+    PRIORITY_CLASSES,
+    SLO,
+    StalenessSLO,
+    default_proxy_slos,
+    default_server_slos,
+)
+from distributedkernelshap_tpu.observability.timeseries import TimeSeriesStore
+
+
+def _counter_ramp(store, name, per_s, until=120, start=0, t0=0):
+    value = 0.0
+    for t in range(t0, until + 1):
+        if t >= start:
+            value += per_s
+        store.add(name, t, value, kind="counter")
+
+
+# --------------------------------------------------------------------- #
+# SLO units
+# --------------------------------------------------------------------- #
+
+
+def test_priority_classes_stay_in_sync_with_scheduler():
+    from distributedkernelshap_tpu.scheduling import (
+        PRIORITY_CLASSES as SCHED_CLASSES,
+    )
+
+    assert tuple(SCHED_CLASSES) == PRIORITY_CLASSES
+
+
+def test_availability_slo_burn_rate_and_windows():
+    store = TimeSeriesStore()
+    _counter_ramp(store, "total", 10.0)
+    _counter_ramp(store, "bad", 5.0, start=30)
+    slo = AvailabilitySLO("avail", total="total", bad="bad", target=0.99,
+                          windows=(BurnRateWindow(20, 5, 2.0),))
+    # before the error burst: burn 0, full budget, not breached
+    status = slo.evaluate(store, now=20)
+    assert status["burn_rates"]["20s"] == pytest.approx(0.0)
+    assert status["budget_remaining"] == pytest.approx(1.0)
+    assert not status["breached"]
+    # mid-burst: 50% bad / 1% budget = 50x burn in both windows
+    status = slo.evaluate(store, now=50)
+    assert status["burn_rates"]["5s"] == pytest.approx(50.0)
+    assert status["breached"]
+    assert status["budget_remaining"] < 0
+    # idle store: no verdict, no breach
+    assert not AvailabilitySLO(
+        "a2", total="nope", bad="bad", target=0.99).evaluate(
+        store, now=50)["breached"]
+
+
+def test_breach_requires_both_windows():
+    """The long window proves sustained burn; the short window clears
+    promptly.  Burn in only ONE window must not breach."""
+
+    store = TimeSeriesStore()
+    _counter_ramp(store, "total", 10.0)
+    # errors stop at t=60: the 5s window is clean by t=70 while the 60s
+    # window still carries the burst
+    _counter_ramp(store, "bad", 5.0, start=30, until=60)
+    for t in range(61, 121):
+        store.add("bad", t, store.latest("bad"), kind="counter")
+    slo = AvailabilitySLO("avail", total="total", bad="bad", target=0.9,
+                          windows=(BurnRateWindow(60, 5, 2.0),))
+    status = slo.evaluate(store, now=70)
+    assert status["burn_rates"]["60s"] > 2.0
+    assert status["burn_rates"]["5s"] == pytest.approx(0.0)
+    assert not status["breached"]
+
+
+def test_latency_slo_over_histogram_labels():
+    store = TimeSeriesStore()
+    buckets = (0.1, 0.5, 1.0)
+    store.add_histogram("lat", 0, buckets, (0, 0, 0, 0), 0.0, 0,
+                        labels={"class": "interactive"})
+    # 8 fast, 2 slow: 20% bad vs 10% budget = burn 2
+    store.add_histogram("lat", 10, buckets, (0, 8, 0, 2), 6.0, 10,
+                        labels={"class": "interactive"})
+    slo = LatencySLO("ilat", histogram="lat", threshold_s=0.5, target=0.9,
+                     labels={"class": "interactive"},
+                     windows=(BurnRateWindow(30, 30, 2.0),))
+    status = slo.evaluate(store, now=10)
+    assert status["burn_rates"]["30s"] == pytest.approx(2.0)
+    assert status["breached"]
+
+
+def test_staleness_slo_fraction_of_bad_samples():
+    store = TimeSeriesStore()
+    for t in range(10):
+        store.add("age", t, 60.0 if t >= 5 else 1.0)
+    slo = StalenessSLO("stale", gauge="age", max_staleness_s=30.0,
+                       target=0.9, windows=(BurnRateWindow(10, 10, 2.0),))
+    status = slo.evaluate(store, now=9)
+    assert status["burn_rates"]["10s"] == pytest.approx(5.0)
+    assert status["breached"]
+
+
+def test_slo_target_validation_and_defaults():
+    with pytest.raises(ValueError):
+        SLO("bad", target=1.0)
+    with pytest.raises(ValueError):
+        SLO("bad", target=0.9, windows=())
+    server_slos = default_server_slos()
+    names = {s.name for s in server_slos}
+    assert {"availability", "interactive_latency", "batch_latency",
+            "best_effort_latency", "inflight_progress"} == names
+    assert {s.name for s in default_proxy_slos()} == {"proxy_availability"}
+
+
+# --------------------------------------------------------------------- #
+# alert state machine
+# --------------------------------------------------------------------- #
+
+
+def _flag_rule(flag, **kw):
+    return AlertRule("flag", lambda store, now: flag["v"], **kw)
+
+
+def test_alert_for_duration_gates_firing():
+    flag = {"v": False}
+    sink = CollectSink()
+    mgr = AlertManager(None, [_flag_rule(flag, for_s=5, keep_firing_s=3)],
+                       sinks=[sink])
+    assert mgr.evaluate(now=0) == []
+    flag["v"] = True
+    mgr.evaluate(now=1)
+    assert mgr.states()["flag"] == "pending"
+    mgr.evaluate(now=3)
+    assert mgr.states()["flag"] == "pending"  # for_s not yet served
+    mgr.evaluate(now=6)
+    assert mgr.states()["flag"] == "firing"
+    # steady firing does not re-notify (dedup)
+    mgr.evaluate(now=7)
+    mgr.evaluate(now=8)
+    assert [e["state"] for e in sink.events] == ["pending", "firing"]
+    # condition clears: firing persists until keep_firing_s elapses
+    flag["v"] = False
+    mgr.evaluate(now=9)
+    assert mgr.states()["flag"] == "firing"
+    mgr.evaluate(now=11.5)
+    assert mgr.states()["flag"] == "inactive"
+    assert [e["state"] for e in sink.events] == [
+        "pending", "firing", "resolved"]
+
+
+def test_pending_flap_notifies_once_per_renotify_window():
+    """A condition blinking just under for_s moves the state machine
+    every episode but must not spam sinks (and the bounded flight ring)
+    with one pending notification per blink."""
+
+    flag = {"v": False}
+    sink = CollectSink()
+    mgr = AlertManager(None, [_flag_rule(flag, for_s=10)], sinks=[sink],
+                       pending_renotify_s=60)
+    for t in range(0, 40, 2):
+        flag["v"] = (t % 4 == 0)  # true/false every other tick
+        mgr.evaluate(now=t)
+    assert [e["state"] for e in sink.events] == ["pending"]
+    # after the renotify window a fresh episode notifies again
+    flag["v"] = True
+    mgr.evaluate(now=100)
+    assert [e["state"] for e in sink.events] == ["pending", "pending"]
+
+
+def test_alert_pending_blink_never_fires():
+    flag = {"v": True}
+    sink = CollectSink()
+    mgr = AlertManager(None, [_flag_rule(flag, for_s=10)], sinks=[sink])
+    mgr.evaluate(now=0)
+    flag["v"] = False
+    mgr.evaluate(now=2)
+    assert mgr.states()["flag"] == "inactive"
+    assert [e["state"] for e in sink.events] == ["pending"]  # no resolved
+
+
+def test_alert_zero_for_fires_immediately_and_refires_after_resolve():
+    flag = {"v": True}
+    sink = CollectSink()
+    mgr = AlertManager(None, [_flag_rule(flag, for_s=0, keep_firing_s=0)],
+                       sinks=[sink])
+    mgr.evaluate(now=0)
+    assert mgr.firing() == ["flag"]
+    flag["v"] = False
+    mgr.evaluate(now=1)
+    flag["v"] = True
+    mgr.evaluate(now=2)
+    assert [e["state"] for e in sink.events] == [
+        "firing", "resolved", "firing"]
+
+
+def test_silence_suppresses_sinks_but_not_state():
+    flag = {"v": True}
+    sink = CollectSink()
+    mgr = AlertManager(None, [_flag_rule(flag, for_s=0)], sinks=[sink])
+    mgr.silence("fl*", duration_s=100, now=0)
+    events = mgr.evaluate(now=1)
+    assert mgr.firing() == ["flag"]  # state machine ran
+    assert sink.events == []  # sink suppressed
+    assert events and events[0].get("silenced")
+    # lapsed silence notifies again
+    flag["v"] = False
+    mgr.evaluate(now=200)
+    assert [e["state"] for e in sink.events] == ["resolved"]
+
+
+def test_duplicate_rule_names_rejected():
+    rule = AlertRule("dup", lambda s, n: False)
+    with pytest.raises(ValueError):
+        AlertManager(None, [rule, AlertRule("dup", lambda s, n: False)])
+
+
+def test_broken_condition_and_sink_do_not_kill_evaluator():
+    def boom(store, now):
+        raise RuntimeError("boom")
+
+    class BadSink:
+        def notify(self, event):
+            raise RuntimeError("sink boom")
+
+    flag = {"v": True}
+    good = CollectSink()
+    mgr = AlertManager(None, [AlertRule("broken", boom),
+                              _flag_rule(flag, for_s=0)],
+                       sinks=[BadSink(), good])
+    mgr.evaluate(now=0)
+    assert mgr.firing() == ["flag"]
+    assert [e["state"] for e in good.events] == ["firing"]
+
+
+def test_firing_gauge_attaches_to_registry():
+    flag = {"v": True}
+    mgr = AlertManager(None, [_flag_rule(flag, for_s=0)])
+    reg = MetricsRegistry()
+    mgr.attach_metrics(reg)
+    assert 'dks_alerts_firing{rule="flag"} 0' in reg.render()
+    mgr.evaluate(now=0)
+    assert 'dks_alerts_firing{rule="flag"} 1' in reg.render()
+
+
+def test_flightrec_sink_records_transitions():
+    flight = FlightRecorder()
+    flag = {"v": True}
+    mgr = AlertManager(None, [_flag_rule(flag, for_s=0)],
+                       sinks=[FlightRecorderSink(flight)], component="test")
+    mgr.evaluate(now=0)
+    events = flight.snapshot("alert")
+    assert len(events) == 1
+    assert events[0]["rule"] == "flag" and events[0]["state"] == "firing"
+
+
+def test_webhook_sink_posts_and_survives_dead_receiver():
+    received = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            received.append(json.loads(
+                self.rfile.read(int(self.headers["Content-Length"]))))
+            self.send_response(204)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    httpd = HTTPServer(("127.0.0.1", 0), Handler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    try:
+        sink = WebhookSink(f"http://127.0.0.1:{httpd.server_address[1]}/")
+        sink.notify({"rule": "r", "state": "firing", "severity": "page"})
+        sink.wait()  # POSTs run on a daemon thread off the evaluator
+        assert received and received[0]["rule"] == "r"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    # dead receiver: logged, never raised (and never blocks notify)
+    dead = WebhookSink("http://127.0.0.1:1/", timeout_s=0.2)
+    dead.notify({"rule": "r", "state": "resolved"})
+    dead.wait()
+
+
+def test_slo_burn_rule_carries_status_info():
+    store = TimeSeriesStore()
+    _counter_ramp(store, "total", 10.0, until=60)
+    _counter_ramp(store, "bad", 10.0, until=60)
+    slo = AvailabilitySLO("avail", total="total", bad="bad", target=0.9,
+                          windows=(BurnRateWindow(20, 5, 2.0),))
+    sink = CollectSink()
+    mgr = AlertManager(store, [slo_burn_rule(slo, for_s=0)], sinks=[sink])
+    mgr.evaluate(now=30)
+    assert mgr.firing() == ["slo_burn:avail"]
+    info = sink.events[0]["info"]
+    assert info["slo"] == "avail"
+    assert info["burn_rates"]["5s"] == pytest.approx(10.0)
+
+
+# --------------------------------------------------------------------- #
+# the committed replay fixture (the make health-check golden path)
+# --------------------------------------------------------------------- #
+
+
+def test_health_check_replay_fixture_golden_transitions():
+    import scripts.health_check as hc
+
+    report = hc.run_check()
+    assert report["ok"], report
+    assert [t["state"] for t in report["transitions"]] == [
+        "pending", "firing", "resolved"]

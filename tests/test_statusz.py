@@ -1,0 +1,232 @@
+"""Tests for the ``/statusz`` endpoint on both serving components:
+stable JSON schema under load, firing-alert rendering on the human page,
+cold-start rendering with an empty store, and the health engine's
+registry back-channel (satellite task)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu.observability.alerts import AlertRule
+from distributedkernelshap_tpu.observability.statusz import (
+    HealthEngine,
+    render_statusz_html,
+)
+from distributedkernelshap_tpu.observability.metrics import MetricsRegistry
+
+#: the stable machine schema — adding a key is a conscious doc +
+#: test update, never an accident (dashboards consume this)
+TOP_LEVEL_KEYS = {"component", "generated_at", "uptime_s", "healthy",
+                  "sampler", "slos", "alerts", "silences", "series",
+                  "flightrec", "detail"}
+
+SLO_KEYS = {"name", "kind", "target", "description", "windows",
+            "burn_rates", "budget_remaining", "breached"}
+
+ALERT_KEYS = {"rule", "state", "severity", "since_s", "transitions_total",
+              "info"}
+
+
+class FakeModel:
+    def explain_batch(self, instances, split_sizes=None):
+        sizes = split_sizes or [instances.shape[0]]
+        out, k = [], 0
+        for n in sizes:
+            rows = instances[k:k + n]
+            k += n
+            out.append(json.dumps(
+                {"data": {"sum": [float(r.sum()) for r in rows]}}))
+        return out
+
+
+@pytest.fixture()
+def stack():
+    from distributedkernelshap_tpu.serving.replicas import FanInProxy
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    server = ExplainerServer(FakeModel(), host="127.0.0.1", port=0,
+                             max_batch_size=4, pipeline_depth=1,
+                             cache_bytes=1 << 20,
+                             health_interval_s=0.05).start()
+    proxy = FanInProxy([("127.0.0.1", server.port)], host="127.0.0.1",
+                       port=0, health_interval_s=0.05).start()
+    try:
+        yield server, proxy
+    finally:
+        proxy.stop()
+        server.stop()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=30) as r:
+        return r.headers.get("Content-Type", ""), r.read().decode()
+
+
+def test_statusz_json_schema_under_load(stack):
+    """Scrape both components' /statusz while real requests flow
+    in-process; the JSON schema must be exactly the documented one."""
+
+    from distributedkernelshap_tpu.serving.client import explain_request
+
+    server, proxy = stack
+    stop = threading.Event()
+
+    def load():
+        i = 0
+        while not stop.is_set():
+            explain_request(
+                f"http://127.0.0.1:{proxy.port}/explain",
+                np.full((1, 3), float(i % 7), dtype=np.float32),
+                timeout=30)
+            i += 1
+
+    loader = threading.Thread(target=load, daemon=True)
+    loader.start()
+    try:
+        time.sleep(0.3)  # let the samplers tick under traffic
+        for port, component in ((server.port, "server"),
+                                (proxy.port, "proxy")):
+            ctype, body = _get(port, "/statusz?format=json")
+            assert ctype.startswith("application/json")
+            doc = json.loads(body)
+            assert set(doc) == TOP_LEVEL_KEYS
+            assert doc["component"] == component
+            assert doc["healthy"] is True
+            for slo in doc["slos"]:
+                assert set(slo) == SLO_KEYS
+            for alert in doc["alerts"]:
+                assert set(alert) == ALERT_KEYS
+                assert alert["state"] in ("inactive", "pending", "firing")
+            assert doc["sampler"]["enabled"]
+            assert doc["sampler"]["samples_taken"] > 0
+            assert doc["series"], "sparkline series missing under load"
+    finally:
+        stop.set()
+        loader.join(timeout=10)
+    # component-specific detail blocks
+    _, body = _get(server.port, "/statusz?format=json")
+    detail = json.loads(body)["detail"]
+    assert {"wedged", "queue_depths", "cache",
+            "in_flight_batches"} <= set(detail)
+    _, body = _get(proxy.port, "/statusz?format=json")
+    detail = json.loads(body)["detail"]
+    assert detail["live_replicas"] == 1
+    assert detail["replicas"][0]["alive"] is True
+    assert detail["supervisor"] is None  # no ReplicaManager here
+
+
+def test_statusz_html_renders_under_load(stack):
+    server, proxy = stack
+    for port in (server.port, proxy.port):
+        ctype, page = _get(port, "/statusz")
+        assert ctype.startswith("text/html")
+        assert "/statusz" in page and "SLOs" in page and "Alerts" in page
+
+
+def test_statusz_cold_start_renders_empty_store():
+    """A server whose sampler never ticked (health_interval_s=0, no
+    traffic) must still serve both /statusz forms (satellite: cold
+    start)."""
+
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    server = ExplainerServer(FakeModel(), host="127.0.0.1", port=0,
+                             health_interval_s=0).start()
+    try:
+        ctype, body = _get(server.port, "/statusz?format=json")
+        doc = json.loads(body)
+        assert set(doc) == TOP_LEVEL_KEYS
+        assert doc["sampler"]["enabled"] is False
+        assert doc["sampler"]["samples_taken"] == 0
+        assert doc["series"] == {}
+        assert doc["healthy"] is True  # silence is not an outage
+        _, page = _get(server.port, "/statusz")
+        assert "no samples yet" in page
+    finally:
+        server.stop()
+
+
+def test_statusz_renders_firing_alert():
+    """A firing rule must show on the JSON payload, the human page and
+    the healthy flag (satellite: firing-alert rendering)."""
+
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    always = AlertRule("always_on", lambda store, now: True, for_s=0,
+                       severity="page")
+    server = ExplainerServer(FakeModel(), host="127.0.0.1", port=0,
+                             health_interval_s=0.05, slos=[],
+                             alert_rules=[always]).start()
+    try:
+        deadline = time.monotonic() + 5
+        while True:
+            _, body = _get(server.port, "/statusz?format=json")
+            doc = json.loads(body)
+            if doc["alerts"] and doc["alerts"][0]["state"] == "firing":
+                break
+            assert time.monotonic() < deadline, doc["alerts"]
+            time.sleep(0.05)
+        assert doc["healthy"] is False
+        _, page = _get(server.port, "/statusz")
+        assert "always_on" in page and "firing" in page
+        assert "UNHEALTHY" in page
+        # and the registry back-channel agrees
+        _, metrics = _get(server.port, "/metrics")
+        assert 'dks_alerts_firing{rule="always_on"} 1' in metrics
+    finally:
+        server.stop()
+
+
+def test_deterministic_tick_evaluates_gauges_at_logical_time():
+    """A replayed tick(now=...) must evaluate the dks_slo_* gauge
+    callbacks at the LOGICAL timestamp, not wall time — otherwise a
+    replay over logically-stamped samples records full-budget gauges
+    during the very burn it is replaying."""
+
+    from distributedkernelshap_tpu.observability.slo import (
+        AvailabilitySLO,
+        BurnRateWindow,
+    )
+
+    reg = MetricsRegistry()
+    total = reg.counter("dks_serve_requests_total", "R.")
+    bad = reg.counter("dks_serve_errors_total", "E.")
+    slo = AvailabilitySLO("avail", total="dks_serve_requests_total",
+                          bad="dks_serve_errors_total", target=0.9,
+                          windows=(BurnRateWindow(20, 5, 2.0),))
+    engine = HealthEngine(reg, component="unit", interval_s=0, slos=[slo])
+    for t in range(0, 31):
+        total.inc(10)
+        bad.inc(10)  # 100% errors: the budget is deeply overspent
+        engine.tick(now=float(t))
+    recorded = engine.store.latest("dks_slo_budget_remaining",
+                                   {"slo": "avail"})
+    assert recorded is not None and recorded < 0
+    # outside a tick, callbacks fall back to wall time (live scrapes)
+    assert engine._eval_now is None
+
+
+def test_health_engine_standalone_tick_and_payload():
+    """The engine works without a serving component: explicit ticks move
+    the store, and the payload builds from any registry."""
+
+    reg = MetricsRegistry()
+    c = reg.counter("dks_serve_requests_total", "R.")
+    engine = HealthEngine(reg, component="unit", interval_s=0,
+                          spark_names=("dks_serve_requests_total",))
+    engine.tick(now=0.0)
+    c.inc(5)
+    engine.tick(now=1.0)
+    payload = engine.statusz_payload(detail={"k": "v"})
+    assert payload["detail"] == {"k": "v"}
+    series = payload["series"]["dks_serve_requests_total"]
+    assert series["kind"] == "rate"
+    assert series["latest"] == pytest.approx(5.0)
+    assert series["sparkline"]
+    html = render_statusz_html(payload)
+    assert "unit /statusz" in html

@@ -1,0 +1,204 @@
+"""Tests for the in-process time-series store and the registry sampler:
+ring bounds, counter/gauge/histogram queries (rate, delta, avg_over,
+windowed quantile with interpolation), reset handling, JSONL
+export/replay, and sampler snapshots of a live registry."""
+
+import threading
+
+import pytest
+
+from distributedkernelshap_tpu.observability.metrics import MetricsRegistry
+from distributedkernelshap_tpu.observability.timeseries import (
+    RegistrySampler,
+    TimeSeriesStore,
+    load_jsonl,
+    sparkline,
+)
+
+
+def test_ring_is_bounded_per_series():
+    store = TimeSeriesStore(capacity=10)
+    for t in range(100):
+        store.add("g", t, float(t))
+    pts = store.points("g")
+    assert len(pts) == 10
+    assert pts[0] == (90.0, 90.0) and pts[-1] == (99.0, 99.0)
+    assert store.samples_total == 100
+
+
+def test_counter_rate_and_delta():
+    store = TimeSeriesStore()
+    for t in range(0, 11):
+        store.add("c", t, 5.0 * t, kind="counter")
+    assert store.delta("c", 10, now=10) == pytest.approx(50.0)
+    assert store.rate("c", 10, now=10) == pytest.approx(5.0)
+    # window restricts which samples count
+    assert store.delta("c", 3, now=10) == pytest.approx(15.0)
+    # counter reset: the negative step is dropped, not summed — the
+    # 3s window [9,12] holds values 45,50,2,4, so the honest increase
+    # is (50-45) + (4-2) = 7
+    store.add("c", 11, 2.0, kind="counter")
+    store.add("c", 12, 4.0, kind="counter")
+    assert store.delta("c", 3, now=12) == pytest.approx(7.0)
+
+
+def test_rate_needs_two_samples_and_distinct_times():
+    store = TimeSeriesStore()
+    assert store.rate("missing", 10, now=0) is None
+    store.add("c", 5, 1.0, kind="counter")
+    assert store.rate("c", 10, now=5) is None
+
+
+def test_avg_over_and_frac_over_gauges():
+    store = TimeSeriesStore()
+    for t, v in enumerate([0.0, 10.0, 20.0, 30.0]):
+        store.add("g", t, v)
+    assert store.avg_over("g", 10, now=3) == pytest.approx(15.0)
+    assert store.frac_over("g", 10, 15.0, now=3) == pytest.approx(0.5)
+    assert store.avg_over("g", 0.5, now=100) is None  # empty window
+
+
+def test_labels_isolate_series():
+    store = TimeSeriesStore()
+    store.add("q", 0, 1.0, labels={"class": "interactive"})
+    store.add("q", 0, 9.0, labels={"class": "batch"})
+    assert store.latest("q", {"class": "interactive"}) == 1.0
+    assert store.latest("q", {"class": "batch"}) == 9.0
+    assert store.latest("q") is None  # the unlabeled series was never fed
+    assert sorted(d["class"] for d in store.labelsets("q")) == [
+        "batch", "interactive"]
+
+
+def test_histogram_window_quantile_interpolates():
+    store = TimeSeriesStore()
+    buckets = (0.1, 0.5, 1.0)
+    # cumulative snapshots: 0 obs, then 100 in (0.1, 0.5] + 10 in +Inf
+    store.add_histogram("h", 0, buckets, (0, 0, 0, 0), 0.0, 0)
+    store.add_histogram("h", 10, buckets, (0, 100, 0, 10), 50.0, 110)
+    # 55th of 110 lands in the (0.1, 0.5] bucket: linear interpolation
+    assert store.quantile("h", 0.5, 60, now=10) == pytest.approx(0.32)
+    # the +Inf tail answers with the highest finite bound
+    assert store.quantile("h", 0.999, 60, now=10) == pytest.approx(1.0)
+    assert store.frac_le("h", 0.5, 60, now=10) == pytest.approx(100 / 110)
+    # threshold between bounds interpolates inside the bucket
+    assert store.frac_le("h", 0.3, 60, now=10) == pytest.approx(
+        (100 * 0.5) / 110)
+    assert store.quantile("h", 0.5, 60, now=5) is None  # one snapshot
+
+
+def test_histogram_reset_mid_window_returns_none():
+    store = TimeSeriesStore()
+    buckets = (1.0,)
+    store.add_histogram("h", 0, buckets, (5, 0), 2.0, 5)
+    store.add_histogram("h", 1, buckets, (2, 0), 1.0, 2)  # restart
+    assert store.histogram_window("h", 10, now=1) is None
+
+
+def test_jsonl_export_replay_round_trip(tmp_path):
+    store = TimeSeriesStore()
+    for t in range(5):
+        store.add("c", t, 2.0 * t, kind="counter",
+                  labels={"class": "batch"})
+    store.add_histogram("h", 4, (0.5,), (3, 1), 1.5, 4)
+    path = str(tmp_path / "series.jsonl")
+    n = store.export_jsonl(path)
+    assert n == 6
+    replayed = load_jsonl(path)
+    assert replayed.delta("c", 10, {"class": "batch"},
+                          now=4) == pytest.approx(8.0)
+    assert replayed.kind("h") == "histogram"
+    # torn tail is skipped, not fatal
+    with open(path, "a") as fh:
+        fh.write('{"name": "c", "t"')
+    assert load_jsonl(path).delta("c", 10, {"class": "batch"},
+                                  now=4) == pytest.approx(8.0)
+
+
+def test_sampler_snapshots_registry_counters_gauges_histograms():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total", "C.", labelnames=("reason",))
+    g = reg.gauge("g", "G.")
+    h = reg.histogram("h_seconds", "H.", buckets=(0.1, 1.0))
+    store = TimeSeriesStore()
+    sampler = RegistrySampler(store, [reg], interval_s=0)
+    c.inc(0, reason="x")  # labeled series exist only once touched
+    sampler.sample_once(now=0)
+    c.inc(4, reason="x")
+    g.set(7.0)
+    h.observe(0.05)
+    h.observe(5.0)
+    sampler.sample_once(now=10)
+    assert store.delta("c_total", 60, {"reason": "x"},
+                       now=10) == pytest.approx(4.0)
+    assert store.latest("g") == 7.0
+    assert store.kind("c_total", {"reason": "x"}) == "counter"
+    win = store.histogram_window("h_seconds", 60, now=10)
+    assert win is not None and win[3] == 2
+    assert sampler.samples_taken == 2
+
+
+def test_sampler_thread_start_stop_and_on_tick():
+    reg = MetricsRegistry()
+    reg.gauge("g", "G.").set(1.0)
+    store = TimeSeriesStore()
+    ticks = []
+    sampler = RegistrySampler(store, [reg], interval_s=0.02)
+    sampler.start(on_tick=lambda: ticks.append(1))
+    deadline = threading.Event()
+    deadline.wait(0.2)
+    sampler.stop()
+    assert sampler.samples_taken >= 2
+    assert len(ticks) >= 2
+    taken = sampler.samples_taken
+    deadline.wait(0.1)
+    assert sampler.samples_taken == taken  # actually stopped
+    # interval 0 never starts a thread
+    s2 = RegistrySampler(store, [reg], interval_s=0)
+    assert s2.start()._thread is None
+
+
+def test_concurrent_writes_and_windowed_reads():
+    """Scrape-time gauge callbacks and /statusz handlers query the store
+    while the sampler thread appends; a read iterating the live deque
+    mid-append would raise 'deque mutated during iteration'."""
+
+    store = TimeSeriesStore(capacity=64)
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        t = 0
+        while not stop.is_set():
+            store.add("c", t, float(t), kind="counter")
+            store.add_histogram("h", t, (0.5,), (t, 0), 0.1 * t, t)
+            t += 1
+
+    def reader():
+        while not stop.is_set():
+            try:
+                store.delta("c", 1e9, now=1e9)
+                store.rate("c", 1e9, now=1e9)
+                store.avg_over("c", 1e9, now=1e9)
+                store.histogram_window("h", 1e9, now=1e9)
+                store.points("c")
+                store.latest("c")
+            except Exception as e:  # pragma: no cover - the regression
+                errors.append(repr(e))
+                return
+
+    threads = [threading.Thread(target=writer, daemon=True)] + \
+        [threading.Thread(target=reader, daemon=True) for _ in range(3)]
+    for t in threads:
+        t.start()
+    stop.wait(0.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert errors == []
+
+
+def test_sparkline_shapes():
+    assert sparkline([]) == ""
+    assert sparkline([1.0, 1.0]) == "▁▁"
+    line = sparkline([0, 1, 2, 3])
+    assert len(line) == 4 and line[0] == "▁" and line[-1] == "█"
