@@ -90,7 +90,18 @@ def run_benchmark(cpu_fallback: bool = False) -> int:
     import jax
 
     from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.runtime.compile_cache import (
+        compile_events,
+        enable_persistent_cache,
+    )
     from distributedkernelshap_tpu.utils import load_data, load_model
+
+    # compile accounting from the first fit compile on (registers the
+    # jax.monitoring listener before anything traces), and the persistent
+    # compile cache when DKS_COMPILE_CACHE_DIR is set — the result line
+    # then records cache effectiveness alongside wall time
+    enable_persistent_cache()
+    compile_before = compile_events().snapshot()
 
     data = load_data()
     clf = load_model()
@@ -149,6 +160,16 @@ def run_benchmark(cpu_fallback: bool = False) -> int:
         # auto-degrade must never masquerade as a kernel measurement
         "kernel_path": explainer.kernel_path,
     }
+    # compile accounting for the whole run (fit + warmup + timed loop):
+    # fresh = XLA compiled, cache_hit = the persistent compile cache
+    # served the executable (non-zero only with DKS_COMPILE_CACHE_DIR) —
+    # BENCH_*.json then records cache effectiveness alongside wall time
+    compile_delta = compile_events().delta(compile_before,
+                                           compile_events().snapshot())
+    record["compile_total"] = {
+        k: int(v) for k, v in compile_delta["totals"].items()}
+    record["compile_seconds_total"] = {
+        k: round(v, 3) for k, v in compile_delta["seconds_totals"].items()}
     print(json.dumps(record))
     if not cpu_fallback:
         # persist the on-chip success for the wedged-path error JSON: the

@@ -17,6 +17,7 @@ import logging
 import math
 import os
 import threading
+from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field, replace
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
@@ -537,6 +538,21 @@ class EngineConfig:
     # (None = resolve via parallel/pipeline.resolve_window: env override or
     # a live round-trip probe — ~8 through a tunnelled chip, 2 locally)
     dispatch_window: Optional[int] = None
+    # plan-constant device cache for the linear fast path: keep the
+    # X-independent masked-background einsums (S×N×K, N×K) and the
+    # factorised WLS Gram matrix device-resident, keyed by a stable
+    # content fingerprint of (model, background, plan), so a small-B
+    # request pays only the B×S×K einsum + the cached triangular solve.
+    # Tri-state: None/True = fast path with the cache (auto: linear
+    # predictors off the host-eval/Pallas paths); False = SAME two-stage
+    # program but the constants are recomputed every call — the A/B
+    # control arm, so cached-vs-uncached phi is bit-identical BY
+    # CONSTRUCTION (identical compiled program, only the consts' origin
+    # differs; asserted by benchmarks/warmup_bench.py --check); 'off' =
+    # classic self-contained program (escape hatch — same formulas, but
+    # XLA fuses a different whole-program graph, so bits may drift at the
+    # last ulp vs the two-stage path).
+    plan_constant_cache: Optional[Union[bool, str]] = None
     # host-eval chunk fan-out across host cores (None = auto: the host's
     # core count): the reference's worker-pool parallelism applied to the
     # only part of the pipeline that still runs on the host — black-box
@@ -597,7 +613,14 @@ class KernelExplainerEngine:
 
         self._plan_cache: Dict[Any, Any] = {}
         self._fn_cache: Dict[Any, Any] = {}
-        self._dev_cache: Dict[Any, Any] = {}
+        # device-resident per-plan constants, keyed by CONTENT fingerprint
+        # (id(plan) keys could alias a recycled address after GC and serve
+        # a different plan's constants); OrderedDict = LRU, entry-bounded
+        self._dev_cache: "OrderedDict[Any, Any]" = OrderedDict()
+        # plan-constant cache for the linear fast path (see
+        # EngineConfig.plan_constant_cache): {(content_key, chunk): consts}
+        self._plan_consts_cache: "OrderedDict[Any, Any]" = OrderedDict()
+        self._content_fp: Optional[str] = None
         self.last_raw_prediction: Optional[np.ndarray] = None
         #: list of K (B, M, M) arrays after an interactions=True explain
         self.last_interaction_values: Optional[List[np.ndarray]] = None
@@ -842,6 +865,7 @@ class KernelExplainerEngine:
 
         self._fn_cache.clear()
         self._dev_cache.clear()
+        self._plan_consts_cache.clear()
 
     @property
     def kernel_path(self) -> Dict[str, Any]:
@@ -856,18 +880,164 @@ class KernelExplainerEngine:
 
         return dict(self._kernel_paths, pallas_degrades=self.pallas_degrades)
 
+    #: bound on device-constant cache entries (plans in play per engine:
+    #: 'auto' + a handful of explicit nsamples values — 8 is generous)
+    _DEV_CACHE_MAX_ENTRIES = 8
+
     def _device_args(self, plan):
         """Device-resident copies of the per-fit constants.
 
         Re-uploading background/mask/G on every call costs one H2D per array
         per explain; through a tunnelled TPU those transfers dominate the
-        small-batch latency, so upload once and key the cache by plan."""
+        small-batch latency, so upload once and key the cache by the plan's
+        CONTENT fingerprint (``ops/coalitions.plan_fingerprint`` — an
+        ``id(plan)`` key could alias a GC'd plan's recycled address and
+        silently serve stale constants).  LRU-bounded."""
 
-        key = id(plan)
+        from distributedkernelshap_tpu.ops.coalitions import plan_fingerprint
+
+        key = plan_fingerprint(plan)
         if key not in self._dev_cache:
             self._dev_cache[key] = tuple(jnp.asarray(a) for a in (
                 self.background, self.bg_weights, plan.mask, plan.weights, self.G))
+            while len(self._dev_cache) > self._DEV_CACHE_MAX_ENTRIES:
+                self._dev_cache.popitem(last=False)
+        else:
+            self._dev_cache.move_to_end(key)
         return self._dev_cache[key]
+
+    # ------------------------------------------------------------------ #
+    # plan-constant device cache (linear fast path)
+
+    def content_fingerprint(self) -> str:
+        """Stable content fingerprint of (model, background, grouping):
+        sha256 over the linear decomposition's weight bytes (or the
+        predictor's repr for non-linear models), the background rows and
+        weights, and the group matrix.  Combined with the plan fingerprint
+        it keys the plan-constant cache — the invalidation contract is
+        documented in docs/PERFORMANCE.md (a refit builds a new engine;
+        in-place predictor mutation is not detected, same as the serving
+        result cache)."""
+
+        if self._content_fp is None:
+            import hashlib
+
+            h = hashlib.sha256()
+            linear = self.predictor.linear_decomposition
+            if linear is not None:
+                W, b, activation = linear
+                h.update(np.asarray(W).tobytes())
+                h.update(np.asarray(b).tobytes())
+                h.update(activation.encode())
+            else:
+                h.update(repr(type(self.predictor)).encode())
+            h.update(self.background.tobytes())
+            h.update(self.bg_weights.tobytes())
+            h.update(self.G.tobytes())
+            h.update(self.config.link.encode())
+            h.update(repr(self.config.shap.ridge).encode())
+            self._content_fp = h.hexdigest()
+        return self._content_fp
+
+    def _plan_consts_enabled(self) -> bool:
+        """Whether the plan-constant fast path applies to this engine: a
+        linear predictor off the host-eval path, with the Pallas fused
+        kernel NOT engaged (it consumes the raw background tensors, so
+        there is nothing to hoist), and the knob not set to ``'off'``.
+        ``False`` keeps the fast path ON but disables constant reuse —
+        the A/B control arm (see ``EngineConfig.plan_constant_cache``)."""
+
+        if self.config.plan_constant_cache == 'off' or self.config.host_eval:
+            return False
+        linear = self.predictor.linear_decomposition
+        if linear is None:
+            return False
+        from distributedkernelshap_tpu.ops.explain import resolve_use_pallas
+
+        if resolve_use_pallas(self.config.shap.use_pallas) \
+                and linear[2] != 'identity':
+            return False
+        return True
+
+    def _plan_consts(self, plan, chunk: int):
+        """Device-resident X-independent constants for (model, background,
+        ``plan``) at coalition-chunk ``chunk`` — computed by the jitted
+        precompute fn, then served from an LRU-bounded cache keyed by
+        content fingerprints (never object identity).  With
+        ``plan_constant_cache=False`` the cache is bypassed both ways:
+        recomputed every call (the A/B control arm pays the hoisted work
+        per request, exactly what the cache exists to save)."""
+
+        from distributedkernelshap_tpu.ops.coalitions import plan_fingerprint
+        from distributedkernelshap_tpu.ops.explain import (
+            build_linear_plan_consts_fn,
+        )
+
+        reuse = self.config.plan_constant_cache is not False
+        key = (self.content_fingerprint(), plan_fingerprint(plan), chunk)
+        if reuse and key in self._plan_consts_cache:
+            self._plan_consts_cache.move_to_end(key)
+            return self._plan_consts_cache[key]
+        fnkey = ('plan_consts', chunk)
+        if fnkey not in self._fn_cache:
+            self._fn_cache[fnkey] = jax.jit(build_linear_plan_consts_fn(
+                self.predictor,
+                replace(self.config.shap, link=self.config.link),
+                chunk))
+        with profiler().phase('plan_consts'):
+            consts = self._fn_cache[fnkey](*self._device_args(plan))
+        if reuse:
+            self._plan_consts_cache[key] = consts
+            while len(self._plan_consts_cache) > self._DEV_CACHE_MAX_ENTRIES:
+                self._plan_consts_cache.popitem(last=False)
+        return consts
+
+    def _linear_fast_call(self, Xp: np.ndarray, plan):
+        """Dispatch ``Xp`` through the plan-constant cached path; returns
+        the output dict, or ``None`` when the path does not apply at these
+        shapes (the caller then runs the classic self-contained program).
+        ``Xp`` is already bucket-padded."""
+
+        if not self._plan_consts_enabled():
+            return None
+        from distributedkernelshap_tpu.ops.explain import (
+            _auto_chunk,
+            build_linear_cached_fn,
+            capture_kernel_paths,
+            plan_constants_variant,
+        )
+
+        cfg = self.config.shap
+        K = self.predictor.n_outputs
+        N = self.background.shape[0]
+        S = plan.n_rows
+        Bp = Xp.shape[0]
+        # the same chunk policy as the uncached path at this padded batch
+        # size — the cached background tensor must be chunked exactly the
+        # way the uncached lax.map would chunk, or bit-identity breaks
+        chunk = cfg.coalition_chunk or _auto_chunk(
+            S, Bp * N * K, cfg.target_chunk_elems)
+        activation = self.predictor.linear_decomposition[2]
+        variant = plan_constants_variant(activation, int(K))
+        if variant != 'identity':
+            # footprint gate: the cached (padded-S, N[, K]) background
+            # tensor must itself fit the chunk budget — past that, holding
+            # it resident costs more HBM than the per-call einsum saves
+            c = min(S, 2 * chunk) if variant == 'binary' else chunk
+            padded_S = math.ceil(S / c) * c
+            elems = padded_S * N * (1 if variant == 'binary' else K)
+            if elems > cfg.target_chunk_elems:
+                return None
+        fnkey = ('linear_fast', chunk)
+        if fnkey not in self._fn_cache:
+            self._fn_cache[fnkey] = jax.jit(build_linear_cached_fn(
+                self.predictor,
+                replace(cfg, link=self.config.link), chunk))
+        consts = self._plan_consts(plan, chunk)
+        with capture_kernel_paths() as kp:
+            out = self._fn_cache[fnkey](jnp.asarray(Xp, jnp.float32), consts)
+        self._kernel_paths.update(kp)
+        return out
 
     def _explain_array(self, X: np.ndarray, nsamples,
                        silent: bool = True) -> Dict[str, np.ndarray]:
@@ -889,12 +1059,22 @@ class KernelExplainerEngine:
         exploits both."""
 
         Xp, B = self._pad_to_bucket(X)
-        from distributedkernelshap_tpu.ops.explain import capture_kernel_paths
+        # plan-constant fast path first: for linear predictors the
+        # X-independent einsums + WLS factorisation are served from the
+        # device cache and only the B×S×K work runs per call (phi is
+        # bit-identical between the cached and uncached arms — see
+        # EngineConfig.plan_constant_cache).  Returns None when it does
+        # not apply.
+        out = self._linear_fast_call(Xp, plan)
+        if out is None:
+            from distributedkernelshap_tpu.ops.explain import (
+                capture_kernel_paths,
+            )
 
-        with capture_kernel_paths() as kp:  # records only on first trace
-            out = self._fn()(jnp.asarray(Xp, jnp.float32),
-                             *self._device_args(plan))
-        self._kernel_paths.update(kp)
+            with capture_kernel_paths() as kp:  # records only on first trace
+                out = self._fn()(jnp.asarray(Xp, jnp.float32),
+                                 *self._device_args(plan))
+            self._kernel_paths.update(kp)
         # one packed D2H instead of three; the copy itself blocks on the
         # value, so an explicit block_until_ready would add a second full
         # round trip.  With transfer_dtype set, only phi rides the reduced

@@ -79,6 +79,35 @@ class CoalitionPlan:
         return self.mask.shape[0]
 
 
+def plan_fingerprint(plan: "CoalitionPlan") -> str:
+    """Stable CONTENT fingerprint of a plan: sha256 over the mask and
+    weight bytes (plus shapes, so transposed aliases cannot collide).
+
+    Device-constant caches used to key by ``id(plan)``; a garbage-collected
+    plan whose address got recycled by a different plan would then silently
+    serve the old plan's device constants.  Content keying makes that
+    impossible — equal bytes ARE the same constants.  Memoised on the plan
+    object (frozen dataclasses still carry a ``__dict__``), so the hash is
+    paid once per plan, not once per explain.
+    """
+
+    cached = plan.__dict__.get("_content_fp")
+    if cached is not None:
+        return cached
+    import hashlib
+
+    h = hashlib.sha256()
+    mask = np.ascontiguousarray(plan.mask)
+    weights = np.ascontiguousarray(plan.weights)
+    h.update(repr((mask.shape, str(mask.dtype), weights.shape,
+                   str(weights.dtype))).encode())
+    h.update(mask.tobytes())
+    h.update(weights.tobytes())
+    fp = h.hexdigest()
+    object.__setattr__(plan, "_content_fp", fp)
+    return fp
+
+
 def _enumerate_size(M: int, s: int) -> np.ndarray:
     rows = np.zeros((math.comb(M, s), M), dtype=np.float32)
     for i, idx in enumerate(combinations(range(M), s)):

@@ -309,6 +309,176 @@ def _ey_linear(W, b, activation: str, X, bg, bgw_n, mask, G, chunk,
     return ey[:, :S]
 
 
+def plan_constants_variant(activation: str, K: int) -> str:
+    """Which cached-fast-path variant a linear predictor maps to (mirrors
+    the dispatch inside :func:`_ey_linear` so the cached and uncached
+    paths always take structurally identical ops — the basis of the
+    bit-identity contract the warmup bench asserts)."""
+
+    if activation == "identity":
+        return "identity"
+    if activation == "softmax" and K == 2:
+        return "binary"
+    return "general"
+
+
+def build_linear_plan_consts_fn(predictor: BasePredictor, config: ShapConfig,
+                                chunk: int):
+    """Precompute fn for the **plan-constant device cache**: everything in
+    the linear fast path that depends only on (model, background, plan) —
+    the ``S×N×K`` masked-background tensor, the ``N×K`` background logits
+    reductions, and the already-factorised WLS Gram matrix — computed ONCE
+    per (model, background, plan, chunk) and kept device-resident, so a
+    small-B interactive request pays only the ``B×S×K`` einsum plus the
+    cached triangular solve (``ISSUE 5``; before this, ``_ey_linear``
+    recomputed all of it per call).
+
+    Returns ``precompute(bg, bgw, mask, weights, G) -> dict`` of device
+    constants consumed by :func:`build_linear_cached_fn`.  ``chunk`` is the
+    coalition chunk the PER-REQUEST fn will use — baked in here because the
+    cached background tensor is stored pre-chunked in exactly the layout
+    the uncached path's ``lax.map`` would produce, keeping the two paths'
+    floating-point op sequences identical.
+    """
+
+    link_fn = convert_to_link(config.link)
+    W, b, activation = predictor.linear_decomposition
+    K = int(W.shape[1])
+    variant = plan_constants_variant(activation, K)
+
+    def precompute(bg, bgw, mask, weights, G):
+        with jax.default_matmul_precision(config.matmul_precision):
+            bg = jnp.asarray(bg, jnp.float32)
+            bgw_n = bgw / jnp.sum(bgw)
+            GW = G[:, :, None] * W[None, :, :]            # (M, D, K)
+            bgWg = jnp.einsum("nd,mdk->nmk", bg, GW)      # (N, M, K)
+            bgW = bg @ W + b                              # (N, K)
+            e_out = jnp.einsum("nk,n->k", predictor(bg), bgw_n)
+            consts = {"mask": mask, "bgw_n": bgw_n, "GW": GW,
+                      "expected_value": link_fn(e_out)}
+            S, M = mask.shape
+            if M > 1:
+                # WLS plan constants: Gram matrix factorised here, so every
+                # request pays only the triangular solve
+                zl = mask[:, -1]
+                Zt = mask[:, :-1] - zl[:, None]
+                Aw = Zt * weights[:, None]
+                A = Aw.T @ Zt
+                A = A + config.ridge * jnp.eye(M - 1, dtype=A.dtype)
+                chol, _ = jax.scipy.linalg.cho_factor(A)
+                consts.update(zl=zl, Aw=Aw, chol=chol)
+            if variant == "identity":
+                consts["e_bgW"] = jnp.einsum("nk,n->k", bgW, bgw_n)
+                consts["t2w"] = jnp.einsum("sm,nmk,n->sk", mask, bgWg, bgw_n)
+            elif variant == "binary":
+                dbgWg = bgWg[:, :, 1] - bgWg[:, :, 0]
+                dbgW = bgW[:, 1] - bgW[:, 0]
+                mask_chunks, _ = _chunked(mask, min(S, 2 * chunk))
+                consts["dt2c"] = jax.lax.map(
+                    lambda mc: (jnp.einsum("sm,nm->sn", mc, dbgWg)
+                                - dbgW[None, :]),
+                    mask_chunks)                          # (n_chunks, c, N)
+            else:
+                mask_chunks, _ = _chunked(mask, chunk)
+                consts["t2c"] = jax.lax.map(
+                    lambda mc: jnp.einsum("sm,nmk->snk", mc, bgWg),
+                    mask_chunks)                          # (n_chunks, c, N, K)
+                consts["bgW"] = bgW
+            return consts
+
+    return precompute
+
+
+def build_linear_cached_fn(predictor: BasePredictor, config: ShapConfig,
+                           chunk: int):
+    """The per-request half of the plan-constant fast path:
+    ``explain(X, consts) -> dict`` consuming
+    :func:`build_linear_plan_consts_fn`'s device constants.
+
+    Every contraction/elementwise op mirrors :func:`_ey_linear` and
+    :func:`_wls_solve` (same formulas, same chunk layout, same op order).
+    The **bit-identity contract** the warmup bench asserts is between the
+    cached and uncached *arms of this same program* (constants served from
+    the device cache vs recomputed per call by the precompute fn) — the
+    compiled X-dependent program is then literally identical, so phi
+    cannot differ by construction.  Versus the classic self-contained
+    program (``plan_constant_cache='off'``) the formulas are the same but
+    XLA fuses a different whole-program graph, so the last ulp may drift
+    (observed ~1e-7 on CPU at B=1).  The Pallas fused kernel has no
+    cached variant (it consumes the raw ``bgWg`` tensors); callers gate
+    on that.
+    """
+
+    link_fn = convert_to_link(config.link)
+    W, b, activation = predictor.linear_decomposition
+    K = int(W.shape[1])
+    variant = plan_constants_variant(activation, K)
+    act = ACTIVATIONS[activation]
+
+    def explain(X, consts):
+        with jax.default_matmul_precision(config.matmul_precision):
+            return _explain(X, consts)
+
+    def _explain(X, consts):
+        record_kernel_path('ey', 'einsum_cached')
+        X = jnp.asarray(X, jnp.float32)
+        mask = consts["mask"]
+        S, M = mask.shape
+        bgw_n = consts["bgw_n"]
+        XWg = jnp.einsum("bd,mdk->bmk", X, consts["GW"])  # (B, M, K)
+        if variant == "identity":
+            p1 = jnp.einsum("sm,bmk->bsk", mask, XWg)
+            ey = (p1 + consts["e_bgW"][None, None, :]
+                  - consts["t2w"][None, :, :])
+        elif variant == "binary":
+            dXWg = XWg[:, :, 1] - XWg[:, :, 0]            # (B, M)
+            mask_chunks, S_orig = _chunked(mask, min(S, 2 * chunk))
+
+            def one_chunk_binary(args):
+                mask_c, dt2 = args
+                dp = jnp.einsum("sm,bm->bs", mask_c, dXWg)
+                probs1 = jax.nn.sigmoid(dp[:, :, None] - dt2[None])
+                return jnp.einsum("bcn,n->bc", probs1, bgw_n)
+
+            ey1 = jax.lax.map(one_chunk_binary,
+                              (mask_chunks, consts["dt2c"]))
+            ey1 = jnp.moveaxis(ey1, 1, 0).reshape(X.shape[0], -1)[:, :S_orig]
+            ey = jnp.stack([1.0 - ey1, ey1], axis=-1)
+        else:
+            bgW = consts["bgW"]
+            mask_chunks, S_orig = _chunked(mask, chunk)
+
+            def one_chunk(args):
+                mask_c, t2 = args
+                p1 = jnp.einsum("sm,bmk->bsk", mask_c, XWg)
+                logits = p1[:, :, None, :] + bgW[None, None, :, :] - t2[None]
+                out = act(logits)
+                return jnp.einsum("bcnk,n->bck", out, bgw_n)
+
+            ey = jax.lax.map(one_chunk, (mask_chunks, consts["t2c"]))
+            ey = jnp.moveaxis(ey, 1, 0).reshape(X.shape[0], -1, ey.shape[-1])
+            ey = ey[:, :S_orig]
+        expected_value = consts["expected_value"]
+        fx = link_fn(predictor(X))
+        ey_adj = link_fn(ey) - expected_value[None, None, :]
+        fx_minus_e = fx - expected_value[None, :]
+        if M == 1:
+            phi = fx_minus_e[:, :, None]
+        else:
+            zl = consts["zl"]
+            rhs = jnp.einsum(
+                "sm,bsk->bkm", consts["Aw"],
+                ey_adj - zl[None, :, None] * fx_minus_e[:, None, :])
+            phi = solve_from_factor(consts["chol"], rhs, fx_minus_e)
+        return {
+            "shap_values": phi,
+            "expected_value": expected_value,
+            "raw_prediction": fx,
+        }
+
+    return explain
+
+
 def normal_equations(mask, w, ey_adj, fx_minus_e):
     """Gram matrix and right-hand sides of the constrained WLS.
 
@@ -326,18 +496,29 @@ def normal_equations(mask, w, ey_adj, fx_minus_e):
     return A, rhs
 
 
+def solve_from_factor(chol, rhs, fx_minus_e):
+    """Triangular-solve the eliminated system from an already-computed
+    Cholesky factor and restore the last coefficient from the additivity
+    constraint.  Shared by the inline solve and the plan-constant cache
+    (which factorises once per plan)."""
+
+    B, K = fx_minus_e.shape
+    M1 = chol.shape[0]
+    sol = jax.scipy.linalg.cho_solve((chol, False),
+                                     rhs.reshape(B * K, M1).T)  # (M1, B*K)
+    phi_rest = sol.T.reshape(B, K, M1)
+    phi_last = fx_minus_e - phi_rest.sum(-1)
+    return jnp.concatenate([phi_rest, phi_last[..., None]], axis=-1)
+
+
 def solve_from_normal(A, rhs, fx_minus_e, ridge):
     """Cholesky-solve the eliminated system and restore the last coefficient
     from the additivity constraint."""
 
-    B, K = fx_minus_e.shape
     M1 = A.shape[0]
     A = A + ridge * jnp.eye(M1, dtype=A.dtype)
-    c, low = jax.scipy.linalg.cho_factor(A)
-    sol = jax.scipy.linalg.cho_solve((c, low), rhs.reshape(B * K, M1).T)  # (M1, B*K)
-    phi_rest = sol.T.reshape(B, K, M1)
-    phi_last = fx_minus_e - phi_rest.sum(-1)
-    return jnp.concatenate([phi_rest, phi_last[..., None]], axis=-1)
+    c, _ = jax.scipy.linalg.cho_factor(A)
+    return solve_from_factor(c, rhs, fx_minus_e)
 
 
 def _wls_solve(mask, w, ey_adj, fx_minus_e, ridge):

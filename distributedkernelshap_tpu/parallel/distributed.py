@@ -21,6 +21,7 @@ dispatcher, citing ``explainers/distributed.py:11-82``.
 
 import json
 import logging
+from collections import OrderedDict
 from dataclasses import replace
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
@@ -185,7 +186,7 @@ class DistributedExplainer:
         # the reference instead spawned n_actors replica processes
         self.engine = explainer_type(*init_args, **init_kwargs)
         self._jit_cache: Dict[Any, Any] = {}
-        self._dev_cache: Dict[Any, Any] = {}
+        self._dev_cache: "OrderedDict[Any, Any]" = OrderedDict()
         self.last_raw_prediction: Optional[np.ndarray] = None
         self.last_interaction_values: Optional[List[np.ndarray]] = None
         self.last_X_fingerprint = None
@@ -254,16 +255,30 @@ class DistributedExplainer:
                 )
         return self._jit_cache[key]
 
+    #: bound on device-constant cache entries (matches the engine's)
+    _DEV_CACHE_MAX_ENTRIES = 8
+
     def _device_args(self, plan):
         """Device-resident per-fit constants (one H2D upload, reused across
-        explain calls — same rationale as the single-device engine)."""
+        explain calls — same rationale as the single-device engine).
 
-        key = id(plan)
+        Keyed by the plan's CONTENT fingerprint, not ``id(plan)``: a GC'd
+        plan whose address got recycled by a different plan would have
+        silently served the old plan's device constants.  LRU-bounded so
+        an explicit-nsamples sweep cannot grow it without bound."""
+
+        from distributedkernelshap_tpu.ops.coalitions import plan_fingerprint
+
+        key = plan_fingerprint(plan)
         if key not in self._dev_cache:
             engine = self.engine
             self._dev_cache[key] = tuple(jnp.asarray(a) for a in (
                 engine.background, engine.bg_weights, plan.mask, plan.weights,
                 engine.G))
+            while len(self._dev_cache) > self._DEV_CACHE_MAX_ENTRIES:
+                self._dev_cache.popitem(last=False)
+        else:
+            self._dev_cache.move_to_end(key)
         return self._dev_cache[key]
 
     def _pad_sharded(self, X: np.ndarray):

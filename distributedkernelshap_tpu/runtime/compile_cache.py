@@ -1,0 +1,330 @@
+"""Persistent XLA compile cache + compile-event accounting.
+
+Cold starts dominate the serving stack's tail story: a replica restart
+(which the supervisor makes routine) re-pays every first-jit compile from
+scratch — ~40-140 s per bucket shape through a tunnelled chip — and the
+only mitigation in the tree used to be a grace timer
+(``serving/server.py`` ``first_batch_grace_s``).  This module is the
+runtime half of the cold-start subsystem:
+
+* :func:`enable_persistent_cache` wires JAX's **persistent compilation
+  cache** (``jax_compilation_cache_dir``) from explicit arguments or the
+  ``DKS_COMPILE_CACHE_DIR`` / ``DKS_COMPILE_CACHE_MIN_S`` env knobs, and
+  degrades to a logged no-op on JAX builds without the config options —
+  callers never need to version-gate.
+* :func:`compile_events` is the process-wide **compile accountant**: a
+  ``jax.monitoring`` listener classifying every backend compile as
+  ``fresh`` (XLA actually ran) or ``cache_hit`` (the persistent cache
+  served the executable), attributing it to the caller-declared *shape
+  signature* (``with compile_events().signature("rows=64"): ...``), and
+  exposing the counts/seconds as the ``dks_compile_total`` /
+  ``dks_compile_seconds_total`` registry metrics plus ``compile.backend``
+  trace spans parented to whatever request/warmup span is ambient.
+
+The classification piggybacks on the event ORDER JAX emits (verified on
+0.4.x): a persistent-cache hit records ``/jax/compilation_cache/
+cache_hits`` immediately before the ``backend_compile_duration`` event of
+the same compile on the same thread, so a thread-local pending-hit flag
+pairs them without any private-API reach-in.  On JAX builds without
+``jax.monitoring`` the accountant stays inert (zero counts, no errors).
+"""
+
+import logging
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+#: env knobs (documented in docs/PERFORMANCE.md)
+CACHE_DIR_ENV = "DKS_COMPILE_CACHE_DIR"
+MIN_COMPILE_S_ENV = "DKS_COMPILE_CACHE_MIN_S"
+
+#: suffixes of the jax.monitoring duration events that mark one backend
+#: compile (0.4.x spells it without a unit suffix; older/newer builds have
+#: carried ``_sec`` variants)
+_COMPILE_EVENT_SUFFIXES = ("backend_compile_duration",
+                           "backend_compile_duration_sec",
+                           "backend_compile_time_sec")
+#: the named event a persistent-cache hit records just before the
+#: (retrieval-timed) backend_compile event of the same compile
+_CACHE_HIT_EVENT = "cache_hits"
+
+_state_lock = threading.Lock()
+_enabled_dir: Optional[str] = None
+
+
+def enable_persistent_cache(cache_dir: Optional[str] = None,
+                            min_compile_time_s: Optional[float] = None
+                            ) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``cache_dir``.
+
+    Resolution order: explicit argument > ``DKS_COMPILE_CACHE_DIR`` env.
+    ``None``/empty resolves to "leave JAX's own configuration alone"
+    (``JAX_COMPILATION_CACHE_DIR`` still works natively) and returns
+    ``None``.  ``min_compile_time_s`` (> ``DKS_COMPILE_CACHE_MIN_S``,
+    default 0.0) sets the write threshold — JAX's own default of 1 s
+    would skip caching the fast CPU compiles the test/bench environments
+    exercise, so the subsystem defaults to caching everything.
+
+    Safe no-op (logged once, returns ``None``) on JAX versions without
+    the config options.  Idempotent: re-enabling with the same directory
+    does nothing; a different directory re-points the cache.
+    """
+
+    global _enabled_dir
+    cache_dir = cache_dir or os.environ.get(CACHE_DIR_ENV) or None
+    if not cache_dir:
+        return None
+    cache_dir = os.path.abspath(cache_dir)
+    if min_compile_time_s is None:
+        try:
+            min_compile_time_s = float(os.environ.get(MIN_COMPILE_S_ENV, "0"))
+        except ValueError:
+            min_compile_time_s = 0.0
+    with _state_lock:
+        if _enabled_dir == cache_dir:
+            return cache_dir
+        try:
+            import jax
+
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            try:
+                jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                                  float(min_compile_time_s))
+            except AttributeError:  # knob renamed/absent on this JAX
+                pass
+            try:
+                # -1: no entry-size floor — tiny CPU executables must cache
+                # too, or the A/B benches would measure nothing
+                jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                                  -1)
+            except AttributeError:
+                pass
+            try:
+                # the cache singleton latches its directory on the FIRST
+                # compile of the process; a server enables the cache only
+                # at start(), after the model fit already compiled, so the
+                # singleton must be re-pointed or the config update is
+                # silently ignored (verified on 0.4.37)
+                from jax.experimental.compilation_cache import (
+                    compilation_cache as _cc,
+                )
+
+                _cc.reset_cache()
+            except (ImportError, AttributeError):
+                pass
+        except (ImportError, AttributeError, ValueError, OSError) as e:
+            # AttributeError/ValueError: JAX without the persistent-cache
+            # config; OSError: unwritable dir.  Cold starts then simply
+            # stay cold — never break the caller.
+            logger.warning("persistent compile cache unavailable (%s); "
+                           "continuing without it", e)
+            return None
+        _enabled_dir = cache_dir
+    logger.info("persistent compile cache at %s "
+                "(min_compile_time_s=%.3g)", cache_dir, min_compile_time_s)
+    return cache_dir
+
+
+def persistent_cache_dir() -> Optional[str]:
+    """The directory :func:`enable_persistent_cache` last applied, if any."""
+
+    with _state_lock:
+        return _enabled_dir
+
+
+class CompileAccounting:
+    """Process-wide compile-event counts, by ``(kind, signature)``.
+
+    ``kind`` is ``'fresh'`` (XLA compiled) or ``'cache_hit'`` (persistent
+    cache served the executable; the recorded seconds are then retrieval
+    time).  ``signature`` is whatever shape label the caller declared via
+    :meth:`signature` around the dispatch that may compile — the warmup
+    ladder uses ``rows=<bucket>`` — and ``_unattributed`` otherwise.
+
+    Thread-safe; listener registration happens once per process on first
+    use (``jax.monitoring`` has no public unregister, and compile truth is
+    process-global anyway — per-component registries read it through
+    render-time callbacks, see :meth:`metric_counts`).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # {(kind, signature): count}, {(kind, signature): seconds}
+        self._counts: Dict[tuple, int] = {}
+        self._seconds: Dict[tuple, float] = {}
+        self._local = threading.local()
+        self._listening = False
+        self.supported = True
+
+    # -------------------------------------------------------------- #
+
+    def _ensure_listening(self) -> None:
+        if self._listening:
+            return
+        with self._lock:
+            if self._listening:
+                return
+            try:
+                import jax.monitoring as monitoring
+
+                monitoring.register_event_listener(self._on_event)
+                monitoring.register_event_duration_secs_listener(
+                    self._on_duration)
+            except Exception as e:  # jax too old / absent: stay inert
+                self.supported = False
+                logger.warning("compile accounting unavailable "
+                               "(jax.monitoring: %s)", e)
+            self._listening = True
+
+    def _on_event(self, event: str, **kwargs) -> None:
+        if event.rsplit("/", 1)[-1] == _CACHE_HIT_EVENT:
+            # pairs with the backend_compile duration event JAX records
+            # next on this same thread (the hit's retrieval is timed
+            # through the same code path as a real compile)
+            self._local.pending_hit = True
+
+    def _on_duration(self, event: str, duration: float, **kwargs) -> None:
+        name = event.rsplit("/", 1)[-1]
+        if name not in _COMPILE_EVENT_SUFFIXES:
+            return
+        hit = getattr(self._local, "pending_hit", False)
+        self._local.pending_hit = False
+        kind = "cache_hit" if hit else "fresh"
+        sig = getattr(self._local, "signature", None) or "_unattributed"
+        key = (kind, sig)
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + 1
+            self._seconds[key] = self._seconds.get(key, 0.0) + float(duration)
+        self._record_span(kind, sig, duration)
+
+    def _record_span(self, kind: str, sig: str, duration: float) -> None:
+        """A ``compile.backend`` trace span for the event, parented to the
+        ambient request/warmup context (compiles run synchronously on the
+        dispatching thread, so the contextvar is the right parent)."""
+
+        try:
+            from distributedkernelshap_tpu.observability import tracing
+
+            tr = tracing.tracer()
+            if not tr.enabled:
+                return
+            end = time.monotonic()
+            tr.record_mono("compile.backend", end - duration, end,
+                           parent=tracing.current_context(),
+                           kind=kind, signature=sig)
+        except Exception:  # tracing must never break a compile
+            logger.debug("compile span recording failed", exc_info=True)
+
+    # -------------------------------------------------------------- #
+
+    @contextmanager
+    def signature(self, sig: str):
+        """Attribute compile events fired on THIS thread inside the block
+        to shape signature ``sig`` (nesting restores the outer value)."""
+
+        self._ensure_listening()
+        prev = getattr(self._local, "signature", None)
+        self._local.signature = str(sig)
+        try:
+            yield self
+        finally:
+            self._local.signature = prev
+
+    def snapshot(self) -> Dict[str, Dict]:
+        """Structured copy of the counts: ``{"counts": {(kind, sig): n},
+        "seconds": {(kind, sig): s}}`` plus per-kind totals."""
+
+        self._ensure_listening()
+        with self._lock:
+            counts = dict(self._counts)
+            seconds = dict(self._seconds)
+        totals = {"fresh": 0, "cache_hit": 0}
+        sec_totals = {"fresh": 0.0, "cache_hit": 0.0}
+        for (kind, _), n in counts.items():
+            totals[kind] = totals.get(kind, 0) + n
+        for (kind, _), s in seconds.items():
+            sec_totals[kind] = sec_totals.get(kind, 0.0) + s
+        return {"counts": counts, "seconds": seconds,
+                "totals": totals, "seconds_totals": sec_totals}
+
+    @staticmethod
+    def delta(before: Dict, after: Dict) -> Dict[str, Dict]:
+        """``after - before`` for two :meth:`snapshot` results (new
+        signatures appear, untouched ones drop out)."""
+
+        out = {"counts": {}, "seconds": {}}
+        for field in ("counts", "seconds"):
+            b = before[field]
+            for key, val in after[field].items():
+                d = val - b.get(key, 0)
+                if d:
+                    out[field][key] = d
+        out["totals"] = {
+            k: after["totals"].get(k, 0) - before["totals"].get(k, 0)
+            for k in set(after["totals"]) | set(before["totals"])}
+        out["seconds_totals"] = {
+            k: (after["seconds_totals"].get(k, 0.0)
+                - before["seconds_totals"].get(k, 0.0))
+            for k in set(after["seconds_totals"])
+            | set(before["seconds_totals"])}
+        return out
+
+    def fresh_for_signature(self, snapshot_delta: Dict, sig: str) -> int:
+        """Fresh-compile count one signature contributed to a delta."""
+
+        return sum(n for (kind, s), n in snapshot_delta["counts"].items()
+                   if kind == "fresh" and s == sig)
+
+    # ----------------------- registry callbacks ------------------- #
+
+    def metric_counts(self) -> Dict[tuple, float]:
+        self._ensure_listening()
+        with self._lock:
+            return {k: float(v) for k, v in self._counts.items()}
+
+    def metric_seconds(self) -> Dict[tuple, float]:
+        self._ensure_listening()
+        with self._lock:
+            return dict(self._seconds)
+
+    def attach_metrics(self, registry) -> None:
+        """Register ``dks_compile_total{kind,signature}`` and
+        ``dks_compile_seconds_total{kind,signature}`` on ``registry`` as
+        callback counters reading this (process-global) accountant.
+        Signature cardinality is bounded: only warmup-ladder rungs and
+        serving buckets declare signatures; everything else folds into
+        ``_unattributed``.  Starts the listener immediately — compiles
+        fired between registration and the first scrape must count."""
+
+        self._ensure_listening()
+        registry.counter(
+            "dks_compile_total",
+            "Backend compile events by kind (fresh = XLA compiled, "
+            "cache_hit = persistent compile cache served the executable) "
+            "and declared shape signature.",
+            labelnames=("kind", "signature")).set_function(self.metric_counts)
+        registry.counter(
+            "dks_compile_seconds_total",
+            "Seconds spent in backend compile events (cache_hit rows "
+            "count retrieval time) by kind and shape signature.",
+            labelnames=("kind", "signature")).set_function(
+            self.metric_seconds)
+
+
+_accounting: Optional[CompileAccounting] = None
+_accounting_lock = threading.Lock()
+
+
+def compile_events() -> CompileAccounting:
+    """The process-wide compile accountant (created on first use)."""
+
+    global _accounting
+    with _accounting_lock:
+        if _accounting is None:
+            _accounting = CompileAccounting()
+        return _accounting

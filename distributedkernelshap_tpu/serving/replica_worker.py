@@ -73,6 +73,11 @@ def main():
     parser.add_argument("--max_batch_size", default=10, type=int)
     parser.add_argument("--pipeline_depth", default=0, type=int,
                         help="0 = self-calibrate at startup")
+    parser.add_argument("--no-warmup", action="store_true",
+                        help="skip the precompile warmup ladder (replicas "
+                             "default it ON — a restarted worker otherwise "
+                             "re-pays every bucket's first-jit compile on "
+                             "live traffic; DKS_WARMUP=0 also disables)")
     args = parser.parse_args()
 
     factory = resolve_factory(args.factory)
@@ -88,7 +93,17 @@ def main():
     # jax imports (inside serve_explainer's dependency chain) happen after
     # the factory resolves, with TPU_VISIBLE_CHIPS already in the
     # environment from the manager — this process initialises ONE chip.
-    from distributedkernelshap_tpu.serving.server import serve_explainer
+    from distributedkernelshap_tpu.serving.server import (
+        resolve_warmup_env,
+        serve_explainer,
+    )
+
+    # replica workers default the warmup ladder ON (the supervisor makes
+    # restarts routine, and a restarted worker must not re-pay its bucket
+    # compiles on live traffic); --no-warmup or DKS_WARMUP=0 opt out, and
+    # the /healthz "warming" readiness gate keeps the prober/supervisor
+    # away while the ladder compiles
+    warmup = False if args.no_warmup else resolve_warmup_env(default=True)
 
     predictor, background, ctor_kwargs, fit_kwargs = factory()
     server = serve_explainer(
@@ -96,7 +111,7 @@ def main():
         host=args.host, port=args.port,
         max_batch_size=args.max_batch_size,
         pipeline_depth=args.pipeline_depth or None,
-        fault_injector=fault_injector)
+        fault_injector=fault_injector, warmup=warmup)
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())

@@ -901,8 +901,17 @@ class ReplicaManager:
                     self.ports[index])
         return subprocess.Popen(argv, env=env)
 
-    def _wait_healthy(self, index: int, timeout_s: float) -> bool:
+    def _wait_healthy(self, index: int, timeout_s: float):
+        """``True`` (ready), ``False`` (dead/unreachable) or ``"warming"``
+        — the replica answers /healthz with the warmup ladder's distinct
+        503 ``{"status": "warming"}``.  Warming is startup PROGRESS, not
+        failure: the manager must neither kill the process (the
+        crash-loop the warmup readiness gate exists to prevent) nor fail
+        startup over it — the proxy's prober readmits the replica the
+        moment its ladder finishes and /healthz answers 200."""
+
         deadline = time.monotonic() + timeout_s
+        warming = False
         while time.monotonic() < deadline and not self._stop.is_set():
             if self.procs[index].poll() is not None:
                 return False  # died during startup
@@ -911,14 +920,19 @@ class ReplicaManager:
                                                   self.ports[index],
                                                   timeout=5)
                 conn.request("GET", "/healthz")
-                ok = conn.getresponse().status == 200
+                resp = conn.getresponse()
+                status, body = resp.status, resp.read()
                 conn.close()
-                if ok:
+                if status == 200:
                     return True
+                try:
+                    warming = json.loads(body).get("status") == "warming"
+                except ValueError:
+                    warming = False
             except OSError:
                 pass
             time.sleep(0.5)
-        return False
+        return "warming" if warming else False
 
     # ------------------------------------------------------------------ #
 
@@ -939,21 +953,29 @@ class ReplicaManager:
             t.start()
         for t in probers:
             t.join()
+        # a replica still compiling its warmup ladder counts as STARTED
+        # (its process is up and making progress) but not yet routable —
+        # killing the fleet because every replica is warming would be the
+        # crash-loop the readiness gate exists to prevent
         if not any(ok):
             self.stop()
             raise RuntimeError(
                 f"no replica became healthy within "
                 f"{self.startup_timeout_s:.0f}s (factory={self.factory})")
-        if not all(ok):
-            logger.warning("replicas %s failed to start; serving with %d/%d",
-                           [i for i, o in enumerate(ok) if not o],
-                           sum(ok), self.n_replicas)
+        if not all(o is True for o in ok):
+            logger.warning(
+                "replicas %s not ready at startup (%s still warming); "
+                "serving with %d/%d — the prober readmits warmers when "
+                "their ladder finishes",
+                [i for i, o in enumerate(ok) if o is not True],
+                [i for i, o in enumerate(ok) if o == "warming"],
+                sum(o is True for o in ok), self.n_replicas)
         self.proxy = FanInProxy(
             [(self.host, p) for p in self.ports],
             host=proxy_host or self.host, port=proxy_port,
             hedge_policy=self.hedge_policy).start()
         for i, o in enumerate(ok):
-            if not o:
+            if o is not True:
                 self.proxy.replicas[i].alive = False
         if self.restart:
             self.supervisor = ReplicaSupervisor(

@@ -34,6 +34,7 @@ Request flow since the scheduling subsystem landed
 import json
 import logging
 import math
+import os
 import queue
 import threading
 import time
@@ -202,6 +203,24 @@ def calibrate_pipeline_depth(model, example_array: Optional[np.ndarray] = None,
     return out["depth"]
 
 
+def resolve_warmup_env(default: bool) -> bool:
+    """The ONE ``DKS_WARMUP`` parser (standalone servers default warmup
+    off, replica workers default it on — but an unrecognised value must
+    mean the same thing everywhere: fall back to the component default,
+    loudly, rather than silently flipping per component)."""
+
+    raw = os.environ.get("DKS_WARMUP", "").strip().lower()
+    if not raw:
+        return default
+    if raw in ("1", "true", "on", "yes"):
+        return True
+    if raw in ("0", "false", "off", "no"):
+        return False
+    logger.warning("unrecognised DKS_WARMUP=%r; using the component "
+                   "default (%s)", raw, default)
+    return default
+
+
 class ExplainerServer:
     """Serves a fitted serving model over HTTP on ``/explain``.
 
@@ -279,6 +298,18 @@ class ExplainerServer:
         :func:`~distributedkernelshap_tpu.observability.slo.
         default_server_slos`), alert rules (default: one burn-rate rule
         per SLO) and sinks (default: log + flight recorder).
+    warmup
+        Precompile **warmup ladder** (docs/PERFORMANCE.md): at start the
+        dispatcher thread traces+compiles the engine over every bucket
+        shape up to ``max_batch_size`` rows, so the first real request of
+        any bucket lands on a warm program.  While warming, ``/healthz``
+        answers 503 ``{"status": "warming", ...}`` — the fan-in prober
+        will not route to the replica and an orchestrator's readiness
+        gate holds — and progress renders on ``/statusz``.  ``None``
+        (default) resolves from the ``DKS_WARMUP`` env (off unless
+        truthy); replica workers default it ON.  A warmup failure is
+        logged and serving proceeds (the first real requests then pay the
+        compiles, exactly the pre-warmup behaviour).
     """
 
     def __init__(self, model, host: str = "0.0.0.0", port: int = 8000,
@@ -296,7 +327,8 @@ class ExplainerServer:
                  admission_control: bool = True,
                  fault_injector=None,
                  health_interval_s: float = 1.0,
-                 slos=None, alert_rules=None, alert_sinks=None):
+                 slos=None, alert_rules=None, alert_sinks=None,
+                 warmup: Optional[bool] = None):
         self.model = model
         self.host = host
         self.port = port
@@ -349,6 +381,18 @@ class ExplainerServer:
             estimator=self._service_rate) if admission_control else None)
         self._cache = ResultCache(cache_bytes) if cache_bytes else None
         self._faults = fault_injector
+        # precompile warmup ladder (see the ``warmup`` parameter): state is
+        # read by /healthz, /statusz and the dks_serve_warming metrics;
+        # mutated only by the dispatcher thread under the lock
+        if warmup is None:
+            warmup = resolve_warmup_env(default=False)
+        self._warmup_lock = threading.Lock()
+        self._warmup_state = {
+            "enabled": bool(warmup),
+            "state": "pending" if warmup else "off",
+            "buckets": [], "completed_buckets": [], "current": None,
+            "elapsed_s": 0.0, "error": None, "compile": {},
+        }
         # observability: every dks_serve_* series is registered here and
         # /metrics is rendered solely by the registry (one renderer for
         # the whole process — SURVEY.md §5.5; docs/OBSERVABILITY.md holds
@@ -481,6 +525,24 @@ class ExplainerServer:
             reg.counter("dks_serve_cache_evictions_total",
                         "LRU evictions under the byte budget.").set_function(
                 lambda: self._cache.stats()["evictions"])
+        # cold-start subsystem: warmup-ladder readiness state plus the
+        # process-global compile accounting (runtime/compile_cache.py) —
+        # fresh-vs-persistent-cache-hit compile counts and seconds, by
+        # declared shape signature
+        reg.gauge("dks_serve_warming",
+                  "Whether the precompile warmup ladder is still gating "
+                  "readiness.").set_function(lambda: int(self._warming()))
+        reg.gauge("dks_serve_warmup_buckets_total",
+                  "Bucket shapes in the warmup ladder.").set_function(
+            lambda: len(self._warmup_state["buckets"]))
+        reg.gauge("dks_serve_warmup_buckets_done",
+                  "Warmup ladder buckets already compiled.").set_function(
+            lambda: len(self._warmup_state["completed_buckets"]))
+        from distributedkernelshap_tpu.runtime.compile_cache import (
+            compile_events,
+        )
+
+        compile_events().attach_metrics(reg)
         # the scheduler registers its own dks_sched_* series (queue wait,
         # expiries) on the same registry so one page carries everything
         attach = getattr(self._sched, "attach_metrics", None)
@@ -671,6 +733,7 @@ class ExplainerServer:
             detail["in_flight_batches"] = len(self._active)
         if self._cache is not None:
             detail["cache"] = self._cache.stats()
+        detail["warmup"] = self.warmup_status()
         return detail
 
     def _split_batch_on_cache(self, batch):
@@ -706,6 +769,146 @@ class ExplainerServer:
             live.append(p)
         return live, leaders, index_map
 
+    # ------------------------------------------------------------------ #
+    # precompile warmup ladder (cold-start subsystem; docs/PERFORMANCE.md)
+
+    def _warming(self) -> bool:
+        """True while the warmup ladder gates readiness (enabled and not
+        yet finished — done/failed/aborted all release the gate)."""
+
+        with self._warmup_lock:
+            return self._warmup_state["state"] in ("pending", "running")
+
+    def warmup_status(self) -> dict:
+        """Snapshot of the warmup ladder for /healthz, /statusz and the
+        warmup bench: enabled flag, state machine position, ladder sizes,
+        completed rungs and the compile accounting delta."""
+
+        with self._warmup_lock:
+            st = dict(self._warmup_state)
+            st["buckets"] = list(st["buckets"])
+            st["completed_buckets"] = list(st["completed_buckets"])
+            st["compile"] = dict(st["compile"])
+        st["total"] = len(st["buckets"])
+        st["completed"] = len(st["completed_buckets"])
+        return st
+
+    def _warmup_ladder(self, engine) -> list:
+        """Every distinct compile bucket a dispatchable batch of up to
+        ``max_batch_size`` rows can pad to, ascending (smallest first so
+        interactive shapes warm earliest).  Uses the engine's own bucket
+        function so the ladder can never drift from the padding the real
+        dispatch applies; falls back to a pure power-of-two ladder for
+        models that expose no engine."""
+
+        top = max(1, self.max_batch_size)
+        bucket = getattr(engine, "_bucket", None)
+        if bucket is None or not getattr(
+                getattr(engine, "config", None), "bucket_batches", True):
+            sizes = {top}
+            b = 1
+            while b < top:
+                sizes.add(b)
+                b *= 2
+            return sorted(sizes)
+        return sorted({int(bucket(n)) for n in range(1, top + 1)})
+
+    def _run_warmup(self) -> None:
+        """Trace+compile the engine over the bucket ladder (dispatcher
+        thread, before the batch loop — the engine's jit caches are
+        single-dispatcher state, so warmup must run exactly where real
+        dispatches will).  Requests arriving meanwhile park in the
+        scheduler; the readiness gate keeps routers away.  Failure is
+        logged and serving proceeds — a broken warmup must never be worse
+        than no warmup."""
+
+        st = self._warmup_state
+        if not st["enabled"]:
+            return
+        from distributedkernelshap_tpu.runtime.compile_cache import (
+            compile_events,
+        )
+
+        ce = compile_events()
+        before = ce.snapshot()
+        t0 = time.monotonic()
+        tr = self._tracer
+        root = tr.begin("server.warmup") if tr.enabled else None
+        state = "failed"
+        try:
+            engine = getattr(getattr(self.model, "explainer", None),
+                             "_explainer", None)
+            bg = getattr(engine, "background", None)
+            if bg is None:
+                # DistributedExplainer wraps the real engine one level
+                # down; the ladder then comes from the inner engine's
+                # _bucket — bucketing is idempotent, so those rungs cover
+                # every shape _pad_sharded produces for real dispatches
+                engine = getattr(engine, "engine", None)
+                bg = getattr(engine, "background", None)
+            if bg is None or not hasattr(self.model, "explain_batch"):
+                raise RuntimeError(
+                    "model exposes no engine background to warm with")
+            ladder = self._warmup_ladder(engine)
+            with self._warmup_lock:
+                st["state"] = "running"
+                st["buckets"] = list(ladder)
+            row = np.asarray(bg[:1], dtype=np.float32)
+            with _tracing.use_context(root.context if root is not None
+                                      else None):
+                for b in ladder:
+                    if self._stop.is_set():
+                        state = "aborted"
+                        return
+                    with self._warmup_lock:
+                        st["current"] = int(b)
+                    span = (tr.begin("warmup.bucket", parent=root, rows=b)
+                            if tr.enabled else None)
+                    try:
+                        with profiler().phase("warmup"), \
+                                ce.signature(f"rows={b}"):
+                            self.model.explain_batch(
+                                np.tile(row, (int(b), 1)),
+                                split_sizes=[int(b)])
+                    finally:
+                        if span is not None:
+                            tr.end(span)
+                    # warmup progress IS device progress — keep the
+                    # watchdog's view current through a long ladder
+                    self._last_progress = time.monotonic()
+                    with self._warmup_lock:
+                        st["completed_buckets"].append(int(b))
+                        st["current"] = None
+                        st["elapsed_s"] = round(time.monotonic() - t0, 3)
+            state = "done"
+        except Exception as e:
+            logger.exception("warmup ladder failed; serving cold")
+            with self._warmup_lock:
+                st["error"] = str(e)
+        finally:
+            delta = ce.delta(before, ce.snapshot())
+            with self._warmup_lock:
+                st["state"] = state
+                st["elapsed_s"] = round(time.monotonic() - t0, 3)
+                st["compile"] = {
+                    "fresh": int(delta["totals"].get("fresh", 0)),
+                    "cache_hit": int(delta["totals"].get("cache_hit", 0)),
+                    "seconds": round(
+                        sum(delta["seconds_totals"].values()), 3)}
+                compile_summary = dict(st["compile"])
+                done = list(st["completed_buckets"])
+            self._flight.record("warmup", component="server", state=state,
+                                buckets=done, **compile_summary)
+            if root is not None:
+                tr.end(root, state=state, **compile_summary)
+            if state == "done":
+                logger.info(
+                    "warmup ladder done: buckets %s in %.1fs (%d fresh "
+                    "compiles, %d persistent-cache hits, %.1fs compiling)",
+                    done, time.monotonic() - t0, compile_summary["fresh"],
+                    compile_summary["cache_hit"],
+                    compile_summary["seconds"])
+
     def _dispatch_loop(self):
         """Form batches via the scheduler and dispatch one device call each.
 
@@ -716,6 +919,11 @@ class ExplainerServer:
         overlap, so pipelining collapses the per-batch round-trip cost."""
 
         try:
+            # precompile warmup ladder first: this thread owns the engine's
+            # jit caches, and the readiness gate (/healthz "warming") keeps
+            # routers away while it runs; queued requests wait in the
+            # scheduler and land on warm programs
+            self._run_warmup()
             while not self._stop.is_set():
                 batch, expired = self._sched.next_batch(
                     self.max_batch_size,
@@ -919,6 +1127,12 @@ class ExplainerServer:
             return 503, {"status": "wedged",
                          "error": "device made no progress within the "
                                   "watchdog timeout"}
+        if self._warming():
+            # not-ready, not broken: the prober must not route here yet and
+            # an orchestrator must not restart a replica that is merely
+            # compiling its ladder — the distinct status string is the
+            # contract ReplicaManager._wait_healthy keys on
+            return 503, {"status": "warming", "warmup": self.warmup_status()}
         with self._active_lock:
             busy = bool(self._active)
         if busy and (time.monotonic() - self._last_progress
@@ -1180,6 +1394,14 @@ class ExplainerServer:
     # ------------------------------------------------------------------ #
 
     def start(self):
+        # persistent compile cache (env-driven; no-op without
+        # DKS_COMPILE_CACHE_DIR): wired before any serving-path compile so
+        # warmup + first requests read/write it
+        from distributedkernelshap_tpu.runtime.compile_cache import (
+            enable_persistent_cache,
+        )
+
+        enable_persistent_cache()
         # bind + serve the socket FIRST: requests arriving during depth
         # calibration park in the scheduler (handlers wait on their response
         # events) instead of getting connection-refused on an unbound port
@@ -1253,10 +1475,19 @@ def serve_explainer(predictor, background_data, constructor_kwargs, fit_kwargs,
     round trips overlap), rather than how many model copies exist.  The
     default (``None``) self-calibrates the depth at startup."""
 
+    from distributedkernelshap_tpu.runtime.compile_cache import (
+        enable_persistent_cache,
+    )
     from distributedkernelshap_tpu.serving.wrappers import (
         BatchKernelShapModel,
         KernelShapModel,
     )
+
+    # persistent compile cache BEFORE the model build: the explainer fit
+    # below compiles too, and a restarted replica should read those
+    # executables from the cache as well (start() re-applies for servers
+    # constructed around a pre-built model — the call is idempotent)
+    enable_persistent_cache()
 
     cls = BatchKernelShapModel if (batched or max_batch_size > 1) else KernelShapModel
     model = cls(predictor, background_data, constructor_kwargs, fit_kwargs,

@@ -1,0 +1,506 @@
+"""Cold-start subsystem: persistent compile cache, precompile warmup
+ladder, plan-constant device caching (ISSUE 5).
+
+Covers the three contracts the warmup bench measures end-to-end, at unit
+scope: warm-vs-cold bit-identity, bucket-ladder coverage of every
+dispatchable padded size, and readiness gating (a replica inside warmup is
+not routed to, not readmitted by the prober, and not restarted by the
+supervisor), plus compile-accounting units, plan fingerprint stability and
+the dev-cache rekey/bound satellite.
+"""
+
+import gc
+import json
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu.data import DenseData
+from distributedkernelshap_tpu.kernel_shap import (
+    EngineConfig,
+    KernelExplainerEngine,
+)
+from distributedkernelshap_tpu.ops.coalitions import (
+    CoalitionPlan,
+    plan_fingerprint,
+)
+from distributedkernelshap_tpu.runtime.compile_cache import (
+    CompileAccounting,
+    compile_events,
+    enable_persistent_cache,
+)
+
+
+# --------------------------------------------------------------------- #
+# fixtures: a tiny linear model (4 features — small plans, fast compiles)
+# --------------------------------------------------------------------- #
+
+
+@pytest.fixture(scope="module")
+def linear_setup():
+    from sklearn.linear_model import LogisticRegression
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(128, 4)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    clf = LogisticRegression(max_iter=200).fit(X, y)
+    bg = DenseData(X[:16], [f"f{i}" for i in range(4)], None)
+    return {"clf": clf, "bg": bg, "X": X}
+
+
+def _engine(setup, **cfg):
+    return KernelExplainerEngine(
+        setup["clf"].predict_proba, setup["bg"], link="logit", seed=0,
+        config=EngineConfig(**cfg) if cfg else None)
+
+
+# --------------------------------------------------------------------- #
+# compile accounting
+# --------------------------------------------------------------------- #
+
+
+def test_compile_events_attributes_signatures():
+    """A compile fired inside a signature() block lands under that shape
+    signature; outside, under _unattributed."""
+
+    import jax
+    import jax.numpy as jnp
+
+    ce = compile_events()
+    before = ce.snapshot()
+    salt = time.monotonic()  # a fresh constant forces a fresh compile
+    with ce.signature("rows=test"):
+        jax.jit(lambda x: x * salt + 1.0)(jnp.ones((3,)))
+    delta = ce.delta(before, ce.snapshot())
+    assert ce.fresh_for_signature(delta, "rows=test") >= 1
+    sig_seconds = [s for (kind, sig), s in delta["seconds"].items()
+                   if sig == "rows=test"]
+    assert sig_seconds and all(s > 0 for s in sig_seconds)
+
+
+def test_compile_events_signature_nesting_restores_outer():
+    ce = compile_events()
+    with ce.signature("outer"):
+        with ce.signature("inner"):
+            assert ce._local.signature == "inner"
+        assert ce._local.signature == "outer"
+    assert ce._local.signature is None
+
+
+def test_compile_delta_only_reports_movement():
+    ce = CompileAccounting()
+    a = {"counts": {("fresh", "x"): 2}, "seconds": {("fresh", "x"): 1.0},
+         "totals": {"fresh": 2}, "seconds_totals": {"fresh": 1.0}}
+    b = {"counts": {("fresh", "x"): 2, ("cache_hit", "y"): 3},
+         "seconds": {("fresh", "x"): 1.0, ("cache_hit", "y"): 0.5},
+         "totals": {"fresh": 2, "cache_hit": 3},
+         "seconds_totals": {"fresh": 1.0, "cache_hit": 0.5}}
+    d = ce.delta(a, b)
+    assert d["counts"] == {("cache_hit", "y"): 3}
+    assert d["totals"]["fresh"] == 0 and d["totals"]["cache_hit"] == 3
+
+
+def test_compile_metrics_registered_on_registry():
+    from distributedkernelshap_tpu.observability.metrics import (
+        MetricsRegistry,
+    )
+
+    reg = MetricsRegistry()
+    compile_events().attach_metrics(reg)
+    described = {m["name"]: m for m in reg.describe()}
+    assert described["dks_compile_total"]["type"] == "counter"
+    assert described["dks_compile_seconds_total"]["type"] == "counter"
+    assert "dks_compile_total" in reg.render()
+
+
+def test_enable_persistent_cache_no_dir_is_noop(monkeypatch):
+    monkeypatch.delenv("DKS_COMPILE_CACHE_DIR", raising=False)
+    assert enable_persistent_cache(None) is None
+
+
+# --------------------------------------------------------------------- #
+# plan fingerprint + dev-cache rekey/bound (satellite)
+# --------------------------------------------------------------------- #
+
+
+def _plan(mask):
+    mask = np.asarray(mask, dtype=np.float32)
+    w = np.full(mask.shape[0], 1.0 / mask.shape[0], dtype=np.float32)
+    return CoalitionPlan(mask=mask, weights=w, exact=False,
+                         n_enumerated=0)
+
+
+def test_plan_fingerprint_content_keyed():
+    a = _plan([[1, 0], [0, 1]])
+    b = _plan([[1, 0], [0, 1]])   # same content, different object
+    c = _plan([[1, 1], [0, 1]])
+    assert plan_fingerprint(a) == plan_fingerprint(b)
+    assert plan_fingerprint(a) != plan_fingerprint(c)
+    # memoised on the plan (sha paid once)
+    assert a.__dict__["_content_fp"] == plan_fingerprint(a)
+
+
+def test_plan_fingerprint_shape_disambiguation():
+    flat = np.array([[1, 0, 0, 1]], dtype=np.float32)
+    tall = flat.reshape(2, 2)
+    assert (plan_fingerprint(_plan(flat))
+            != plan_fingerprint(_plan(tall)))
+
+
+def test_dev_cache_rekeyed_by_content_and_bounded(linear_setup):
+    """A GC'd plan whose address is recycled can no longer alias a cache
+    entry: content-identical plans share one entry, distinct plans get
+    their own, and the LRU bound holds."""
+
+    eng = _engine(linear_setup)
+    a = _plan(np.eye(4))
+    eng._device_args(a)
+    key_a = plan_fingerprint(a)
+    del a
+    gc.collect()
+    b = _plan(np.eye(4))  # same content — MUST hit the same entry
+    eng._device_args(b)
+    assert len(eng._dev_cache) == 1
+    assert plan_fingerprint(b) == key_a
+    # bound: distinct plans never grow the cache past the cap
+    for i in range(eng._DEV_CACHE_MAX_ENTRIES + 4):
+        mask = np.eye(4, dtype=np.float32)
+        mask[0, 0] = float(i + 2)
+        eng._device_args(_plan(mask))
+    assert len(eng._dev_cache) <= eng._DEV_CACHE_MAX_ENTRIES
+
+
+def test_distributed_dev_cache_rekeyed_and_bounded(linear_setup):
+    from distributedkernelshap_tpu.parallel.distributed import (
+        DistributedExplainer,
+    )
+
+    dist = DistributedExplainer(
+        {"n_devices": 1, "batch_size": None, "algorithm": "kernel_shap"},
+        KernelExplainerEngine,
+        (linear_setup["clf"].predict_proba, linear_setup["bg"]),
+        {"link": "logit", "seed": 0},
+    )
+    a = _plan(np.eye(4))
+    dist._device_args(a)
+    del a
+    gc.collect()
+    dist._device_args(_plan(np.eye(4)))
+    assert len(dist._dev_cache) == 1
+    for i in range(dist._DEV_CACHE_MAX_ENTRIES + 4):
+        mask = np.eye(4, dtype=np.float32)
+        mask[0, 0] = float(i + 2)
+        dist._device_args(_plan(mask))
+    assert len(dist._dev_cache) <= dist._DEV_CACHE_MAX_ENTRIES
+
+
+# --------------------------------------------------------------------- #
+# plan-constant device cache (linear fast path)
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("B", [1, 3])
+def test_plan_constant_cache_bit_identical_to_uncached_arm(linear_setup, B):
+    """Cached and uncached arms run the SAME two-stage compiled program
+    (constants served from the device cache vs recomputed per call), so
+    phi must agree bit-for-bit — the contract the warmup bench asserts."""
+
+    on = _engine(linear_setup)
+    ctl = _engine(linear_setup, plan_constant_cache=False)
+    X = linear_setup["X"]
+    for lo in (40, 80):
+        a = np.stack(on.get_explanation(X[lo:lo + B]))
+        b = np.stack(ctl.get_explanation(X[lo:lo + B]))
+        assert (a == b).all()
+    assert on.kernel_path["ey"] == "einsum_cached"
+    assert len(on._plan_consts_cache) == 1      # reused, not regrown
+    assert len(ctl._plan_consts_cache) == 0     # control arm never stores
+
+
+def test_plan_constant_cache_classic_path_allclose(linear_setup):
+    """'off' runs the classic self-contained program — same formulas,
+    different whole-program XLA graph, so equality is tolerance-based."""
+
+    on = _engine(linear_setup)
+    off = _engine(linear_setup, plan_constant_cache='off')
+    X = linear_setup["X"][40:43]
+    a = np.stack(on.get_explanation(X))
+    c = np.stack(off.get_explanation(X))
+    assert off.kernel_path["ey"] == "einsum"
+    np.testing.assert_allclose(a, c, atol=2e-6)
+
+
+def test_plan_constant_cache_disabled_for_nonlinear(linear_setup):
+    """A black-box callable has no linear decomposition — the fast path
+    must not engage."""
+
+    clf = linear_setup["clf"]
+
+    def opaque(x):  # numpy in/out: lifts to CallbackPredictor
+        return clf.predict_proba(np.asarray(x))
+
+    eng = KernelExplainerEngine(opaque, linear_setup["bg"], link="logit",
+                                seed=0)
+    assert eng.predictor.linear_decomposition is None
+    assert not eng._plan_consts_enabled()
+
+
+def test_plan_constant_cache_cleared_on_reset(linear_setup):
+    eng = _engine(linear_setup)
+    eng.get_explanation(linear_setup["X"][40:42])
+    assert len(eng._plan_consts_cache) == 1
+    eng.reset_device_state()
+    assert len(eng._plan_consts_cache) == 0
+
+
+# --------------------------------------------------------------------- #
+# warm-vs-cold bit identity + ladder coverage
+# --------------------------------------------------------------------- #
+
+
+def test_warmed_ladder_phi_bit_identical_to_cold_engine(linear_setup):
+    """Explaining through an engine pre-warmed over every bucket shape
+    yields the same bits as a cold engine answering directly — warmup only
+    moves WHEN programs compile, never what they compute."""
+
+    warmed = _engine(linear_setup)
+    bg = np.asarray(linear_setup["bg"].data[:1], dtype=np.float32)
+    for b in (1, 2, 4):  # the bucket ladder for max_batch_size=4
+        warmed.get_explanation(np.tile(bg, (b, 1)))
+    cold = _engine(linear_setup)
+    X = linear_setup["X"][40:43]
+    a = np.stack(warmed.get_explanation(X))
+    b = np.stack(cold.get_explanation(X))
+    assert (a == b).all()
+
+
+def test_warmup_ladder_covers_every_dispatchable_padded_size(linear_setup):
+    """Every batch size 1..max_batch_size must pad to a bucket that is in
+    the ladder — otherwise a first request of that size would compile."""
+
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    eng = _engine(linear_setup)
+    for top in (1, 3, 8, 10):
+        stub = types.SimpleNamespace(max_batch_size=top)
+        ladder = ExplainerServer._warmup_ladder(stub, eng)
+        assert ladder == sorted(set(ladder))
+        for n in range(1, top + 1):
+            assert eng._bucket(n) in ladder, (top, n)
+
+
+def test_warmup_ladder_fallback_without_engine():
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    stub = types.SimpleNamespace(max_batch_size=10)
+    ladder = ExplainerServer._warmup_ladder(stub, None)
+    assert ladder == [1, 2, 4, 8, 10]
+
+
+# --------------------------------------------------------------------- #
+# readiness gating (no jax in the fake model — fast)
+# --------------------------------------------------------------------- #
+
+
+class _GatedWarmupModel:
+    """Fake model whose warmup blocks until released; real requests answer
+    instantly (the test controls exactly when the ladder 'compiles')."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        engine = types.SimpleNamespace(
+            background=np.ones((4, 2), dtype=np.float32))
+        self.explainer = types.SimpleNamespace(_explainer=engine)
+
+    def explain_batch(self, instances, split_sizes=None):
+        if not self.release.is_set():
+            # only warmup calls arrive before release; never wedge forever
+            assert self.release.wait(timeout=30)
+        sizes = split_sizes or [instances.shape[0]]
+        out, k = [], 0
+        for n in sizes:
+            rows = instances[k:k + n]
+            k += n
+            out.append(json.dumps(
+                {"data": {"sum": [float(r.sum()) for r in rows]}}))
+        return out
+
+
+def _healthz(port):
+    try:
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5)
+        return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture()
+def warming_server():
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    model = _GatedWarmupModel()
+    server = ExplainerServer(model, host="127.0.0.1", port=0,
+                             max_batch_size=4, pipeline_depth=1,
+                             health_interval_s=0, warmup=True).start()
+    try:
+        yield server, model
+    finally:
+        model.release.set()
+        server.stop()
+
+
+def _wait_for(predicate, timeout_s=10.0, interval_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return False
+
+
+def test_healthz_gates_readiness_during_warmup(warming_server):
+    server, model = warming_server
+    code, body = _healthz(server.port)
+    assert code == 503 and body["status"] == "warming"
+    assert body["warmup"]["state"] in ("pending", "running")
+    model.release.set()
+    assert _wait_for(lambda: _healthz(server.port)[0] == 200)
+    assert server.warmup_status()["state"] == "done"
+    assert server.warmup_status()["completed_buckets"] == [1, 2, 4]
+
+
+def test_statusz_renders_warmup_progress(warming_server):
+    server, model = warming_server
+    payload = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}/statusz?format=json",
+        timeout=10).read())
+    assert payload["detail"]["warmup"]["state"] in ("pending", "running")
+    model.release.set()
+    assert _wait_for(lambda: _healthz(server.port)[0] == 200)
+    payload = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}/statusz?format=json",
+        timeout=10).read())
+    assert payload["detail"]["warmup"]["state"] == "done"
+    assert payload["detail"]["warmup"]["completed"] == 3
+
+
+def test_prober_does_not_readmit_warming_replica(warming_server):
+    """The fan-in prober keys readmission on /healthz 200 — a replica
+    answering the warming 503 stays out of rotation until its ladder
+    finishes, then returns automatically."""
+
+    from distributedkernelshap_tpu.serving.replicas import FanInProxy
+
+    server, model = warming_server
+    proxy = FanInProxy([("127.0.0.1", server.port)], host="127.0.0.1",
+                       port=0, probe_interval_s=0.05,
+                       health_interval_s=0).start()
+    try:
+        proxy.replicas[0].alive = False
+        time.sleep(0.5)  # ≥9 probe rounds against the warming replica
+        assert proxy.replicas[0].alive is False
+        model.release.set()
+        assert _wait_for(lambda: proxy.replicas[0].alive)
+    finally:
+        proxy.stop()
+
+
+def test_supervisor_does_not_restart_warming_replica(warming_server):
+    """The supervisor restarts on process EXIT only; a warming replica's
+    process is alive, so ticks must not count it as crashed."""
+
+    from distributedkernelshap_tpu.resilience.supervisor import (
+        ReplicaSupervisor,
+    )
+
+    server, model = warming_server
+    assert server.warmup_status()["state"] in ("pending", "running")
+    warming_proc = types.SimpleNamespace(poll=lambda: None, returncode=None)
+    sup = ReplicaSupervisor([warming_proc],
+                            spawn=lambda i: pytest.fail(
+                                "supervisor respawned a warming replica"))
+    for _ in range(5):
+        sup._tick()
+    assert sup.restarts_total == 0
+    assert sup._respawn_at == {}
+
+
+def test_manager_wait_healthy_reports_warming(warming_server):
+    """ReplicaManager._wait_healthy distinguishes 'warming' (startup
+    progress — keep the process) from dead (False)."""
+
+    from distributedkernelshap_tpu.serving.replicas import ReplicaManager
+
+    server, model = warming_server
+    stub = types.SimpleNamespace(
+        procs=[types.SimpleNamespace(poll=lambda: None)],
+        host="127.0.0.1", ports=[server.port], _stop=threading.Event())
+    assert ReplicaManager._wait_healthy(stub, 0, timeout_s=1.5) == "warming"
+    model.release.set()
+    assert _wait_for(lambda: _healthz(server.port)[0] == 200)
+    assert ReplicaManager._wait_healthy(stub, 0, timeout_s=5.0) is True
+
+
+def test_warmup_failure_serves_cold():
+    """A broken warmup must never be worse than no warmup: the gate
+    releases, /healthz goes ready, and the error is recorded."""
+
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    class NoEngineModel:
+        def explain_batch(self, instances, split_sizes=None):
+            return [json.dumps({"data": {}})
+                    for _ in (split_sizes or [1])]
+
+    server = ExplainerServer(NoEngineModel(), host="127.0.0.1", port=0,
+                             max_batch_size=2, pipeline_depth=1,
+                             health_interval_s=0, warmup=True).start()
+    try:
+        assert _wait_for(lambda: _healthz(server.port)[0] == 200)
+        status = server.warmup_status()
+        assert status["state"] == "failed"
+        assert "background" in status["error"]
+    finally:
+        server.stop()
+
+
+@pytest.mark.parametrize("raw,default,expected", [
+    ("", True, True), ("", False, False),
+    ("1", False, True), ("yes", False, True),
+    ("0", True, False), ("off", True, False),
+    # unrecognised values fall back to the component default — the same
+    # value must never mean ON for replica workers but OFF for servers
+    ("enabled", True, True), ("enabled", False, False),
+])
+def test_resolve_warmup_env_one_parser(monkeypatch, raw, default, expected):
+    from distributedkernelshap_tpu.serving.server import resolve_warmup_env
+
+    if raw:
+        monkeypatch.setenv("DKS_WARMUP", raw)
+    else:
+        monkeypatch.delenv("DKS_WARMUP", raising=False)
+    assert resolve_warmup_env(default=default) is expected
+
+
+def test_warmup_off_by_default():
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    class M:
+        def explain_batch(self, instances, split_sizes=None):
+            return [json.dumps({"data": {}})
+                    for _ in (split_sizes or [1])]
+
+    server = ExplainerServer(M(), host="127.0.0.1", port=0,
+                             max_batch_size=2, pipeline_depth=1,
+                             health_interval_s=0).start()
+    try:
+        assert server.warmup_status()["state"] == "off"
+        assert _wait_for(lambda: _healthz(server.port)[0] == 200)
+    finally:
+        server.stop()
