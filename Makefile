@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test tier1 collect fuzz bench configs serve sweep-pool sweep-serve analysis multihost-ci sched-bench chaos-bench obs-check health-check perf-gate warmup-bench
+.PHONY: test tier1 collect fuzz bench configs serve sweep-pool sweep-serve analysis multihost-ci sched-bench chaos-bench obs-check health-check perf-gate warmup-bench stream-bench
 
 multihost-ci:    ## multi-host validation: 2-proc pool/phi/interactions, 4-proc 2x2 mesh, 2-proc serve (one JSON line, rc 0/1)
 	$(PY) benchmarks/multihost_ci.py
@@ -26,6 +26,9 @@ chaos-bench:     ## chaos scenario: kill-one-replica + slow-replica serving (zer
 
 warmup-bench:    ## cold-start A/B: persistent compile cache across process starts (zero fresh ladder compiles on warm start) + plan-constant cache on small-B requests, phi bit-identity asserted
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/warmup_bench.py --check
+
+stream-bench:    ## streaming hot path A/B: binary wire + staging vs JSON on the REAL linear engine at B=1 (>=2x goodput, phi bit-identical, device-busy fraction reported)
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/streaming_bench.py --check
 
 obs-check:       ## observability drift lint: registry vs docs/OBSERVABILITY.md catalog, stray dks_ literals, ad-hoc exposition renderers
 	env JAX_PLATFORMS=cpu $(PY) scripts/obs_check.py

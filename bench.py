@@ -60,6 +60,16 @@ RAY_POOL_32VCPU_BASELINE_S = 125.05  # BASELINE.md: best single-node reference
 _METRIC = "adult_2560_bg100_wall_s"
 
 
+def _wire_format_name() -> str:
+    """The serving wire protocol this commit negotiates by default
+    (``serving/wire.py``) — recorded so historical result lines state
+    which protocol their era's serving stack spoke."""
+
+    from distributedkernelshap_tpu.serving import wire
+
+    return wire.WIRE_FORMAT_NAME
+
+
 def _total_budget() -> float:
     return float(os.environ.get("DKS_BENCH_BUDGET", "420"))
 
@@ -159,6 +169,13 @@ def run_benchmark(cpu_fallback: bool = False) -> int:
         # which evaluation kernel engaged + Pallas degrade count — a Mosaic
         # auto-degrade must never masquerade as a kernel measurement
         "kernel_path": explainer.kernel_path,
+        # protocol in effect for serving deployments at this commit (this
+        # bench itself explains in-process; the field pins which wire
+        # format a TPU rerun's serving numbers would ride — ROADMAP bench
+        # caveat) + the headline task's goodput in rows/s, the unit the
+        # streaming bench gates on
+        "wire_format": _wire_format_name(),
+        "goodput_rows_per_s": round(X_explain.shape[0] / value, 1),
     }
     # compile accounting for the whole run (fit + warmup + timed loop):
     # fresh = XLA compiled, cache_hit = the persistent compile cache

@@ -1,0 +1,452 @@
+"""Streaming hot path benchmark: binary wire + staging vs JSON on the
+REAL linear engine at B=1 (standalone, CPU backend, exits nonzero on
+``--check`` fail).
+
+PR 1's scheduling bench had to use a deliberately-slow *synthetic* device
+to be device-bound — per-request Python/HTTP plumbing dominated the real
+engine.  This bench is the proof that the streaming hot path (ISSUE 6:
+binary wire protocol ``serving/wire.py``, persistent connections, buffer
+donation, double-buffered host→device staging) killed that overhead: the
+device model here is the REAL plan-constant-cached linear engine, no
+synthetic slowdown anywhere.
+
+Three arms, same fitted model, same request rows, open-loop B=1
+interactive traffic fired above saturation (arrivals on a fixed schedule;
+under overload measured goodput converges to each arm's capacity):
+
+1. ``json``          — historical JSON clients, staging off (the pre-wire
+                       baseline);
+2. ``binary``        — binary wire clients, staging off (isolates the
+                       protocol);
+3. ``binary_staging``— binary wire + the double-buffered staging pipeline
+                       (the full hot path).
+
+``--check`` asserts, measured:
+
+* phi **bit-identical** across all three arms (and for the JSON clients
+  served mid-flight by the binary+staging server — negotiation keeps old
+  clients first-class);
+* ``binary_staging`` goodput ≥ 2× the ``json`` arm's (single process,
+  same engine);
+* the staged arm recorded nonzero ``dks_staging_overlap_seconds_total``
+  and binary ``dks_wire_bytes_total`` moved;
+* the engine-busy fraction of the ``binary_staging`` arm is reported and
+  must own the majority (≥0.6) of the arm's wall clock: with plumbing
+  gone, wall time belongs to the engine, not the HTTP stack.
+
+Every measured run self-records into ``results/perf_history.jsonl``
+(``--no-record`` opts out) with the full-hot-path arm's wall clock as
+``wall_s``, so ``make perf-gate`` fails a commit that regresses streaming
+goodput.
+
+    JAX_PLATFORMS=cpu python benchmarks/streaming_bench.py --check
+"""
+
+import argparse
+import http.client
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO_ROOT = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO_ROOT)
+
+N_FEATURES = 448
+N_BACKGROUND = 24
+SEED = 0
+
+
+# --------------------------------------------------------------------- #
+# timed model shim: measures engine-busy intervals without touching the
+# serving path (dispatch→finalize-return per batch, union'd over overlap)
+# --------------------------------------------------------------------- #
+
+
+class TimedModel:
+    """Delegates to a real serving model, recording one
+    ``(t_dispatch, t_finalized)`` interval per device batch.  The union of
+    the intervals over an arm's wall clock is the engine-busy fraction —
+    the honest "is the device or the plumbing the bottleneck" number."""
+
+    supports_wire_formats = True
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.intervals = []
+        self._lock = threading.Lock()
+
+    # capability surface the server probes
+    def stage_rows(self, instances):
+        return self.inner.stage_rows(instances)
+
+    def explain_batch(self, instances, split_sizes=None, formats=None):
+        t0 = time.monotonic()
+        try:
+            return self.inner.explain_batch(instances,
+                                            split_sizes=split_sizes,
+                                            formats=formats)
+        finally:
+            with self._lock:
+                self.intervals.append((t0, time.monotonic()))
+
+    def explain_batch_async(self, instances, split_sizes=None, formats=None):
+        t0 = time.monotonic()
+        fin = self.inner.explain_batch_async(instances,
+                                             split_sizes=split_sizes,
+                                             formats=formats)
+
+        def finalize():
+            try:
+                return fin()
+            finally:
+                with self._lock:
+                    self.intervals.append((t0, time.monotonic()))
+
+        return finalize
+
+    def reset_intervals(self):
+        with self._lock:
+            self.intervals = []
+
+    def busy_seconds(self):
+        """Union length of the recorded intervals (overlapping pipelined
+        batches are not double-counted)."""
+
+        with self._lock:
+            spans = sorted(self.intervals)
+        total, cur_start, cur_end = 0.0, None, None
+        for s, e in spans:
+            if cur_start is None or s > cur_end:
+                if cur_start is not None:
+                    total += cur_end - cur_start
+                cur_start, cur_end = s, e
+            else:
+                cur_end = max(cur_end, e)
+        if cur_start is not None:
+            total += cur_end - cur_start
+        return total
+
+
+def build_model():
+    """One fitted REAL linear model (logistic regression → the engine's
+    plan-constant-cached linear fast path), shared by every arm so jit
+    caches stay warm and the A/B isolates the serving plumbing."""
+
+    from sklearn.linear_model import LogisticRegression
+
+    from distributedkernelshap_tpu.serving.wrappers import (
+        BatchKernelShapModel,
+    )
+
+    rng = np.random.default_rng(SEED)
+    X = rng.normal(size=(512, N_FEATURES)).astype(np.float32)
+    y = (X[:, :4].sum(axis=1) > 0).astype(int)
+    clf = LogisticRegression(max_iter=300).fit(X, y)
+    # interactive-serving deployment shape: l1_reg pinned OFF (the
+    # default 'auto' would route every request through the per-instance
+    # host-side AIC selection — a sync-fallback path that cannot stage
+    # and buries the wire A/B under host regression fits) and a
+    # latency-oriented nsamples (the knob real interactive deployments
+    # turn; the estimator stays the real seeded sampled KernelSHAP)
+    inner = BatchKernelShapModel(clf, X[:N_BACKGROUND],
+                                 {"link": "logit", "seed": SEED}, {},
+                                 explain_kwargs={"l1_reg": False,
+                                                 "nsamples": 512})
+    return TimedModel(inner)
+
+
+def make_rows(n):
+    rng = np.random.default_rng(SEED + 1)
+    return rng.normal(size=(n, N_FEATURES)).astype(np.float32)
+
+
+def scrape_metric(port, needle, labels=None):
+    """Sum the samples of one metric, optionally restricted to a label
+    subset — dks_wire_bytes_total carries {format, direction}, and e.g.
+    the binary-rx check must not be satisfied by json/tx bytes under the
+    same family name."""
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+    finally:
+        conn.close()
+    from distributedkernelshap_tpu.observability.metrics import (
+        parse_exposition,
+    )
+
+    total = 0.0
+    for family in parse_exposition(text).values():
+        for name, sample_labels, value in family["samples"]:
+            if name == needle and all(
+                    sample_labels.get(k) == v
+                    for k, v in (labels or {}).items()):
+                total += value
+    return total
+
+
+# --------------------------------------------------------------------- #
+# open-loop traffic
+# --------------------------------------------------------------------- #
+
+
+def run_arm(model, rows, wire_format, staging, rate_rps, max_workers=8):
+    """Serve ``rows`` as open-loop B=1 requests (arrival schedule at
+    ``rate_rps``, fired regardless of completions up to the worker bound)
+    and return the arm's measurement dict.  ``phi`` per request index so
+    arms can be compared bit-for-bit."""
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    from distributedkernelshap_tpu.serving import client
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    model.reset_intervals()
+    # max_batch_size=1: the workload IS B=1 interactive, and identical
+    # compile shapes per request across arms are what makes phi
+    # bit-identity assertable (coalescing would make batch composition,
+    # hence chunking, timing-dependent)
+    server = ExplainerServer(
+        model, host="127.0.0.1", port=0, max_batch_size=1,
+        pipeline_depth=2, admission_control=False,
+        health_interval_s=0, staging=staging).start()
+    url = f"http://127.0.0.1:{server.port}/explain"
+    client.reset_negotiation_cache()
+    n = rows.shape[0]
+    phi = [None] * n
+    errors = []
+
+    def one(i):
+        try:
+            if wire_format == "json":
+                payload = client.explain_request(url, rows[i:i + 1],
+                                                 timeout=120)
+                doc = json.loads(payload)
+                phi[i] = np.asarray(doc["data"]["shap_values"],
+                                    dtype=np.float32)
+            else:
+                out = client.explain_request(url, rows[i:i + 1], timeout=120,
+                                             wire_format="binary")
+                phi[i] = np.stack(out["shap_values"])
+        except Exception as e:  # counted, surfaced in --check
+            errors.append(f"req {i}: {e}")
+
+    try:
+        # warmup outside the timed window: first-trace compiles + the
+        # plan-constant populate must not ride either arm's clock
+        for i in range(min(4, n)):
+            one(i)
+        # collect the previous pass's garbage outside the timed window
+        # (the JSON arms allocate ~50 KB documents per request)
+        import gc
+
+        gc.collect()
+        t0 = time.monotonic()
+        with ThreadPoolExecutor(max_workers=max_workers) as pool:
+            futures = []
+            for i in range(n):
+                target = t0 + i / rate_rps
+                delay = target - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                futures.append(pool.submit(one, i))
+            for f in futures:
+                f.result()
+        wall = time.monotonic() - t0
+        busy = model.busy_seconds()
+        result = {
+            "wire_format": wire_format,
+            "staging": bool(staging),
+            "requests": n,
+            "errors": len(errors),
+            "error_sample": errors[:3],
+            "wall_s": round(wall, 3),
+            "goodput_rows_per_s": round((n - len(errors)) / wall, 2),
+            "engine_busy_frac": round(min(1.0, busy / wall), 3),
+            "wire_rx_binary_bytes": scrape_metric(
+                server.port, "dks_wire_bytes_total",
+                labels={"format": "binary", "direction": "rx"})
+            if wire_format == "binary" else None,
+            "staging_overlap_s": round(scrape_metric(
+                server.port, "dks_staging_overlap_seconds_total"), 4),
+        }
+        # negotiation regression inside the hot arm: a historical JSON
+        # client against the binary+staging server must be served the
+        # same bits
+        if wire_format == "binary" and staging:
+            json_phi = []
+            for i in range(min(4, n)):
+                payload = client.explain_request(url, rows[i:i + 1],
+                                                 timeout=120)
+                json_phi.append(np.asarray(
+                    json.loads(payload)["data"]["shap_values"],
+                    dtype=np.float32))
+            result["json_clients_served"] = all(
+                np.array_equal(json_phi[i], phi[i])
+                for i in range(len(json_phi)))
+        return result, phi
+    finally:
+        server.stop()
+
+
+def probe_rate(model, rows):
+    """Closed-loop burst against a staging-off JSON server to size the
+    open-loop arrival rate: every arm is then driven at ~2.5× the JSON
+    arm's capacity, comfortably above saturation for the baseline and the
+    hot path alike."""
+
+    from distributedkernelshap_tpu.serving import client
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    server = ExplainerServer(
+        model, host="127.0.0.1", port=0, max_batch_size=1,
+        pipeline_depth=2, admission_control=False,
+        health_interval_s=0).start()
+    url = f"http://127.0.0.1:{server.port}/explain"
+    try:
+        for i in range(3):  # compile + plan-consts warmup
+            client.explain_request(url, rows[i:i + 1], timeout=120)
+        t0 = time.monotonic()
+        n = 12
+        for i in range(n):
+            client.explain_request(url, rows[i % rows.shape[0]:
+                                             i % rows.shape[0] + 1],
+                                   timeout=120)
+        return n / (time.monotonic() - t0)
+    finally:
+        server.stop()
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--check", action="store_true",
+                        help="exit nonzero unless every criterion holds")
+    parser.add_argument("--requests", type=int, default=96,
+                        help="open-loop requests per arm")
+    parser.add_argument("--no-record", action="store_true",
+                        help="skip the perf-history self-record "
+                             "(results/perf_history.jsonl)")
+    args = parser.parse_args()
+
+    t_start = time.monotonic()
+    # ~14 threads (client pool + server handlers + dispatcher/batcher/
+    # finalizers) share 2 cores here; the default 5 ms GIL switch interval
+    # produces convoy effects that dominated run-to-run variance.  1 ms is
+    # the standard tune for mixed IO/compute threaded serving.
+    sys.setswitchinterval(0.001)
+    model = build_model()
+    rows = make_rows(args.requests)
+    json_serial_rps = probe_rate(model, rows)
+    # 4x the serial JSON capacity: comfortably past saturation for the
+    # baseline AND (with pipelining) usually past the hot path's too, so
+    # measured goodput converges to each arm's capacity
+    rate = 4.0 * json_serial_rps
+
+    # the arms interleave round-robin in short passes and aggregate:
+    # this box's speed drifts on a minutes timescale (shared host), so a
+    # sequential one-pass-per-arm layout hands whichever arm runs in a
+    # fast window a phantom win — fine-grained interleaving makes the
+    # drift land on every arm nearly equally.  phi bit-identity is
+    # asserted for EVERY pass of every arm.
+    specs = {"json": ("json", False), "binary": ("binary", False),
+             "binary_staging": ("binary", True)}
+    rounds = 3
+    arms = {}
+    phis = {}
+    totals = {name: {"wall": 0.0, "ok": 0} for name in specs}
+    for r in range(rounds):
+        for name, (fmt, staging) in specs.items():
+            result, phi = run_arm(model, rows, fmt, staging, rate)
+            totals[name]["wall"] += result["wall_s"]
+            totals[name]["ok"] += result["requests"] - result["errors"]
+            prev = phis.get(name)
+            if prev is not None and not all(
+                    a is not None and b is not None and np.array_equal(a, b)
+                    for a, b in zip(prev, phi)):
+                result["errors"] += 1
+                result["error_sample"].append(
+                    "phi differed between this arm's passes")
+            if name not in arms or result["errors"] > arms[name]["errors"]:
+                arms[name] = result
+            phis[name] = phi
+    for name, agg in totals.items():
+        arms[name]["passes"] = rounds
+        arms[name]["wall_s"] = round(agg["wall"], 3)
+        arms[name]["goodput_rows_per_s"] = round(
+            agg["ok"] / max(agg["wall"], 1e-9), 2)
+
+    # bit-identity across every arm, per request row
+    bit_identical = all(
+        phis["json"][i] is not None
+        and np.array_equal(phis["json"][i], phis["binary"][i])
+        and np.array_equal(phis["json"][i], phis["binary_staging"][i])
+        for i in range(args.requests))
+    # additivity on one arm (the payloads carry link-space predictions)
+    goodput_ratio = (arms["binary_staging"]["goodput_rows_per_s"]
+                     / max(arms["json"]["goodput_rows_per_s"], 1e-9))
+    staging_ratio = (arms["binary_staging"]["goodput_rows_per_s"]
+                     / max(arms["binary"]["goodput_rows_per_s"], 1e-9))
+
+    checks = {
+        "phi_bit_identical_across_arms": bit_identical,
+        "no_errors": all(a["errors"] == 0 for a in arms.values()),
+        "goodput_binary_staging_ge_2x_json": goodput_ratio >= 2.0,
+        "json_clients_served_by_hot_server":
+            bool(arms["binary_staging"].get("json_clients_served")),
+        "staging_overlap_recorded":
+            arms["binary_staging"]["staging_overlap_s"] > 0.0,
+        "binary_wire_bytes_recorded":
+            (arms["binary_staging"]["wire_rx_binary_bytes"] or 0) > 0,
+        # the engine (not the HTTP stack) owns the MAJORITY of the hot
+        # arm's wall clock.  Not compared against the JSON arm: on a
+        # shared-core CPU box GIL contention stretches the JSON arm's
+        # engine intervals too, so its fraction is inflated, not
+        # meaningful.
+        "engine_is_bottleneck_in_hot_arm":
+            arms["binary_staging"]["engine_busy_frac"] >= 0.6,
+    }
+
+    report = {
+        "bench": "streaming_bench",
+        "open_loop_rate_rps": round(rate, 1),
+        "json_serial_rps": round(json_serial_rps, 1),
+        "goodput_ratio_binary_staging_vs_json": round(goodput_ratio, 2),
+        "goodput_ratio_staging_vs_unstaged_binary": round(staging_ratio, 2),
+        "arms": arms,
+        "checks": checks,
+        "elapsed_s": round(time.monotonic() - t_start, 1),
+    }
+
+    if not args.no_record:
+        from benchmarks.regression_gate import DEFAULT_HISTORY, record_run
+
+        entry = record_run(
+            DEFAULT_HISTORY, "streaming_bench",
+            config={"requests": args.requests, "features": N_FEATURES,
+                    "background": N_BACKGROUND, "max_batch_size": 1,
+                    "arms": ["json", "binary", "binary_staging"]},
+            metrics={"wall_s": arms["binary_staging"]["wall_s"]},
+            extra={"goodput_rows_per_s":
+                   arms["binary_staging"]["goodput_rows_per_s"],
+                   "goodput_ratio_vs_json": round(goodput_ratio, 2),
+                   # "checks_ok" is the key regression_gate filters
+                   # failed runs out of the baseline median by
+                   "checks_ok": all(checks.values())})
+        report["perf_history"] = {"git_sha": entry["git_sha"],
+                                  "config_fp": entry["config_fp"]}
+
+    print(json.dumps(report))
+    if args.check and not all(checks.values()):
+        print(json.dumps({"failed_checks":
+                          [k for k, v in checks.items() if not v]}),
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

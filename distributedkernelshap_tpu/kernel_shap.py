@@ -43,6 +43,7 @@ from distributedkernelshap_tpu.ops.explain import (
     ShapConfig,
     build_explainer_fn,
     groups_to_matrix,
+    jit_batch_entry,
     pack_transfer,
     split_shap_values,
     unpack_transfer,
@@ -519,6 +520,29 @@ def sum_categories(values: np.ndarray, start_idx: Sequence[int], enc_feat_dim: S
 
 
 @dataclass
+class StagedRows:
+    """A request batch whose host→device upload is already in flight.
+
+    Produced by :meth:`KernelExplainerEngine.stage_rows` (the serving
+    staging pipeline's hook): ``host`` is the original ``(B, D)`` float32
+    rows (the JSON re-split and any sync fallback read it), ``device`` the
+    bucket-padded device-resident copy (``jax.device_put`` is asynchronous,
+    so by the time the dispatcher consumes this the copy has overlapped the
+    previous batch's compute), ``B`` the unpadded row count.  Single-use:
+    the device buffer is donated to the compute call where the backend
+    supports donation, so a StagedRows must feed exactly one explain.
+    """
+
+    host: np.ndarray
+    device: Any
+    B: int
+
+    @property
+    def shape(self):
+        return self.host.shape
+
+
+@dataclass
 class EngineConfig:
     """Static configuration of a single-device explain engine."""
 
@@ -695,7 +719,11 @@ class KernelExplainerEngine:
                 self.predictor,
                 replace(self.config.shap, link=self.config.link),
                 with_ey=with_ey)
-            self._fn_cache[with_ey] = jax.jit(base)
+            # argnum 0 is the per-call padded batch upload — donated so the
+            # backend reuses its HBM instead of copying (never the plan
+            # constants in argnums 1-5: those are _dev_cache entries)
+            self._fn_cache[with_ey] = jit_batch_entry(base,
+                                                      donate_argnums=(0,))
         return self._fn_cache[with_ey]
 
     @staticmethod
@@ -731,7 +759,12 @@ class KernelExplainerEngine:
                 with jax.default_matmul_precision(precision):
                     return _wls_solve(mask, w, ey_adj, fx_minus_e, ridge)
 
-            self._fn_cache['solve'] = jax.jit(solve)
+            # ey_adj is the host-eval path's per-call B×S×K upload (its
+            # dominant buffer) and is never referenced after the solve —
+            # donate it; mask/weights stay (tiny, and harmless either way,
+            # but the donation contract is "per-call batch buffers only")
+            self._fn_cache['solve'] = jit_batch_entry(solve,
+                                                      donate_argnums=(2,))
         return self._fn_cache['solve']
 
     def _hosteval_stats(self, X: np.ndarray, plan, silent: bool = True):
@@ -992,11 +1025,20 @@ class KernelExplainerEngine:
                 self._plan_consts_cache.popitem(last=False)
         return consts
 
-    def _linear_fast_call(self, Xp: np.ndarray, plan):
+    def _linear_fast_call(self, Xp: np.ndarray, plan, packed_dtype):
         """Dispatch ``Xp`` through the plan-constant cached path; returns
-        the output dict, or ``None`` when the path does not apply at these
-        shapes (the caller then runs the classic self-contained program).
-        ``Xp`` is already bucket-padded."""
+        the packed flat D2H vector (:func:`~distributedkernelshap_tpu.ops.
+        explain.pack_transfer` layout at ``packed_dtype`` — the
+        ``transfer_dtype`` knob, usually ``None`` for f32), or ``None``
+        when the path does not apply at these shapes (the caller then
+        runs the classic self-contained program + :meth:`_pack_fn`).
+        ``Xp`` is already bucket-padded.
+
+        The packing is FUSED into the same jitted call: at interactive
+        batch sizes a second jit round trip per request was a measurable
+        slice of the streaming hot path.  Fusing cannot break the
+        cached-vs-recompute bit-identity contract — both arms run this
+        same program."""
 
         if not self._plan_consts_enabled():
             return None
@@ -1028,11 +1070,24 @@ class KernelExplainerEngine:
             elems = padded_S * N * (1 if variant == 'binary' else K)
             if elems > cfg.target_chunk_elems:
                 return None
-        fnkey = ('linear_fast', chunk)
+        fnkey = ('linear_fast_packed', chunk, packed_dtype)
         if fnkey not in self._fn_cache:
-            self._fn_cache[fnkey] = jax.jit(build_linear_cached_fn(
-                self.predictor,
-                replace(cfg, link=self.config.link), chunk))
+            # donate the per-call X upload (argnum 0) ONLY: argnum 1 is the
+            # consts dict served from _plan_consts_cache — donating it would
+            # invalidate the cached device constants in place
+            base = build_linear_cached_fn(
+                self.predictor, replace(cfg, link=self.config.link), chunk)
+
+            def fused_fn(X, consts):
+                out = base(X, consts)
+                return pack_transfer(
+                    out['shap_values'],
+                    jnp.concatenate([out['expected_value'].ravel(),
+                                     out['raw_prediction'].ravel()]),
+                    packed_dtype)
+
+            self._fn_cache[fnkey] = jit_batch_entry(fused_fn,
+                                                    donate_argnums=(0,))
         consts = self._plan_consts(plan, chunk)
         with capture_kernel_paths() as kp:
             out = self._fn_cache[fnkey](jnp.asarray(Xp, jnp.float32), consts)
@@ -1048,6 +1103,23 @@ class KernelExplainerEngine:
         with profiler().phase('device_explain'):
             return self._dispatch_array(X, plan)()
 
+    def _pack_fn(self, transfer_dtype):
+        """Jitted single-call D2H packing (phi + expected_value + f(x) →
+        one flat vector, :func:`~distributedkernelshap_tpu.ops.explain.
+        pack_transfer` semantics).  Only phi (argnum 0) is donated: it is
+        fresh per call, while ``expected_value`` on the linear fast path
+        is a plan-constant cache buffer that must never be invalidated."""
+
+        key = ('pack', transfer_dtype)
+        if key not in self._fn_cache:
+            def pack(phi, e_val, fx):
+                return pack_transfer(
+                    phi, jnp.concatenate([e_val.ravel(), fx.ravel()]),
+                    transfer_dtype)
+
+            self._fn_cache[key] = jit_batch_entry(pack, donate_argnums=(0,))
+        return self._fn_cache[key]
+
     def _dispatch_array(self, X: np.ndarray, plan):
         """Launch the device computation for ``X`` and return a zero-argument
         ``finalize`` that blocks on the D2H copy and unpacks the result.
@@ -1056,17 +1128,34 @@ class KernelExplainerEngine:
         work (or do host work) between dispatch and finalize; through a
         tunnelled TPU the D2H copy costs ~70ms of RPC latency regardless of
         payload size, and concurrent copies overlap — the serving pipeline
-        exploits both."""
+        exploits both.  ``X`` may be a :class:`StagedRows` from
+        :meth:`stage_rows`, whose already-uploaded device buffer is consumed
+        directly (the staging pipeline's zero-copy handoff)."""
 
-        Xp, B = self._pad_to_bucket(X)
+        if isinstance(X, StagedRows):
+            Xp, B = X.device, X.B
+        else:
+            Xp, B = self._pad_to_bucket(X)
+        # one packed D2H instead of three; the copy itself blocks on the
+        # value, so an explicit block_until_ready would add a second full
+        # round trip.  With transfer_dtype set, only phi rides the reduced
+        # dtype — E[f]/f(x) are K and B*K floats whose truncation would
+        # inflate the reported additivity error for free (ADVICE.md r3).
+        # The packing runs INSIDE the jitted call (fused on the linear
+        # fast path, one jitted pack on the classic path): eager jnp
+        # ravel/cast/concat dispatches cost ~1 ms/call on CPU — more than
+        # the whole B=1 linear fast path — so at interactive batch sizes
+        # the pack was the engine's dominant host overhead
+        # (streaming-hot-path bench).
+        td = self.config.shap.transfer_dtype  # opt-in halved D2H (ShapConfig)
         # plan-constant fast path first: for linear predictors the
         # X-independent einsums + WLS factorisation are served from the
         # device cache and only the B×S×K work runs per call (phi is
         # bit-identical between the cached and uncached arms — see
         # EngineConfig.plan_constant_cache).  Returns None when it does
         # not apply.
-        out = self._linear_fast_call(Xp, plan)
-        if out is None:
+        packed = self._linear_fast_call(Xp, plan, packed_dtype=td)
+        if packed is None:
             from distributedkernelshap_tpu.ops.explain import (
                 capture_kernel_paths,
             )
@@ -1075,16 +1164,9 @@ class KernelExplainerEngine:
                 out = self._fn()(jnp.asarray(Xp, jnp.float32),
                                  *self._device_args(plan))
             self._kernel_paths.update(kp)
-        # one packed D2H instead of three; the copy itself blocks on the
-        # value, so an explicit block_until_ready would add a second full
-        # round trip.  With transfer_dtype set, only phi rides the reduced
-        # dtype — E[f]/f(x) are K and B*K floats whose truncation would
-        # inflate the reported additivity error for free (ADVICE.md r3).
-        td = self.config.shap.transfer_dtype  # opt-in halved D2H (ShapConfig)
-        packed = pack_transfer(
-            out['shap_values'],
-            jnp.concatenate([out['expected_value'].ravel(),
-                             out['raw_prediction'].ravel()]), td)
+            packed = self._pack_fn(td)(out['shap_values'],
+                                       out['expected_value'],
+                                       out['raw_prediction'])
         Bp = Xp.shape[0]
 
         def finalize() -> Dict[str, np.ndarray]:
@@ -1099,6 +1181,32 @@ class KernelExplainerEngine:
 
         return finalize
 
+    def stage_rows(self, X: np.ndarray,
+                   nsamples: Union[str, int, None] = None,
+                   l1_reg: Union[str, float, int, None] = 'auto',
+                   interactions: bool = False) -> Optional[StagedRows]:
+        """Start the host→device upload for a request batch NOW and return
+        a :class:`StagedRows` handle, or ``None`` when these explain options
+        would route through a sync-fallback path (host-eval, exact,
+        interactions, active l1, instance chunking) that consumes host rows.
+
+        The serving staging pipeline calls this from its batcher thread
+        while the previous batch computes: ``jax.device_put`` is
+        asynchronous, so the copy overlaps device work and the dispatcher
+        never waits on H2D.  Thread-safety: this touches no jit/plan caches
+        beyond ``_plan`` (which the gate below needs and is dict-memoised —
+        benign to race) — dispatch itself stays on the dispatcher thread.
+        """
+
+        X = np.atleast_2d(np.asarray(X, dtype=np.float32))
+        needs_chunking = (self.config.instance_chunk
+                          and X.shape[0] > self.config.instance_chunk)
+        if (self.config.host_eval or needs_chunking or nsamples == 'exact'
+                or interactions or self._l1_active(l1_reg, nsamples)):
+            return None
+        Xp, B = self._pad_to_bucket(X)
+        return StagedRows(host=X, device=jax.device_put(Xp), B=B)
+
     def get_explanation_async(self,
                               X: np.ndarray,
                               nsamples: Union[str, int, None] = None,
@@ -1112,9 +1220,16 @@ class KernelExplainerEngine:
 
         Dispatch must stay on one thread (it populates the jit/plan caches);
         ``finalize`` may run on another thread, and concurrent finalizes of
-        different batches overlap their D2H round trips."""
+        different batches overlap their D2H round trips.
 
-        X = np.atleast_2d(np.asarray(X, dtype=np.float32))
+        ``X`` may be a :class:`StagedRows` from :meth:`stage_rows` — the
+        pre-uploaded device buffer then feeds the dispatch directly and no
+        second H2D happens here (the serving staging pipeline overlaps that
+        upload with the previous batch's compute)."""
+
+        staged = X if isinstance(X, StagedRows) else None
+        X = (staged.host if staged is not None
+             else np.atleast_2d(np.asarray(X, dtype=np.float32)))
         needs_chunking = (self.config.instance_chunk
                           and X.shape[0] > self.config.instance_chunk)
         if (self.config.host_eval or needs_chunking or nsamples == 'exact'
@@ -1133,7 +1248,7 @@ class KernelExplainerEngine:
 
         with profiler().phase('coalition_plan'):
             plan = self._plan(nsamples)
-        fin = self._dispatch_array(X, plan)
+        fin = self._dispatch_array(staged if staged is not None else X, plan)
 
         def finalize():
             # in the pipelined path the device time materialises here, at

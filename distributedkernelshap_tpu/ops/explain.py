@@ -26,6 +26,7 @@ jits unchanged under ``jax.jit`` sharding on a device mesh (see
 
 import contextvars
 import math
+import os
 from dataclasses import dataclass
 from typing import List, Optional, Sequence
 
@@ -171,6 +172,41 @@ def groups_to_matrix(groups: Optional[Sequence[Sequence[int]]], n_columns: int) 
     for i, cols in enumerate(groups):
         G[i, list(cols)] = 1.0
     return G
+
+
+def buffer_donation_enabled() -> bool:
+    """Whether per-batch entry points donate their padded batch buffer
+    (``jax.jit(..., donate_argnums=...)``).
+
+    Auto: on for accelerator backends (TPU/GPU implement aliasing — the
+    batch buffer's HBM is reused for an output instead of copied), off on
+    CPU where jaxlib does not implement donation and every donated call
+    would log a "donated buffers were not usable" warning.  ``DKS_DONATE``
+    overrides both ways (the streaming bench's A/B hook).
+    """
+
+    from distributedkernelshap_tpu.utils import resolve_bool_env
+
+    return resolve_bool_env("DKS_DONATE",
+                            jax.default_backend() not in ("cpu",))
+
+
+def jit_batch_entry(fn, donate_argnums):
+    """``jax.jit`` for a per-batch entry point, donating the batch-buffer
+    argnums where the backend implements donation.
+
+    The donation contract (docs/PERFORMANCE.md): ONLY the per-call batch
+    buffer (the padded ``X`` upload, or host-eval's ``ey_adj``) may be
+    donated — it is created fresh for every call and never referenced
+    after.  Plan constants, the ``_dev_cache`` device args and the
+    plan-constant cache's ``consts`` are long-lived cached buffers; donating
+    any of them would invalidate a cache entry in place and poison every
+    later call, so their argnums must never appear in ``donate_argnums``.
+    """
+
+    if buffer_donation_enabled():
+        return jax.jit(fn, donate_argnums=donate_argnums)
+    return jax.jit(fn)
 
 
 def resolve_use_pallas(use_pallas: Optional[bool]) -> bool:

@@ -3,6 +3,7 @@ from distributedkernelshap_tpu.scheduling.scheduler import (  # noqa: F401
     PRIORITY_CLASSES,
     FIFOScheduler,
     SLOScheduler,
+    StagingBuffer,
     make_scheduler,
 )
 from distributedkernelshap_tpu.scheduling.admission import (  # noqa: F401

@@ -35,6 +35,7 @@ and cost — as where accelerator serving throughput comes from.
 """
 
 import heapq
+import queue
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -284,6 +285,73 @@ class SLOScheduler:
         with self._cond:
             self._stopped = True
             self._cond.notify_all()
+
+
+class StagingBuffer:
+    """Bounded handoff for the double-buffered host→device staging pipeline
+    (ISSUE 6; Podracer's pipelined host/device split, PAPERS.md arXiv
+    2104.06272).
+
+    The server's batcher thread forms scheduler batches and starts their
+    host→device upload (``engine.stage_rows`` — ``jax.device_put`` is
+    asynchronous), then :meth:`put`\\ s them here; the dispatcher thread
+    :meth:`get`\\ s batches whose rows are already device-resident.  With
+    ``depth=1`` the steady state is the classic double buffer: one batch
+    computing on the device, one staged and ready, one being formed — the
+    device never waits on an H2D copy between scheduler batches.
+
+    :meth:`get` also returns how long the staged batch sat ready before
+    dispatch — the measured upload/compute overlap the server surfaces as
+    ``dks_staging_overlap_seconds_total`` (0 means the dispatcher was
+    already waiting, i.e. the host is the bottleneck; sustained positive
+    values mean the upload fully hid behind device work).
+    """
+
+    def __init__(self, depth: int = 1):
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+
+    def put(self, item, stop: Optional[threading.Event] = None,
+            poll_s: float = 0.1) -> bool:
+        """Block until a staging slot frees (bounded: at most ``depth``
+        staged batches hold device buffers at once).  Returns ``False``
+        without enqueueing once ``stop`` is set — the caller owns failing
+        the batch."""
+
+        entry = (item, time.monotonic())
+        while True:
+            if stop is not None and stop.is_set():
+                return False
+            try:
+                self._q.put(entry, timeout=poll_s)
+                return True
+            except queue.Full:
+                continue
+
+    def get(self, stop: Optional[threading.Event] = None,
+            poll_s: float = 0.1):
+        """``(item, ready_s)`` for the next staged batch — ``ready_s`` is
+        the seconds it sat device-ready before this pop.  ``None`` once
+        ``stop`` is set and the buffer is empty (staged leftovers are still
+        delivered first so no request silently leaks)."""
+
+        while True:
+            try:
+                item, t_ready = self._q.get(timeout=poll_s)
+            except queue.Empty:
+                if stop is not None and stop.is_set():
+                    return None
+                continue
+            return item, max(0.0, time.monotonic() - t_ready)
+
+    def drain(self) -> List:
+        """Remove and return every still-staged item (shutdown path)."""
+
+        items = []
+        while True:
+            try:
+                items.append(self._q.get_nowait()[0])
+            except queue.Empty:
+                return items
 
 
 class FIFOScheduler(SLOScheduler):

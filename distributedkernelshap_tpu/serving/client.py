@@ -24,8 +24,26 @@ from urllib.parse import urlparse
 import numpy as np
 
 import distributedkernelshap_tpu.observability.tracing as _tracing
+import distributedkernelshap_tpu.serving.wire as _wire
 
 _tls = threading.local()
+
+# per-host negotiated transport ("binary" | "json"), learned from the
+# server's responses: a 415 (or a 400 answered to a binary body — the
+# pre-wire servers' reaction, they JSON-parse everything) downgrades the
+# host to JSON for the process lifetime, so one failed probe per host is
+# the whole negotiation cost.  Shared across threads (benign to race: the
+# value converges and every transition is also handled per-request).
+_negotiated: dict = {}
+_negotiated_lock = threading.Lock()
+
+
+def reset_negotiation_cache() -> None:
+    """Forget learned per-host transports (tests; or after a fleet
+    upgrade, to let clients re-probe binary)."""
+
+    with _negotiated_lock:
+        _negotiated.clear()
 
 #: ceiling on any single backoff sleep, whatever the server's hint says —
 #: a buggy/adversarial ``Retry-After: 86400`` must not park a client thread
@@ -79,13 +97,46 @@ def parse_retry_after(headers, payload) -> Optional[float]:
         return None
 
 
+def _request_body(instance: np.ndarray, binary: bool,
+                  extra_headers: Optional[dict]):
+    """(body, headers) for one transport: binary wire framing (raw float32
+    row bytes + binary Accept) or the historical JSON document."""
+
+    if binary:
+        body = _wire.encode_request(instance)
+        headers = {"Content-Type": _wire.CONTENT_TYPE,
+                   "Accept": _wire.CONTENT_TYPE}
+    else:
+        body = json.dumps({"array": np.asarray(instance).tolist()}).encode()
+        headers = {"Content-Type": "application/json"}
+    headers.update(extra_headers or {})
+    return body, headers
+
+
 def explain_request(url: str, instance: np.ndarray, timeout: float = 300.0,
                     max_retries: int = 4,
                     extra_headers: Optional[dict] = None,
+                    wire_format: str = "json",
                     _sleep: Callable[[float], None] = time.sleep,
-                    _rng: Optional[random.Random] = None) -> str:
+                    _rng: Optional[random.Random] = None):
     """POST one instance (or minibatch) to the explanation endpoint and
-    return the JSON payload, reusing this thread's connection.
+    return the payload, reusing this thread's connection.
+
+    ``wire_format`` selects the transport and the return type:
+
+    * ``'json'`` (default, the historical contract) — JSON request body,
+      returns the raw Explanation JSON payload ``str``.
+    * ``'binary'`` / ``'auto'`` — the zero-copy wire protocol
+      (``serving/wire.py``): binary request body + binary ``Accept``;
+      returns a dict ``{'shap_values': [K x (B, M)], 'expected_value',
+      'raw_prediction'}`` whatever transport the negotiation lands on.
+      A server answering 415 (a future-version decoder) **or** 400 to the
+      binary body (a pre-wire server JSON-parsing everything) downgrades
+      this host to JSON for the process (``reset_negotiation_cache`` to
+      re-probe); the downgraded request is re-sent as JSON on the same
+      connection without consuming the retry budget, and the structured
+      dict is then extracted from the JSON document — callers never see
+      the transport.
 
     Retriable failures are retried within a bounded budget
     (``max_retries`` beyond the first attempt), with capped, jittered
@@ -122,8 +173,15 @@ def explain_request(url: str, instance: np.ndarray, timeout: float = 300.0,
 
     parsed = urlparse(url)
     path = parsed.path or "/"
-    body = json.dumps({"array": np.asarray(instance).tolist()}).encode()
-    headers = {"Content-Type": "application/json", **(extra_headers or {})}
+    if wire_format not in ("json", "binary", "auto"):
+        raise ValueError(f"wire_format must be 'json', 'binary' or 'auto', "
+                         f"got {wire_format!r}")
+    host_key = (parsed.scheme or "http", parsed.netloc)
+    with _negotiated_lock:
+        negotiated = _negotiated.get(host_key)
+    # binary unless this host already downgraded; plain 'json' never probes
+    sent_binary = wire_format != "json" and negotiated != "json"
+    body, headers = _request_body(instance, sent_binary, extra_headers)
     rng = _rng or random.Random()
     tr = _tracing.tracer()
     root = None
@@ -137,6 +195,7 @@ def explain_request(url: str, instance: np.ndarray, timeout: float = 300.0,
                             -1, np.asarray(instance).shape[-1]).shape[0]))
     attempt = 0
     last_status = None
+    tentative_400 = False
     try:
         while True:
             conn = _get_connection(parsed.scheme or "http", parsed.netloc,
@@ -156,26 +215,76 @@ def explain_request(url: str, instance: np.ndarray, timeout: float = 300.0,
                 raw = resp.read()
                 last_status = resp.status
                 tr.end(aspan, status=resp.status)
-                try:
-                    payload = raw.decode()
-                except UnicodeDecodeError:
-                    # corrupted on the wire (bit-rot, an injected garble):
-                    # idempotency makes a re-fetch safe, so spend a retry
-                    # on a clean copy instead of surfacing garbage — but
-                    # only for statuses that are retriable anyway; a
-                    # garbled 400/500 is still an answer the server would
-                    # deterministically repeat
-                    if resp.status not in (200, 429, 502, 503) \
-                            or attempt >= max_retries:
-                        raise RuntimeError(
-                            f"HTTP {resp.status}: undecodable (corrupt) "
-                            f"payload of {len(raw)} bytes")
-                    payload = None
-                    backoff = BASE_BACKOFF_S * (2.0 ** attempt)
+                if sent_binary and resp.status in (415, 400):
+                    # the server does not speak the wire format — 415 is
+                    # the explicit signal (version mismatch), 400 the
+                    # pre-wire servers' reaction (they JSON-parse every
+                    # body).  Downgrade the host and re-send as JSON on
+                    # the SAME connection; negotiation is not a failure,
+                    # so the retry budget is untouched.  sent_binary is
+                    # now False, so a second 415/400 is terminal.  A 400
+                    # is only a TENTATIVE verdict: a wire-capable server
+                    # also answers 400 for a bad SLO header, and caching
+                    # 'json' off that would silently disable the binary
+                    # transport for every later request to the host — so
+                    # the cached verdict is withdrawn below if the JSON
+                    # re-send draws the same 400 (the request itself was
+                    # bad, not the transport).
+                    tentative_400 = resp.status == 400
+                    with _negotiated_lock:
+                        _negotiated[host_key] = "json"
+                    sent_binary = False
+                    body, headers = _request_body(instance, False,
+                                                  extra_headers)
+                    continue
+                if tentative_400 and resp.status == 400:
+                    # the JSON re-send failed identically: the 400 was
+                    # about THIS request, not the wire format — forget
+                    # the downgrade so the host keeps its binary path
+                    with _negotiated_lock:
+                        if _negotiated.get(host_key) == "json":
+                            del _negotiated[host_key]
+                resp_binary = _wire.is_wire_content_type(
+                    resp.headers.get("Content-Type"))
+                if resp_binary:
+                    payload = raw  # framing validated at decode below
+                else:
+                    try:
+                        payload = raw.decode()
+                    except UnicodeDecodeError:
+                        # corrupted on the wire (bit-rot, an injected
+                        # garble): idempotency makes a re-fetch safe, so
+                        # spend a retry on a clean copy instead of
+                        # surfacing garbage — but only for statuses that
+                        # are retriable anyway; a garbled 400/500 is still
+                        # an answer the server would deterministically
+                        # repeat
+                        if resp.status not in (200, 429, 502, 503) \
+                                or attempt >= max_retries:
+                            raise RuntimeError(
+                                f"HTTP {resp.status}: undecodable (corrupt) "
+                                f"payload of {len(raw)} bytes")
+                        payload = None
+                        backoff = BASE_BACKOFF_S * (2.0 ** attempt)
                 if payload is not None:
                     if resp.status == 200:
-                        return payload
-                    if resp.status == 429:
+                        if wire_format == "json":
+                            return payload
+                        try:
+                            return (_wire.decode_explanation(payload)
+                                    if resp_binary else
+                                    _wire.explanation_payload_from_json(
+                                        payload))
+                        except (_wire.WireError, ValueError, KeyError):
+                            # structured-mode analog of the undecodable
+                            # branch: a torn/garbled 200 body re-fetches
+                            # bit-identically
+                            if attempt >= max_retries:
+                                raise RuntimeError(
+                                    f"HTTP 200: unparseable explanation "
+                                    f"payload of {len(raw)} bytes")
+                            backoff = BASE_BACKOFF_S * (2.0 ** attempt)
+                    elif resp.status == 429:
                         hint = parse_retry_after(resp.headers, payload)
                         backoff = hint if hint is not None else \
                             BASE_BACKOFF_S * (2.0 ** attempt)
@@ -210,7 +319,8 @@ def distribute_requests(url: str,
                         batch_mode: str = "ray",
                         minibatches: Optional[Sequence[np.ndarray]] = None,
                         max_workers: int = 16,
-                        timeout: float = 300.0) -> List[str]:
+                        timeout: float = 300.0,
+                        wire_format: str = "json") -> List:
     """Fan requests out to the endpoint.
 
     ``batch_mode='ray'`` mirrors the reference's server-side batching mode
@@ -221,6 +331,10 @@ def distribute_requests(url: str,
     ``max_workers`` bounds the in-flight requests; the default is sized for a
     colocated single-core client, where more threads only fight the serving
     pipeline for the GIL.
+
+    ``wire_format`` is forwarded to :func:`explain_request` — ``'json'``
+    (default) returns payload strings, ``'binary'``/``'auto'`` structured
+    dicts over the negotiated zero-copy transport.
     """
 
     if batch_mode == "ray" or minibatches is None:
@@ -229,5 +343,6 @@ def distribute_requests(url: str,
         parts = list(minibatches)
 
     with ThreadPoolExecutor(max_workers=max_workers) as pool:
-        futures = [pool.submit(explain_request, url, p, timeout) for p in parts]
+        futures = [pool.submit(explain_request, url, p, timeout,
+                               wire_format=wire_format) for p in parts]
         return [f.result() for f in futures]

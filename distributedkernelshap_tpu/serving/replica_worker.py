@@ -78,6 +78,10 @@ def main():
                              "default it ON — a restarted worker otherwise "
                              "re-pays every bucket's first-jit compile on "
                              "live traffic; DKS_WARMUP=0 also disables)")
+    parser.add_argument("--staging", action="store_true",
+                        help="enable the double-buffered host-to-device "
+                             "staging pipeline (default: resolved from "
+                             "DKS_STAGING, off unless truthy)")
     args = parser.parse_args()
 
     factory = resolve_factory(args.factory)
@@ -111,7 +115,9 @@ def main():
         host=args.host, port=args.port,
         max_batch_size=args.max_batch_size,
         pipeline_depth=args.pipeline_depth or None,
-        fault_injector=fault_injector, warmup=warmup)
+        fault_injector=fault_injector, warmup=warmup,
+        # --staging forces it on; otherwise None defers to DKS_STAGING
+        staging=True if args.staging else None)
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())

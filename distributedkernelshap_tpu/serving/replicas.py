@@ -128,6 +128,11 @@ class FanInProxy:
         self.probe_interval_s = probe_interval_s
         self._rr_lock = threading.Lock()
         self._rr = 0
+        # per-thread keep-alive connections to each replica (handler and
+        # hedge threads are long-lived pool threads): without reuse every
+        # forwarded request paid a TCP handshake — the proxy-side half of
+        # the per-request plumbing the streaming hot path removes
+        self._fwd_tls = threading.local()
         # every dks_fanin_* series lives on the shared registry (one
         # renderer; per-metric locks make increments from hedge/handler
         # threads atomic — these used to be bare dict/int updates)
@@ -225,21 +230,14 @@ class FanInProxy:
                     return r
         return None
 
-    def _forward(self, method: str, path: str, body: bytes,
-                 replica: _Replica,
-                 timeout_s: Optional[float] = None,
-                 headers: Optional[Dict[str, str]] = None
-                 ) -> Tuple[int, bytes, Dict[str, str]]:
-        """One forwarded request; raises on transport failure.  Separating
-        connect from send lets the caller distinguish never-processed
-        (safe to retry) from possibly-processed (must surface).  Returns
-        ``(status, payload, response_headers)`` — the headers carry the
-        replica's ``Retry-After`` on a 429."""
+    def _fresh_connection(self, replica: _Replica,
+                          timeout_s: float) -> http.client.HTTPConnection:
+        """Connect a new socket to one replica.  Short CONNECT timeout
+        regardless of the request budget: a wedged replica with a full
+        listen backlog neither accepts nor refuses — without this a client
+        request would stall the full request_timeout_s inside connect()
+        while healthy replicas idle."""
 
-        # short CONNECT timeout regardless of the request budget: a wedged
-        # replica with a full listen backlog neither accepts nor refuses —
-        # without this a client request would stall the full
-        # request_timeout_s inside connect() while healthy replicas idle
         conn = http.client.HTTPConnection(replica.host, replica.port,
                                           timeout=5.0)
         try:
@@ -247,16 +245,72 @@ class FanInProxy:
         except OSError:
             conn.close()
             raise _ConnectFailed(replica)
-        conn.sock.settimeout(timeout_s or self.request_timeout_s)
+        conn.sock.settimeout(timeout_s)
+        return conn
+
+    def _forward(self, method: str, path: str, body: bytes,
+                 replica: _Replica,
+                 timeout_s: Optional[float] = None,
+                 headers: Optional[Dict[str, str]] = None
+                 ) -> Tuple[int, bytes, Dict[str, str]]:
+        """One forwarded request over this thread's pooled keep-alive
+        connection; raises on transport failure.  Separating connect from
+        send lets the caller distinguish never-processed (safe to retry)
+        from possibly-processed (must surface).  Returns ``(status,
+        payload, response_headers)`` — the headers carry the replica's
+        ``Retry-After`` on a 429 and its ``Content-Type`` (binary wire
+        responses must reach the client labelled as such).
+
+        Connections persist per (handler thread, replica) and fall back to
+        a fresh socket only when the pooled one fails
+        (``HTTPException``/``ConnectionError``/``OSError`` — typically a
+        replica restart or an idle keep-alive the peer closed).  The
+        single fresh-socket retry after a stale-reuse failure cannot
+        corrupt results: explains are deterministic and content-addressed
+        (the same property hedging already relies on), so a double
+        execution produces a bit-identical payload.  A ``socket.timeout``
+        is never retried here — slow is not stale, and the caller maps it
+        to 504."""
+
+        timeout = timeout_s or self.request_timeout_s
+        send_headers = {}
+        if headers:
+            send_headers.update(headers)
+        send_headers.setdefault("Content-Type", "application/json")
+        conns = getattr(self._fwd_tls, "conns", None)
+        if conns is None:
+            conns = self._fwd_tls.conns = {}
+        key = (replica.host, replica.port)
+        conn = conns.get(key)
+        reused = conn is not None and conn.sock is not None
+        if not reused:
+            conn = conns[key] = self._fresh_connection(replica, timeout)
+        else:
+            conn.sock.settimeout(timeout)
         try:
-            send_headers = {"Content-Type": "application/json"}
-            if headers:
-                send_headers.update(headers)
             conn.request(method, path, body=body, headers=send_headers)
             resp = conn.getresponse()
             return resp.status, resp.read(), dict(resp.getheaders())
-        finally:
+        except socket.timeout:
+            conns.pop(key, None)
             conn.close()
+            raise
+        except (http.client.HTTPException, ConnectionError, OSError):
+            conns.pop(key, None)
+            conn.close()
+            if not reused:
+                raise
+            # the pooled socket went stale under us: one fresh-socket
+            # retry before classifying the replica as failed
+            conn = conns[key] = self._fresh_connection(replica, timeout)
+            try:
+                conn.request(method, path, body=body, headers=send_headers)
+                resp = conn.getresponse()
+                return resp.status, resp.read(), dict(resp.getheaders())
+            except (http.client.HTTPException, ConnectionError, OSError):
+                conns.pop(key, None)
+                conn.close()
+                raise
 
     @staticmethod
     def _retry_after_s(resp_headers: Dict[str, str], payload: bytes) -> float:
@@ -624,7 +678,13 @@ class FanInProxy:
                     forward_sink.append(replica.index)
                 else:
                     self._m_forwarded.inc()
-                return status, payload, {}
+                # propagate the replica's Content-Type: a binary wire
+                # response must reach the client labelled as such (the
+                # proxy forwards bodies verbatim, both directions)
+                ctype = next((v for k, v in resp_headers.items()
+                              if k.lower() == "content-type"), None)
+                return status, payload, (
+                    {"Content-Type": ctype} if ctype else {})
             finally:
                 if fspan is not None:
                     tr.end(fspan, outcome=outcome)
@@ -742,9 +802,17 @@ class FanInProxy:
                         {"error": "unknown route"}).encode())
                     return
                 # forward the client's scheduling headers so the replica's
-                # scheduler/admission/cache see the declared SLO and key
+                # scheduler/admission/cache see the declared SLO and key —
+                # plus the wire-negotiation pair (Content-Type/Accept), so
+                # binary bodies forward VERBATIM instead of being
+                # re-encoded (the replica answers the negotiation; the
+                # proxy stays format-agnostic)
                 sched_headers = {k: v for k, v in self.headers.items()
                                  if k.lower().startswith("x-dks-")}
+                for wire_header in ("Content-Type", "Accept"):
+                    value = self.headers.get(wire_header)
+                    if value:
+                        sched_headers[wire_header] = value
                 if not proxy.trust_client_header:
                     # the replica would otherwise see every request from
                     # the proxy's address (one shared bucket) — and a
@@ -760,7 +828,11 @@ class FanInProxy:
                     sched_headers["X-DKS-Client"] = self.client_address[0]
                 code, payload, extra = proxy.handle_explain(
                     self.command, body, headers=sched_headers)
-                self._reply(code, payload, headers=extra)
+                # the replica's own Content-Type (binary wire vs JSON)
+                # rides in `extra` — lift it out so _reply doesn't emit a
+                # duplicate header
+                ctype = extra.pop("Content-Type", "application/json")
+                self._reply(code, payload, ctype=ctype, headers=extra)
 
             do_GET = _handle
             do_POST = _handle

@@ -44,6 +44,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 import distributedkernelshap_tpu.observability.tracing as _tracing
+import distributedkernelshap_tpu.serving.wire as _wire
 from distributedkernelshap_tpu.observability.flightrec import flightrec
 from distributedkernelshap_tpu.observability.metrics import MetricsRegistry
 from distributedkernelshap_tpu.observability.slo import default_server_slos
@@ -57,6 +58,7 @@ from distributedkernelshap_tpu.scheduling import (
     AdmissionController,
     ResultCache,
     ServiceRateEstimator,
+    StagingBuffer,
     make_scheduler,
     model_fingerprint,
     request_cache_key,
@@ -81,12 +83,13 @@ class _HTTPServer(ThreadingHTTPServer):
 class _Pending:
     __slots__ = ("array", "event", "response", "error", "t_enqueued", "done",
                  "klass", "deadline", "cache_key", "status_code", "cache_hit",
-                 "trace")
+                 "trace", "wire_format")
 
     def __init__(self, array: np.ndarray, klass: str = "interactive",
                  deadline: Optional[float] = None,
                  cache_key: Optional[str] = None,
-                 trace: Optional[_tracing.SpanContext] = None):
+                 trace: Optional[_tracing.SpanContext] = None,
+                 wire_format: str = "json"):
         self.array = array
         self.event = threading.Event()
         self.response: Optional[str] = None
@@ -112,6 +115,10 @@ class _Pending:
         # is off); the dispatcher/finalizer threads parent queue-wait /
         # device / finalize spans to it
         self.trace = trace
+        # negotiated response encoding: "json" (historical Explanation
+        # document) or "binary" (serving/wire.py raw-bytes payload, asked
+        # for via Accept and only granted when the model can produce it)
+        self.wire_format = wire_format
 
     @property
     def rows(self) -> int:
@@ -205,20 +212,21 @@ def calibrate_pipeline_depth(model, example_array: Optional[np.ndarray] = None,
 
 def resolve_warmup_env(default: bool) -> bool:
     """The ONE ``DKS_WARMUP`` parser (standalone servers default warmup
-    off, replica workers default it on — but an unrecognised value must
-    mean the same thing everywhere: fall back to the component default,
-    loudly, rather than silently flipping per component)."""
+    off, replica workers default it on); shared warn-on-garbage contract
+    in ``utils.resolve_bool_env``."""
 
-    raw = os.environ.get("DKS_WARMUP", "").strip().lower()
-    if not raw:
-        return default
-    if raw in ("1", "true", "on", "yes"):
-        return True
-    if raw in ("0", "false", "off", "no"):
-        return False
-    logger.warning("unrecognised DKS_WARMUP=%r; using the component "
-                   "default (%s)", raw, default)
-    return default
+    from distributedkernelshap_tpu.utils import resolve_bool_env
+
+    return resolve_bool_env("DKS_WARMUP", default)
+
+
+def resolve_staging_env(default: bool) -> bool:
+    """The ONE ``DKS_STAGING`` parser (same contract as
+    :func:`resolve_warmup_env`)."""
+
+    from distributedkernelshap_tpu.utils import resolve_bool_env
+
+    return resolve_bool_env("DKS_STAGING", default)
 
 
 class ExplainerServer:
@@ -310,6 +318,17 @@ class ExplainerServer:
         truthy); replica workers default it ON.  A warmup failure is
         logged and serving proceeds (the first real requests then pay the
         compiles, exactly the pre-warmup behaviour).
+    staging
+        Double-buffered host→device staging pipeline (the zero-copy
+        streaming hot path, docs/PERFORMANCE.md): batch formation +
+        stacking + ``jax.device_put`` move to a dedicated batcher thread,
+        so while batch *k* computes, batch *k+1*'s rows are already
+        device-resident and the dispatcher never waits on an H2D copy.
+        ``None`` (default) resolves from the ``DKS_STAGING`` env (off
+        unless truthy).  Engages only for models exposing ``stage_rows`` +
+        ``explain_batch_async`` (the serving wrappers); otherwise the
+        single-thread dispatch loop runs unchanged.  Overlap is measured
+        as ``dks_staging_overlap_seconds_total``.
     """
 
     def __init__(self, model, host: str = "0.0.0.0", port: int = 8000,
@@ -328,7 +347,8 @@ class ExplainerServer:
                  fault_injector=None,
                  health_interval_s: float = 1.0,
                  slos=None, alert_rules=None, alert_sinks=None,
-                 warmup: Optional[bool] = None):
+                 warmup: Optional[bool] = None,
+                 staging: Optional[bool] = None):
         self.model = model
         self.host = host
         self.port = port
@@ -431,6 +451,14 @@ class ExplainerServer:
         self._model_fp_model = None
         self._model_fp_lock = threading.Lock()
         self._last_complete_t = time.monotonic()
+        # double-buffered host→device staging (see the ``staging``
+        # parameter): requested here, resolved against the model's
+        # capabilities in start(); the buffer exists only when active
+        if staging is None:
+            staging = resolve_staging_env(default=False)
+        self._staging_requested = bool(staging)
+        self._staging_enabled = False
+        self._staged: Optional[StagingBuffer] = None
         # (batch, finalize) pairs already dispatched to the device; bounded so
         # a slow host can't pile up unbounded in-flight device work (the
         # queue is created in start(), once the depth is known)
@@ -483,6 +511,21 @@ class ExplainerServer:
             labelnames=("reason",)).seed(
             "deadline_expired", "projected_wait", "queue_full",
             "rate_limited")
+        # streaming hot path: payload bytes by negotiated wire format
+        # (rx = request bodies, tx = success response payloads) and the
+        # measured upload/compute overlap of the staging pipeline
+        self._m_wire_bytes = reg.counter(
+            "dks_wire_bytes_total",
+            "Payload bytes on /explain by wire format and direction "
+            "(rx = request bodies, tx = success responses).",
+            labelnames=("format", "direction")).seed(
+            ("binary", "rx"), ("binary", "tx"),
+            ("json", "rx"), ("json", "tx"))
+        self._m_staging_overlap = reg.counter(
+            "dks_staging_overlap_seconds_total",
+            "Seconds staged batches sat device-ready before dispatch "
+            "(host-to-device upload overlapped with the previous batch's "
+            "compute).")
         self._m_latency = reg.histogram(
             "dks_serve_request_latency_seconds",
             "Queue+explain latency of answered requests.",
@@ -580,7 +623,8 @@ class ExplainerServer:
         self._m_latency.observe(elapsed)
         self._m_class_latency.observe(elapsed, **{"class": pending.klass})
 
-    def _cache_key_for(self, array: np.ndarray) -> Optional[str]:
+    def _cache_key_for(self, array: np.ndarray,
+                       wire_format: str = "json") -> Optional[str]:
         if self._cache is None:
             return None
         with self._model_fp_lock:
@@ -589,7 +633,12 @@ class ExplainerServer:
                 self._model_fp = model_fingerprint(model)
                 self._model_fp_model = model
             fp = self._model_fp
-        return request_cache_key(array, fp)
+        key = request_cache_key(array, fp)
+        # the cache stores ENCODED payloads, so the negotiated format is
+        # part of the identity: a binary client must never be served a
+        # cached JSON document (and vice versa).  JSON keys keep the
+        # historical unsuffixed form — pre-PR-6 cache semantics unchanged.
+        return key if wire_format == "json" else f"{key}#{wire_format}"
 
     def _shed(self, reason: str) -> None:
         self._m_sheds.inc(reason=reason)
@@ -728,6 +777,7 @@ class ExplainerServer:
             "pipeline_depth": self.pipeline_depth or 0,
             "max_batch_size": self.max_batch_size,
             "admission_control": self._admission is not None,
+            "staging": self._staging_enabled,
         }
         with self._active_lock:
             detail["in_flight_batches"] = len(self._active)
@@ -909,6 +959,156 @@ class ExplainerServer:
                     compile_summary["cache_hit"],
                     compile_summary["seconds"])
 
+    def _form_batch(self):
+        """Pop one schedulable batch: expired requests are failed (504),
+        cache hits answered and in-batch duplicates collapsed.  Returns
+        ``(live, leaders, index_map, t_claim)`` or ``None`` when nothing
+        dispatchable came out (idle wakeup, all-expired, all-cached)."""
+
+        batch, expired = self._sched.next_batch(
+            self.max_batch_size,
+            max_rows=getattr(self.model, "max_rows", None),
+            batch_timeout_s=self.batch_timeout_s, stop=self._stop)
+        tr = self._tracer
+        t_claim = time.monotonic()
+        for p in expired:
+            # the declared SLO is already missed: answering late would
+            # waste a device slot on a response the client has abandoned
+            self._shed("deadline_expired")
+            if tr.enabled and p.trace is not None:
+                tr.record_mono("server.queue_wait", p.t_enqueued,
+                               t_claim, parent=p.trace, expired=True)
+            self._fail_request(p, "deadline expired before dispatch "
+                              "(server overloaded)", 504)
+        if not batch:
+            return None
+        live, leaders, index_map = self._split_batch_on_cache(batch)
+        if not leaders:
+            return None
+        return live, leaders, index_map, t_claim
+
+    def _dispatch_batch(self, live, leaders, index_map, t_claim,
+                        stacked=None, staged=None):
+        """Dispatch one formed batch to the device (dispatcher thread only:
+        the engine's jit caches are single-dispatcher state).  ``stacked``
+        /``staged`` come pre-built from the staging batcher; without them
+        the rows are stacked here (the classic single-thread path)."""
+
+        # read at dispatch: tests may swap self.model while the
+        # dispatcher is parked in next_batch / the staging buffer
+        pipelined = hasattr(self.model, "explain_batch_async")
+        tr = self._tracer
+        sizes = [p.array.shape[0] for p in leaders]
+        with self._active_lock:
+            # registered BEFORE the device call so the watchdog can
+            # fail it if the call never returns
+            self._active[id(live)] = live
+        t_dispatch = time.monotonic()
+        device_rows = sum(sizes)
+        if tr.enabled:
+            for p in live:
+                if p.trace is not None:
+                    tr.record_mono("server.queue_wait", p.t_enqueued,
+                                   t_claim, parent=p.trace)
+                    tr.record_mono("server.schedule", t_claim,
+                                   t_dispatch, parent=p.trace,
+                                   batch_requests=len(live))
+        # engine profiling phases fired during the device call
+        # parent to one traced request of the batch (attrs carry
+        # the batch size; a batch can mix trace ids)
+        batch_ctx = next((p.trace for p in leaders
+                          if p.trace is not None), None) \
+            if tr.enabled else None
+        # per-leader response encodings, only for models that speak the
+        # wire protocol (the serving wrappers); stub models keep the
+        # historical JSON-only call signature.  An all-JSON batch also
+        # omits the kwarg, so pre-wire model subclasses overriding
+        # explain_batch(_async) without `formats` keep working for the
+        # traffic they can serve.
+        formats = ([p.wire_format for p in leaders]
+                   if getattr(self.model, "supports_wire_formats", False)
+                   else None)
+        kwargs = ({"formats": formats} if formats is not None
+                  and any(f != "json" for f in formats) else {})
+        try:
+            if stacked is None:
+                stacked = np.concatenate([p.array for p in leaders],
+                                         axis=0)
+            if pipelined:
+                with _tracing.use_context(batch_ctx):
+                    finalize = self.model.explain_batch_async(
+                        staged if staged is not None else stacked,
+                        split_sizes=sizes, **kwargs)
+                self._inflight.put((live, finalize, index_map,
+                                    device_rows, t_dispatch,
+                                    batch_ctx))
+            else:
+                with _tracing.use_context(batch_ctx):
+                    payloads = self.model.explain_batch(
+                        stacked, split_sizes=sizes, **kwargs)
+                self._complete(
+                    live, payloads,
+                    index_map=index_map, device_rows=device_rows,
+                    t_dispatch=t_dispatch,
+                    t_fetch=time.monotonic())
+        except Exception as e:  # surface errors to waiting requests
+            logger.exception("explain batch failed")
+            self._complete(live, error=str(e))
+
+    def _batcher_loop(self):
+        """Staging half of the double-buffered pipeline (staging enabled
+        only): form scheduler batches, stack their rows, and start the
+        host→device upload (``model.stage_rows`` → ``jax.device_put``,
+        asynchronous) while the dispatcher thread's current batch is still
+        computing.  The bounded :class:`StagingBuffer` is the double
+        buffer: one batch computing, one staged, one forming."""
+
+        tr = self._tracer
+        while not self._stop.is_set():
+            # deliberately NO try around batch formation: an exception in
+            # next_batch/cache-split has already popped requests this
+            # frame holds no reference to — swallowing it would leak them
+            # into a silent per-request hang.  Propagating kills the
+            # batcher loudly, exactly the unstaged dispatch loop's
+            # fail-fast behaviour.
+            formed = self._form_batch()
+            if formed is None:
+                continue
+            live, leaders, index_map, t_claim = formed
+            try:
+                stacked = np.concatenate([p.array for p in leaders],
+                                         axis=0)
+                staged = None
+                t0 = time.monotonic()
+                try:
+                    staged = self.model.stage_rows(stacked)
+                except Exception:
+                    # staging is an optimisation: a failed upload must
+                    # degrade to the classic dispatch-time H2D, never
+                    # fail the batch
+                    logger.exception(
+                        "stage_rows failed; dispatching unstaged")
+                if tr.enabled and staged is not None:
+                    batch_ctx = next((p.trace for p in leaders
+                                      if p.trace is not None), None)
+                    if batch_ctx is not None:
+                        tr.record_mono("staging.upload", t0,
+                                       time.monotonic(), parent=batch_ctx,
+                                       rows=int(stacked.shape[0]))
+            except Exception as e:
+                # from here on this frame OWNS the popped requests: any
+                # failure must answer them, not drop them
+                logger.exception("staging batcher: stacking failed")
+                self._complete(live, error=str(e))
+                continue
+            if not self._staged.put((live, leaders, index_map, t_claim,
+                                     stacked, staged), stop=self._stop):
+                # shutdown won the race for the staging slot: fail the
+                # batch like the scheduler drain would have
+                self._complete(live, error="server shutting down",
+                               status=503)
+                return
+
     def _dispatch_loop(self):
         """Form batches via the scheduler and dispatch one device call each.
 
@@ -916,7 +1116,11 @@ class ExplainerServer:
         ``(batch, finalize)`` pair is handed to the finalizer pool, so batch
         k+1's dispatch overlaps batch k's D2H fetch + postprocess — the fetch
         is ~70ms of RPC latency on a tunnelled TPU and concurrent fetches
-        overlap, so pipelining collapses the per-batch round-trip cost."""
+        overlap, so pipelining collapses the per-batch round-trip cost.
+
+        With staging enabled, batch formation + stacking + H2D move to
+        :meth:`_batcher_loop` and this thread consumes the staging buffer —
+        each batch it dispatches already has device-resident rows."""
 
         try:
             # precompile warmup ladder first: this thread owns the engine's
@@ -924,74 +1128,31 @@ class ExplainerServer:
             # routers away while it runs; queued requests wait in the
             # scheduler and land on warm programs
             self._run_warmup()
+            if self._staging_enabled:
+                while True:
+                    got = self._staged.get(stop=self._stop)
+                    if got is None:
+                        break
+                    (live, leaders, index_map, t_claim,
+                     stacked, staged), ready_s = got
+                    # time the staged batch sat device-ready while this
+                    # thread was busy with the previous one — the measured
+                    # upload/compute overlap
+                    self._m_staging_overlap.inc(ready_s)
+                    self._dispatch_batch(live, leaders, index_map, t_claim,
+                                         stacked=stacked, staged=staged)
+                for item in self._staged.drain():
+                    # staged but never dispatched (shutdown): fail like the
+                    # scheduler drain so no handler thread leaks
+                    self._complete(item[0], error="server shutting down",
+                                   status=503)
+                return
             while not self._stop.is_set():
-                batch, expired = self._sched.next_batch(
-                    self.max_batch_size,
-                    max_rows=getattr(self.model, "max_rows", None),
-                    batch_timeout_s=self.batch_timeout_s, stop=self._stop)
-                # read after batch formation: tests may swap self.model
-                # while the dispatcher is parked in next_batch
-                pipelined = hasattr(self.model, "explain_batch_async")
-                tr = self._tracer
-                t_claim = time.monotonic()
-                for p in expired:
-                    # the declared SLO is already missed: answering late
-                    # would waste a device slot on a response the client
-                    # has abandoned
-                    self._shed("deadline_expired")
-                    if tr.enabled and p.trace is not None:
-                        tr.record_mono("server.queue_wait", p.t_enqueued,
-                                       t_claim, parent=p.trace, expired=True)
-                    self._fail_request(p, "deadline expired before dispatch "
-                                      "(server overloaded)", 504)
-                if not batch:
+                formed = self._form_batch()
+                if formed is None:
                     continue
-                live, leaders, index_map = self._split_batch_on_cache(batch)
-                if not leaders:
-                    continue
-                sizes = [p.array.shape[0] for p in leaders]
-                with self._active_lock:
-                    # registered BEFORE the device call so the watchdog can
-                    # fail it if the call never returns
-                    self._active[id(live)] = live
-                t_dispatch = time.monotonic()
-                device_rows = sum(sizes)
-                if tr.enabled:
-                    for p in live:
-                        if p.trace is not None:
-                            tr.record_mono("server.queue_wait", p.t_enqueued,
-                                           t_claim, parent=p.trace)
-                            tr.record_mono("server.schedule", t_claim,
-                                           t_dispatch, parent=p.trace,
-                                           batch_requests=len(live))
-                # engine profiling phases fired during the device call
-                # parent to one traced request of the batch (attrs carry
-                # the batch size; a batch can mix trace ids)
-                batch_ctx = next((p.trace for p in leaders
-                                  if p.trace is not None), None) \
-                    if tr.enabled else None
-                try:
-                    stacked = np.concatenate([p.array for p in leaders],
-                                             axis=0)
-                    if pipelined:
-                        with _tracing.use_context(batch_ctx):
-                            finalize = self.model.explain_batch_async(
-                                stacked, split_sizes=sizes)
-                        self._inflight.put((live, finalize, index_map,
-                                            device_rows, t_dispatch,
-                                            batch_ctx))
-                    else:
-                        with _tracing.use_context(batch_ctx):
-                            payloads = self.model.explain_batch(
-                                stacked, split_sizes=sizes)
-                        self._complete(
-                            live, payloads,
-                            index_map=index_map, device_rows=device_rows,
-                            t_dispatch=t_dispatch,
-                            t_fetch=time.monotonic())
-                except Exception as e:  # surface errors to waiting requests
-                    logger.exception("explain batch failed")
-                    self._complete(live, error=str(e))
+                live, leaders, index_map, t_claim = formed
+                self._dispatch_batch(live, leaders, index_map, t_claim)
         finally:
             # finalizers only exit once dispatch can no longer enqueue, so a
             # batch dispatched during shutdown is still fetched + answered
@@ -1152,14 +1313,17 @@ class ExplainerServer:
             # threads instead of spawning one per request
             protocol_version = "HTTP/1.1"
 
-            def _reply(self, code: int, body: str, ctype="application/json",
+            def _reply(self, code: int, body, ctype="application/json",
                        headers=None):
                 # the request's root span (set only on the /explain route)
                 # ends with the reply, whatever branch produced it
                 span = self.__dict__.pop("_dks_root", None)
                 if span is not None:
                     server._tracer.end(span, status=code)
-                data = body.encode()
+                # binary wire payloads arrive as bytes; everything else is
+                # the historical str
+                data = body if isinstance(body, (bytes, bytearray)) \
+                    else body.encode()
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
@@ -1168,13 +1332,21 @@ class ExplainerServer:
                 self.end_headers()
                 self.wfile.write(data)
 
-            def _reply_explain_ok(self, body: str):
+            def _reply_explain_ok(self, body):
                 """Success reply for /explain, routed through the chaos
                 site ``server.explain``: crash/hang/slow happen inside
                 ``fire``; ``drop`` closes the socket without replying
                 (mid-request connection loss); ``corrupt`` garbles the
-                payload bytes under an intact Content-Length."""
+                payload bytes under an intact Content-Length.
 
+                The payload's TYPE is the transport truth: wire-encoded
+                explanations are bytes (Content-Type
+                ``application/x-dks-wire``), the historical Explanation
+                document a str (JSON) — so a model swap mid-flight can
+                never mislabel a payload."""
+
+                binary = isinstance(body, (bytes, bytearray))
+                ctype = _wire.CONTENT_TYPE if binary else "application/json"
                 action = (server._faults.fire("server.explain")
                           if server._faults is not None else None)
                 if action == "drop":
@@ -1183,8 +1355,13 @@ class ExplainerServer:
                         server._tracer.end(span, status=0, dropped=True)
                     self.close_connection = True
                     return
+                # counted only for responses actually sent (a chaos drop
+                # above never puts these bytes on the wire)
+                server._m_wire_bytes.inc(
+                    len(body), format="binary" if binary else "json",
+                    direction="tx")
                 if action != "corrupt":
-                    self._reply(200, body)
+                    self._reply(200, body, ctype=ctype)
                     return
                 from distributedkernelshap_tpu.resilience.faults import (
                     corrupt_payload,
@@ -1195,9 +1372,9 @@ class ExplainerServer:
                     server._tracer.end(span, status=200, corrupt=True)
                 # raw-bytes variant of _reply: the garbled payload is not
                 # valid text, so it cannot round-trip through str
-                data = corrupt_payload(body.encode())
+                data = corrupt_payload(body if binary else body.encode())
                 self.send_response(200)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
@@ -1233,11 +1410,41 @@ class ExplainerServer:
                     return
                 try:
                     length = int(self.headers.get("Content-Length", 0))
-                    payload = json.loads(self.rfile.read(length) or b"{}")
-                    array = np.atleast_2d(np.asarray(payload["array"], dtype=np.float32))
+                    body = self.rfile.read(length) or b"{}"
+                    if _wire.is_wire_content_type(
+                            self.headers.get("Content-Type")):
+                        # binary streaming ingest: one zero-copy
+                        # np.frombuffer view straight into the scheduler's
+                        # row buffer — no JSON parse, no float-list
+                        # re-materialisation
+                        req_format = "binary"
+                        array = _wire.decode_request(body)
+                    else:
+                        req_format = "json"
+                        payload = json.loads(body)
+                        array = np.atleast_2d(
+                            np.asarray(payload["array"], dtype=np.float32))
+                except _wire.WireVersionError as e:
+                    # well-formed framing, future protocol: 415 is the
+                    # client's downgrade-to-JSON signal
+                    self._reply(415, json.dumps({
+                        "error": f"unsupported wire version: {e}",
+                        "supported_wire_versions": [_wire.WIRE_VERSION]}))
+                    return
                 except (KeyError, ValueError, json.JSONDecodeError) as e:
+                    # covers WireError too (truncated header, bad dtype,
+                    # torn body): a hostile body is a 400, never a crash
                     self._reply(400, json.dumps({"error": f"bad request: {e}"}))
                     return
+                server._m_wire_bytes.inc(len(body), format=req_format,
+                                         direction="rx")
+                # response negotiation: binary only on an EXPLICIT Accept
+                # and only when the served model can encode it — otherwise
+                # the historical JSON document (old clients, stub models)
+                wire_format = ("binary" if _wire.accepts_wire(
+                    self.headers.get("Accept"))
+                    and getattr(server.model, "supports_wire_formats",
+                                False) else "json")
                 tr = server._tracer
                 if tr.enabled:
                     # the request's root span, parented to whatever the
@@ -1301,9 +1508,11 @@ class ExplainerServer:
                     return
                 root = self.__dict__.get("_dks_root")
                 pending = _Pending(array, klass=klass, deadline=deadline,
-                                   cache_key=server._cache_key_for(array),
+                                   cache_key=server._cache_key_for(
+                                       array, wire_format),
                                    trace=root.context if root is not None
-                                   else None)
+                                   else None,
+                                   wire_format=wire_format)
                 # cache fast path: a duplicate of an already-served request
                 # is answered bit-identically without queueing at all
                 if pending.cache_key is not None:
@@ -1416,12 +1625,31 @@ class ExplainerServer:
                 logger.exception("depth calibration failed; defaulting to 8")
                 self.pipeline_depth = 8
         self._inflight = queue.Queue(maxsize=self.pipeline_depth)
+        # staging resolves against the model's actual capabilities here:
+        # it needs the pipelined path plus the stage_rows hook (serving
+        # wrappers), and stage_rows itself may still decline per call
+        # (exact/interactions/l1 deployments return None → unstaged path)
+        self._staging_enabled = (
+            self._staging_requested
+            and hasattr(self.model, "stage_rows")
+            and hasattr(self.model, "explain_batch_async"))
+        if self._staging_requested and not self._staging_enabled:
+            logger.warning(
+                "staging requested but the model exposes no "
+                "stage_rows/explain_batch_async; serving unstaged")
+        t_batcher = None
+        if self._staging_enabled:
+            self._staged = StagingBuffer(depth=1)
+            t_batcher = threading.Thread(target=self._batcher_loop,
+                                         daemon=True)
         t_disp = threading.Thread(target=self._dispatch_loop, daemon=True)
         # one finalizer per pipeline slot (capped: each thread holds a live
         # RPC stream to the device tunnel) so D2H overlap scales with depth
         t_fin = [threading.Thread(target=self._finalize_loop, daemon=True)
                  for _ in range(min(self.pipeline_depth, 8))]
         t_disp.start()
+        if t_batcher is not None:
+            t_batcher.start()
         for t in t_fin:
             t.start()
         t_dog = threading.Thread(target=self._watchdog_loop, daemon=True)
@@ -1430,6 +1658,8 @@ class ExplainerServer:
         # health_interval_s == 0)
         self.health.start()
         self._threads = [t_http, t_disp, t_dog, *t_fin]
+        if t_batcher is not None:
+            self._threads.append(t_batcher)
         logger.info("ExplainerServer listening on %s:%d/explain (max_batch_size=%d)",
                     self.host, self.port, self.max_batch_size)
         return self

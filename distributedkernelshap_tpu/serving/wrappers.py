@@ -19,6 +19,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from distributedkernelshap_tpu.kernel_shap import KernelShap
+from distributedkernelshap_tpu.serving import wire
 
 logger = logging.getLogger(__name__)
 
@@ -117,19 +118,41 @@ class KernelShapModel:
                                              **self.explain_kwargs)
         return explanation.to_json()
 
+    #: the server checks this capability flag before asking for per-request
+    #: wire formats — swapped-in stub models (benchmarks, tests) without it
+    #: keep the historical JSON-only contract
+    supports_wire_formats = True
+
     def _resplit_payloads(self, instances: np.ndarray, shap_values,
                           expected_value, raw_predictions: np.ndarray,
                           split_sizes: List[int],
-                          interaction_values=None) -> List[str]:
-        """Re-split one batched run into per-request Explanation JSON,
-        reusing the batched raw outputs (no per-slice predictor pass)."""
+                          interaction_values=None, formats=None) -> List:
+        """Re-split one batched run into per-request payloads, reusing the
+        batched raw outputs (no per-slice predictor pass).
+
+        ``formats[i]`` selects slot ``i``'s encoding: ``'json'`` (default —
+        the historical Explanation JSON string) or ``'binary'`` (the wire
+        format's raw-bytes explanation, ``serving/wire.py``).  Binary slots
+        skip ``build_explanation`` + ``to_json`` entirely — that per-request
+        document build is the single largest host cost on the serving hot
+        path, which is exactly what the streaming protocol exists to kill.
+        """
 
         sv = shap_values if isinstance(shap_values, list) else [shap_values]
         e_val = list(np.atleast_1d(np.asarray(expected_value)))
         payloads = []
         offset = 0
-        for size in split_sizes:
+        for slot, size in enumerate(split_sizes):
             sl = slice(offset, offset + size)
+            fmt = formats[slot] if formats is not None else "json"
+            if fmt == "binary":
+                payloads.append(wire.encode_explanation(
+                    [values[sl] for values in sv], e_val,
+                    raw_predictions[sl],
+                    interaction_values=None if interaction_values is None
+                    else [v[sl] for v in interaction_values]))
+                offset += size
+                continue
             piece = self.explainer.build_explanation(
                 instances[sl],
                 [values[sl] for values in sv],
@@ -143,10 +166,27 @@ class KernelShapModel:
             offset += size
         return payloads
 
+    def stage_rows(self, instances: np.ndarray):
+        """Pre-upload a stacked request batch to the device (the serving
+        staging pipeline's hook): returns an engine ``StagedRows`` whose
+        H2D copy is already in flight, or ``None`` when this deployment's
+        explain path cannot consume pre-staged rows (host-eval, exact,
+        interactions, active l1 — the sync-fallback paths).  The returned
+        object is accepted by :meth:`explain_batch_async` in place of the
+        raw array."""
+
+        engine = self.explainer._explainer
+        stage = getattr(engine, "stage_rows", None)
+        if stage is None:
+            return None
+        return stage(instances, **self.explain_kwargs)
+
     def explain_batch(self, instances: np.ndarray,
-                      split_sizes: Optional[List[int]] = None) -> List[str]:
+                      split_sizes: Optional[List[int]] = None,
+                      formats: Optional[List[str]] = None) -> List:
         """Explain a stacked array in one device call and re-split the
-        results into per-request JSON payloads."""
+        results into per-request payloads (JSON strings, or wire bytes for
+        slots marked ``'binary'`` in ``formats``)."""
 
         explanation = self.explainer.explain(instances, silent=True,
                                              **self.explain_kwargs)
@@ -156,17 +196,21 @@ class KernelShapModel:
             instances, explanation.shap_values, explanation.expected_value,
             explanation.data["raw"]["raw_prediction"], split_sizes,
             interaction_values=explanation.data["raw"].get(
-                "interaction_values"))
+                "interaction_values"), formats=formats)
 
-    def explain_batch_async(self, instances: np.ndarray,
-                            split_sizes: Optional[List[int]] = None):
+    def explain_batch_async(self, instances,
+                            split_sizes: Optional[List[int]] = None,
+                            formats: Optional[List[str]] = None):
         """Pipelined variant of :meth:`explain_batch`: dispatches the device
-        work immediately and returns ``finalize() -> List[str]``.
+        work immediately and returns ``finalize() -> List[payload]``.
 
         The server's dispatcher thread calls this back-to-back for successive
         request batches while finalizer threads fetch + postprocess earlier
         ones, overlapping the per-call D2H round trips that dominate
-        small-batch latency on a tunnelled TPU."""
+        small-batch latency on a tunnelled TPU.  ``instances`` may be an
+        engine ``StagedRows`` from :meth:`stage_rows` — its device buffer is
+        then consumed directly (no second H2D), and the host copy feeds the
+        JSON re-split."""
 
         engine = self.explainer._explainer
         # both explainer kinds expose the same async contract:
@@ -175,15 +219,17 @@ class KernelShapModel:
         # where the sharded fetch has no collectives; multi-host falls back
         # to a synchronous closure internally)
         fin = engine.get_explanation_async(instances, **self.explain_kwargs)
-        sizes = ([1] * instances.shape[0] if split_sizes is None
+        host_rows = getattr(instances, "host", instances)
+        sizes = ([1] * host_rows.shape[0] if split_sizes is None
                  else list(split_sizes))
 
-        def finalize() -> List[str]:
+        def finalize() -> List:
             values, info = fin()
             return self._resplit_payloads(
-                instances, values, info["expected_value"],
+                host_rows, values, info["expected_value"],
                 info["raw_prediction"], sizes,
-                interaction_values=info.get("interaction_values"))
+                interaction_values=info.get("interaction_values"),
+                formats=formats)
 
         return finalize
 

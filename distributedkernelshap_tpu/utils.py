@@ -48,6 +48,39 @@ class Bunch(dict):
             raise AttributeError(key)
 
 
+def parse_bool_token(raw: Optional[str]) -> Optional[bool]:
+    """The ONE truthy/falsy env-token parser shared by every boolean knob
+    (``DKS_WARMUP``/``DKS_STAGING``/``DKS_DONATE``): ``True``/``False``
+    for a recognised token, ``None`` for empty/unrecognised — each caller
+    applies its own default (and decides whether to warn), so the token
+    vocabulary can never drift between knobs."""
+
+    raw = (raw or "").strip().lower()
+    if raw in ("1", "true", "on", "yes"):
+        return True
+    if raw in ("0", "false", "off", "no"):
+        return False
+    return None
+
+
+def resolve_bool_env(name: str, default: bool) -> bool:
+    """Resolve one boolean env knob via :func:`parse_bool_token`.  An
+    unrecognised non-empty value falls back to ``default`` LOUDLY — the
+    shared contract of ``DKS_WARMUP``/``DKS_STAGING``/``DKS_DONATE``: a
+    typo must never silently flip (or silently keep) a behaviour the
+    operator thinks they set."""
+
+    raw = os.environ.get(name, "")
+    parsed = parse_bool_token(raw)
+    if parsed is not None:
+        return parsed
+    if raw.strip():
+        logging.getLogger(__name__).warning(
+            "unrecognised %s=%r; using the component default (%s)",
+            name, raw, default)
+    return default
+
+
 def methdispatch(func: Callable):
     """singledispatch on ``args[1]`` so it works for instance methods
     (reference utils.py:43-64)."""

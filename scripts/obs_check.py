@@ -34,9 +34,10 @@ DOCS_PATH = os.path.join(REPO_ROOT, "docs", "OBSERVABILITY.md")
 #: metric-shaped literals; deliberately NOT bare ``dks_`` — env knobs
 #: (DKS_TRACE), header names and file paths share the prefix.  ``slo``
 #: and ``alerts`` joined when the health engine landed its
-#: ``dks_slo_*``/``dks_alerts_*`` series.
+#: ``dks_slo_*``/``dks_alerts_*`` series; ``wire`` and ``staging`` when
+#: the streaming hot path landed ``dks_wire_*``/``dks_staging_*``.
 _LITERAL_RE = re.compile(
-    r"dks_(?:serve|fanin|sched|phase|slo|alerts)_[a-z0-9_]+")
+    r"dks_(?:serve|fanin|sched|phase|slo|alerts|wire|staging)_[a-z0-9_]+")
 
 #: directories never scanned for literals/renderers
 _SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "results", "data",

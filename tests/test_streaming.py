@@ -1,0 +1,587 @@
+"""Streaming hot path: wire framing fuzz/roundtrip, protocol negotiation
+(old clients, new servers, pre-wire servers), staging pipeline, buffer
+donation gating, connection pooling."""
+
+import http.client
+import http.server
+import json
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu.serving import client, wire
+
+# --------------------------------------------------------------------- #
+# wire framing
+# --------------------------------------------------------------------- #
+
+
+def test_roundtrip_arrays_zero_copy():
+    arrays = {
+        "a": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "b": np.array([1.5, -2.5], dtype=np.float64),
+        "flags": np.array([True, False]),
+        "ids": np.arange(5, dtype=np.int64),
+    }
+    buf = wire.encode_arrays(arrays)
+    out = wire.decode_arrays(buf)
+    assert set(out) == set(arrays)
+    for name, arr in arrays.items():
+        assert out[name].dtype == arr.dtype
+        assert np.array_equal(out[name], arr)
+    # zero copy: the decoded float32 payload views the message buffer
+    assert out["a"].base is not None
+
+
+def test_request_roundtrip_casts_to_f32():
+    x = np.arange(6, dtype=np.float64).reshape(2, 3)
+    arr = wire.decode_request(wire.encode_request(x))
+    assert arr.dtype == np.float32 and arr.shape == (2, 3)
+    assert np.array_equal(arr, x.astype(np.float32))
+
+
+def test_explanation_roundtrip():
+    sv = [np.random.default_rng(0).normal(size=(3, 5)).astype(np.float32)
+          for _ in range(2)]
+    e = np.array([0.1, 0.9], dtype=np.float32)
+    fx = np.random.default_rng(1).normal(size=(3, 2)).astype(np.float32)
+    out = wire.decode_explanation(wire.encode_explanation(sv, e, fx))
+    assert all(np.array_equal(a, b) for a, b in zip(out["shap_values"], sv))
+    assert np.array_equal(out["expected_value"], e)
+    assert np.array_equal(out["raw_prediction"], fx)
+
+
+def test_json_payload_extraction_matches_binary():
+    """The client's downgrade path must produce the same structure the
+    binary decoder does (Explanation.to_json schema)."""
+
+    payload = json.dumps({
+        "meta": {},
+        "data": {"shap_values": [[[1.0, 2.0]], [[3.0, 4.0]]],
+                 "expected_value": [0.5, 0.25],
+                 "raw": {"raw_prediction": [[0.9, 0.1]]}}})
+    out = wire.explanation_payload_from_json(payload)
+    assert np.array_equal(out["shap_values"][1], [[3.0, 4.0]])
+    assert out["expected_value"].shape == (2,)
+    assert out["raw_prediction"].shape == (1, 2)
+
+
+@pytest.mark.parametrize("mutate", [
+    lambda b: b[:3],                                   # truncated header
+    lambda b: b[:20],                                  # truncated array head
+    lambda b: b[:-4],                                  # torn body
+    lambda b: b"XXXX" + b[4:],                         # bad magic
+    lambda b: b + b"\x00\x00",                         # trailing bytes
+    lambda b: b[:6] + b"\xff" + b[7:],                 # garbled count/etc.
+])
+def test_malformed_messages_raise_wire_error_never_crash(mutate):
+    buf = mutate(bytearray(wire.encode_request(np.zeros((2, 3),
+                                                        np.float32))))
+    with pytest.raises(wire.WireError):
+        wire.decode_arrays(bytes(buf))
+
+
+def test_bad_dtype_code_raises():
+    buf = bytearray(wire.encode_request(np.zeros((1, 2), np.float32)))
+    # array header starts right after the 8-byte message header:
+    # name_len(u16) dtype(u8) ndim(u8) name(...) — poison the dtype code
+    dtype_off = 8 + 2
+    assert buf[dtype_off] == wire.DTYPE_CODES[np.dtype(np.float32)]
+    buf[dtype_off] = 250
+    with pytest.raises(wire.WireError, match="dtype"):
+        wire.decode_arrays(bytes(buf))
+
+
+def test_future_version_raises_version_error():
+    buf = bytearray(wire.encode_request(np.zeros((1, 2), np.float32)))
+    struct.pack_into("<H", buf, 4, wire.WIRE_VERSION + 1)
+    with pytest.raises(wire.WireVersionError):
+        wire.decode_arrays(bytes(buf))
+
+
+def test_fuzz_random_bytes_never_crash():
+    rng = np.random.default_rng(0)
+    base = wire.encode_request(rng.normal(size=(4, 8)).astype(np.float32))
+    for trial in range(200):
+        buf = bytearray(base)
+        for _ in range(rng.integers(1, 6)):
+            buf[rng.integers(0, len(buf))] = rng.integers(0, 256)
+        try:
+            out = wire.decode_arrays(bytes(buf))
+        except wire.WireError:
+            continue  # rejected cleanly — the contract
+        for arr in out.values():  # or decoded into valid arrays
+            assert isinstance(arr, np.ndarray)
+
+
+def test_accept_negotiation_is_explicit_only():
+    assert wire.accepts_wire(wire.CONTENT_TYPE)
+    assert wire.accepts_wire(f"application/json, {wire.CONTENT_TYPE};q=0.9")
+    assert not wire.accepts_wire("*/*")
+    assert not wire.accepts_wire("application/json")
+    assert not wire.accepts_wire(None)
+    assert wire.is_wire_content_type(f"{wire.CONTENT_TYPE}; charset=x")
+    assert not wire.is_wire_content_type("application/json")
+
+
+# --------------------------------------------------------------------- #
+# end-to-end negotiation against a real server
+# --------------------------------------------------------------------- #
+
+
+@pytest.fixture(scope="module")
+def linear_server():
+    from sklearn.linear_model import LogisticRegression
+
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+    from distributedkernelshap_tpu.serving.wrappers import (
+        BatchKernelShapModel,
+    )
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(96, 6)).astype(np.float32)
+    clf = LogisticRegression(max_iter=200).fit(
+        X, (X[:, 0] > 0).astype(int))
+    model = BatchKernelShapModel(clf, X[:12], {"link": "logit", "seed": 0},
+                                 {}, explain_kwargs={"l1_reg": False})
+    srv = ExplainerServer(model, host="127.0.0.1", port=0, max_batch_size=1,
+                          pipeline_depth=1, cache_bytes=1 << 20,
+                          health_interval_s=0, staging=True).start()
+    try:
+        yield srv
+    finally:
+        srv.stop()
+
+
+def _url(srv):
+    return f"http://127.0.0.1:{srv.port}/explain"
+
+
+def test_old_json_client_against_new_server(linear_server):
+    """The historical contract byte-for-byte: JSON body in, Explanation
+    JSON out — a pre-wire client never notices the upgrade."""
+
+    row = np.random.default_rng(1).normal(size=(1, 6))
+    payload = client.explain_request(_url(linear_server), row, timeout=60)
+    doc = json.loads(payload)
+    assert "shap_values" in doc["data"]
+
+
+def test_binary_client_bit_identical_to_json(linear_server):
+    client.reset_negotiation_cache()
+    row = np.random.default_rng(2).normal(size=(1, 6))
+    payload = client.explain_request(_url(linear_server), row, timeout=60)
+    phi_json = np.asarray(json.loads(payload)["data"]["shap_values"],
+                          dtype=np.float32)
+    out = client.explain_request(_url(linear_server), row, timeout=60,
+                                 wire_format="binary")
+    assert np.array_equal(phi_json, np.stack(out["shap_values"]))
+
+
+def test_cache_keys_are_format_scoped(linear_server):
+    """A binary client must never be served a cached JSON document (and
+    vice versa): same rows over both transports answer in their own
+    encoding."""
+
+    client.reset_negotiation_cache()
+    row = np.random.default_rng(3).normal(size=(1, 6))
+    # populate the cache through the JSON path first
+    p1 = client.explain_request(_url(linear_server), row, timeout=60)
+    p2 = client.explain_request(_url(linear_server), row, timeout=60)
+    assert p1 == p2  # cached, bit-identical
+    out = client.explain_request(_url(linear_server), row, timeout=60,
+                                 wire_format="binary")
+    assert np.array_equal(
+        np.asarray(json.loads(p1)["data"]["shap_values"], np.float32),
+        np.stack(out["shap_values"]))
+
+
+def test_malformed_binary_body_is_400_not_crash(linear_server):
+    conn = http.client.HTTPConnection("127.0.0.1", linear_server.port,
+                                      timeout=30)
+    try:
+        body = wire.encode_request(np.zeros((1, 6), np.float32))[:-3]
+        conn.request("POST", "/explain", body=body,
+                     headers={"Content-Type": wire.CONTENT_TYPE})
+        resp = conn.getresponse()
+        assert resp.status == 400
+        assert "bad request" in json.loads(resp.read())["error"]
+        # the server survived: a clean request on a fresh connection works
+    finally:
+        conn.close()
+    row = np.random.default_rng(4).normal(size=(1, 6))
+    assert client.explain_request(_url(linear_server), row, timeout=60)
+
+
+def test_future_wire_version_is_415(linear_server):
+    buf = bytearray(wire.encode_request(np.zeros((1, 6), np.float32)))
+    struct.pack_into("<H", buf, 4, wire.WIRE_VERSION + 7)
+    conn = http.client.HTTPConnection("127.0.0.1", linear_server.port,
+                                      timeout=30)
+    try:
+        conn.request("POST", "/explain", body=bytes(buf),
+                     headers={"Content-Type": wire.CONTENT_TYPE})
+        resp = conn.getresponse()
+        assert resp.status == 415
+        assert json.loads(resp.read())["supported_wire_versions"] == [
+            wire.WIRE_VERSION]
+    finally:
+        conn.close()
+
+
+def test_wildcard_accept_stays_json(linear_server):
+    """An old client sending Accept: */* must get JSON bytes."""
+
+    conn = http.client.HTTPConnection("127.0.0.1", linear_server.port,
+                                      timeout=60)
+    try:
+        body = json.dumps(
+            {"array": np.zeros((1, 6)).tolist()}).encode()
+        conn.request("POST", "/explain", body=body,
+                     headers={"Content-Type": "application/json",
+                              "Accept": "*/*"})
+        resp = conn.getresponse()
+        payload = resp.read()
+        assert resp.status == 200
+        assert not wire.is_wire_content_type(
+            resp.headers.get("Content-Type"))
+        json.loads(payload)  # parses as the historical document
+    finally:
+        conn.close()
+
+
+def test_staging_pipeline_served_and_metered(linear_server):
+    """The module server runs staging=True: after traffic, the staging
+    overlap counter exists on /metrics (the staged dispatch path ran)."""
+
+    conn = http.client.HTTPConnection("127.0.0.1", linear_server.port,
+                                      timeout=30)
+    try:
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+    finally:
+        conn.close()
+    assert "dks_staging_overlap_seconds_total" in text
+    assert 'dks_wire_bytes_total{format="binary",direction="rx"}' in text
+    assert linear_server._staging_enabled
+
+
+# --------------------------------------------------------------------- #
+# downgrade against a pre-wire (JSON-only) server
+# --------------------------------------------------------------------- #
+
+
+class _ScriptedOldServer:
+    """A pre-wire server: answers ``answer_binary`` (415 or 400) to binary
+    bodies and a minimal Explanation JSON to JSON bodies."""
+
+    def __init__(self, answer_binary=415):
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_POST(self):
+                body = self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+                if wire.is_wire_content_type(
+                        self.headers.get("Content-Type")):
+                    outer.binary_hits += 1
+                    data = json.dumps({"error": "nope"}).encode()
+                    code = answer_binary
+                else:
+                    outer.json_hits += 1
+                    json.loads(body)
+                    data = json.dumps({
+                        "meta": {},
+                        "data": {"shap_values": [[[0.25, 0.75]]],
+                                 "expected_value": [0.5],
+                                 "raw": {"raw_prediction": [[0.9]]}},
+                    }).encode()
+                    code = 200
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, fmt, *args):
+                pass
+
+        self.binary_hits = 0
+        self.json_hits = 0
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                     Handler)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.mark.parametrize("status", [415, 400])
+def test_binary_client_downgrades_cleanly(status):
+    """415 (explicit) or 400 (a pre-wire server JSON-parsing the binary
+    body) downgrades to JSON without consuming the retry budget, and the
+    host's verdict is cached so later requests go straight to JSON."""
+
+    srv = _ScriptedOldServer(answer_binary=status)
+    client.reset_negotiation_cache()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/explain"
+        out = client.explain_request(url, np.zeros((1, 2)), timeout=30,
+                                     max_retries=0, wire_format="binary")
+        assert np.allclose(out["shap_values"][0], [[0.25, 0.75]])
+        assert srv.binary_hits == 1 and srv.json_hits == 1
+        out2 = client.explain_request(url, np.zeros((1, 2)), timeout=30,
+                                      max_retries=0, wire_format="auto")
+        assert np.allclose(out2["expected_value"], [0.5])
+        assert srv.binary_hits == 1  # no re-probe: negotiation cached
+    finally:
+        srv.stop()
+        client.reset_negotiation_cache()
+
+
+def test_request_level_400_does_not_disable_binary(linear_server):
+    """A wire-capable server answering 400 for a bad SLO header must not
+    poison the host's negotiation cache: the downgrade verdict is
+    withdrawn when the JSON re-send draws the same 400, so later
+    well-formed requests still ride the binary transport."""
+
+    client.reset_negotiation_cache()
+    row = np.random.default_rng(7).normal(size=(1, 6))
+    with pytest.raises(RuntimeError, match="HTTP 400"):
+        client.explain_request(
+            _url(linear_server), row, timeout=60, wire_format="binary",
+            extra_headers={"X-DKS-Priority": "bogus"},
+            _sleep=lambda s: None)
+    # the bad request did not cache a JSON downgrade...
+    from distributedkernelshap_tpu.serving.client import _negotiated
+    assert not _negotiated
+    # ...and a well-formed request still gets binary bytes end to end
+    conn = http.client.HTTPConnection("127.0.0.1", linear_server.port,
+                                      timeout=60)
+    try:
+        conn.request("POST", "/explain", body=wire.encode_request(row),
+                     headers={"Content-Type": wire.CONTENT_TYPE,
+                              "Accept": wire.CONTENT_TYPE})
+        resp = conn.getresponse()
+        payload = resp.read()
+        assert resp.status == 200
+        assert wire.is_wire_content_type(resp.headers.get("Content-Type"))
+        wire.decode_explanation(payload)
+    finally:
+        conn.close()
+
+
+def test_json_mode_400_stays_terminal():
+    """The downgrade trigger must not soften genuine client errors: after
+    the one binary→JSON downgrade, a 400 to the JSON body raises
+    immediately (no loop, no retry-budget spend)."""
+
+    import http.server as hs
+
+    class AlwaysBad(hs.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            data = json.dumps({"error": "bad"}).encode()
+            self.send_response(400)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, fmt, *args):
+            pass
+
+    httpd = hs.ThreadingHTTPServer(("127.0.0.1", 0), AlwaysBad)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    client.reset_negotiation_cache()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}/explain"
+        with pytest.raises(RuntimeError, match="HTTP 400"):
+            client.explain_request(url, np.zeros((1, 2)), timeout=30,
+                                   max_retries=2, wire_format="binary",
+                                   _sleep=lambda s: None)
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        client.reset_negotiation_cache()
+
+
+# --------------------------------------------------------------------- #
+# connection pooling (the per-attempt-reconnect satellite)
+# --------------------------------------------------------------------- #
+
+
+def test_client_reuses_one_connection_across_retry_loop():
+    """A 429-retry loop must ride ONE TCP connection: reconnecting per
+    attempt was pure handshake overhead (fresh sockets are for
+    HTTPException/ConnectionError only)."""
+
+    connections = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        calls = [0]
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            Handler.calls[0] += 1
+            if Handler.calls[0] < 3:
+                data = json.dumps({"retry_after_s": 0.01}).encode()
+                code = 429
+            else:
+                data = json.dumps({"data": "ok"}).encode()
+                code = 200
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def setup(self):
+            connections.append(self.client_address)
+            super().setup()
+
+        def log_message(self, fmt, *args):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        payload = client.explain_request(
+            f"http://127.0.0.1:{httpd.server_address[1]}/explain",
+            np.zeros((1, 2)), timeout=30, _sleep=lambda s: None)
+        assert json.loads(payload)["data"] == "ok"
+        assert Handler.calls[0] == 3
+        assert len(connections) == 1  # one socket for all three attempts
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_proxy_pools_forward_connections():
+    """The fan-in proxy's per-thread replica connections persist across
+    forwarded requests (a fresh socket per forward was the proxy-side
+    reconnect-per-attempt bug)."""
+
+    from distributedkernelshap_tpu.serving.replicas import FanInProxy
+
+    connections = []
+
+    class Replica(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            data = json.dumps({"data": "ok"}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def setup(self):
+            connections.append(self.client_address)
+            super().setup()
+
+        def log_message(self, fmt, *args):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Replica)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    proxy = FanInProxy([("127.0.0.1", httpd.server_address[1])],
+                       health_interval_s=0, probe_interval_s=60.0)
+    try:
+        body = json.dumps({"array": [[0.0]]}).encode()
+        for _ in range(4):
+            status, payload, _ = proxy.handle_explain("POST", body)
+            assert status == 200
+        # handle_explain runs on this one thread → one pooled connection
+        assert len(connections) == 1
+    finally:
+        proxy.stop()
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# --------------------------------------------------------------------- #
+# buffer donation gating
+# --------------------------------------------------------------------- #
+
+
+def test_donation_disabled_on_cpu_and_env_overridable(monkeypatch):
+    from distributedkernelshap_tpu.ops import explain as ops_explain
+
+    monkeypatch.delenv("DKS_DONATE", raising=False)
+    assert ops_explain.buffer_donation_enabled() is False  # cpu backend
+    monkeypatch.setenv("DKS_DONATE", "1")
+    assert ops_explain.buffer_donation_enabled() is True
+    monkeypatch.setenv("DKS_DONATE", "off")
+    assert ops_explain.buffer_donation_enabled() is False
+
+
+def test_donated_entry_points_still_bit_identical(monkeypatch):
+    """Forcing donation on (CPU ignores it with a warning at worst) must
+    not change results — and repeated calls through the donating entry
+    points keep serving the plan-constant cache correctly (the donated
+    argnum never aliases cached buffers)."""
+
+    from sklearn.linear_model import LogisticRegression
+
+    from distributedkernelshap_tpu.kernel_shap import KernelExplainerEngine
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(64, 5)).astype(np.float32)
+    clf = LogisticRegression(max_iter=200).fit(X, (X[:, 0] > 0).astype(int))
+    row = rng.normal(size=(2, 5)).astype(np.float32)
+
+    monkeypatch.delenv("DKS_DONATE", raising=False)
+    eng = KernelExplainerEngine(clf.predict_proba, X[:8], link="logit",
+                                seed=0)
+    base = np.stack(eng.get_explanation(row, l1_reg=False, silent=True))
+
+    monkeypatch.setenv("DKS_DONATE", "1")
+    eng2 = KernelExplainerEngine(clf.predict_proba, X[:8], link="logit",
+                                 seed=0)
+    for _ in range(3):  # repeated: cached consts must survive every call
+        out = np.stack(eng2.get_explanation(row, l1_reg=False, silent=True))
+        assert np.array_equal(base, out)
+
+
+# --------------------------------------------------------------------- #
+# StagingBuffer unit
+# --------------------------------------------------------------------- #
+
+
+def test_staging_buffer_handoff_and_overlap():
+    from distributedkernelshap_tpu.scheduling import StagingBuffer
+
+    buf = StagingBuffer(depth=1)
+    stop = threading.Event()
+    assert buf.put("a", stop=stop)
+    item, ready_s = buf.get(stop=stop)
+    assert item == "a" and ready_s >= 0.0
+    # stop set + empty → None; staged leftovers still delivered first
+    buf.put("b", stop=stop)
+    stop.set()
+    assert buf.get(stop=stop)[0] == "b"
+    assert buf.get(stop=stop) is None
+    assert not buf.put("c", stop=stop)
+
+
+def test_staging_buffer_drain():
+    from distributedkernelshap_tpu.scheduling import StagingBuffer
+
+    buf = StagingBuffer(depth=2)
+    buf.put("x")
+    buf.put("y")
+    assert buf.drain() == ["x", "y"]
+    assert buf.drain() == []
