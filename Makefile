@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test tier1 collect fuzz bench configs serve sweep-pool sweep-serve analysis multihost-ci sched-bench chaos-bench obs-check health-check perf-gate warmup-bench stream-bench
+.PHONY: test tier1 collect fuzz bench configs serve sweep-pool sweep-serve analysis multihost-ci sched-bench chaos-bench obs-check health-check perf-gate warmup-bench stream-bench exact-bench
 
 multihost-ci:    ## multi-host validation: 2-proc pool/phi/interactions, 4-proc 2x2 mesh, 2-proc serve (one JSON line, rc 0/1)
 	$(PY) benchmarks/multihost_ci.py
@@ -29,6 +29,9 @@ warmup-bench:    ## cold-start A/B: persistent compile cache across process star
 
 stream-bench:    ## streaming hot path A/B: binary wire + staging vs JSON on the REAL linear engine at B=1 (>=2x goodput, phi bit-identical, device-busy fraction reported)
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/streaming_bench.py --check
+
+exact-bench:     ## exact-TreeSHAP arms: packed path-parallel schedule vs einsum vs sampled at >=1000 trees x depth>=10 (phi bit-identical), plus exact requests on the staged+donated serving hot path; self-records for perf-gate
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/exact_ab.py --arm large,serving --check
 
 obs-check:       ## observability drift lint: registry vs docs/OBSERVABILITY.md catalog, stray dks_ literals, ad-hoc exposition renderers
 	env JAX_PLATFORMS=cpu $(PY) scripts/obs_check.py

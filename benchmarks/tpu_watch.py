@@ -82,11 +82,14 @@ def default_steps() -> List[Step]:
                                  "DKS_BENCH_BUDGET": "420"},
              why="the driver's exact contract; caches its own success"),
         Step("exact_ab",
-             [py, os.path.join(REPO_ROOT, "benchmarks", "exact_ab.py")],
+             [py, os.path.join(REPO_ROOT, "benchmarks", "exact_ab.py"),
+              "--arm", "adult,large"],
              timeout_s=2700,
              why="fused exact kernels vs einsum on real Mosaic — the "
                  "kernel_path field proves which path engaged (a Mosaic "
-                 "auto-degrade can no longer masquerade as a measurement)"),
+                 "auto-degrade can no longer masquerade as a measurement); "
+                 "the large arm exercises the packed pallas route "
+                 "(per-bucket dmax) at >=1000 trees x depth>=10"),
         Step("serve_and_pool",
              [py, reval, "--only", "serve,pool"],
              timeout_s=3600,

@@ -1181,14 +1181,30 @@ class KernelExplainerEngine:
 
         return finalize
 
+    def _exact_async_ready(self, interactions: bool = False) -> bool:
+        """Whether ``nsamples='exact'`` can ride the pipelined hot path
+        (staging, donation, single packed D2H): a lifted tree ensemble
+        with identity link, off host-eval, phi-only.  Interactions stay on
+        the sync path (their fn computes phi + the pairwise matrices in
+        one program with a different output contract)."""
+
+        if interactions or self.config.host_eval:
+            return False
+        from distributedkernelshap_tpu.ops.treeshap import supports_exact
+
+        return supports_exact(self.predictor) and self.config.link == 'identity'
+
     def stage_rows(self, X: np.ndarray,
                    nsamples: Union[str, int, None] = None,
                    l1_reg: Union[str, float, int, None] = 'auto',
                    interactions: bool = False) -> Optional[StagedRows]:
         """Start the host→device upload for a request batch NOW and return
         a :class:`StagedRows` handle, or ``None`` when these explain options
-        would route through a sync-fallback path (host-eval, exact,
-        interactions, active l1, instance chunking) that consumes host rows.
+        would route through a sync-fallback path (host-eval, exact
+        interactions, active l1, instance chunking) that consumes host
+        rows.  ``nsamples='exact'`` on a lifted tree ensemble stages like
+        the sampled path since the exact hot path rides the same
+        donated-entry machinery (:meth:`_dispatch_exact`).
 
         The serving staging pipeline calls this from its batcher thread
         while the previous batch computes: ``jax.device_put`` is
@@ -1201,8 +1217,13 @@ class KernelExplainerEngine:
         X = np.atleast_2d(np.asarray(X, dtype=np.float32))
         needs_chunking = (self.config.instance_chunk
                           and X.shape[0] > self.config.instance_chunk)
-        if (self.config.host_eval or needs_chunking or nsamples == 'exact'
-                or interactions or self._l1_active(l1_reg, nsamples)):
+        if self.config.host_eval or needs_chunking or interactions:
+            return None
+        if nsamples == 'exact':
+            # l1 is ignored in exact mode, so it never forces the sync path
+            if not self._exact_async_ready(interactions):
+                return None
+        elif self._l1_active(l1_reg, nsamples):
             return None
         Xp, B = self._pad_to_bucket(X)
         return StagedRows(host=X, device=jax.device_put(Xp), B=B)
@@ -1232,6 +1253,50 @@ class KernelExplainerEngine:
              else np.atleast_2d(np.asarray(X, dtype=np.float32)))
         needs_chunking = (self.config.instance_chunk
                           and X.shape[0] > self.config.instance_chunk)
+        if (nsamples == 'exact' and not needs_chunking
+                and self._exact_async_ready(interactions)):
+            # exact hot path: same pipelined contract as the sampled path —
+            # the jitted packed/dense exact entry consumes the staged (or
+            # freshly padded) batch buffer with donation and one packed
+            # D2H; finalize may run on another thread
+            if l1_reg not in (None, False, 0, 'auto'):
+                logger.warning(
+                    "l1_reg=%r is ignored with nsamples='exact': there is "
+                    "no sampling noise to regularise away.", l1_reg)
+            try:
+                fin0 = self._dispatch_exact(
+                    staged if staged is not None else X)
+            except Exception as e:
+                if not self._maybe_degrade_exact(e):
+                    raise
+                # staged buffer may have been consumed by the failed
+                # dispatch — redo from host rows on the einsum path
+                fin0 = self._dispatch_exact(X)
+
+            def finalize_exact():
+                try:
+                    with profiler().phase('device_explain'):
+                        r = fin0()
+                except Exception as e:
+                    # a Mosaic/VMEM failure can surface at the blocking
+                    # fetch (execution time), not dispatch: persist the
+                    # degrade so the NEXT dispatch (dispatcher thread)
+                    # rebuilds on the einsum path, then surface the error
+                    # for THIS batch — rebuilding jit caches from a
+                    # finalizer thread would race the dispatcher, and the
+                    # serving client retry policy re-lands the request on
+                    # the recovered path
+                    self._maybe_degrade_exact(e)
+                    raise
+                info = {
+                    'raw_prediction': r['raw_prediction'],
+                    'expected_value': np.atleast_1d(np.asarray(
+                        self.expected_value, dtype=np.float32)),
+                }
+                return (split_shap_values(r['shap_values'],
+                                          self.vector_out), info)
+
+            return finalize_exact
         if (self.config.host_eval or needs_chunking or nsamples == 'exact'
                 or interactions or self._l1_active(l1_reg, nsamples)):
             # these paths don't gain from pipelining (host-eval is
@@ -1418,12 +1483,227 @@ class KernelExplainerEngine:
 
     # ------------------------------------------------------------------ #
 
+    def _exact_consts(self):
+        """X-independent exact-path device constants — the background reach
+        tensors, the host-side packed-path plan and its packed gathers
+        (``ops/treeshap_pack.py``), and the per-fit weight/group uploads —
+        computed once and served from the same content-fingerprint-keyed
+        LRU device cache as the linear path's plan constants (identical
+        invalidation contract: a refit builds a new engine; in-place
+        predictor mutation is not detected, docs/PERFORMANCE.md)."""
+
+        # plan_constant_cache=False is the A/B control arm (recompute the
+        # hoisted constants per call) — honoured here like the linear
+        # path's _plan_consts so "same contract" is literally true
+        reuse = self.config.plan_constant_cache is not False
+        # pack_paths is part of the identity: flipping the escape hatch on
+        # a live engine must rebuild the consts, not serve the stale
+        # packed/dense decision
+        key = ('exact_consts', self.content_fingerprint(),
+               self.config.shap.pack_paths)
+        if reuse and key in self._plan_consts_cache:
+            self._plan_consts_cache.move_to_end(key)
+            return self._plan_consts_cache[key]
+        from distributedkernelshap_tpu.ops.treeshap import (
+            background_reach,
+            build_packed_plan,
+            pack_reach,
+            resolve_pack_paths,
+        )
+
+        pred = self.predictor
+        precision = self.config.shap.matmul_precision
+        budget = self.config.shap.target_chunk_elems
+        with profiler().phase('background_reach'), \
+                jax.default_matmul_precision(precision):
+            reach = jax.jit(
+                lambda bg, G: background_reach(
+                    pred, bg, G, target_chunk_elems=budget))(
+                        jnp.asarray(self.background), jnp.asarray(self.G))
+        plan = build_packed_plan(pred, self.G)
+        packed = None
+        if resolve_pack_paths(self.config.shap.pack_paths, plan):
+            with jax.default_matmul_precision(precision):
+                packed = pack_reach(pred, reach, plan)
+            # the packed phi route reads only onpath_g from the dense
+            # reach: dropping the dense z tensors here releases their HBM
+            # (at production-ensemble scale they rival the packed gathers)
+            # — the interactions path rebuilds full reach on demand via
+            # _exact_full_reach
+            reach = {'onpath_g': reach['onpath_g']}
+        consts = {'reach': reach, 'plan': plan, 'packed': packed,
+                  'bgw': jnp.asarray(self.bg_weights),
+                  'G': jnp.asarray(self.G)}
+        if reuse:
+            self._plan_consts_cache[key] = consts
+            while len(self._plan_consts_cache) > self._DEV_CACHE_MAX_ENTRIES:
+                self._plan_consts_cache.popitem(last=False)
+        return consts
+
+    def _exact_full_reach(self):
+        """Full dense reach tensors for the interactions path.  When the
+        packed plan engages, :meth:`_exact_consts` keeps only
+        ``onpath_g`` device-resident (the phi hot path needs nothing
+        else), so interactions rebuild — and separately cache — the full
+        tensors here."""
+
+        consts = self._exact_consts()
+        if 'z_ok' in consts['reach']:
+            return consts['reach']
+        reuse = self.config.plan_constant_cache is not False
+        key = ('exact_reach_full', self.content_fingerprint())
+        if reuse and key in self._plan_consts_cache:
+            self._plan_consts_cache.move_to_end(key)
+            return self._plan_consts_cache[key]
+        from distributedkernelshap_tpu.ops.treeshap import background_reach
+
+        pred = self.predictor
+        budget = self.config.shap.target_chunk_elems
+        with profiler().phase('background_reach'), \
+                jax.default_matmul_precision(
+                    self.config.shap.matmul_precision):
+            reach = jax.jit(
+                lambda bg, G: background_reach(
+                    pred, bg, G, target_chunk_elems=budget))(
+                        jnp.asarray(self.background), jnp.asarray(self.G))
+        if reuse:
+            self._plan_consts_cache[key] = reach
+            while len(self._plan_consts_cache) > self._DEV_CACHE_MAX_ENTRIES:
+                self._plan_consts_cache.popitem(last=False)
+        return reach
+
+    def _maybe_degrade_exact(self, e: Exception) -> bool:
+        """Shared Mosaic-rejection handler for the exact paths: the fused
+        kernel auto-enables on TPU backends but cannot be compile-checked
+        off-chip (interpret mode skips Mosaic).  Returns True when the
+        engine degraded to the einsum path (caller retries once); the
+        degrade persists — retrying the broken kernel on every explain
+        would recompile-and-fail each time — and is counted
+        (``pallas_degrades`` + ``dks_treeshap_fallback_total``) so a
+        rejected kernel can never pass for a measured one (VERDICT r4 #2).
+        """
+
+        msg = str(e)
+        pallas_error = any(s in msg.lower()
+                           for s in ("mosaic", "pallas", "vmem"))
+        if not pallas_error or self.config.shap.use_pallas is False:
+            return False
+        logger.warning(
+            "exact-path Pallas kernel failed to compile/run (%s...); "
+            "retrying with the XLA einsum path", msg[:200])
+        from distributedkernelshap_tpu.ops.treeshap import (
+            record_exact_fallback,
+        )
+
+        record_exact_fallback('pallas_runtime', msg[:120])
+        # drop EVERY cached exact fn: any of them may close over the
+        # pre-degrade use_pallas=True.  list() snapshots the keys in one
+        # GIL-atomic step — this can run on a finalizer thread while the
+        # dispatcher inserts entries, and iterating the live dict there
+        # would raise 'changed size during iteration'
+        for k in list(self._fn_cache):
+            if k in ('exact', 'exact_inter') or (
+                    isinstance(k, tuple) and k and k[0] == 'exact_entry'):
+                self._fn_cache.pop(k, None)
+        self.pallas_degrades += 1
+        self.config = replace(
+            self.config, shap=replace(self.config.shap, use_pallas=False))
+        return True
+
+    def _exact_fn(self, consts):
+        """The jitted exact-phi batch entry ``(Xp, reach, [packed,] bgw, G)
+        -> packed flat D2H vector`` — the ONE program behind the sync
+        chunk loop, the async serving path and the warmup ladder, so a
+        warmed rung is exactly the executable real requests hit.  Routes
+        through the packed path-parallel contraction when the plan
+        engages, the dense reach contraction otherwise; the per-call
+        batch upload (argnum 0) is donated, the ``consts`` arguments are
+        (usually cached) device buffers and never donated."""
+
+        packed_on = consts['packed'] is not None
+        td = self.config.shap.transfer_dtype
+        key = ('exact_entry', packed_on, td,
+               self.config.shap.use_pallas)
+        if key in self._fn_cache:
+            return self._fn_cache[key]
+        from distributedkernelshap_tpu.ops.treeshap import (
+            exact_shap_from_reach,
+            exact_shap_packed,
+        )
+
+        pred = self.predictor
+        precision = self.config.shap.matmul_precision
+        budget = self.config.shap.target_chunk_elems
+        use_pallas = self.config.shap.use_pallas
+        buckets = consts['plan'].buckets if packed_on else None
+
+        def fn_packed(Xp, onpath_g, packed, bgw, G):
+            with jax.default_matmul_precision(precision):
+                phi = exact_shap_packed(
+                    pred, Xp, onpath_g, packed, bgw, G, buckets,
+                    target_chunk_elems=budget, use_pallas=use_pallas)
+                return pack_transfer(phi, pred(Xp), td)
+
+        def fn_dense(Xp, reach, bgw, G):
+            with jax.default_matmul_precision(precision):
+                phi = exact_shap_from_reach(
+                    pred, Xp, reach, bgw, G, target_chunk_elems=budget,
+                    use_pallas=use_pallas)
+                return pack_transfer(phi, pred(Xp), td)
+
+        self._fn_cache[key] = jit_batch_entry(
+            fn_packed if packed_on else fn_dense, donate_argnums=(0,))
+        return self._fn_cache[key]
+
+    def _dispatch_exact(self, X):
+        """Launch the exact-phi computation for one batch and return a
+        blocking ``finalize() -> {'shap_values', 'raw_prediction'}``.
+        ``X`` may be a :class:`StagedRows` (its pre-uploaded, donatable
+        device buffer feeds the entry directly — the serving staging
+        pipeline's zero-copy handoff, now covering exact requests too)."""
+
+        from distributedkernelshap_tpu.ops.explain import (
+            capture_kernel_paths,
+        )
+
+        if isinstance(X, StagedRows):
+            Xp, B = X.device, X.B
+            Bp = X.device.shape[0]
+        else:
+            Xp, B = self._pad_to_bucket(X)
+            Bp = Xp.shape[0]
+            Xp = jnp.asarray(Xp, jnp.float32)
+        consts = self._exact_consts()
+        fn = self._exact_fn(consts)
+        td = self.config.shap.transfer_dtype
+        with capture_kernel_paths() as kp:
+            if consts['packed'] is not None:
+                packed_out = fn(Xp, consts['reach']['onpath_g'],
+                                consts['packed'], consts['bgw'],
+                                consts['G'])
+            else:
+                packed_out = fn(Xp, consts['reach'], consts['bgw'],
+                                consts['G'])
+        self._kernel_paths.update(kp)
+
+        def finalize() -> Dict[str, np.ndarray]:
+            K, M = self.predictor.n_outputs, self.M
+            phi, fx = unpack_transfer(packed_out, Bp * K * M, td)
+            return {
+                'shap_values': phi.reshape(Bp, K, M)[:B],
+                'raw_prediction': fx.reshape(Bp, K)[:B],
+            }
+
+        return finalize
+
     def _exact_tree_explanation(self, chunks, X, l1_reg,
                                 interactions: bool = False):
         """``nsamples='exact'``: closed-form interventional Shapley values
-        for a lifted tree ensemble (``ops/treeshap.exact_tree_shap``);
-        with ``interactions`` also the exact interaction matrices
-        (``ops/treeshap.exact_interactions_from_reach``)."""
+        for a lifted tree ensemble, via the packed path-parallel
+        contraction when the planner engages (``ops/treeshap_pack.py``) or
+        the dense reach contraction otherwise; with ``interactions`` also
+        the exact interaction matrices (dense path —
+        ``ops/treeshap.exact_interactions_from_reach``)."""
 
         from distributedkernelshap_tpu.ops.treeshap import validate_exact
 
@@ -1432,49 +1712,63 @@ class KernelExplainerEngine:
             logger.warning(
                 "l1_reg=%r is ignored with nsamples='exact': there is no "
                 "sampling noise to regularise away.", l1_reg)
+        if interactions:
+            return self._exact_inter_explanation(chunks, X)
 
-        key = 'exact_inter' if interactions else 'exact'
-        if key not in self._fn_cache:
+        from distributedkernelshap_tpu.parallel.pipeline import (
+            resolve_window,
+            run_pipeline,
+        )
+
+        with profiler().phase('device_explain'):
+            try:
+                results = run_pipeline(
+                    chunks, self._dispatch_exact, lambda fin: fin(),
+                    window=resolve_window(self.config.dispatch_window,
+                                          n_items=len(chunks)))
+            except Exception as e:  # pragma: no cover - needs a TPU Mosaic
+                if not self._maybe_degrade_exact(e):
+                    raise
+                return self._exact_tree_explanation(chunks, X, l1_reg)
+        phi = np.concatenate([r['shap_values'] for r in results], 0)
+        self.last_raw_prediction = np.concatenate(
+            [r['raw_prediction'] for r in results], 0)
+        self.last_X_fingerprint = _fingerprint(X)
+        return split_shap_values(phi, self.vector_out)
+
+    def _exact_inter_explanation(self, chunks, X):
+        """The interactions variant of the exact path: phi + the pairwise
+        matrices in one jitted program over the dense reach tensors
+        (packed scheduling covers the phi-only hot path; the pairwise
+        pass keeps the measured dense kernel/einsum formulation)."""
+
+        if 'exact_inter' not in self._fn_cache:
             from distributedkernelshap_tpu.ops.treeshap import (
-                background_reach,
                 exact_interactions_from_reach,
                 exact_shap_from_reach,
             )
 
             pred = self.predictor
             precision = self.config.shap.matmul_precision
-            # background reach tensors: computed once per fit and shared by
-            # every instance chunk AND both exact fn variants (reach depends
-            # only on (background, G), not on the interactions flag)
-            if 'exact_reach' not in self._fn_cache:
-                with profiler().phase('background_reach'), \
-                        jax.default_matmul_precision(precision):
-                    self._fn_cache['exact_reach'] = jax.jit(
-                        lambda bg, G: background_reach(pred, bg, G))(
-                            jnp.asarray(self.background), jnp.asarray(self.G))
-            reach = self._fn_cache['exact_reach']
-
             budget = self.config.shap.target_chunk_elems
-
             use_pallas = self.config.shap.use_pallas
+            reach = self._exact_full_reach()
 
             def fn(Xc, bgw, G, reach=reach):
                 with jax.default_matmul_precision(precision):
-                    out = {'shap_values':
-                           exact_shap_from_reach(
-                               pred, Xc, reach, bgw, G,
-                               target_chunk_elems=budget,
-                               use_pallas=use_pallas),
-                           'raw_prediction': pred(Xc)}
-                    if interactions:
-                        out['interaction_values'] = \
-                            exact_interactions_from_reach(
-                                pred, Xc, reach, bgw, G,
-                                target_chunk_elems=budget,
-                                use_pallas=use_pallas)
-                    return out
+                    return {
+                        'shap_values': exact_shap_from_reach(
+                            pred, Xc, reach, bgw, G,
+                            target_chunk_elems=budget,
+                            use_pallas=use_pallas),
+                        'raw_prediction': pred(Xc),
+                        'interaction_values': exact_interactions_from_reach(
+                            pred, Xc, reach, bgw, G,
+                            target_chunk_elems=budget,
+                            use_pallas=use_pallas),
+                    }
 
-            self._fn_cache[key] = jax.jit(fn)
+            self._fn_cache['exact_inter'] = jax.jit(fn)
 
         with profiler().phase('device_explain'):
             from distributedkernelshap_tpu.parallel.pipeline import (
@@ -1482,15 +1776,13 @@ class KernelExplainerEngine:
                 run_pipeline,
             )
 
-            # per-fit constants uploaded once, not once per chunk
-            bgw_dev = jnp.asarray(self.bg_weights)
-            G_dev = jnp.asarray(self.G)
-
+            consts = self._exact_consts()
+            bgw_dev, G_dev = consts['bgw'], consts['G']
             td = self.config.shap.transfer_dtype
 
             def _dispatch(c):
                 Xp, B = self._pad_to_bucket(c)
-                out = self._fn_cache[key](
+                out = self._fn_cache['exact_inter'](
                     jnp.asarray(Xp, jnp.float32), bgw_dev, G_dev)
                 if td:  # opt-in halved D2H — same contract as the sampled path
                     # phi/interactions dominate the wire; f(x) is B*K floats
@@ -1516,42 +1808,16 @@ class KernelExplainerEngine:
                                               n_items=len(chunks)))
                 self._kernel_paths.update(kp)
             except Exception as e:  # pragma: no cover - needs a TPU Mosaic
-                # The fused exact kernel auto-enables on TPU backends but
-                # cannot be compile-checked off-chip (interpret mode skips
-                # Mosaic): if Mosaic rejects it at first execution, degrade
-                # to the chunked-einsum path instead of failing the explain.
-                msg = str(e)
-                pallas_error = any(s in msg.lower()
-                                   for s in ("mosaic", "pallas", "vmem"))
-                if not pallas_error or self.config.shap.use_pallas is False:
+                if not self._maybe_degrade_exact(e):
                     raise
-                logger.warning(
-                    "exact-path Pallas kernel failed to compile/run "
-                    "(%s...); retrying with the XLA einsum path",
-                    msg[:200])
-                # drop EVERY cached exact fn (not just this variant): any
-                # of them may close over the pre-degrade use_pallas=True
-                self._fn_cache.pop('exact', None)
-                self._fn_cache.pop('exact_inter', None)
-                # persist the degrade: retrying the broken kernel on every
-                # explain would recompile-and-fail each time.  The counter
-                # (surfaced via `kernel_path`) lets benchmarks state that a
-                # degrade happened — a rejected kernel must never pass for a
-                # measured one (VERDICT r4 #2)
-                self.pallas_degrades += 1
-                self.config = replace(
-                    self.config,
-                    shap=replace(self.config.shap, use_pallas=False))
-                return self._exact_tree_explanation(
-                    chunks, X, l1_reg, interactions=interactions)
+                return self._exact_inter_explanation(chunks, X)
         phi = np.concatenate([r['shap_values'] for r in results], 0)
         self.last_raw_prediction = np.concatenate(
             [r['raw_prediction'] for r in results], 0)
-        if interactions:
-            inter = np.concatenate(
-                [r['interaction_values'] for r in results], 0)  # (B, K, M, M)
-            self.last_interaction_values = [inter[:, k]
-                                            for k in range(inter.shape[1])]
+        inter = np.concatenate(
+            [r['interaction_values'] for r in results], 0)  # (B, K, M, M)
+        self.last_interaction_values = [inter[:, k]
+                                        for k in range(inter.shape[1])]
         self.last_X_fingerprint = _fingerprint(X)
         return split_shap_values(phi, self.vector_out)
 

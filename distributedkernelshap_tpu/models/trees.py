@@ -134,7 +134,16 @@ class TreeEnsemblePredictor(BasePredictor):
     def __init__(self, feature, threshold, left, right, value, depth: int,
                  aggregation: str = "sum", base=None, scale: float = 1.0,
                  out_transform: str = "identity", missing_left=None,
-                 vector_out: bool = True):
+                 vector_out: bool = True,
+                 max_path_flops_per_row: Optional[int] = None):
+        if max_path_flops_per_row is not None:
+            # per-instance override of the class budget: production-scale
+            # ensembles (thousands of trees) opt IN to path tensors — the
+            # exact-TreeSHAP path requires them, and its packed work
+            # scheduling (ops/treeshap_pack.py) is what makes those shapes
+            # tractable; __call__ still reroutes oversized predicts to the
+            # iterative traversal independently of this knob
+            self.max_path_flops_per_row = int(max_path_flops_per_row)
         if aggregation not in ("sum", "mean"):
             raise ValueError(f"aggregation must be sum|mean, got {aggregation!r}")
         if out_transform not in OUT_TRANSFORMS:

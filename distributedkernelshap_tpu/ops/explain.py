@@ -99,6 +99,14 @@ class ShapConfig:
     # fallback everywhere).  GSPMD-sharded callers must disable it — a
     # pallas_call has no SPMD partitioning rule; shard_map callers are fine.
     use_pallas: Optional[bool] = None
+    # Path-parallel packed work scheduling for the exact TreeSHAP path
+    # (ops/treeshap_pack.py): None = auto (engage when the planner's
+    # modelled work saving clears PACK_AUTO_GAIN — unbalanced production
+    # ensembles pack, balanced small ones keep the tuned dense layout),
+    # True/False force.  The packed einsum route is bit-identical to the
+    # dense einsum reference by construction; escape hatch documented in
+    # docs/PERFORMANCE.md.
+    pack_paths: Optional[bool] = None
     # D2H dtype of the packed (phi, E, f(x)) result: None keeps float32.
     # 'float16' halves the transfer — worthwhile for huge-batch configs whose
     # result tensor dominates the wire (Covertype: 581k x 7 x 12 phi ≈

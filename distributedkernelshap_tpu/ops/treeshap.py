@@ -43,13 +43,70 @@ for the same background distribution, and against direct enumeration of
 the (interaction) index definitions.
 """
 
-from typing import Optional
+import logging
+import threading
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from distributedkernelshap_tpu.models.trees import TreeEnsemblePredictor
+
+logger = logging.getLogger(__name__)
+
+# ---------------------------------------------------------------------- #
+# Exact-path fallback accounting.  Every silent demotion off the fused
+# kernel (loose dmax bound under tracing, VMEM footprint gate, Mosaic
+# runtime rejection) used to be observable only as a 10x wall-clock
+# surprise; these process-global counters surface each demotion as
+# ``dks_treeshap_fallback_total{reason=...}`` (registered on the serving
+# registry via :func:`attach_treeshap_metrics`) and log the first
+# occurrence of each reason.
+
+_fallback_lock = threading.Lock()
+_fallback_counts: Dict[str, float] = {}
+_fallback_logged: set = set()
+
+
+def record_exact_fallback(reason: str, detail: str = "") -> None:
+    """Count one exact-path demotion; warn on the first of each reason."""
+
+    with _fallback_lock:
+        _fallback_counts[reason] = _fallback_counts.get(reason, 0.0) + 1.0
+        first = reason not in _fallback_logged
+        if first:
+            _fallback_logged.add(reason)
+    if first:
+        logger.warning(
+            "exact TreeSHAP fell back off the fused-kernel hot path "
+            "(reason=%s%s); counted in dks_treeshap_fallback_total — "
+            "further occurrences are counted silently",
+            reason, f": {detail}" if detail else "")
+
+
+def exact_fallback_counts() -> Dict[Tuple[str, ...], float]:
+    """``{(reason,): count}`` — the registry-callback shape."""
+
+    with _fallback_lock:
+        return {(r,): n for r, n in _fallback_counts.items()}
+
+
+def attach_treeshap_metrics(registry) -> None:
+    """Register ``dks_treeshap_fallback_total{reason}`` on ``registry`` as
+    a callback counter over the process-global fallback accounting."""
+
+    registry.counter(
+        "dks_treeshap_fallback_total",
+        "Exact-TreeSHAP demotion EVENTS off the fused-kernel hot path "
+        "(counted when the choice is made — at program build/trace time "
+        "or on a runtime rejection — not per served request), by reason "
+        "(dmax_static_bound = loose node-count bound under tracing, "
+        "kernel_footprint = VMEM gate, dmax_cap = bucket too deep for "
+        "the kernel, pallas_runtime = Mosaic rejected at run time, "
+        "plan_traced = packed planner unavailable under tracing).  Any "
+        "nonzero value means requests are running a demoted program.",
+        labelnames=("reason",)).set_function(exact_fallback_counts)
 
 
 def _unwrap(pred):
@@ -190,14 +247,37 @@ def _unsat(pred, rows, onpath, want_left):
     return onpath[None] * jnp.abs(gl[:, :, None, :] - want_left[None])
 
 
-def background_reach(pred, bg, G):
+def _chunked_rows(fn, rows, chunk: int, n: int):
+    """Apply per-row ``fn`` over ``rows`` in ``chunk``-row blocks via
+    ``lax.map`` (last row tiled as padding, outputs unpadded to ``n``) —
+    rows are independent in every reach computation, so chunking is
+    numerically invariant.  Shared by the background- and instance-side
+    reach passes so the padding/chunk invariant lives in one place."""
+
+    if chunk >= n:
+        return fn(rows)
+    pad = (-n) % chunk
+    rows_p = (jnp.concatenate([rows, jnp.tile(rows[-1:], (pad, 1))], 0)
+              if pad else rows)
+    out = jax.lax.map(fn, rows_p.reshape(-1, chunk, rows.shape[1]))
+    return jax.tree_util.tree_map(
+        lambda a: a.reshape((-1,) + a.shape[2:])[:n], out)
+
+
+def background_reach(pred, bg, G, target_chunk_elems: Optional[int] = None):
     """Background-side reach tensors, computed ONCE per (background, G) and
     reused across every instance chunk: ``z_ok (N, T, L, M)`` per-group
     satisfaction, ``z_ung_dead (N, T, L)`` leaves a background row already
     kills through a split on an UNGROUPED column (the sampled pipeline
     keeps ungrouped columns at their background values in every coalition,
     so such a split must be z-satisfied for the leaf to be reachable at
-    all), and ``onpath_g (T, L, M)``."""
+    all), and ``onpath_g (T, L, M)``.
+
+    ``target_chunk_elems`` bounds the transient ``(chunk, T, L, Nn)``
+    unsat tensor by chunking the background axis: at production-ensemble
+    scale (thousands of trees) the unchunked intermediate alone exceeds
+    HBM.  Rows are independent, so chunking is numerically invariant;
+    ``None`` keeps the historical single-pass body."""
 
     pred, _ = _unwrap(pred)
     bg = jnp.asarray(bg, jnp.float32)
@@ -206,12 +286,23 @@ def background_reach(pred, bg, G):
     onpath = jnp.abs(sign)
     want_left = (sign > 0).astype(jnp.float32)
     GH = jnp.swapaxes(G, 0, 1)[pred.feature]    # (T, Nn, M)
-
-    uz = _unsat(pred, bg, onpath, want_left)    # (N, T, L, Nn)
-    z_ok = (jnp.einsum("ntlj,tjg->ntlg", uz, GH) < 0.5).astype(jnp.float32)
     ung_node = (jnp.sum(GH, -1) < 0.5).astype(jnp.float32)  # (T, Nn)
-    z_ung_dead = (jnp.einsum("ntlj,tj->ntl", uz, ung_node) > 0.5)
     onpath_g = (jnp.einsum("tlj,tjg->tlg", onpath, GH) > 0.5).astype(jnp.float32)
+
+    N = bg.shape[0]
+    T, L, Nn = sign.shape
+    chunk = N
+    if target_chunk_elems:
+        chunk = max(1, min(N, int(target_chunk_elems)
+                           // max(1, T * L * max(Nn, G.shape[0]))))
+
+    def rows_reach(rows):
+        uz = _unsat(pred, rows, onpath, want_left)    # (c, T, L, Nn)
+        z_ok = (jnp.einsum("ntlj,tjg->ntlg", uz, GH) < 0.5).astype(jnp.float32)
+        z_ung_dead = (jnp.einsum("ntlj,tj->ntl", uz, ung_node) > 0.5)
+        return z_ok, z_ung_dead
+
+    z_ok, z_ung_dead = _chunked_rows(rows_reach, bg, chunk, N)
     return {"z_ok": z_ok, "z_ung_dead": z_ung_dead, "onpath_g": onpath_g}
 
 
@@ -247,8 +338,14 @@ def _exact_dmax(pred, M: int) -> int:
             jax.errors.ConcretizationTypeError):
         # path tensors traced (caller jitted over the predictor itself):
         # fall back to the static node-count bound — looser, so very deep
-        # trees may skip the fused kernel, never break
+        # trees may skip the fused kernel, never break.  Counted + logged
+        # once: this demotion used to be a silent ~10x slowdown.
         onpath_nodes = int(pred.path_sign.shape[-1])
+        record_exact_fallback(
+            "dmax_static_bound",
+            f"path tensors are tracers, using node-count bound "
+            f"{onpath_nodes}; jit over data, not the predictor, to keep "
+            f"the tight per-fit bound")
     return max(1, min(int(M), onpath_nodes))
 
 
@@ -319,16 +416,26 @@ def exact_shap_from_reach(pred, X, reach, bgw, G,
     # memory/behaviour contract of that knob) — the kernel only takes the
     # default route; the footprint gate rejects shapes whose minimal tile
     # Mosaic would refuse, BEFORE any tracing, for every caller
-    use_kernel = (bg_chunk is None and resolve_use_pallas(use_pallas)
-                  and exact_kernel_fits(min(N, n_slice), M, K)
-                  and _exact_dmax(pred, M) <= 64)
+    want_kernel = bg_chunk is None and resolve_use_pallas(use_pallas)
+    # evaluate the gate's inputs ONCE: _exact_dmax itself records a
+    # fallback event under tracing, and re-invoking it in the demotion
+    # branch would double-count one decision
+    fits = want_kernel and exact_kernel_fits(min(N, n_slice), M, K)
+    dmax_gate = _exact_dmax(pred, M) if want_kernel else 0
+    use_kernel = want_kernel and fits and dmax_gate <= 64
+    if want_kernel and not use_kernel:
+        # the kernel was requested (auto or explicit) but the gate demoted
+        # this shape to the einsum path — observable, not silent
+        record_exact_fallback(
+            "kernel_footprint" if not fits else "dmax_cap",
+            f"N={N} M={M} K={K} dmax={dmax_gate}")
     from distributedkernelshap_tpu.ops.explain import record_kernel_path
     record_kernel_path('exact_phi', 'pallas' if use_kernel else 'einsum')
     if use_kernel:
         B = X.shape[0]
         L = leaf_val.shape[1]
         P = T * L
-        dmax = _exact_dmax(pred, M)
+        dmax = dmax_gate
         xo = x_only.reshape(B, P, M)
         xn = x_not.reshape(B, P, M)
         zo = z_ok.reshape(N, P, M)
@@ -380,6 +487,297 @@ def exact_shap_from_reach(pred, X, reach, bgw, G,
     if pred.aggregation == "mean":
         phi = phi / T
     return jnp.swapaxes(phi, 1, 2)              # (B, K, M)
+
+
+# ---------------------------------------------------------------------- #
+# Path-parallel packed exact path (GPUTreeShap-class work scheduling).
+#
+# The planner (``ops/treeshap_pack.py``) enumerates live leaf-paths,
+# drops zero-contribution ones, and bin-packs the rest into depth-bucketed
+# grid tiles; the functions below gather the reach tensors into that
+# packed layout and run the phi contraction over it — either the fused
+# Pallas kernel per (bucket, background-slice) with the bucket's TIGHT
+# static dmax, or an XLA route engineered op-for-op to be bit-identical
+# to the dense chunked-einsum reference (same Beta-weight route, same
+# background chunk layout, same final contraction on a scattered dense
+# tensor), so flipping packing on can never change a served answer.
+
+
+def build_packed_plan(pred, G, tile: Optional[int] = None, shards: int = 1):
+    """Host-side packed-path plan for ``pred``'s concrete path tensors, or
+    ``None`` when planning cannot apply (no path tensors, or the tensors
+    are tracers — the planner needs concrete numpy)."""
+
+    from distributedkernelshap_tpu.ops.treeshap_pack import (
+        DEFAULT_TILE,
+        leaf_group_counts,
+        plan_packed_paths,
+    )
+
+    tree, _ = _unwrap(pred)
+    if getattr(tree, "path_sign", None) is None:
+        return None
+    try:
+        ps = np.asarray(tree.path_sign)
+        feat = np.asarray(tree.feature)
+        G_np = np.asarray(G)
+    except (jax.errors.TracerArrayConversionError,
+            jax.errors.ConcretizationTypeError):
+        record_exact_fallback(
+            "plan_traced", "path tensors or G are tracers; packed "
+            "scheduling needs concrete per-fit tensors")
+        return None
+    counts = leaf_group_counts(ps, feat, G_np)
+    return plan_packed_paths(counts, tile=tile or DEFAULT_TILE,
+                             shards=max(1, int(shards)))
+
+
+def resolve_pack_paths(pack_paths: Optional[bool], plan) -> bool:
+    """Resolve the ``ShapConfig.pack_paths`` knob against a plan: ``None``
+    = auto (engage when the modelled work saving clears
+    ``treeshap_pack.PACK_AUTO_GAIN`` — balanced small ensembles keep the
+    tuned dense layout), explicit bools win."""
+
+    from distributedkernelshap_tpu.ops.treeshap_pack import PACK_AUTO_GAIN
+
+    if plan is None or plan.n_live == 0:
+        return False
+    if pack_paths is None:
+        return plan.gain >= PACK_AUTO_GAIN
+    return bool(pack_paths)
+
+
+def pack_reach(pred, reach, plan):
+    """Gather the dense reach tensors into the plan's packed path layout.
+
+    Returns device tensors keyed for :func:`exact_shap_packed`:
+    ``z_ok (N, Pp, M)``, ``z_dead (N, Pp)`` (pad slots forced dead),
+    ``lv (Pp, K)`` (pad slots zeroed — the padding invariant that makes
+    their contribution exactly 0), ``perm (Pp,)`` and ``live (Pp,)``.
+    X-independent: computed once per (model, background, grouping) and
+    cached device-resident by the engine."""
+
+    tree, _ = _unwrap(pred)
+    perm = jnp.asarray(plan.perm, jnp.int32)
+    live = jnp.asarray(plan.live)
+    z_ok = reach["z_ok"]
+    N, T, L, M = z_ok.shape
+    K = tree.leaf_value.shape[-1]
+    z_ok_p = z_ok.reshape(N, T * L, M)[:, perm]
+    z_dead_p = (reach["z_ung_dead"].reshape(N, T * L)[:, perm]
+                | ~live[None, :])
+    lv_p = (tree.leaf_value.reshape(T * L, K)[perm]
+            * live[:, None].astype(jnp.float32))
+    return {"z_ok": z_ok_p, "z_dead": z_dead_p, "lv": lv_p,
+            "perm": perm, "live": live.astype(jnp.float32)}
+
+
+def _x_reach(pred, X, G, onpath_g, target_chunk_elems: Optional[int] = None):
+    """Instance-side reach indicators ``(x_only, x_not)`` — the dense
+    ``(B, T, L, M)`` tensors both exact routes consume, with the transient
+    ``(chunk, T, L, Nn)`` unsat tensor bounded by instance chunking (rows
+    are independent, so chunking is numerically invariant)."""
+
+    sign = pred.path_sign
+    onpath = jnp.abs(sign)
+    want_left = (sign > 0).astype(jnp.float32)
+    GH = jnp.swapaxes(G, 0, 1)[pred.feature]
+    B = X.shape[0]
+    T, L, Nn = sign.shape
+    chunk = B
+    if target_chunk_elems:
+        chunk = max(1, min(B, int(target_chunk_elems)
+                           // max(1, T * L * max(Nn, G.shape[0]))))
+
+    def rows_ok(rows):
+        ux = _unsat(pred, rows, onpath, want_left)
+        return (jnp.einsum("btlj,tjg->btlg", ux, GH) < 0.5).astype(jnp.float32)
+
+    x_ok = _chunked_rows(rows_ok, X, chunk, B)
+    return x_ok * onpath_g[None], (1.0 - x_ok) * onpath_g[None]
+
+
+def _packed_kernel_slice_rows(N: int, M: int, K: int) -> int:
+    """Largest background slice (<= 256, halving) whose minimal kernel
+    tile fits VMEM — the adaptive counterpart of the fixed dense-path
+    ``n_slice`` so large backgrounds stop disqualifying the kernel."""
+
+    from distributedkernelshap_tpu.ops.pallas_kernels import exact_kernel_fits
+
+    rows = 256
+    while rows > 32 and not exact_kernel_fits(min(N, rows), M, K):
+        rows //= 2
+    return rows
+
+
+def exact_shap_packed(pred, X, onpath_g, packed, bgw, G, buckets,
+                      normalized: bool = False,
+                      target_chunk_elems: Optional[int] = None,
+                      use_pallas: Optional[bool] = None,
+                      dmax_kernel_cap: int = 64):
+    """Exact phi ``(B, K, M)`` over a packed path layout.
+
+    ``packed`` is :func:`pack_reach`'s dict (full plan, or one shard's
+    local slice under ``shard_map``); ``buckets`` the matching static
+    ``(start, stop, dmax)`` structure; ``onpath_g`` the dense per-path
+    group incidence from :func:`background_reach`.
+
+    Two routes, chosen by ``use_pallas`` (same auto rule as the dense
+    path):
+
+    * **pallas_packed** — per (bucket, background-slice) calls of the
+      fused kernel with the bucket's tight ``dmax``; buckets deeper than
+      ``dmax_kernel_cap`` (or shapes the VMEM gate rejects) drop to the
+      packed einsum for just that slice, so one deep bucket no longer
+      disqualifies the whole ensemble.
+    * **einsum_packed** — the XLA route, engineered to be bit-identical
+      to the dense chunked-einsum reference: identical Beta-weight route
+      (backend-dispatched ``_beta_weights``), identical background chunk
+      policy (sized from the DENSE shapes), and per-chunk scatter of the
+      packed per-path sums back into the dense ``(B, T, L, M)`` layout so
+      the final leaf-value contraction is literally the same einsum on a
+      tensor equal element-for-element.  Pinned by
+      ``tests/test_treeshap_pack.py``.
+    """
+
+    from distributedkernelshap_tpu.ops.explain import (
+        record_kernel_path,
+        resolve_use_pallas,
+    )
+
+    tree, head_scale = _unwrap(pred)
+    X = jnp.asarray(X, jnp.float32)
+    bgw = jnp.asarray(bgw, jnp.float32)
+    if not normalized:
+        bgw = bgw / jnp.sum(bgw)
+    G = jnp.asarray(G, jnp.float32)
+    T, L = tree.path_sign.shape[:2]
+    M = int(G.shape[0])
+    K = int(tree.leaf_value.shape[-1])
+    B = X.shape[0]
+    N = packed["z_ok"].shape[0]
+
+    x_only, x_not = _x_reach(tree, X, G, onpath_g,
+                             target_chunk_elems=target_chunk_elems)
+    perm = packed["perm"]
+    live = packed["live"]
+    xo_p = x_only.reshape(B, T * L, M)[:, perm]
+    xn_p = x_not.reshape(B, T * L, M)[:, perm]
+    z_ok_p = packed["z_ok"]
+    z_dead_p = packed["z_dead"]
+    lv_p = packed["lv"]
+
+    if resolve_use_pallas(use_pallas):
+        from distributedkernelshap_tpu.ops.pallas_kernels import (
+            exact_kernel_fits,
+            exact_tree_phi,
+        )
+
+        n_slice = _packed_kernel_slice_rows(N, M, K)
+        # per-bucket kernel eligibility decided (and any demotion counted)
+        # ONCE per program build, not once per background slice — the
+        # counter tracks demotion events at trace time (see
+        # attach_treeshap_metrics), so the slice loop must not inflate it
+        bucket_kernel = {}
+        for start, stop, dmax in buckets:
+            ok = (dmax <= dmax_kernel_cap
+                  and exact_kernel_fits(min(N, n_slice), M, K))
+            bucket_kernel[(start, stop)] = ok
+            if not ok:
+                record_exact_fallback(
+                    "dmax_cap" if dmax > dmax_kernel_cap
+                    else "kernel_footprint",
+                    f"bucket dmax={dmax} N={N} M={M} K={K} "
+                    f"(bucket einsum fallback, kernel keeps the rest)")
+        # the label states what actually STAGED: a run whose every bucket
+        # demoted must read as einsum, never as a kernel measurement
+        # (VERDICT r4 #2)
+        record_kernel_path(
+            'exact_phi', 'pallas_packed' if any(bucket_kernel.values())
+            else 'einsum_packed')
+        phi = None
+        for s0 in range(0, N, n_slice):
+            zo_s = z_ok_p[s0:s0 + n_slice]
+            zd_s = z_dead_p[s0:s0 + n_slice]
+            w_s = bgw[s0:s0 + n_slice]
+            for start, stop, dmax in buckets:
+                sl = slice(start, stop)
+                if bucket_kernel[(start, stop)]:
+                    part = exact_tree_phi(
+                        xo_p[:, sl], xn_p[:, sl], zo_s[:, sl], zd_s[:, sl],
+                        lv_p[sl], w_s, dmax=int(dmax))
+                else:
+                    part = _packed_einsum_bucket(
+                        xo_p[:, sl], xn_p[:, sl], zo_s[:, sl], zd_s[:, sl],
+                        lv_p[sl], w_s, M)
+                phi = part if phi is None else phi + part
+        phi = phi * (tree.scale * head_scale)
+        if tree.aggregation == "mean":
+            phi = phi / T
+        return jnp.swapaxes(phi, 1, 2)
+
+    record_kernel_path('exact_phi', 'einsum_packed')
+    chunk = _bounded_bg_chunk(None, N, B, T, L, budget=target_chunk_elems)
+    z_ok_c, z_dead_c, bgw_c = pad_background(z_ok_p, z_dead_p, bgw, chunk)
+    z_chunks = z_ok_c.reshape(-1, chunk, *z_ok_p.shape[1:])
+    zd_chunks = z_dead_c.reshape(-1, chunk, *z_dead_p.shape[1:])
+    w_chunks = bgw_c.reshape(-1, chunk)
+    lv_dense = tree.leaf_value                   # (T, L, K)
+    live_col = live[None, :, None]
+
+    def one_chunk(args):
+        zc, zu, wc = args                        # (c, Pp, M), (c, Pp), (c,)
+        s_p, s_m = _packed_sums(xo_p, xn_p, zc, zu, wc, M)
+        d = (s_p - s_m) * live_col
+        # scatter back into the dense path order (indices are unique over
+        # live slots; pad slots add exact zeros), then contract leaf
+        # values with the SAME einsum as the dense reference — f32 sums
+        # happen in the identical association order, which is what makes
+        # the packed path bit-identical rather than merely close
+        d_dense = jnp.zeros((B, T * L, M), jnp.float32).at[:, perm].add(d)
+        return jnp.einsum("btlg,tlk->bgk", d_dense.reshape(B, T, L, M),
+                          lv_dense)
+
+    phi = jnp.sum(jax.lax.map(one_chunk, (z_chunks, zd_chunks, w_chunks)),
+                  axis=0)
+    phi = phi * (tree.scale * head_scale)
+    if tree.aggregation == "mean":
+        phi = phi / T
+    return jnp.swapaxes(phi, 1, 2)
+
+
+def _packed_sums(xo, xn, zc, zu, w, M: int):
+    """Shared packed-layout core of the exact contraction: conjunction
+    counts -> alive gate -> backend-dispatched Beta weights -> weight-
+    folded background reductions, returning ``(s_p, s_m)`` in ``(B, Pp,
+    M)``.  The DENSE ``one_chunk`` in :func:`exact_shap_from_reach`
+    intentionally keeps its own copy of this sequence — it is the tuned
+    reference whose op order defines the bit-identity contract the packed
+    route is pinned against; changing either side requires re-pinning
+    ``tests/test_treeshap_pack.py``."""
+
+    nz = 1.0 - zc
+    u = jnp.einsum("bpg,npg->bnp", xo, nz)
+    v = jnp.einsum("bpg,npg->bnp", xn, zc)
+    dead = jnp.einsum("bpg,npg->bnp", xn, nz)
+    alive = ((dead < 0.5) & ~zu[None]).astype(jnp.float32)
+    wp, wm = _beta_weights(u, v, M)
+    wp = wp * alive * w[None, :, None]
+    wm = wm * alive * w[None, :, None]
+    s_p = jnp.einsum("bnp,npg->bpg", wp, nz) * xo
+    s_m = jnp.einsum("bnp,npg->bpg", wm, zc) * xn
+    return s_p, s_m
+
+
+def _packed_einsum_bucket(xo, xn, zo, zd, lv, bgw, M: int):
+    """Packed einsum phi partial for ONE (bucket, background-slice): the
+    deep-bucket fallback inside the kernel route.  No dense scatter (the
+    kernel route makes no bit-identity claim) — a direct packed leaf
+    contraction; returns ``(B, M, K)`` like :func:`~distributedkernelshap_tpu
+    .ops.pallas_kernels.exact_tree_phi`."""
+
+    s_p, s_m = _packed_sums(xo, xn, zo, zd, bgw, M)
+    return jnp.einsum("bpg,pk->bgk", s_p - s_m, lv)
 
 
 def _device_interaction_weights(u, v):

@@ -198,6 +198,18 @@ class DistributedExplainer:
             raise AttributeError(item)
         return getattr(self.engine, item)
 
+    def stage_rows(self, X, nsamples=None, l1_reg='auto',
+                   interactions: bool = False):
+        """Decline serving-side row staging: the sharded dispatch re-pads
+        per mesh layout (``_pad_sharded``), so a buffer staged with the
+        single-engine bucketing would not fit it.  Defined explicitly so
+        ``__getattr__`` cannot proxy the INNER engine's stage_rows — that
+        would hand the server a single-device StagedRows this explainer's
+        async path cannot consume as such."""
+
+        del X, nsamples, l1_reg, interactions
+        return None
+
     # ------------------------------------------------------------------ #
 
     def reset_device_state(self) -> None:
@@ -411,8 +423,10 @@ class DistributedExplainer:
         if key not in self._jit_cache:
             from distributedkernelshap_tpu.ops.treeshap import (
                 background_reach,
+                build_packed_plan,
                 exact_interactions_from_reach,
                 exact_shap_from_reach,
+                resolve_pack_paths,
             )
 
             engine = self.engine
@@ -420,6 +434,19 @@ class DistributedExplainer:
             precision = engine.config.shap.matmul_precision
             budget = engine.config.shap.target_chunk_elems
             n_coal = self.mesh.shape[COALITION_AXIS]
+            if not interactions:
+                # packed work-item sharding: the planner stripes its
+                # depth-bucketed tiles over the coalition axis (identical
+                # local bucket structure on every rank — shard_map is
+                # SPMD), each rank contracts ITS paths against the full
+                # background and one psum combines the partial phi.  The
+                # background-axis decomposition below stays the fallback
+                # (and the interactions path).
+                plan = build_packed_plan(pred, engine.G, shards=n_coal)
+                if resolve_pack_paths(engine.config.shap.pack_paths, plan):
+                    self._jit_cache[key] = self._exact_packed_sharded_fn(
+                        plan)
+                    return self._jit_cache[key]
             if 'exact_reach' not in self._jit_cache:
                 # reach tensors + padded weights depend only on
                 # (background, G, mesh) — shared by both exact fn variants
@@ -496,6 +523,84 @@ class DistributedExplainer:
                 out_shardings=out_sh)
             self._jit_cache[key] = (jitted, args)
         return self._jit_cache[key]
+
+    def _exact_packed_sharded_fn(self, plan):
+        """Packed-work-item sharded exact phi: path tiles striped over the
+        coalition axis (``ops/treeshap_pack.py`` with ``shards=n_coal``),
+        the instance axis over ``data``.  Each rank holds only its slice
+        of the packed reach tensors (``(N, Pp/R, M)`` instead of the full
+        dense ``(N, T·L, M)``), computes partial phi over its paths with
+        the per-bucket tight ``dmax``, and one psum over ICI combines the
+        partials — the WLS-normal-equation decomposition's analog for the
+        closed-form path."""
+
+        from distributedkernelshap_tpu.ops.treeshap import (
+            background_reach,
+            exact_shap_packed,
+            pack_reach,
+        )
+
+        engine = self.engine
+        pred = engine.predictor
+        precision = engine.config.shap.matmul_precision
+        budget = engine.config.shap.target_chunk_elems
+        use_pallas = engine.config.shap.use_pallas
+        buckets = plan.buckets                  # LOCAL per-rank structure
+
+        with jax.default_matmul_precision(precision):
+            reach = jax.jit(
+                lambda bg, G: background_reach(
+                    pred, bg, G, target_chunk_elems=budget))(
+                        jnp.asarray(engine.background),
+                        jnp.asarray(engine.G))
+            packed = pack_reach(pred, reach, plan)
+        bgw0 = np.asarray(engine.bg_weights, np.float64)
+        bgw0 = jnp.asarray((bgw0 / bgw0.sum()).astype(np.float32))
+
+        def body(Xl, bgw, G, onpath_g, z_ok_l, z_dead_l, lv_l, perm_l,
+                 live_l):
+            packed_l = {'z_ok': z_ok_l, 'z_dead': z_dead_l, 'lv': lv_l,
+                        'perm': perm_l, 'live': live_l}
+            with jax.default_matmul_precision(precision):
+                phi_local = exact_shap_packed(
+                    pred, Xl, onpath_g, packed_l, bgw, G, buckets,
+                    normalized=True, target_chunk_elems=budget,
+                    use_pallas=use_pallas)
+                return {
+                    'shap_values': jax.lax.psum(phi_local, COALITION_AXIS),
+                    'raw_prediction': pred(Xl),
+                }
+
+        sharded = compat.shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(P(DATA_AXIS), P(), P(), P(),
+                      P(None, COALITION_AXIS), P(None, COALITION_AXIS),
+                      P(COALITION_AXIS), P(COALITION_AXIS),
+                      P(COALITION_AXIS)),
+            out_specs={'shap_values': P(DATA_AXIS),
+                       'raw_prediction': P(DATA_AXIS)},
+            check_vma=False,
+        )
+        shard = NamedSharding(self.mesh, P(DATA_AXIS))
+        repl = NamedSharding(self.mesh, P())
+        path0 = NamedSharding(self.mesh, P(COALITION_AXIS))
+        path1 = NamedSharding(self.mesh, P(None, COALITION_AXIS))
+        # commit the per-fit packed constants to their mesh shardings once
+        args = (jax.device_put(bgw0, repl),
+                jax.device_put(jnp.asarray(engine.G), repl),
+                jax.device_put(reach['onpath_g'], repl),
+                jax.device_put(packed['z_ok'], path1),
+                jax.device_put(packed['z_dead'], path1),
+                jax.device_put(packed['lv'], path0),
+                jax.device_put(packed['perm'], path0),
+                jax.device_put(packed['live'], path0))
+        jitted = jax.jit(
+            sharded,
+            in_shardings=(shard, repl, repl, repl, path1, path1, path0,
+                          path0, path0),
+            out_shardings={'shap_values': shard, 'raw_prediction': shard})
+        return jitted, args
 
     def _explain_exact_sharded(self, X: np.ndarray, l1_reg,
                                interactions: bool = False) -> Any:
@@ -722,6 +827,11 @@ class DistributedExplainer:
         slab-split batches, and active l1 selection — mirroring the
         engine's fallback matrix."""
 
+        # a StagedRows could only arrive through a caller bypassing
+        # stage_rows (which declines for sharded explainers — the staged
+        # buffer is padded for the single-engine layout, not the mesh);
+        # consume its host rows rather than failing opaquely
+        X = getattr(X, 'host', X)
         X = np.atleast_2d(np.asarray(X, dtype=np.float32))
         if not self.takes_async_fast_path(X.shape[0], nsamples=nsamples,
                                           l1_reg=l1_reg,

@@ -586,6 +586,19 @@ class ExplainerServer:
         )
 
         compile_events().attach_metrics(reg)
+        # evaluation-path attribution (exact closed-form TreeSHAP vs the
+        # sampled estimator) and the exact path's fallback accounting —
+        # both process-global, rendered via callbacks like the compile
+        # accountant
+        from distributedkernelshap_tpu.ops.treeshap import (
+            attach_treeshap_metrics,
+        )
+        from distributedkernelshap_tpu.serving.wrappers import (
+            attach_path_metrics,
+        )
+
+        attach_path_metrics(reg)
+        attach_treeshap_metrics(reg)
         # the scheduler registers its own dks_sched_* series (queue wait,
         # expiries) on the same registry so one page carries everything
         attach = getattr(self._sched, "attach_metrics", None)
@@ -754,6 +767,8 @@ class ExplainerServer:
                 tr.record_mono("server.device_explain", t_dispatch,
                                end_fetch, parent=p.trace,
                                batch_rows=device_rows,
+                               path=getattr(self.model, "explain_path",
+                                            None),
                                error=error is not None)
                 tr.record_mono("server.finalize", end_fetch,
                                time.monotonic(), parent=p.trace)
@@ -915,8 +930,19 @@ class ExplainerServer:
                     span = (tr.begin("warmup.bucket", parent=root, rows=b)
                             if tr.enabled else None)
                     try:
+                        from distributedkernelshap_tpu.runtime.\
+                            compile_cache import shape_signature
+
+                        # the declared signature carries the deployment's
+                        # evaluation path: the exact-TreeSHAP entry and
+                        # the sampled pipeline are distinct executables
+                        # at the same bucket, and the compile accounting
+                        # must attribute each rung to the one it warmed
+                        sig = shape_signature(
+                            int(b), getattr(self.model, "explain_path",
+                                            None))
                         with profiler().phase("warmup"), \
-                                ce.signature(f"rows={b}"):
+                                ce.signature(sig):
                             self.model.explain_batch(
                                 np.tile(row, (int(b), 1)),
                                 split_sizes=[int(b)])

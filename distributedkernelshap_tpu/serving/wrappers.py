@@ -14,6 +14,7 @@ server-side batching actually multiplies throughput.
 """
 
 import logging
+import threading
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -22,6 +23,42 @@ from distributedkernelshap_tpu.kernel_shap import KernelShap
 from distributedkernelshap_tpu.serving import wire
 
 logger = logging.getLogger(__name__)
+
+#: env opt-out for the exact-path auto-selection (default ON): a served
+#: lifted tree ensemble with raw-margin outputs answers every request with
+#: closed-form exact Shapley values instead of the sampled estimator
+EXACT_AUTO_ENV = "DKS_EXACT_AUTO"
+
+# per-request explain-path accounting, process-global so the serving
+# registry can render it via a callback (same pattern as the compile
+# accountant): {'exact': n, 'sampled': n} requests answered per path
+_path_lock = threading.Lock()
+_path_counts: Dict[str, float] = {"exact": 0.0, "sampled": 0.0}
+
+
+def record_explain_path(path: str, n: int = 1) -> None:
+    with _path_lock:
+        _path_counts[path] = _path_counts.get(path, 0.0) + n
+
+
+def explain_path_counts() -> Dict[tuple, float]:
+    """``{(path,): count}`` — the registry-callback shape."""
+
+    with _path_lock:
+        return {(p,): n for p, n in _path_counts.items()}
+
+
+def attach_path_metrics(registry) -> None:
+    """Register ``dks_serve_explain_path_total{path}`` on ``registry``:
+    requests answered per evaluation path (exact closed-form TreeSHAP vs
+    the sampled KernelSHAP estimator), fed by the serving wrappers."""
+
+    registry.counter(
+        "dks_serve_explain_path_total",
+        "Request slots explained by evaluation path (exact = closed-form "
+        "interventional TreeSHAP, sampled = KernelSHAP estimator); "
+        "includes warmup-ladder rungs, which drive the same entry points.",
+        labelnames=("path",)).set_function(explain_path_counts)
 
 # explain options a deployment may pin for every request: the keys every
 # request path supports — including the pipelined get_explanation_async,
@@ -81,6 +118,7 @@ class KernelShapModel:
         # nsamples/l1_reg policy; validated at construction so a bad key
         # fails the deployment, not every request
         self.explain_kwargs = _check_explain_kwargs(explain_kwargs)
+        self._resolve_explain_path()
 
     @classmethod
     def from_explainer(cls, explainer: KernelShap,
@@ -92,7 +130,59 @@ class KernelShapModel:
         model = cls.__new__(cls)
         model.explainer = explainer
         model.explain_kwargs = _check_explain_kwargs(explain_kwargs)
+        model._resolve_explain_path()
         return model
+
+    def _serving_engine(self):
+        """The fitted engine behind this deployment's explainer (the
+        DistributedExplainer wraps the real engine one level down)."""
+
+        engine = getattr(self.explainer, "_explainer", None)
+        if engine is not None and not hasattr(engine, "predictor"):
+            engine = getattr(engine, "engine", None)
+        return engine
+
+    def _resolve_explain_path(self) -> None:
+        """Auto-select ``nsamples='exact'`` for deployments whose fitted
+        predictor is a lifted tree ensemble with raw-margin outputs and an
+        identity link (lgbm/xgb/sklearn-tree lifts): closed-form exact
+        Shapley values beat the sampled estimator on both wall-clock
+        (path-packed kernel) and exactness, so they are the default for
+        tree predictors.  A pinned ``nsamples`` key always wins (including
+        ``nsamples=None`` as an explicit opt-out), as does
+        ``DKS_EXACT_AUTO=0``.  Sets ``explain_path`` (``'exact'`` |
+        ``'sampled'``) and ``explain_path_reason`` for the per-request
+        span/metric attribution."""
+
+        from distributedkernelshap_tpu.utils import resolve_bool_env
+
+        if "nsamples" in self.explain_kwargs:
+            path = ("exact" if self.explain_kwargs["nsamples"] == "exact"
+                    else "sampled")
+            self.explain_path, self.explain_path_reason = path, "pinned"
+            return
+        self.explain_path, self.explain_path_reason = "sampled", "default"
+        if not resolve_bool_env(EXACT_AUTO_ENV, True):
+            self.explain_path_reason = "auto_disabled"
+            return
+        try:
+            from distributedkernelshap_tpu.ops.treeshap import supports_exact
+
+            engine = self._serving_engine()
+            if engine is None:
+                return
+            if supports_exact(engine.predictor) \
+                    and engine.config.link == "identity":
+                self.explain_kwargs["nsamples"] = "exact"
+                self.explain_path = "exact"
+                self.explain_path_reason = "auto"
+                logger.info(
+                    "serving auto-selected the exact TreeSHAP path for a "
+                    "lifted %s (set %s=0 or pin nsamples to opt out)",
+                    type(engine.predictor).__name__, EXACT_AUTO_ENV)
+        except Exception:  # never fail a deployment over path selection
+            logger.debug("exact-path auto-selection probe failed",
+                         exc_info=True)
 
     def reset(self) -> None:
         """Drop device-resident state (uploaded constants, jitted
@@ -116,6 +206,7 @@ class KernelShapModel:
         instance = _request_array(request)
         explanation = self.explainer.explain(instance, silent=True,
                                              **self.explain_kwargs)
+        record_explain_path(self.explain_path, 1)
         return explanation.to_json()
 
     #: the server checks this capability flag before asking for per-request
@@ -170,10 +261,11 @@ class KernelShapModel:
         """Pre-upload a stacked request batch to the device (the serving
         staging pipeline's hook): returns an engine ``StagedRows`` whose
         H2D copy is already in flight, or ``None`` when this deployment's
-        explain path cannot consume pre-staged rows (host-eval, exact,
-        interactions, active l1 — the sync-fallback paths).  The returned
-        object is accepted by :meth:`explain_batch_async` in place of the
-        raw array."""
+        explain path cannot consume pre-staged rows (host-eval,
+        interactions, active l1 — the sync-fallback paths; exact tree
+        deployments stage like sampled ones since the exact path rides
+        the donated-entry hot path).  The returned object is accepted by
+        :meth:`explain_batch_async` in place of the raw array."""
 
         engine = self.explainer._explainer
         stage = getattr(engine, "stage_rows", None)
@@ -192,6 +284,7 @@ class KernelShapModel:
                                              **self.explain_kwargs)
         if split_sizes is None:
             split_sizes = [1] * instances.shape[0]
+        record_explain_path(self.explain_path, len(split_sizes))
         return self._resplit_payloads(
             instances, explanation.shap_values, explanation.expected_value,
             explanation.data["raw"]["raw_prediction"], split_sizes,
@@ -222,6 +315,7 @@ class KernelShapModel:
         host_rows = getattr(instances, "host", instances)
         sizes = ([1] * host_rows.shape[0] if split_sizes is None
                  else list(split_sizes))
+        record_explain_path(self.explain_path, len(sizes))
 
         def finalize() -> List:
             values, info = fin()

@@ -35,9 +35,12 @@ DOCS_PATH = os.path.join(REPO_ROOT, "docs", "OBSERVABILITY.md")
 #: (DKS_TRACE), header names and file paths share the prefix.  ``slo``
 #: and ``alerts`` joined when the health engine landed its
 #: ``dks_slo_*``/``dks_alerts_*`` series; ``wire`` and ``staging`` when
-#: the streaming hot path landed ``dks_wire_*``/``dks_staging_*``.
+#: the streaming hot path landed ``dks_wire_*``/``dks_staging_*``;
+#: ``treeshap`` when the exact path's fallback accounting landed
+#: ``dks_treeshap_*``.
 _LITERAL_RE = re.compile(
-    r"dks_(?:serve|fanin|sched|phase|slo|alerts|wire|staging)_[a-z0-9_]+")
+    r"dks_(?:serve|fanin|sched|phase|slo|alerts|wire|staging|treeshap)"
+    r"_[a-z0-9_]+")
 
 #: directories never scanned for literals/renderers
 _SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "results", "data",

@@ -1,0 +1,159 @@
+"""Exact TreeSHAP on the serving hot path (ISSUE 7 serving promotion):
+auto-selection for lifted tree predictors, staged-rows + donated-entry
+integration, warmup-ladder coverage and per-request path attribution.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def tree_setup():
+    from sklearn.ensemble import HistGradientBoostingRegressor
+
+    rng = np.random.default_rng(8)
+    X = rng.normal(size=(200, 5)).astype(np.float64)
+    y = X[:, 0] - np.where(X[:, 2] > 0, 1.0, -1.0) * X[:, 3]
+    gbr = HistGradientBoostingRegressor(max_iter=8, random_state=0).fit(X, y)
+    return dict(gbr=gbr, bg=X[:15].astype(np.float32),
+                Xe=X[100:106].astype(np.float32))
+
+
+@pytest.fixture(scope="module")
+def linear_setup():
+    from sklearn.linear_model import LogisticRegression
+
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(120, 5)).astype(np.float64)
+    y = (X[:, 0] > 0).astype(int)
+    clf = LogisticRegression(max_iter=200).fit(X, y)
+    return dict(clf=clf, bg=X[:10].astype(np.float32),
+                Xe=X[50:54].astype(np.float32))
+
+
+def test_auto_selects_exact_for_lifted_tree_regressor(tree_setup):
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.serving.wrappers import KernelShapModel
+
+    s = tree_setup
+    model = KernelShapModel(s["gbr"].predict, s["bg"], {"seed": 0}, {})
+    assert model.explain_path == "exact"
+    assert model.explain_path_reason == "auto"
+    assert model.explain_kwargs == {"nsamples": "exact"}
+    # responses match a direct exact explain bit-for-bit (the served
+    # engine runs the same packed/dense exact program)
+    payloads = model.explain_batch(s["Xe"], split_sizes=[3, 3])
+    direct = KernelShap(s["gbr"].predict, seed=0)
+    direct.fit(s["bg"])
+    want = np.asarray(direct.explain(s["Xe"], silent=True,
+                                     nsamples="exact").shap_values)
+    want = want[0] if want.ndim == 3 else want
+    got = np.asarray(json.loads(payloads[0])["data"]["shap_values"])
+    np.testing.assert_array_equal(np.squeeze(got), want[:3])
+
+
+def test_auto_selection_opt_outs(tree_setup, linear_setup, monkeypatch):
+    from distributedkernelshap_tpu.serving.wrappers import KernelShapModel
+
+    s = tree_setup
+    # pinned nsamples always wins — including None as an explicit opt-out
+    pinned = KernelShapModel(s["gbr"].predict, s["bg"], {"seed": 0}, {},
+                             explain_kwargs={"nsamples": 100})
+    assert pinned.explain_path == "sampled"
+    assert pinned.explain_path_reason == "pinned"
+    opted = KernelShapModel(s["gbr"].predict, s["bg"], {"seed": 0}, {},
+                            explain_kwargs={"nsamples": None})
+    assert opted.explain_path == "sampled"
+    # env kill switch
+    monkeypatch.setenv("DKS_EXACT_AUTO", "0")
+    off = KernelShapModel(s["gbr"].predict, s["bg"], {"seed": 0}, {})
+    assert off.explain_path == "sampled"
+    assert off.explain_path_reason == "auto_disabled"
+    assert "nsamples" not in off.explain_kwargs
+    monkeypatch.delenv("DKS_EXACT_AUTO")
+    # non-tree predictors keep the sampled path AND their staging
+    li = linear_setup
+    lin = KernelShapModel(li["clf"], li["bg"],
+                          {"link": "logit", "seed": 0}, {},
+                          explain_kwargs={"l1_reg": False})
+    assert lin.explain_path == "sampled"
+    assert lin.stage_rows(li["Xe"]) is not None
+
+
+def test_exact_staged_async_matches_sync_payloads(tree_setup):
+    from distributedkernelshap_tpu.kernel_shap import StagedRows
+    from distributedkernelshap_tpu.serving.wrappers import (
+        BatchKernelShapModel,
+    )
+
+    s = tree_setup
+    model = BatchKernelShapModel(s["gbr"].predict, s["bg"], {"seed": 0}, {})
+    staged = model.stage_rows(s["Xe"])
+    assert isinstance(staged, StagedRows)
+    sync = model.explain_batch(s["Xe"], split_sizes=[2, 2, 2])
+    got = model.explain_batch_async(staged, split_sizes=[2, 2, 2])()
+    assert got == sync
+    # binary wire slots work on the exact path too
+    staged2 = model.stage_rows(s["Xe"])
+    binary = model.explain_batch_async(
+        staged2, split_sizes=[2, 2, 2],
+        formats=["binary", "json", "binary"])()
+    assert isinstance(binary[0], (bytes, bytearray))
+    assert binary[1] == sync[1]
+
+
+def test_explain_path_metric_counts(tree_setup):
+    from distributedkernelshap_tpu.serving import wrappers
+
+    s = tree_setup
+    model = wrappers.BatchKernelShapModel(s["gbr"].predict, s["bg"],
+                                          {"seed": 0}, {})
+    before = wrappers.explain_path_counts().get(("exact",), 0.0)
+    model.explain_batch(s["Xe"], split_sizes=[3, 3])
+    after = wrappers.explain_path_counts()[("exact",)]
+    assert after == before + 2  # one per request slot, not per row
+
+
+def test_warmup_ladder_covers_exact_path(tree_setup):
+    """A warmup-enabled server over an auto-exact deployment compiles the
+    exact entry per bucket (signatures carry the path), serves requests
+    warm, and renders the path/fallback metrics."""
+
+    from distributedkernelshap_tpu.runtime.compile_cache import (
+        compile_events,
+    )
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+    from distributedkernelshap_tpu.serving.wrappers import (
+        BatchKernelShapModel,
+    )
+
+    s = tree_setup
+    model = BatchKernelShapModel(s["gbr"].predict, s["bg"], {"seed": 0}, {})
+    assert model.explain_path == "exact"
+    ce = compile_events()
+    before = ce.snapshot()
+    srv = ExplainerServer(model, host="127.0.0.1", port=0,
+                          max_batch_size=4, warmup=True,
+                          health_interval_s=0).start()
+    try:
+        deadline = time.monotonic() + 60
+        while srv.warmup_status()["state"] in ("pending", "running"):
+            assert time.monotonic() < deadline, "warmup never finished"
+            time.sleep(0.05)
+        st = srv.warmup_status()
+        assert st["state"] == "done"
+        assert st["completed_buckets"] == st["buckets"] != []
+        # the ladder's compile signatures name the exact path — the
+        # accounting can attribute each rung to the executable it warmed
+        delta = ce.delta(before, ce.snapshot())
+        sigs = {sig for (_, sig) in delta["counts"]}
+        assert any(sig.endswith(",path=exact") for sig in sigs), sigs
+        # the metrics page carries the path attribution + fallback series
+        page = srv.metrics.render()
+        assert 'dks_serve_explain_path_total{path="exact"}' in page
+        assert "dks_treeshap_fallback_total" in page
+    finally:
+        srv.stop()

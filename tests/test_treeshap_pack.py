@@ -1,0 +1,302 @@
+"""Path-parallel packed exact TreeSHAP (ops/treeshap_pack.py + the packed
+routes in ops/treeshap.py and their engine/mesh integration).
+
+Oracles: the planner's invariants are checked structurally (every live
+path scheduled exactly once, tile alignment, bucket dmax bounds, shard
+balance); the packed einsum route is pinned BIT-IDENTICAL to the dense
+chunked-einsum reference (its engineered property — same Beta-weight
+route, same chunk layout, scatter-to-dense final contraction); the
+packed Pallas route (interpret mode on CPU) is pinned to the same
+tolerance class as the existing dense kernel tests, including ensembles
+whose deep buckets straddle the old global ``_exact_dmax <= 64`` kernel
+cap that used to disqualify the WHOLE ensemble.
+"""
+
+import numpy as np
+import pytest
+
+from benchmarks.exact_ab import build_unbalanced_ensemble
+from distributedkernelshap_tpu.kernel_shap import (
+    EngineConfig,
+    KernelExplainerEngine,
+    StagedRows,
+)
+from distributedkernelshap_tpu.ops import groups_to_matrix
+from distributedkernelshap_tpu.ops import treeshap as ts
+from distributedkernelshap_tpu.ops.explain import ShapConfig
+from distributedkernelshap_tpu.ops.treeshap_pack import plan_packed_paths
+
+
+@pytest.fixture(scope="module")
+def unbalanced():
+    """Mostly-shallow bushy trees + a deep caterpillar minority over a
+    wide feature space: deep paths touch > 64 DISTINCT features, so their
+    bucket straddles the old global kernel dmax cap."""
+
+    rng = np.random.default_rng(4)
+    D = 80
+    pred = build_unbalanced_ensemble(
+        n_bushy=18, bushy_depth=3, n_deep=2, deep_depth=70, D=D, seed=4)
+    G = groups_to_matrix(None, D)
+    X = rng.normal(size=(6, D)).astype(np.float32)
+    bg = rng.normal(size=(21, D)).astype(np.float32)
+    bgw = (rng.random(21) + 0.1).astype(np.float32)
+    return dict(pred=pred, G=G, X=X, bg=bg, bgw=bgw, D=D)
+
+
+# --------------------------------------------------------------------- #
+# planner units
+# --------------------------------------------------------------------- #
+
+
+def test_planner_covers_each_live_path_exactly_once():
+    rng = np.random.default_rng(0)
+    counts = rng.integers(-1, 10, size=(7, 13))
+    plan = plan_packed_paths(counts, tile=32)
+    flat = counts.ravel()
+    want = np.sort(np.nonzero(flat > 0)[0])
+    got = np.sort(plan.perm[plan.live])
+    np.testing.assert_array_equal(got, want)
+    assert plan.n_live == want.shape[0]
+    # tile-aligned local bucket slices tiling [0, n_packed) exactly
+    pos = 0
+    for start, stop, dmax in plan.buckets:
+        assert start == pos and (stop - start) % plan.tile == 0
+        members = flat[plan.perm[start:stop][plan.live[start:stop]]]
+        assert members.size == 0 or members.max() <= dmax
+        pos = stop
+    assert pos == plan.n_packed
+    # pad slots are masked and zero-group paths are dropped (their phi
+    # contribution is identically zero)
+    assert int(plan.live.sum()) == plan.n_live
+    assert (flat[plan.perm[plan.live]] > 0).all()
+
+
+def test_planner_shard_striping_and_balance():
+    rng = np.random.default_rng(1)
+    counts = rng.integers(1, 13, size=(40, 50))
+    shards, tile = 4, 16
+    plan = plan_packed_paths(counts, tile=tile, shards=shards)
+    assert plan.n_packed == shards * plan.local_len
+    assert plan.local_len % tile == 0
+    # every shard carries the SAME static bucket structure (shard_map is
+    # SPMD) and the strided deal keeps live work balanced
+    assert plan.buckets[-1][1] == plan.local_len
+    assert plan.shard_balance <= 1.35
+    # per-shard coverage: the union of shard slices is the live set
+    flat = counts.ravel()
+    got = np.sort(plan.perm[plan.live])
+    np.testing.assert_array_equal(got, np.sort(np.nonzero(flat > 0)[0]))
+
+
+def test_planner_gain_models_unbalance(unbalanced):
+    plan = ts.build_packed_plan(unbalanced["pred"], unbalanced["G"])
+    assert plan.gain > 1.2          # unbalanced ensembles pack profitably
+    assert plan.dmax_global > 64    # the deep bucket straddles the old cap
+    assert any(d > 64 for _, _, d in plan.buckets)
+    assert any(d <= 64 for _, _, d in plan.buckets)
+    # uniform ensemble: packing models ~no saving, the auto rule keeps
+    # the tuned dense layout
+    uniform = build_unbalanced_ensemble(
+        n_bushy=16, bushy_depth=3, n_deep=0, deep_depth=0, D=12, seed=2)
+    plan_u = ts.build_packed_plan(uniform, groups_to_matrix(None, 12))
+    assert plan_u.gain <= 1.05
+    assert not ts.resolve_pack_paths(None, plan_u)
+    assert ts.resolve_pack_paths(True, plan_u)      # explicit force wins
+    assert not ts.resolve_pack_paths(False, plan)
+
+
+# --------------------------------------------------------------------- #
+# packed routes vs the dense einsum reference
+# --------------------------------------------------------------------- #
+
+
+def test_packed_einsum_bit_identical_to_dense_reference(unbalanced):
+    """The packed einsum route must reproduce the dense chunked-einsum
+    exact path BIT-identically (np.array_equal) — the property that makes
+    enabling packing safe for served answers and result caches."""
+
+    s = unbalanced
+    pred = s["pred"]
+    for groups in (None, [[i, i + 1] for i in range(0, 40, 2)]):
+        G = groups_to_matrix(groups, s["D"])
+        reach = ts.background_reach(pred, s["bg"], G)
+        ref = np.asarray(ts.exact_shap_from_reach(
+            pred, s["X"], reach, s["bgw"], G, use_pallas=False))
+        plan = ts.build_packed_plan(pred, G)
+        packed = ts.pack_reach(pred, reach, plan)
+        got = np.asarray(ts.exact_shap_packed(
+            pred, s["X"], reach["onpath_g"], packed, s["bgw"], G,
+            plan.buckets, use_pallas=False))
+        assert np.array_equal(got, ref)
+
+
+def test_packed_pallas_matches_dense_straddling_dmax_cap(unbalanced,
+                                                         monkeypatch):
+    """The packed Pallas route (interpret mode on CPU) at depths
+    straddling the old ``_exact_dmax <= 64`` cap: shallow buckets run the
+    fused kernel with their TIGHT dmax, the deep bucket falls back to the
+    packed einsum for just its slice (counted), and phi matches the dense
+    einsum reference to the established kernel tolerance."""
+
+    from distributedkernelshap_tpu.ops import pallas_kernels as pk
+
+    s = unbalanced
+    pred, G = s["pred"], s["G"]
+    reach = ts.background_reach(pred, s["bg"], G)
+    plan = ts.build_packed_plan(pred, G)
+    packed = ts.pack_reach(pred, reach, plan)
+
+    kernel_dmaxes = []
+    real = pk.exact_tree_phi
+
+    def spy(*a, **k):
+        kernel_dmaxes.append(k.get("dmax"))
+        return real(*a, **k)
+
+    monkeypatch.setattr(pk, "exact_tree_phi", spy)
+    before = ts.exact_fallback_counts().get(("dmax_cap",), 0)
+    ref = np.asarray(ts.exact_shap_from_reach(
+        pred, s["X"], reach, s["bgw"], G, use_pallas=False))
+    got = np.asarray(ts.exact_shap_packed(
+        pred, s["X"], reach["onpath_g"], packed, s["bgw"], G,
+        plan.buckets, use_pallas=True))
+    scale = np.abs(ref).max()
+    np.testing.assert_allclose(got, ref, atol=2e-5 * max(scale, 1.0),
+                               rtol=2e-5)
+    # shallow buckets engaged the kernel with their tight per-bucket dmax
+    shallow = [d for _, _, d in plan.buckets if d <= 64]
+    deep = [d for _, _, d in plan.buckets if d > 64]
+    assert deep and shallow
+    assert sorted(set(kernel_dmaxes)) == sorted(set(shallow))
+    assert ts.exact_fallback_counts().get(("dmax_cap",), 0) > before
+
+
+def test_dmax_static_bound_fallback_counted(unbalanced):
+    """Tracing over the predictor itself loses the tight per-fit dmax —
+    that demotion must be counted, not silent (the satellite's 10x
+    slowdown observability)."""
+
+    import types
+
+    import jax
+    import jax.numpy as jnp
+
+    before = ts.exact_fallback_counts().get(("dmax_static_bound",), 0)
+
+    def f(ps):
+        fake = types.SimpleNamespace(path_sign=ps)
+        return jnp.zeros((ts._exact_dmax(fake, 6),))
+
+    jax.jit(f)(jnp.abs(unbalanced["pred"].path_sign))
+    assert ts.exact_fallback_counts()[("dmax_static_bound",)] == before + 1
+
+
+# --------------------------------------------------------------------- #
+# engine integration
+# --------------------------------------------------------------------- #
+
+
+def test_engine_packed_matches_dense_bitwise_and_caches(unbalanced):
+    s = unbalanced
+    bg = s["bg"][:16]
+    e_dense = KernelExplainerEngine(
+        s["pred"], bg, link="identity", seed=0,
+        config=EngineConfig(shap=ShapConfig(pack_paths=False)))
+    e_packed = KernelExplainerEngine(
+        s["pred"], bg, link="identity", seed=0,
+        config=EngineConfig(shap=ShapConfig(pack_paths=True)))
+    want = np.asarray(e_dense.get_explanation(s["X"], nsamples="exact"))
+    got = np.asarray(e_packed.get_explanation(s["X"], nsamples="exact"))
+    assert np.array_equal(got, want)
+    assert e_packed.kernel_path["exact_phi"] == "einsum_packed"
+    assert e_dense.kernel_path["exact_phi"] == "einsum"
+    # consts are device-cached by content fingerprint and dropped by the
+    # wedge-recovery hook
+    key = ('exact_consts', e_packed.content_fingerprint(), True)
+    assert key in e_packed._plan_consts_cache
+    e_packed.reset_device_state()
+    assert key not in e_packed._plan_consts_cache
+    got2 = np.asarray(e_packed.get_explanation(s["X"], nsamples="exact"))
+    assert np.array_equal(got2, want)
+
+
+def test_engine_staged_async_exact_matches_sync(unbalanced):
+    """nsamples='exact' rides the pipelined hot path: stage_rows accepts
+    it, the staged buffer feeds the donated entry, and the async result is
+    bit-identical to the sync explain."""
+
+    s = unbalanced
+    engine = KernelExplainerEngine(s["pred"], s["bg"][:12], link="identity",
+                                   seed=0)
+    want = engine.get_explanation(s["X"], nsamples="exact")
+    staged = engine.stage_rows(s["X"], nsamples="exact")
+    assert isinstance(staged, StagedRows)
+    fin = engine.get_explanation_async(staged, nsamples="exact")
+    values, info = fin()
+    np.testing.assert_array_equal(np.asarray(values), np.asarray(want))
+    np.testing.assert_array_equal(
+        info["raw_prediction"],
+        np.asarray(engine.last_raw_prediction))
+    assert info["expected_value"].shape == (1,)
+    # interactions stay on the sync path (and decline staging)
+    assert engine.stage_rows(s["X"], nsamples="exact",
+                             interactions=True) is None
+    # non-tree explain options keep their historical staging behaviour
+    assert engine.stage_rows(s["X"], nsamples=64, l1_reg=False) is not None
+
+
+def test_engine_async_exact_unstaged(unbalanced):
+    """The async exact path without pre-staged rows (the server's
+    staging-off deployments) pads/buckets identically to sync."""
+
+    s = unbalanced
+    engine = KernelExplainerEngine(s["pred"], s["bg"][:12], link="identity",
+                                   seed=0)
+    want = engine.get_explanation(s["X"][:5], nsamples="exact")
+    values, _ = engine.get_explanation_async(s["X"][:5],
+                                             nsamples="exact")()
+    np.testing.assert_array_equal(np.asarray(values), np.asarray(want))
+
+
+# --------------------------------------------------------------------- #
+# mesh sharding of packed work items
+# --------------------------------------------------------------------- #
+
+
+def test_sharded_packed_matches_single_device(unbalanced):
+    """Packed work items striped over the coalition axis (each rank owns
+    a balanced slice of path tiles, partial phi psum'd) must match the
+    single-device engine."""
+
+    from distributedkernelshap_tpu.parallel.distributed import (
+        DistributedExplainer,
+    )
+
+    s = unbalanced
+    bg = s["bg"][:16]
+    cfg = EngineConfig(shap=ShapConfig(pack_paths=True))
+    seq = KernelExplainerEngine(s["pred"], bg, link="identity", seed=0,
+                                config=cfg)
+    want = seq.get_explanation(s["X"], nsamples="exact")
+
+    dist = DistributedExplainer(
+        {"n_devices": 8, "coalition_parallel": 2,
+         "algorithm": "kernel_shap"},
+        KernelExplainerEngine, (s["pred"], bg),
+        {"link": "identity", "seed": 0, "config": cfg})
+    got = dist.get_explanation(s["X"], nsamples="exact")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5)
+    # staging declines for sharded explainers (mesh padding differs from
+    # the single-engine bucketing) instead of proxying the inner engine's
+    assert dist.stage_rows(s["X"], nsamples="exact") is None
+
+    dist4 = DistributedExplainer(
+        {"n_devices": 8, "coalition_parallel": 4,
+         "algorithm": "kernel_shap"},
+        KernelExplainerEngine, (s["pred"], bg),
+        {"link": "identity", "seed": 0, "config": cfg})
+    got4 = dist4.get_explanation(s["X"], nsamples="exact")
+    np.testing.assert_allclose(np.asarray(got4), np.asarray(want),
+                               atol=1e-5)
