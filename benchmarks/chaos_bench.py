@@ -18,6 +18,12 @@ Two scenarios, one JSON line:
    explanations are idempotent (deterministic + content-addressed), so a
    retry can change WHERE the answer computes, never WHAT it is.
 
+3. **Scaler chaos** — the autoscaler's control loop is crashed
+   (thread-scoped) and wedged (hang) at the ``scaler.tick`` fault site:
+   either way the fleet must stay at its CURRENT size and keep serving
+   (a dead control plane degrades to a static fleet, never drains the
+   data plane).
+
 2. **Pool resume** — a sharded batch explain run in a subprocess with
    shard journaling on (``distributed_opts['checkpoint_dir']``), killed
    deterministically by ``DKS_FAULTS=crash:site=pool.shard,after=K``
@@ -165,12 +171,17 @@ def run_serve_chaos(n_requests=48, n_replicas=3, slow_delay_s=0.5,
         wall = time.monotonic() - t0
 
         # the supervisor must resurrect the victim and the prober must
-        # return it to rotation
+        # return it to rotation.  /healthz alone is not enough to wait
+        # on: a fast client run can finish at the kill instant, and the
+        # corpse's `alive` flag stays stale-True until the supervisor's
+        # next tick — so "3 live" must be REACHED THROUGH a restart, not
+        # observed before anyone noticed the death
         all_live = False
         deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
             health = json.loads(_scrape(proxy.host, proxy.port, "/healthz"))
-            if len(health.get("live", [])) == n_replicas:
+            if manager.supervisor.stats()["restarts_total"] >= 1 and \
+                    len(health.get("live", [])) == n_replicas:
                 all_live = True
                 break
             time.sleep(1.0)
@@ -218,6 +229,84 @@ def run_serve_chaos(n_requests=48, n_replicas=3, slow_delay_s=0.5,
         "hedge_wins": int(_metric(metrics, "dks_fanin_hedge_wins_total")),
         "proxy_502s": int(_metric(metrics, "dks_fanin_replica_errors_total")),
     }
+
+
+# --------------------------------------------------------------------- #
+# scenario 3: wedged/killed autoscaler degrades to the current fleet size
+# --------------------------------------------------------------------- #
+
+
+def run_scaler_chaos():
+    """Fault-inject the autoscaler's control loop (site ``scaler.tick``,
+    ``resilience/faults.py``): a CRASHED scaler (thread-scoped — the
+    control thread dies, the serving process lives) and a WEDGED one
+    (hang) must both leave the fleet at its CURRENT size and serving —
+    a dead control plane degrades to a static fleet, it never drains the
+    data plane to zero.
+
+    Runs against the in-process elastic fleet (real ``ExplainerServer``
+    replicas + ``FanInProxy`` + the real ``Autoscaler``) so both fault
+    kinds finish in seconds; the subprocess spawn/retire path is scenario
+    1's fleet plus ``tests/test_autoscaler.py``."""
+
+    from benchmarks.autoscale_bench import (
+        DIM,
+        LocalFleet,
+        SyntheticServedModel,
+        _post_with_retry,
+    )
+    from distributedkernelshap_tpu.resilience.faults import (
+        FaultInjector,
+        parse_faults,
+    )
+    from distributedkernelshap_tpu.serving.autoscaler import (
+        Autoscaler,
+        AutoscalerConfig,
+    )
+
+    out = {}
+    for kind in ("crash", "hang"):
+        fleet = LocalFleet(SyntheticServedModel).start(2)
+        scaler = None
+        try:
+            fleet.wait_ready()
+            injector = FaultInjector(parse_faults(
+                "crash:site=scaler.tick,after=3" if kind == "crash"
+                else "hang:site=scaler.tick,after=3,delay=3600"))
+            # down knobs deliberately inert (down_ticks huge): the ONLY
+            # thing that may change the fleet before or after the fault
+            # is the fault's effect itself
+            scaler = Autoscaler(
+                fleet, fleet.proxy,
+                config=AutoscalerConfig(
+                    min_replicas=1, max_replicas=3, interval_s=0.1,
+                    down_ticks=10_000),
+                fault_injector=injector).start()
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and \
+                    injector.hits("scaler.tick") < 4:
+                time.sleep(0.05)
+            ticks_at_fault = scaler.ticks_total
+            time.sleep(1.5)  # the window a dying scaler could misuse
+            counts = fleet.proxy.replica_state_counts()
+            status, _, _ = _post_with_retry(
+                fleet.proxy.host, fleet.proxy.port,
+                np.zeros((1, DIM), np.float32), {})
+            out[kind] = {
+                "fault_fired": injector.hits("scaler.tick") >= 4,
+                "ready_after": counts.get("ready", 0),
+                "draining_after": counts.get("draining", 0),
+                "serving_after": status == 200,
+                # crash: the loop thread must be DEAD; hang: alive but
+                # frozen (no tick since the fault)
+                "scaler_thread_alive": scaler._thread.is_alive(),
+                "ticks_frozen": scaler.ticks_total == ticks_at_fault,
+            }
+        finally:
+            if scaler is not None:
+                scaler.stop()
+            fleet.stop()
+    return out
 
 
 # --------------------------------------------------------------------- #
@@ -475,6 +564,26 @@ def main():
                     trace["hedged_pass_traces"] >= 1,
                 "perfetto_round_trips": trace["perfetto_round_trips"],
             })
+    if not args.pool_only:
+        scaler = run_scaler_chaos()
+        report["scaler"] = scaler
+        checks.update({
+            # a dead/wedged control plane degrades to the CURRENT fleet
+            # size — it never drains the data plane (to zero or at all)
+            "scaler_crash_fleet_intact":
+                scaler["crash"]["fault_fired"]
+                and scaler["crash"]["ready_after"] == 2
+                and scaler["crash"]["draining_after"] == 0
+                and scaler["crash"]["serving_after"],
+            "scaler_crash_thread_dead":
+                not scaler["crash"]["scaler_thread_alive"],
+            "scaler_hang_fleet_intact":
+                scaler["hang"]["fault_fired"]
+                and scaler["hang"]["ready_after"] == 2
+                and scaler["hang"]["draining_after"] == 0
+                and scaler["hang"]["serving_after"],
+            "scaler_hang_ticks_frozen": scaler["hang"]["ticks_frozen"],
+        })
     if not args.serve_only:
         pool = run_pool_resume()
         report["pool"] = pool

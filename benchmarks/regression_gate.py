@@ -19,11 +19,13 @@ This module closes the loop:
   median of the last N prior runs with the SAME benchmark AND config
   fingerprint (a config change starts a fresh baseline instead of
   producing a false regression).  The gate fails when the newest run's
-  wall time exceeds the baseline median by more than
-  ``--max-wall-regression`` (default 20%) or any ``*p99_s`` metric by
-  ``--max-p99-regression`` (default 50% — a p99 over a few dozen
-  requests is one order statistic and noisy).  Lower-is-better only: a
-  run that got FASTER never fails, it just tightens the next baseline.
+  wall time (or the autoscale bench's ``replica_seconds`` provisioning
+  cost — its wall is a fixed open-loop trace) exceeds the baseline
+  median by more than ``--max-wall-regression`` (default 20%) or any
+  ``*p99_s`` metric by ``--max-p99-regression`` (default 50% — a p99
+  over a few dozen requests is one order statistic and noisy).
+  Lower-is-better only: a run that got FASTER never fails, it just
+  tightens the next baseline.
 
 First runs (no baseline yet) pass with a note — a gate that fails on an
 empty history would block the first measurement forever.
@@ -142,6 +144,11 @@ def _median(values: List[float]) -> float:
 def _threshold_for(metric: str, max_wall: float,
                    max_p99: float) -> Optional[float]:
     if metric == "wall_s":
+        return max_wall
+    if metric == "replica_seconds":
+        # the autoscale bench's provisioning cost: its wall is a FIXED
+        # open-loop trace, so replica-seconds is the number a scaler
+        # regression would move — gated as tightly as wall time
         return max_wall
     if metric.endswith("p99_s"):
         return max_p99

@@ -162,6 +162,14 @@ class HealthEngine:
             self._status_cache = (now, statuses)
         return statuses
 
+    def slo_statuses(self, now: Optional[float] = None) -> List[Dict]:
+        """Public view of the current SLO evaluations (memoised per tick
+        like the gauge callbacks) — the autoscaler reads its burn-rate
+        and budget-remaining signals from here instead of re-deriving
+        them from the store."""
+
+        return self._statuses(now)
+
     def _budget_series(self) -> Dict[tuple, float]:
         out = {}
         for status in self._statuses():

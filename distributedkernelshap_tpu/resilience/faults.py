@@ -56,6 +56,18 @@ Sites currently consulted:
     fetched-but-unjournaled shard, the shard a resume must recompute.
     Non-journaled pipelines (the engine's internal chunk loops, serving)
     never consult it, so the hit count stays a pure shard counter.
+``scaler.tick``
+    ``serving/autoscaler.Autoscaler``'s control loop, at the top of each
+    evaluation tick.  The scaler is a control thread inside the fleet's
+    parent process, so ``crash`` here is THREAD-scoped (the scaler calls
+    :meth:`FaultInjector.fire` with ``crash_scope="thread"``): the fired
+    crash is returned to the caller, which kills the scaler loop and
+    nothing else — a whole-process ``os._exit`` would take the fan-in
+    proxy and every client connection with it, which is a different
+    fault (process death) the other sites already script.  ``hang``
+    wedges the tick thread.  Either way the fleet must degrade to its
+    CURRENT size and keep serving (never drain to zero) — the invariant
+    ``chaos_bench.py --check`` asserts.
 """
 
 import logging
@@ -188,7 +200,14 @@ class FaultInjector:
                 return spec
         return None
 
-    def fire(self, site: str) -> Optional[str]:
+    def fire(self, site: str, crash_scope: str = "process") -> Optional[str]:
+        """Evaluate ``site``; see the class doc.  ``crash_scope`` selects
+        what a fired ``crash`` kills: ``"process"`` (default — the
+        historical ``os._exit``) or ``"thread"``, where ``"crash"`` is
+        RETURNED and the caller owns dying — used by control loops (the
+        autoscaler's ``scaler.tick``) whose death must not take the
+        serving process with them."""
+
         spec = self._decide(site)
         if spec is None:
             return None
@@ -206,6 +225,10 @@ class FaultInjector:
         flightrec().record("fault_injected", fault=spec.kind, site=site,
                            delay_s=spec.delay_s)
         if spec.kind == "crash":
+            if crash_scope == "thread":
+                # the caller kills ITS OWN loop; the process (proxy,
+                # replicas, client sockets) lives on
+                return spec.kind
             # the dump happens HERE because nothing after os._exit does:
             # no atexit, no flush — an injected crash is the one fault
             # that can still leave its black box behind

@@ -17,6 +17,12 @@ its grown-up replacement:
   stays owned by the proxy's ``/healthz`` prober, so exactly one
   component (the prober) ever declares a replica live, and exactly one
   (the supervisor or a failed connect) declares it dead.
+* **elastic membership** — the autoscaler (``serving/autoscaler.py``)
+  grows the fleet mid-run (:meth:`ReplicaSupervisor.track` puts a
+  freshly spawned worker under supervision) and shrinks it by DRAINING:
+  a retired replica (:meth:`ReplicaSupervisor.retire`) exited on
+  purpose, so its process exit must NOT trigger a restart — retirement
+  is the one exit the crash loop is wrong about.
 
 Used by ``serving/replicas.ReplicaManager``; standalone-usable for any
 list of worker ``Popen`` objects plus a spawn function.
@@ -105,6 +111,9 @@ class ReplicaSupervisor:
         self._consecutive: Dict[int, int] = {}
         self._last_start: Dict[int, float] = {}
         self._respawn_at: Dict[int, float] = {}
+        # indices retired ON PURPOSE (autoscaler drain): their exit is the
+        # goal, not a crash — never respawned
+        self._retired: set = set()
         self.restarts_total = 0
         self.crash_loops_backing_off = 0
 
@@ -125,6 +134,8 @@ class ReplicaSupervisor:
     def _tick(self) -> None:
         now = time.monotonic()
         for i, proc in enumerate(self.procs):
+            if i in self._retired:
+                continue  # drained on purpose: its exit is the goal
             if proc is None or proc.poll() is None:
                 continue
             # dead: the proxy must stop routing to the corpse NOW — the
@@ -191,6 +202,33 @@ class ReplicaSupervisor:
 
         self._stop.set()
 
+    # -- elastic membership (autoscaler) -------------------------------- #
+
+    def track(self, index: int) -> None:
+        """Put a freshly spawned worker at ``procs[index]`` under
+        supervision: stamp its start time (a scaler-spawned worker must
+        earn ``healthy_reset_s`` like any other incarnation) and clear
+        any retirement left over from a previously drained slot being
+        reused."""
+
+        self._last_start[index] = time.monotonic()
+        self._consecutive.pop(index, None)
+        self._respawn_at.pop(index, None)
+        self._retired.discard(index)
+
+    def retire(self, index: int) -> None:
+        """Mark one replica as retired ON PURPOSE (the autoscaler's
+        drain-based scale-down): its upcoming process exit is the desired
+        outcome, so the crash-restart loop must skip it.  Distinct from
+        :meth:`stop`, which ends supervision fleet-wide."""
+
+        self._retired.add(index)
+        self._respawn_at.pop(index, None)
+
+    def is_retired(self, index: int) -> bool:
+        return index in self._retired
+
     def stats(self) -> Dict[str, int]:
         return {"restarts_total": self.restarts_total,
-                "crash_loops_backing_off": self.crash_loops_backing_off}
+                "crash_loops_backing_off": self.crash_loops_backing_off,
+                "retired": len(self._retired)}

@@ -74,11 +74,23 @@ class ServiceRateEstimator:
     admission controller divides queued rows by it to project queue wait.
     Before any observation it reports ``None`` — the projected-wait gate
     then admits (no evidence of overload yet).
+
+    The EWMA assumes the downstream capacity producing the observations
+    is static.  When it is not — the autoscaler resized the replica
+    fleet behind a fan-in, or a fleet-level estimator watches N workers —
+    :meth:`capacity_hint` rescales the believed rate proportionally at
+    the moment capacity changes, so projected-wait shedding neither
+    over-sheds right after a scale-up (the EWMA still believing the old,
+    smaller fleet) nor under-sheds after a drain (still believing the
+    bigger one).  The hint moves the ESTIMATE once; subsequent
+    observations keep correcting it as usual.
     """
 
     def __init__(self, alpha: float = 0.3):
         self.alpha = float(alpha)
         self._rate: Optional[float] = None
+        self._capacity_units: Optional[float] = None
+        self._rows_total = 0
         self._lock = threading.Lock()
 
     def observe(self, rows: int, seconds: float) -> None:
@@ -86,9 +98,35 @@ class ServiceRateEstimator:
             return
         sample = rows / seconds
         with self._lock:
+            self._rows_total += int(rows)
             self._rate = (sample if self._rate is None
                           else self.alpha * sample
                           + (1.0 - self.alpha) * self._rate)
+
+    def capacity_hint(self, units: float) -> None:
+        """Declare the downstream capacity in arbitrary ``units``
+        (typically ready replicas).  The first call only records the
+        baseline; later calls rescale the current EWMA by the units
+        ratio.  Called by the autoscaler on every completed scale event
+        and by ``ReplicaManager`` with the starting fleet size."""
+
+        units = float(units)
+        if units <= 0:
+            raise ValueError("capacity_hint units must be positive")
+        with self._lock:
+            if self._capacity_units and self._rate is not None:
+                self._rate *= units / self._capacity_units
+            self._capacity_units = units
+
+    def rows_observed_total(self) -> int:
+        """Cumulative rows fed through :meth:`observe` — a monotonic
+        served-rows counter.  A fleet-level consumer (the autoscaler)
+        differentiates it across polls to get a rows/s DEMAND that is
+        unit-compatible with :meth:`rows_per_s` capacity, which a
+        request-count rate is not (requests carry arbitrary row counts)."""
+
+        with self._lock:
+            return self._rows_total
 
     def rows_per_s(self) -> Optional[float]:
         with self._lock:
@@ -153,6 +191,13 @@ class AdmissionController:
 
     def _bound_for(self, klass: str) -> int:
         return int(self._bounds.get(klass, self._default_bound) or 0)
+
+    def capacity_hint(self, units: float) -> None:
+        """Forward a downstream-capacity change to the estimator (no-op
+        without one) — see :meth:`ServiceRateEstimator.capacity_hint`."""
+
+        if self.estimator is not None:
+            self.estimator.capacity_hint(units)
 
     def _bucket_for(self, client_key: str) -> TokenBucket:
         rate, burst = self.rate_limit_per_client

@@ -71,13 +71,32 @@ class _ProxyHTTPServer(ThreadingHTTPServer):
 
 
 class _Replica:
-    """Fan-in-side state for one backend replica."""
+    """Fan-in-side state for one backend replica.
+
+    Besides liveness (``alive`` — owned by the prober/supervisor/failed
+    connects, exactly as before), a replica carries the autoscaler's
+    lifecycle flags:
+
+    * ``warming`` — the prober saw the warmup ladder's distinct 503
+      ``{"status": "warming"}``: started, compiling, not yet routable.
+    * ``standby`` — a warm-standby pool member: fully probed-ready
+      (``warm_ready``) but held OUT of rotation until the scaler
+      activates it (activation is then instant instead of a spawn+warm).
+    * ``draining`` — scale-down victim: no NEW forwards are routed to it,
+      but in-flight requests (and its queued work) still answer normally.
+    * ``retired`` — drained and gone; never probed, never routed.
+    """
 
     def __init__(self, index: int, host: str, port: int):
         self.index = index
         self.host = host
         self.port = port
         self.alive = True
+        self.warming = False
+        self.standby = False
+        self.warm_ready = False
+        self.draining = False
+        self.retired = False
         # monotonic time until which this replica has declared itself
         # saturated (it answered 429 reason=queue_full): alive, just not
         # worth forwarding to.  Keyed by the request's priority class —
@@ -85,6 +104,28 @@ class _Replica:
         # filling batch queues must not mark the replica saturated for
         # interactive traffic it still admits.
         self.saturated_until: Dict[str, float] = {}
+
+    def routable(self) -> bool:
+        """Eligible for NEW forwards.  ``alive`` alone is not enough: a
+        draining victim must finish its in-flight work without taking on
+        more, and a standby is deliberately held out of rotation."""
+
+        return (self.alive and not self.draining and not self.retired
+                and not self.standby)
+
+    def state(self) -> str:
+        """The autoscaler's one-word lifecycle view (feeds
+        ``dks_autoscale_replicas{state=}`` and ``/statusz``)."""
+
+        if self.retired:
+            return "retired"
+        if self.draining:
+            return "draining"
+        if self.standby:
+            return "standby"
+        if self.alive:
+            return "ready"
+        return "warming" if self.warming else "down"
 
     def saturated_for(self, klass: str) -> float:
         """Backoff expiry for one class (0.0 when not backed off)."""
@@ -164,6 +205,20 @@ class FanInProxy:
         self._m_hedge_wins = reg.counter(
             "dks_fanin_hedge_wins_total",
             "Hedged requests whose hedge answered first with a success.")
+        # end-to-end latency by priority class, observed at the proxy for
+        # every 200 it returns (hedged or not) — the histogram the
+        # autoscaler's interactive-latency SLO burns against, and the
+        # fleet-level twin of the replica-side
+        # dks_serve_class_latency_seconds.  Bucket bounds match the
+        # server's LATENCY_BUCKETS_S (slo.CLASS_LATENCY_TARGETS requires
+        # every threshold at or below the largest finite bucket).
+        self._m_class_latency = reg.histogram(
+            "dks_fanin_class_latency_seconds",
+            "Proxy-observed request latency of successful /explain "
+            "answers by priority class.",
+            buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
+            labelnames=("class",))
         reg.gauge("dks_fanin_replica_up", "Replica liveness by index.",
                   labelnames=("replica", "address")).set_function(
             lambda: {(str(r.index), r.address): int(r.alive)
@@ -195,8 +250,10 @@ class FanInProxy:
                          "dks_fanin_hedges_total",
                          "dks_fanin_sheds_total"))
         # replica supervisor, when a ReplicaManager runs one: its restart
-        # stats join the /statusz replica-liveness block
+        # stats join the /statusz replica-liveness block; ditto the
+        # autoscaler's panel once one attaches
         self._supervisor = None
+        self._autoscaler = None
         #: tail-latency hedging (``resilience/hedging.py``).  ``None``
         #: (default) disables it — behaviour is then byte-identical to the
         #: pre-hedging proxy.  Safe to enable because /explain is
@@ -217,6 +274,113 @@ class FanInProxy:
 
     # ------------------------------------------------------------------ #
 
+    def _observe_latency(self, klass: str, seconds: float) -> None:
+        """One successful answer's end-to-end latency: feeds the hedge
+        policy's sliding quantiles AND the per-class histogram the
+        autoscaler's SLO burn rate reads."""
+
+        self._latency.observe(klass, seconds)
+        self._m_class_latency.observe(seconds, **{"class": klass})
+
+    # -- elastic membership (serving/autoscaler.py) --------------------- #
+
+    def add_target(self, host: str, port: int,
+                   standby: bool = False,
+                   index: Optional[int] = None) -> int:
+        """Register a NEW replica address mid-run (the autoscaler's
+        scale-up path; construction-time targets come via ``targets``).
+        The replica starts OUT of rotation (``alive=False``): life is
+        declared only by the prober, which readmits it the moment its
+        ``/healthz`` answers 200 — i.e. the instant the warmup ladder
+        finishes.  With ``standby=True`` the prober instead marks it
+        ``warm_ready`` and holds it out of rotation until
+        :meth:`activate_standby`.  Returns the replica index.
+
+        A retired slot is RECYCLED rather than left to accumulate: the
+        first retired replica's index is reused for the new address
+        (``index=`` pins a specific retired slot — ``ReplicaManager``
+        passes its own reused process slot so the two index spaces stay
+        aligned), which bounds the rotation, the prober's scan and the
+        per-index metric label sets at the fleet's high-water mark
+        instead of growing by one dead entry per scale cycle."""
+
+        with self._rr_lock:
+            if index is not None:
+                replica = self.replicas[index]
+                if not replica.retired:
+                    raise ValueError(
+                        f"replica slot {index} is not retired (state "
+                        f"{replica.state()}); only retired slots can be "
+                        "reused")
+            else:
+                replica = next((r for r in self.replicas if r.retired),
+                               None)
+            if replica is not None:
+                index = replica.index
+                replica.host, replica.port = host, int(port)
+                replica.retired = False
+                replica.draining = False
+                replica.warm_ready = False
+                replica.saturated_until.clear()
+            else:
+                index = len(self.replicas)
+                replica = _Replica(index, host, port)
+                self.replicas.append(replica)
+            replica.alive = False
+            replica.warming = True  # until the prober says otherwise
+            replica.standby = bool(standby)
+        # seed the per-replica failure series so the new label combo
+        # renders at 0 like the construction-time ones
+        self._m_replica_failures.seed((str(index), replica.address))
+        logger.info("fan-in: added replica %d at %s%s (awaiting prober)",
+                    index, replica.address,
+                    " as standby" if standby else "")
+        return index
+
+    def activate_standby(self, index: int) -> bool:
+        """Promote a warm standby into rotation.  If the prober has
+        already verified it ready (``warm_ready``), admission is
+        immediate — the prober's last verdict is what standby-warmth
+        MEANS, so this does not usurp the prober's ownership of life;
+        otherwise the flag is cleared and the prober admits it on its
+        next 200.  Returns whether the replica is routable right away."""
+
+        r = self.replicas[index]
+        r.standby = False
+        if r.warm_ready and not r.retired:
+            r.alive = True
+            return True
+        return False
+
+    def start_drain(self, index: int) -> None:
+        """Take one replica out of NEW-forward rotation while its queued
+        and in-flight work keeps answering (scale-down's first half).
+        The replica's own scheduler finishes what it holds; anything it
+        503s during final shutdown is pre-dispatch and fails over."""
+
+        self.replicas[index].draining = True
+
+    def finish_drain(self, index: int) -> None:
+        """Retire a drained replica for good: never probed, never routed
+        again (its index stays — indices are identities here)."""
+
+        r = self.replicas[index]
+        r.draining = False
+        r.retired = True
+        r.alive = False
+        r.warm_ready = False
+        r.warming = False
+
+    def replica_state_counts(self) -> Dict[str, int]:
+        """``{state: count}`` over every registered replica — the
+        autoscaler's ``dks_autoscale_replicas{state=}`` feed."""
+
+        counts = {"ready": 0, "warming": 0, "draining": 0, "standby": 0,
+                  "down": 0, "retired": 0}
+        for r in self.replicas:
+            counts[r.state()] = counts.get(r.state(), 0) + 1
+        return counts
+
     def _pick(self, exclude: set) -> Optional[_Replica]:
         """Next live replica after the round-robin cursor, skipping
         ``exclude`` (replicas already tried for this request)."""
@@ -225,7 +389,7 @@ class FanInProxy:
             n = len(self.replicas)
             for step in range(n):
                 r = self.replicas[(self._rr + step) % n]
-                if r.alive and r.index not in exclude:
+                if r.routable() and r.index not in exclude:
                     self._rr = (self._rr + step + 1) % n
                     return r
         return None
@@ -366,7 +530,7 @@ class FanInProxy:
                 result = self._route_explain(method, body, headers, klass,
                                              span_parent=root)
                 if result[0] == 200:
-                    self._latency.observe(klass, time.monotonic() - t0)
+                    self._observe_latency(klass, time.monotonic() - t0)
             else:
                 result = self._handle_hedged(method, body, headers, klass,
                                              root=root)
@@ -419,7 +583,7 @@ class FanInProxy:
             slot, res, lat, fwd = results.get(timeout=delay)
         except queue.Empty:
             exclude = list(primary_tried)
-            if not any(r.alive and r.index not in exclude
+            if not any(r.routable() and r.index not in exclude
                        for r in self.replicas):
                 # nowhere to hedge onto: just wait the primary out
                 slot, res, lat, fwd = results.get()
@@ -450,7 +614,7 @@ class FanInProxy:
             self._m_hedge_wins.inc()
             self._flight.record("hedge_win", klass=klass)
         if res[0] == 200:
-            self._latency.observe(klass, lat)
+            self._observe_latency(klass, lat)
         return res
 
     def _replica_failed(self, replica: _Replica) -> None:
@@ -692,29 +856,56 @@ class FanInProxy:
     # ------------------------------------------------------------------ #
 
     def _probe_loop(self):
-        """Return recovered replicas to rotation (dead → /healthz → live)."""
+        """Return recovered replicas to rotation (dead → /healthz → live).
+
+        The prober is also the autoscaler's readiness oracle: it tracks
+        the warmup ladder's distinct ``{"status": "warming"}`` 503 (so
+        ``dks_autoscale_replicas{state="warming"}`` is honest), admits a
+        freshly added replica the moment its ladder finishes, and marks
+        standbys ``warm_ready`` WITHOUT admitting them — activation stays
+        a scaler decision.  Retired replicas are never probed."""
 
         while not self._stop.wait(self.probe_interval_s):
-            for r in self.replicas:
-                if r.alive or self._stop.is_set():
+            for r in list(self.replicas):
+                if self._stop.is_set():
+                    break
+                if r.retired or (r.alive and not r.standby):
                     continue
                 try:
                     # short dedicated timeout: a wedged-but-accepting
                     # replica must not stall the prober for the full
                     # request timeout and starve other replicas' recovery
-                    status, _, _ = self._forward("GET", "/healthz", b"", r,
-                                                 timeout_s=5.0)
+                    status, body, _ = self._forward("GET", "/healthz", b"",
+                                                    r, timeout_s=5.0)
                 except (OSError, http.client.HTTPException):
                     # HTTPException too: a garbage health response must not
                     # kill the prober thread (that would silently disable
                     # dead-replica recovery for the process lifetime)
+                    r.warm_ready = False
                     continue
                 if status == 200:
+                    r.warming = False
+                    if r.standby:
+                        # ready but deliberately held out of rotation: the
+                        # scaler's activate_standby() is the admission
+                        if not r.warm_ready:
+                            r.warm_ready = True
+                            logger.info("standby replica %s warm and "
+                                        "ready for activation", r.address)
+                        continue
                     logger.info("replica %s recovered; back in rotation",
                                 r.address)
+                    r.warm_ready = True
                     r.alive = True
                     self._flight.record("replica_recovered",
                                         replica=r.index, address=r.address)
+                else:
+                    r.warm_ready = False
+                    try:
+                        r.warming = (json.loads(body).get("status")
+                                     == "warming")
+                    except (ValueError, AttributeError):
+                        r.warming = False
 
     def _render_metrics(self) -> str:
         # rendered SOLELY by the shared registry (declarations live in
@@ -728,10 +919,17 @@ class FanInProxy:
 
         self._supervisor = supervisor
 
+    def attach_autoscaler(self, autoscaler) -> None:
+        """Let ``/statusz`` render the autoscaler panel (fleet target,
+        bounds, last decision, cooldowns) next to the replica rotation it
+        acts on (``serving/autoscaler.Autoscaler`` calls this once)."""
+
+        self._autoscaler = autoscaler
+
     def _statusz_detail(self) -> Dict:
         """Proxy-specific ``/statusz`` block: replica liveness (the
-        rotation's own view), saturation backoffs, supervisor restart
-        stats when one is attached."""
+        rotation's own view), lifecycle states, saturation backoffs,
+        supervisor restart stats and the autoscaler panel when attached."""
 
         now = time.monotonic()
         replicas = []
@@ -740,17 +938,22 @@ class FanInProxy:
             replicas.append({
                 "index": r.index, "address": r.address,
                 "alive": bool(r.alive),
+                "state": r.state(),
                 # remaining backoff, counting DOWN to readmission (0 =
                 # not saturated) — named for what it measures
                 "saturation_expires_in_s": round(max(0.0, backoff - now),
                                                  2),
             })
         sup = self._supervisor
+        scaler = self._autoscaler
         return {
             "replicas": replicas,
             "live_replicas": sum(1 for r in self.replicas if r.alive),
+            "replica_states": self.replica_state_counts(),
             "hedging": self.hedge_policy is not None,
             "supervisor": sup.stats() if sup is not None else None,
+            "autoscaler": (scaler.statusz_panel()
+                           if scaler is not None else None),
         }
 
     def _make_handler(self):
@@ -787,7 +990,12 @@ class FanInProxy:
                         "status": "ok" if live else "no live replicas",
                         "live": live,
                         "dead": [r.address for r in proxy.replicas
-                                 if not r.alive]}).encode())
+                                 if not (r.alive or r.retired
+                                         or r.standby)],
+                        "draining": [r.address for r in proxy.replicas
+                                     if r.draining],
+                        "standby": [r.address for r in proxy.replicas
+                                    if r.standby]}).encode())
                     return
                 if route == "/metrics":
                     self._reply(200, proxy._render_metrics().encode(),
@@ -909,7 +1117,8 @@ class ReplicaManager:
                  env_extra: Optional[Dict[str, str]] = None,
                  startup_timeout_s: float = 300.0,
                  restart_policy: Optional[RestartPolicy] = None,
-                 hedge_policy: Optional[HedgePolicy] = None):
+                 hedge_policy: Optional[HedgePolicy] = None,
+                 autoscale=None):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         self.n_replicas = n_replicas
@@ -921,6 +1130,17 @@ class ReplicaManager:
         self.restart = restart
         self.restart_policy = restart_policy
         self.hedge_policy = hedge_policy
+        #: elastic fleet sizing: ``None``/falsy (the default — the
+        #: ``autoscale=off`` escape hatch for pinned/single-replica
+        #: deployments) serves the fixed ``n_replicas`` forever; an
+        #: ``AutoscalerConfig`` (``serving/autoscaler.py``) starts a
+        #: scaler over this manager's spawn/retire hooks.  Requires
+        #: ``restart=True`` (retirement rides on the supervisor).
+        self.autoscale = autoscale or None
+        if self.autoscale is not None and not restart:
+            raise ValueError("autoscale needs restart=True (scale-down "
+                             "retires replicas through the supervisor)")
+        self.autoscaler = None
         self.env_extra = dict(env_extra or {})
         self.startup_timeout_s = startup_timeout_s
         self.ports: List[int] = []
@@ -935,7 +1155,7 @@ class ReplicaManager:
 
     # ------------------------------------------------------------------ #
 
-    def _reserve_ports(self) -> List[int]:
+    def _reserve_ports(self, n: Optional[int] = None) -> List[int]:
         """OS-assigned free ports, reserved briefly then released to the
         workers.  The tiny bind race this leaves is acceptable for a
         single-host deployment (k8s mode gives each replica its own pod)."""
@@ -943,7 +1163,7 @@ class ReplicaManager:
         import socket
 
         socks, ports = [], []
-        for _ in range(self.n_replicas):
+        for _ in range(self.n_replicas if n is None else n):
             s = socket.socket()
             s.bind((self.host, 0))
             socks.append(s)
@@ -1006,6 +1226,64 @@ class ReplicaManager:
             time.sleep(0.5)
         return "warming" if warming else False
 
+    # -- elastic fleet hooks (serving/autoscaler.py) -------------------- #
+
+    def spawn_replica(self, standby: bool = False) -> Optional[int]:
+        """Scale-up: spawn ONE new worker on a fresh port and register it
+        with the proxy (out of rotation until its warmup ladder finishes
+        and the prober admits it — the ``warming`` pre-warm state).  The
+        worker inherits the fleet's env, so ``DKS_WARMUP`` defaults the
+        ladder ON exactly like construction-time workers.  A previously
+        retired slot is reused (same index at proxy and supervisor —
+        ``track`` clears the retirement) so scale cycles don't grow the
+        roster.  Returns the replica index, or ``None`` if the manager
+        is stopping."""
+
+        with self._procs_lock:
+            if self._stop.is_set():
+                return None
+            reused = next(
+                (i for i in range(len(self.procs))
+                 if self.supervisor is not None
+                 and self.supervisor.is_retired(i)), None)
+            if reused is not None:
+                index = reused
+                self.ports[index] = self._reserve_ports(1)[0]
+                self.procs[index] = self._spawn(index)
+            else:
+                index = len(self.procs)
+                self.ports.append(self._reserve_ports(1)[0])
+                self.procs.append(self._spawn(index))
+        if self.supervisor is not None:
+            self.supervisor.track(index)
+        self.proxy.add_target(self.host, self.ports[index], standby=standby,
+                              index=reused)
+        return index
+
+    def retire_replica(self, index: int, grace_s: float = 10.0) -> None:
+        """Scale-down's second half (the scaler calls this AFTER the
+        drain emptied the replica's queues): mark the worker retired with
+        the supervisor (its exit is on purpose — no restart), SIGTERM it
+        (the worker's signal handler runs ``server.stop()``, which
+        answers any straggler with a retriable pre-dispatch 503), and
+        retire its slot at the proxy."""
+
+        if self.supervisor is not None:
+            self.supervisor.retire(index)
+        with self._procs_lock:
+            proc = self.procs[index]
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+        try:
+            proc.wait(timeout=grace_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass  # D-state child: the shutdown sweep retries
+        self.proxy.finish_drain(index)
+
     # ------------------------------------------------------------------ #
 
     def start(self, proxy_port: int = 0,
@@ -1056,10 +1334,27 @@ class ReplicaManager:
                 lock=self._procs_lock).start()
             # restart stats join the proxy's /statusz replica block
             self.proxy.attach_supervisor(self.supervisor)
+        if self.autoscale is not None:
+            # imported here: autoscaler.py is fleet-agnostic (it drives
+            # this manager OR any object with the spawn/retire hooks),
+            # so module-level imports stay acyclic
+            from distributedkernelshap_tpu.serving.autoscaler import (
+                Autoscaler,
+            )
+
+            self.autoscaler = Autoscaler(self, self.proxy,
+                                         config=self.autoscale)
+            # baseline the capacity projection at the starting fleet
+            # size, so the first scale event rescales from a known
+            # denominator instead of waiting a gather tick
+            self.autoscaler.capacity_hint(max(1, self.n_replicas))
+            self.autoscaler.start()
         return self
 
     def stop(self):
         self._stop.set()
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         if self.supervisor is not None:
             self.supervisor.stop()
         if self.proxy is not None:

@@ -794,6 +794,21 @@ class ExplainerServer:
             "admission_control": self._admission is not None,
             "staging": self._staging_enabled,
         }
+        # the autoscaler's queue-pressure inputs: the admission EWMA's
+        # device throughput and the EDF-aware projected wait per class
+        # (rows sorting ahead of a fresh request of that class, over the
+        # observed rate — the same projection admission sheds on, so the
+        # scaler and the shedder can never disagree about "behind")
+        rate = self._service_rate.rows_per_s()
+        detail["service_rate_rows_per_s"] = (round(rate, 3)
+                                             if rate else None)
+        detail["rows_served_total"] = self._service_rate.rows_observed_total()
+        if rate:
+            detail["projected_wait_s"] = {
+                klass: round(self._sched.rows_ahead(klass, None) / rate, 3)
+                for klass in PRIORITY_CLASSES}
+        else:
+            detail["projected_wait_s"] = None
         with self._active_lock:
             detail["in_flight_batches"] = len(self._active)
         if self._cache is not None:

@@ -37,10 +37,11 @@ DOCS_PATH = os.path.join(REPO_ROOT, "docs", "OBSERVABILITY.md")
 #: ``dks_slo_*``/``dks_alerts_*`` series; ``wire`` and ``staging`` when
 #: the streaming hot path landed ``dks_wire_*``/``dks_staging_*``;
 #: ``treeshap`` when the exact path's fallback accounting landed
-#: ``dks_treeshap_*``.
+#: ``dks_treeshap_*``; ``autoscale`` when the elastic-fleet scaler
+#: landed ``dks_autoscale_*``.
 _LITERAL_RE = re.compile(
-    r"dks_(?:serve|fanin|sched|phase|slo|alerts|wire|staging|treeshap)"
-    r"_[a-z0-9_]+")
+    r"dks_(?:serve|fanin|sched|phase|slo|alerts|wire|staging|treeshap|"
+    r"autoscale)_[a-z0-9_]+")
 
 #: directories never scanned for literals/renderers
 _SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "results", "data",
@@ -51,6 +52,7 @@ def live_catalog():
     """Instantiate the real components and collect their registries'
     self-description — the ground truth the docs are diffed against."""
 
+    from distributedkernelshap_tpu.serving.autoscaler import Autoscaler
     from distributedkernelshap_tpu.serving.replicas import FanInProxy
     from distributedkernelshap_tpu.serving.server import ExplainerServer
 
@@ -58,9 +60,12 @@ def live_catalog():
         pass
 
     # cache enabled so the conditional cache series register; neither
-    # component is start()ed — registration happens in __init__
+    # component is start()ed — registration happens in __init__.  The
+    # autoscaler registers its dks_autoscale_* series on the proxy's
+    # registry (fleet=None: metrics-only construction, no control loop).
     server = ExplainerServer(_StubModel(), cache_bytes=1024)
     proxy = FanInProxy([("127.0.0.1", 1)])
+    Autoscaler(None, proxy)
     described = server.metrics.describe() + proxy.metrics.describe()
     return {d["name"]: d for d in described}
 

@@ -1,0 +1,601 @@
+"""Elastic SLO-driven autoscaling (``serving/autoscaler.py``): decision
+logic against a real ``FanInProxy`` (fake targets, patched signals),
+the replica lifecycle states and drain semantics, the admission
+estimator's ``capacity_hint``, supervisor retirement, the ``scaler.tick``
+chaos site, and one full in-process spawn→warm→admit→drain→retire cycle
+against real ``ExplainerServer`` replicas."""
+
+import time
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu.resilience.faults import (
+    FaultInjector,
+    parse_faults,
+)
+from distributedkernelshap_tpu.resilience.supervisor import ReplicaSupervisor
+from distributedkernelshap_tpu.scheduling.admission import (
+    AdmissionController,
+    ServiceRateEstimator,
+)
+from distributedkernelshap_tpu.serving.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    _ScalerCrashed,
+)
+from distributedkernelshap_tpu.serving.replicas import FanInProxy
+
+
+# --------------------------------------------------------------------- #
+# capacity_hint (scheduling/admission.py satellite)
+# --------------------------------------------------------------------- #
+
+
+def test_capacity_hint_rescales_the_ewma():
+    est = ServiceRateEstimator()
+    est.observe(100, 1.0)
+    est.capacity_hint(2)          # first call: baseline only
+    assert est.rows_per_s() == pytest.approx(100.0)
+    est.capacity_hint(4)          # capacity doubled: rate doubles NOW
+    assert est.rows_per_s() == pytest.approx(200.0)
+    est.capacity_hint(1)          # drained to a quarter
+    assert est.rows_per_s() == pytest.approx(50.0)
+
+
+def test_capacity_hint_without_observation_is_baseline_only():
+    est = ServiceRateEstimator()
+    est.capacity_hint(2)
+    est.capacity_hint(8)          # no observations yet: nothing to scale
+    assert est.rows_per_s() is None
+    est.observe(40, 1.0)          # later observations land unscaled
+    assert est.rows_per_s() == pytest.approx(40.0)
+
+
+def test_capacity_hint_rejects_nonpositive():
+    with pytest.raises(ValueError):
+        ServiceRateEstimator().capacity_hint(0)
+
+
+def test_admission_controller_delegates_capacity_hint():
+    est = ServiceRateEstimator()
+    est.observe(10, 1.0)
+    ctl = AdmissionController(estimator=est)
+    ctl.capacity_hint(1)
+    ctl.capacity_hint(3)
+    assert est.rows_per_s() == pytest.approx(30.0)
+    # and a controller without an estimator shrugs it off
+    AdmissionController(estimator=None).capacity_hint(5)
+
+
+# --------------------------------------------------------------------- #
+# replica lifecycle states on the proxy
+# --------------------------------------------------------------------- #
+
+
+def _proxy(n=1, **kwargs):
+    kwargs.setdefault("probe_interval_s", 3600)
+    kwargs.setdefault("health_interval_s", 0)
+    return FanInProxy([("127.0.0.1", 1 + i) for i in range(n)], **kwargs)
+
+
+def test_add_target_starts_warming_and_unroutable():
+    proxy = _proxy(1)
+    index = proxy.add_target("127.0.0.1", 99)
+    r = proxy.replicas[index]
+    assert r.state() == "warming" and not r.routable()
+    assert proxy.replica_state_counts()["warming"] == 1
+    # only the prober may declare it live; _pick must never return it
+    assert proxy._pick(set()).index == 0
+    assert proxy._pick({0}) is None
+
+
+def test_draining_replica_is_unroutable_but_alive():
+    proxy = _proxy(2)
+    proxy.start_drain(0)
+    r = proxy.replicas[0]
+    assert r.alive and r.draining and not r.routable()
+    assert r.state() == "draining"
+    # every pick lands on the survivor
+    for _ in range(4):
+        assert proxy._pick(set()).index == 1
+    proxy.finish_drain(0)
+    assert r.retired and not r.alive and r.state() == "retired"
+    assert proxy.replica_state_counts()["retired"] == 1
+
+
+def test_standby_held_out_until_activation():
+    proxy = _proxy(1)
+    index = proxy.add_target("127.0.0.1", 99, standby=True)
+    r = proxy.replicas[index]
+    assert r.state() in ("standby",) and not r.routable()
+    # not yet probed ready: activation clears the flag but cannot admit
+    assert proxy.activate_standby(index) is False
+    r.standby = True              # back in the pool for the real case
+    r.warm_ready = True           # the prober's 200 verdict
+    r.warming = False
+    assert proxy.activate_standby(index) is True
+    assert r.routable() and r.state() == "ready"
+
+
+# --------------------------------------------------------------------- #
+# supervisor retirement (resilience/supervisor.py satellite)
+# --------------------------------------------------------------------- #
+
+
+class _FakeProc:
+    def __init__(self, returncode=None):
+        self.returncode = returncode
+
+    def poll(self):
+        return self.returncode
+
+
+def test_supervisor_never_respawns_a_retired_replica():
+    from distributedkernelshap_tpu.resilience.supervisor import (
+        RestartPolicy,
+    )
+
+    spawned = []
+    procs = [_FakeProc(returncode=0)]  # already exited
+    sup = ReplicaSupervisor(
+        procs, lambda i: spawned.append(i) or _FakeProc(),
+        policy=RestartPolicy(base_backoff_s=0.001, max_backoff_s=0.001),
+        poll_interval_s=3600)
+    sup.retire(0)
+    for _ in range(3):
+        sup._tick()
+        time.sleep(0.01)
+    assert spawned == []          # the exit was the goal
+    assert sup.is_retired(0)
+    assert sup.stats()["retired"] == 1
+    # track() reuses the slot for a scaler-spawned replacement
+    sup.track(0)
+    assert not sup.is_retired(0)
+    sup._tick()                   # schedules the respawn (backoff)...
+    time.sleep(0.05)
+    sup._tick()                   # ...and performs it
+    assert spawned == [0]         # supervision resumed
+
+
+# --------------------------------------------------------------------- #
+# scaler.tick fault site (resilience/faults.py satellite)
+# --------------------------------------------------------------------- #
+
+
+def test_fire_crash_thread_scope_returns_instead_of_exiting():
+    injector = FaultInjector(parse_faults("crash:site=scaler.tick"))
+    # process scope would os._exit(42) and kill the test runner; thread
+    # scope must RETURN the kind so the caller's loop can die alone
+    assert injector.fire("scaler.tick", crash_scope="thread") == "crash"
+
+
+def test_scaler_tick_crash_kills_only_the_loop():
+    proxy = _proxy(1)
+    scaler = Autoscaler(_FakeFleet(proxy), proxy,
+                        config=AutoscalerConfig(max_replicas=2),
+                        fault_injector=FaultInjector(
+                            parse_faults("crash:site=scaler.tick")))
+    with pytest.raises(_ScalerCrashed):
+        scaler.tick()
+    # the fleet is untouched: still one ready replica, nothing draining
+    counts = proxy.replica_state_counts()
+    assert counts["ready"] == 1 and counts["draining"] == 0
+
+
+# --------------------------------------------------------------------- #
+# decision logic (real proxy, fake fleet, patched signals)
+# --------------------------------------------------------------------- #
+
+
+class _FakeFleet:
+    def __init__(self, proxy):
+        self.proxy = proxy
+        self.spawned = []
+        self.retired = []
+
+    def spawn_replica(self, standby=False):
+        index = self.proxy.add_target("127.0.0.1",
+                                      90 + len(self.proxy.replicas),
+                                      standby=standby)
+        self.spawned.append((index, standby))
+        return index
+
+    def retire_replica(self, index):
+        self.retired.append(index)
+        self.proxy.finish_drain(index)
+
+
+_IDLE_DETAIL = {"queue_depths": {}, "in_flight_batches": 0,
+                "service_rate_rows_per_s": 10.0,
+                "rows_served_total": 0,
+                "projected_wait_s": {"interactive": 0.0}}
+
+
+def _scaler(proxy, **cfg_kwargs):
+    cfg_kwargs.setdefault("min_replicas", 1)
+    cfg_kwargs.setdefault("max_replicas", 4)
+    cfg_kwargs.setdefault("up_ticks", 1)
+    cfg_kwargs.setdefault("interval_s", 0.05)
+    fleet = _FakeFleet(proxy)
+    scaler = Autoscaler(fleet, proxy,
+                        config=AutoscalerConfig(**cfg_kwargs))
+    scaler._replica_detail = lambda r: dict(_IDLE_DETAIL)
+    return scaler, fleet
+
+
+def _feed_rate(proxy, slope_recent, slope_old=None, span_s=12.0):
+    """Write a dks_fanin_forwarded_total counter history into the
+    proxy's health store: ``slope_old`` req/s until 2 s ago, then
+    ``slope_recent`` req/s (defaults to a flat rate)."""
+
+    store = proxy.health.store
+    slope_old = slope_recent if slope_old is None else slope_old
+    now = time.time()
+    value = 0.0
+    t = now - span_s
+    while t <= now:
+        value += (slope_recent if t > now - 2.0 else slope_old) * 0.5
+        store.add("dks_fanin_forwarded_total", t, value, kind="counter")
+        t += 0.5
+
+
+def test_scale_up_on_breached_slo():
+    proxy = _proxy(1)
+    scaler, fleet = _scaler(proxy)
+    proxy.health.slo_statuses = lambda now=None: [
+        {"name": "interactive_latency", "breached": True}]
+    sig = scaler.tick()
+    assert sig["breached_slos"] == ["interactive_latency"]
+    assert [s for s, standby in fleet.spawned if not standby]
+    assert proxy.replica_state_counts()["warming"] == 1
+
+
+def test_scale_up_on_queue_wait_projection():
+    proxy = _proxy(1)
+    scaler, fleet = _scaler(proxy)
+    scaler.estimator.observe(10, 1.0)  # fleet serves ~10 rows/s
+    busy = dict(_IDLE_DETAIL, queue_depths={"interactive": 20})
+    scaler._replica_detail = lambda r: dict(busy)
+    scaler.tick()                      # projected wait 20/10 = 2 s
+    assert fleet.spawned
+
+
+def test_predictive_prewarm_on_rate_trend():
+    proxy = _proxy(1)
+    scaler, fleet = _scaler(proxy, trend_factor=1.5,
+                            trend_window_short_s=2.0,
+                            trend_window_long_s=10.0,
+                            trend_min_utilization=0.4)
+    scaler.estimator.observe(12, 1.0)
+    _feed_rate(proxy, slope_recent=10.0, slope_old=1.0)
+    # rows_served_total must actually move: utilization is served ROWS
+    # over rows/s capacity, so a ramp in request counts alone (cache
+    # hits, errors) cannot pre-warm.  First tick primes the demand
+    # differentiator, second sees the rising counter and fires.
+    rows = {"n": 0.0}
+
+    def _busy_detail(r):
+        rows["n"] += 5.0
+        return dict(_IDLE_DETAIL, rows_served_total=rows["n"])
+
+    scaler._replica_detail = _busy_detail
+    scaler.tick()
+    sig = scaler.tick()
+    assert sig["rate_short_rps"] > 1.5 * sig["rate_long_rps"]
+    assert sig["utilization"] is not None and sig["utilization"] >= 0.4
+    assert fleet.spawned
+    # and the decision is attributed to the trend signal
+    decisions = proxy.metrics.get("dks_autoscale_decisions_total")
+    assert decisions.value(action="scale_up", reason="rate_trend") == 1
+
+
+def test_flat_traffic_never_triggers_the_trend():
+    proxy = _proxy(1)
+    scaler, fleet = _scaler(proxy)
+    scaler.estimator.observe(12, 1.0)
+    _feed_rate(proxy, slope_recent=5.0)
+    scaler.tick()
+    assert not fleet.spawned
+
+
+def test_scale_up_holds_at_max_replicas():
+    proxy = _proxy(1)
+    scaler, fleet = _scaler(proxy, max_replicas=1)
+    proxy.health.slo_statuses = lambda now=None: [
+        {"name": "x", "breached": True}]
+    scaler.tick()
+    assert not fleet.spawned
+    decisions = proxy.metrics.get("dks_autoscale_decisions_total")
+    assert decisions.value(action="hold", reason="max_replicas") == 1
+
+
+def test_crashed_replica_counts_against_max():
+    """A "down" replica is about to be respawned by the supervisor — the
+    scaler must not spawn a replacement the restart then overshoots."""
+
+    proxy = _proxy(2)
+    scaler, fleet = _scaler(proxy, max_replicas=2)
+    dead = proxy.replicas[1]
+    dead.alive, dead.warming = False, False   # crashed, not warming
+    assert dead.state() == "down"
+    proxy.health.slo_statuses = lambda now=None: [
+        {"name": "x", "breached": True}]
+    scaler.tick()
+    assert not fleet.spawned
+    decisions = proxy.metrics.get("dks_autoscale_decisions_total")
+    assert decisions.value(action="hold", reason="max_replicas") == 1
+
+
+def test_up_cooldown_blocks_back_to_back_spawns():
+    proxy = _proxy(1)
+    scaler, fleet = _scaler(proxy, max_replicas=4, up_cooldown_s=60.0)
+    proxy.health.slo_statuses = lambda now=None: [
+        {"name": "x", "breached": True}]
+    scaler.tick()
+    assert len(fleet.spawned) == 1
+    scaler.tick()                      # still breached, but cooling down
+    assert len(fleet.spawned) == 1
+    decisions = proxy.metrics.get("dks_autoscale_decisions_total")
+    assert decisions.value(action="hold", reason="cooldown") >= 1
+
+
+def test_hysteresis_requires_consecutive_up_ticks():
+    proxy = _proxy(1)
+    scaler, fleet = _scaler(proxy, up_ticks=3)
+    proxy.health.slo_statuses = lambda now=None: [
+        {"name": "x", "breached": True}]
+    scaler.tick()
+    scaler.tick()
+    assert not fleet.spawned           # 2 of 3
+    scaler.tick()
+    assert fleet.spawned
+
+
+def test_scale_down_drains_then_retires():
+    proxy = _proxy(2)
+    scaler, fleet = _scaler(proxy, down_ticks=2, down_cooldown_s=0.0,
+                            drain_settle_polls=2)
+    _feed_rate(proxy, slope_recent=1.0)    # flat traffic, ~20 capacity
+    scaler.tick()                          # primes the demand snapshot
+    scaler.tick()                          # demand 0 rows/s: streak 1
+    assert not proxy.replicas[1].draining
+    scaler.tick()                          # streak 2: drain starts
+    assert proxy.replicas[1].draining      # LIFO victim
+    assert fleet.retired == []
+    scaler.tick()                          # idle poll 1
+    scaler.tick()                          # idle poll 2: retire
+    assert fleet.retired == [1]
+    assert proxy.replicas[1].retired
+    # the survivor is at min_replicas: no further drain ever
+    for _ in range(6):
+        scaler.tick()
+    assert proxy.replica_state_counts()["ready"] == 1
+
+
+def test_scale_down_holds_at_min_replicas():
+    proxy = _proxy(1)
+    scaler, fleet = _scaler(proxy, down_ticks=1, down_cooldown_s=0.0)
+    _feed_rate(proxy, slope_recent=0.5)
+    scaler.estimator.observe(10, 1.0)
+    for _ in range(4):
+        scaler.tick()
+    assert not proxy.replicas[0].draining and not fleet.retired
+
+
+def test_scale_down_held_while_warming():
+    proxy = _proxy(2)
+    scaler, fleet = _scaler(proxy, down_ticks=1, down_cooldown_s=0.0)
+    proxy.add_target("127.0.0.1", 99)      # a warming scale-up in flight
+    _feed_rate(proxy, slope_recent=0.5)
+    scaler.estimator.observe(20, 1.0)
+    scaler.tick()
+    assert not any(r.draining for r in proxy.replicas)
+
+
+def test_queue_pressure_blocks_scale_down():
+    proxy = _proxy(2)
+    scaler, fleet = _scaler(proxy, down_ticks=1, down_cooldown_s=0.0)
+    _feed_rate(proxy, slope_recent=0.5)
+    busy = dict(_IDLE_DETAIL, queue_depths={"batch": 3})
+    scaler._replica_detail = lambda r: dict(busy)
+    scaler.tick()
+    assert not any(r.draining for r in proxy.replicas)
+
+
+def test_drain_tolerates_transient_statusz_misses():
+    """One failed /statusz poll on a draining victim must NOT force the
+    SIGTERM — only a replica dark for 3 consecutive polls (crashed
+    mid-drain) is forced early; drain_timeout_s backstops the rest."""
+
+    proxy = _proxy(2)
+    scaler, fleet = _scaler(proxy, down_ticks=1, down_cooldown_s=0.0,
+                            drain_settle_polls=2, drain_timeout_s=3600)
+    scaler._scale_down(time.monotonic())
+    assert proxy.replicas[1].draining
+    answers = iter([None, dict(_IDLE_DETAIL), None, None, None])
+    scaler._replica_detail = lambda r: next(answers)
+    scaler._poll_draining(time.monotonic())   # miss 1: keep draining
+    assert not proxy.replicas[1].retired and fleet.retired == []
+    scaler._poll_draining(time.monotonic())   # reachable: miss reset
+    scaler._poll_draining(time.monotonic())   # miss 1
+    scaler._poll_draining(time.monotonic())   # miss 2
+    assert not proxy.replicas[1].retired
+    scaler._poll_draining(time.monotonic())   # miss 3: forced
+    assert proxy.replicas[1].retired and fleet.retired == [1]
+
+
+def test_retired_slot_is_reused_by_add_target():
+    """Scale cycles must not grow the roster forever: a retired slot's
+    index is recycled for the next dynamically added address."""
+
+    proxy = _proxy(2)
+    proxy.start_drain(1)
+    proxy.finish_drain(1)
+    assert proxy.replicas[1].retired
+    index = proxy.add_target("127.0.0.1", 777)
+    assert index == 1                      # recycled, not appended
+    assert len(proxy.replicas) == 2
+    r = proxy.replicas[1]
+    assert r.port == 777 and not r.retired and r.state() == "warming"
+    # pinning a non-retired slot is refused
+    with pytest.raises(ValueError):
+        proxy.add_target("127.0.0.1", 778, index=0)
+
+
+def test_warm_standby_pool_fills_and_activates_first():
+    proxy = _proxy(1)
+    fleet = _FakeFleet(proxy)
+    scaler = Autoscaler(fleet, proxy, config=AutoscalerConfig(
+        min_replicas=1, max_replicas=3, warm_standby=1, up_ticks=1,
+        interval_s=0.05))
+    scaler._replica_detail = lambda r: dict(_IDLE_DETAIL)
+    scaler._replenish_standby()
+    assert fleet.spawned == [(1, True)]
+    # the prober declares it warm; a scale-up then ACTIVATES instead of
+    # spawning serving capacity (the replenish spawn is a standby again)
+    standby = proxy.replicas[1]
+    standby.warm_ready, standby.warming = True, False
+    proxy.health.slo_statuses = lambda now=None: [
+        {"name": "x", "breached": True}]
+    scaler.tick()
+    assert standby.routable() and standby.state() == "ready"
+    assert [s for _, s in fleet.spawned] == [True, True]
+
+
+def test_capacity_hint_applied_when_capacity_actually_moves():
+    proxy = _proxy(1)
+    scaler, fleet = _scaler(proxy)
+    scaler.estimator.observe(10, 1.0)
+    scaler.capacity_hint(1)
+    proxy.health.slo_statuses = lambda now=None: [
+        {"name": "x", "breached": True}]
+    scaler.tick()                          # spawn: replica 1 warming
+    # a warming replica serves nothing — the projection must NOT be
+    # credited before the prober admits it
+    assert scaler.estimator.rows_per_s() == pytest.approx(10.0)
+    # prober admits it: ready 1 -> 2; the next gather reconciles the
+    # hint BEFORE folding in the new capacity observation
+    added = proxy.replicas[1]
+    added.alive, added.warming = True, False
+    proxy.health.slo_statuses = lambda now=None: []
+    scaler.tick()
+    assert scaler.estimator.rows_per_s() == pytest.approx(20.0)
+
+
+def test_capacity_hint_on_standby_activation_is_immediate():
+    proxy = _proxy(1)
+    fleet = _FakeFleet(proxy)
+    scaler = Autoscaler(fleet, proxy, config=AutoscalerConfig(
+        min_replicas=1, max_replicas=3, warm_standby=1, up_ticks=1,
+        interval_s=0.05))
+    scaler._replica_detail = lambda r: dict(_IDLE_DETAIL)
+    scaler.estimator.observe(10, 1.0)
+    scaler.capacity_hint(1)
+    scaler._replenish_standby()
+    standby = proxy.replicas[1]
+    standby.warm_ready, standby.warming = True, False
+    proxy.health.slo_statuses = lambda now=None: [
+        {"name": "x", "breached": True}]
+    scaler.tick()                          # activates: ready 1 -> 2 NOW
+    assert standby.state() == "ready"
+    assert scaler.estimator.rows_per_s() == pytest.approx(20.0)
+
+
+def test_statusz_panel_shape():
+    proxy = _proxy(1)
+    scaler, _ = _scaler(proxy)
+    panel = proxy._statusz_detail()["autoscaler"]
+    assert panel["bounds"] == [1, 4]
+    assert {"states", "last_decision", "signals", "ticks_total",
+            "cooldown_up_remaining_s", "draining_age_s"} <= set(panel)
+
+
+# --------------------------------------------------------------------- #
+# server /statusz: the scaler's queue-pressure inputs
+# --------------------------------------------------------------------- #
+
+
+def test_server_statusz_reports_rate_and_projected_wait():
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    class _StubModel:
+        pass
+
+    server = ExplainerServer(_StubModel())
+    detail = server._statusz_detail()
+    assert detail["service_rate_rows_per_s"] is None
+    assert detail["projected_wait_s"] is None   # no observations yet
+    server._service_rate.observe(10, 1.0)
+
+    class _Item:
+        klass, deadline, t_enqueued, rows, done = \
+            "interactive", None, time.monotonic(), 5, False
+
+    server._sched.put(_Item())
+    detail = server._statusz_detail()
+    assert detail["service_rate_rows_per_s"] == pytest.approx(10.0)
+    # the cumulative served-rows counter the autoscaler differentiates
+    # into a rows/s demand (unit-compatible with the capacity EWMA)
+    assert detail["rows_served_total"] == 10
+    # 5 rows ahead of a fresh interactive request at 10 rows/s
+    assert detail["projected_wait_s"]["interactive"] == pytest.approx(
+        0.5, abs=0.05)
+
+
+# --------------------------------------------------------------------- #
+# full in-process cycle: spawn -> warm -> admit -> drain -> retire
+# --------------------------------------------------------------------- #
+
+
+def test_full_scale_cycle_with_real_replicas():
+    from benchmarks.autoscale_bench import (
+        DIM,
+        LocalFleet,
+        SyntheticServedModel,
+        _post_with_retry,
+    )
+
+    fleet = LocalFleet(lambda: SyntheticServedModel(base_s=0.005,
+                                                    per_row_s=0.005),
+                       max_batch_size=4).start(1)
+    scaler = None
+    try:
+        assert fleet.wait_ready(30)
+        scaler = Autoscaler(fleet, fleet.proxy, config=AutoscalerConfig(
+            min_replicas=1, max_replicas=2, interval_s=0.1,
+            drain_settle_polls=1, drain_timeout_s=10.0))
+        t0 = time.monotonic()
+        scaler._scale_up("queue_wait", t0)
+        index = max(fleet.servers)
+        assert fleet.proxy.replicas[index].state() == "warming"
+        # the prober admits it the moment its warmup ladder finishes
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and \
+                not fleet.proxy.replicas[index].routable():
+            time.sleep(0.05)
+        assert fleet.proxy.replicas[index].routable()
+        assert fleet.servers[index].warmup_status()["state"] == "done"
+        status, payload, _ = _post_with_retry(
+            fleet.proxy.host, fleet.proxy.port,
+            np.ones((1, DIM), np.float32), {})
+        assert status == 200 and "echo" in payload
+        # drain it back down: unroutable immediately, retired once idle
+        scaler._scale_down(time.monotonic())
+        assert fleet.proxy.replicas[index].draining
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and \
+                not fleet.proxy.replicas[index].retired:
+            scaler._poll_draining(time.monotonic())
+            time.sleep(0.1)
+        assert fleet.proxy.replicas[index].retired
+        assert fleet.servers[index]._stop.is_set()
+        # the survivor still serves
+        status, _, _ = _post_with_retry(
+            fleet.proxy.host, fleet.proxy.port,
+            np.ones((1, DIM), np.float32), {})
+        assert status == 200
+    finally:
+        if scaler is not None:
+            scaler.stop()
+        fleet.stop()

@@ -124,6 +124,23 @@ def test_informational_metrics_are_recorded_not_gated(history):
     assert "goodput_rps" not in report["benches"][0]["comparisons"]
 
 
+def test_replica_seconds_is_gated_like_wall(history):
+    """The autoscale bench's provisioning cost: its wall is a fixed
+    open-loop trace, so ``replica_seconds`` is the number a scaler
+    regression moves — it must gate at the wall threshold, not ride
+    along as informational."""
+
+    for _ in range(3):
+        record_run(history, "autoscale", CFG,
+                   {"wall_s": 80.0, "replica_seconds": 160.0})
+    record_run(history, "autoscale", CFG,
+               {"wall_s": 80.0, "replica_seconds": 208.0})  # +30%
+    report = gate(history)
+    assert not report["ok"]
+    comp = report["benches"][0]["comparisons"]["replica_seconds"]
+    assert comp["regressed"] and comp["ratio"] == pytest.approx(1.3)
+
+
 def test_newer_different_config_run_cannot_mask_a_regression(history):
     """Gating only the single newest entry would hand a fresh config
     fingerprint a free 'new baseline' pass that buries the regressed

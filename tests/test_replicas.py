@@ -557,6 +557,131 @@ def test_fanin_429_reroutes_then_sheds_when_all_saturated():
         ok.stop()
 
 
+def test_prober_admits_replica_added_mid_run_on_unseen_address():
+    """Dynamic-add path (the autoscaler's scale-up): a replica registered
+    mid-run on a previously-unseen address starts OUT of rotation and is
+    admitted by the prober the moment its /healthz answers 200 — only
+    fixed-roster down→up recovery was tested before."""
+
+    first = _SchedFakeReplica("echo")
+    proxy = FanInProxy([("127.0.0.1", first.port)],
+                       probe_interval_s=0.2).start()
+    second = None
+    try:
+        status, _, _ = _request_with_headers(proxy.host, proxy.port, {})
+        assert status == 200
+
+        second = _SchedFakeReplica("echo")
+        index = proxy.add_target("127.0.0.1", second.port)
+        r = proxy.replicas[index]
+        # registered but NOT routable until the prober declares it live
+        assert not r.routable() and r.state() == "warming"
+
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not r.alive:
+            time.sleep(0.05)
+        assert r.alive and r.routable(), \
+            "prober never admitted the dynamically added replica"
+
+        # round-robin now reaches the new address with real traffic
+        for _ in range(4):
+            status, _, _ = _request_with_headers(proxy.host, proxy.port, {})
+            assert status == 200
+        assert second.requests > 0
+    finally:
+        proxy.stop()
+        first.stop()
+        if second is not None:
+            second.stop()
+
+
+def test_draining_replica_rejects_new_forwards_in_flight_returns():
+    """Drain semantics (the autoscaler's scale-down): once a replica is
+    marked draining, NO new request may be forwarded to it — but a
+    request already in flight on it still returns its answer."""
+
+    slow = _FakeReplica("hang", delay_s=1.5)     # in-flight holder
+    fast = _SchedFakeReplica("echo")
+    proxy = FanInProxy([("127.0.0.1", slow.port),
+                        ("127.0.0.1", fast.port)],
+                       probe_interval_s=3600).start()
+    inflight = {}
+
+    def fire():
+        # round-robin cursor starts at replica 0 (the slow one)
+        inflight["result"] = _request(proxy.host, proxy.port, timeout=30)
+
+    try:
+        t = threading.Thread(target=fire, daemon=True)
+        t.start()
+        time.sleep(0.3)                          # request now on `slow`
+        proxy.start_drain(0)
+        # new forwards all land on the survivor
+        for _ in range(3):
+            status, _, _ = _request_with_headers(proxy.host, proxy.port, {})
+            assert status == 200
+        assert fast.requests == 3
+        # the in-flight answer still comes back from the draining replica
+        t.join(timeout=30)
+        assert inflight["result"][0] == 200
+        assert proxy.replicas[0].alive and proxy.replicas[0].draining
+        proxy.finish_drain(0)
+        assert proxy.replicas[0].retired
+        # the prober must never resurrect a retired replica, even though
+        # its server still answers /healthz 200
+        time.sleep(0.5)
+        assert not proxy.replicas[0].alive
+    finally:
+        proxy.stop()
+        slow.stop()
+        fast.stop()
+
+
+@pytest.mark.slow
+def test_replica_manager_dynamic_spawn_and_retire():
+    """The subprocess fleet's elastic hooks: ``spawn_replica`` launches a
+    real worker (pre-warming through the DKS_WARMUP ladder; the prober
+    admits it on readiness), ``retire_replica`` SIGTERMs it after a
+    drain with the supervisor marking the exit as on-purpose (no
+    restart)."""
+
+    m = ReplicaManager(1, factory=FACTORY, pin_devices=False,
+                       restart=True, env_extra=WORKER_ENV,
+                       max_batch_size=4, pipeline_depth=2,
+                       startup_timeout_s=240)
+    with m:
+        proxy = m.proxy
+        index = m.spawn_replica()
+        assert index == 1
+        r = proxy.replicas[index]
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline and not r.alive:
+            time.sleep(0.5)
+        assert r.alive, "spawned worker never admitted"
+        status, _ = _request(proxy.host, proxy.port)
+        assert status == 200
+        proxy.start_drain(index)
+        m.retire_replica(index, grace_s=30)
+        assert m.procs[index].poll() is not None
+        assert r.retired
+        assert m.supervisor.is_retired(index)
+        # the supervisor leaves the on-purpose exit alone
+        time.sleep(2.0)
+        assert m.supervisor.stats()["restarts_total"] == 0
+        status, _ = _request(proxy.host, proxy.port)
+        assert status == 200
+
+
+def test_autoscale_knob_requires_restart():
+    from distributedkernelshap_tpu.serving.autoscaler import (
+        AutoscalerConfig,
+    )
+
+    with pytest.raises(ValueError):
+        ReplicaManager(1, restart=False,
+                       autoscale=AutoscalerConfig(max_replicas=2))
+
+
 def test_fanin_slow_replica_times_out_without_eviction():
     """A replica slower than request_timeout_s earns its client a 504 but
     stays in rotation — slow is not dead (first compiles run minutes)."""
