@@ -74,6 +74,31 @@ def _total_budget() -> float:
     return float(os.environ.get("DKS_BENCH_BUDGET", "420"))
 
 
+def _phi_vs_exact_err(explainer, X, explanation, limit: int = 16):
+    """Max abs phi error of the measured sampled run against the exact
+    path on the first ``limit`` instances, when the fitted predictor
+    admits one (lifted tree ensemble or tensor-train structure at
+    identity link) — ``None`` otherwise (the headline Adult task runs a
+    logit-link linear model, which has no exact route).  TPU reruns then
+    carry accuracy alongside wall-clock and ``kernel_path``."""
+
+    try:
+        engine = explainer._explainer
+        if getattr(engine, "_exact_flavor", lambda: None)() is None \
+                or engine.config.link != "identity":
+            return None
+        exact = explainer.explain(X[:limit], silent=True,
+                                  nsamples="exact").shap_values
+        exact = exact if isinstance(exact, list) else [exact]
+        sampled = explanation.shap_values
+        sampled = sampled if isinstance(sampled, list) else [sampled]
+        return round(float(max(
+            np.abs(np.asarray(s)[:limit] - np.asarray(e)).max()
+            for s, e in zip(sampled, exact))), 8)
+    except Exception:
+        return None  # accuracy is a bonus field, never a bench failure
+
+
 def _device_probe(timeout_s: float):
     """Probe backend init in a subprocess; returns ``(ok, detail)``.
 
@@ -180,9 +205,15 @@ def run_benchmark(cpu_fallback: bool = False) -> int:
     # compile accounting for the whole run (fit + warmup + timed loop):
     # fresh = XLA compiled, cache_hit = the persistent compile cache
     # served the executable (non-zero only with DKS_COMPILE_CACHE_DIR) —
-    # BENCH_*.json then records cache effectiveness alongside wall time
+    # BENCH_*.json then records cache effectiveness alongside wall time.
+    # Snapshot BEFORE the accuracy probe: its exact-path rerun compiles a
+    # program the measured sampled run never touched
     compile_delta = compile_events().delta(compile_before,
                                            compile_events().snapshot())
+    # max abs phi error vs the exact path (tree/TN predictors at
+    # identity link; null when no exact route exists for the task)
+    record["phi_vs_exact_err"] = _phi_vs_exact_err(explainer, X_explain,
+                                                   explanation)
     record["compile_total"] = {
         k: int(v) for k, v in compile_delta["totals"].items()}
     record["compile_seconds_total"] = {

@@ -1,0 +1,413 @@
+"""Estimator-accuracy benchmark + CI gate (``make accuracy-gate``).
+
+The sampled KernelSHAP estimator pays for accuracy with ``nsamples`` —
+and until now nothing measured that trade against ground truth, so an
+estimator regression (a weighting bug, a broken sampler, a degraded
+solve) would ship as silently as a perf regression did before
+``make perf-gate``.  The exact paths close the loop: exact-TN
+(``ops/tensor_shap.py``) provides sampling-free ground truth at feature
+counts whose coalition spaces (``2^M``) no enumeration-based A/B —
+``results/exact_ab.jsonl`` included — could ever cover, and exact-tree
+(``ops/treeshap.py``) anchors a second model family.
+
+What one run does:
+
+* sweeps the sampled estimator across ``nsamples`` budgets on a
+  mid-size tensor-train model (M=24: 16.7M coalitions) and a lifted
+  GBT, recording the max-abs phi error against the exact path per
+  budget into ``results/accuracy_history.jsonl`` (same entry schema as
+  the perf history: git SHA + config fingerprint + metrics);
+* gates the newest run of each (bench, config) against the median of
+  its trailing same-config baselines with the ``regression_gate``
+  machinery — an error metric rising >50% over baseline (above a small
+  absolute floor) fails, exactly how ``wall_s`` fails the perf gate;
+* ``--check`` additionally asserts the structural criteria: error
+  decreases monotonically-ish with budget, the exact-TN path beats the
+  sampled path's per-instance wall-clock at matched phi error (the
+  sampled arm's most accurate budget still carries MORE error than the
+  exact path's zero, so beating its wall means exact dominates both
+  axes — self-recorded with ``checks_ok`` into
+  ``results/perf_history.jsonl`` so ``make perf-gate`` covers it), and
+  a synthetic degraded-estimator entry demonstrably fails the gate
+  (drilled against a throwaway copy of the history).
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.regression_gate import (  # noqa: E402
+    DEFAULT_HISTORY,
+    _median,
+    config_fingerprint,
+    load_history,
+    record_run,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ACCURACY_HISTORY = os.path.join(REPO_ROOT, "results",
+                                "accuracy_history.jsonl")
+
+#: allowed per-budget error increase over the trailing baseline median
+#: (fraction) — accuracy analog of regression_gate's wall threshold
+MAX_ERR_REGRESSION = 0.50
+#: absolute error floor below which ratios are noise (f32 phi on unit-
+#: scale models: a 2e-7 -> 4e-7 wobble is not a regression)
+ERR_ABS_FLOOR = 1e-6
+#: trailing runs folded into the baseline median
+BASELINE_N = 5
+
+#: default nsamples sweep (well under the TN model's 2^24 coalition
+#: space, so every budget genuinely samples)
+DEFAULT_BUDGETS = (128, 512, 2048)
+
+#: adjacent-budget tolerance for the monotonicity criterion: sampling
+#: error is stochastic in the seed, so "monotonically-ish" allows one
+#: budget step to backslide by up to this factor
+MONO_SLACK = 1.25
+
+
+# --------------------------------------------------------------------- #
+# models
+
+
+def build_tn_model(seed: int = 0):
+    """Mid-size tensor-train model + background/explain rows: M=24
+    features (2^24 coalitions — beyond any enumeration A/B), rank 4,
+    deterministic from the seed.  Cores are scaled so products stay
+    O(1) over 24 sites (the per-site scale ~ r^-1/2 keeps the chained
+    matmuls from exploding, mirroring how fitted surrogates come out)."""
+
+    from distributedkernelshap_tpu.models.tensor_net import (
+        TensorTrainPredictor,
+    )
+
+    rng = np.random.default_rng(seed)
+    M, r = 24, 4
+    dims = [1] + [r] * (M - 1) + [1]
+    scale = 1.0 / np.sqrt(r)
+    cores = []
+    for i in range(M):
+        A = rng.normal(scale=scale, size=(dims[i], dims[i + 1]))
+        B = rng.normal(scale=0.3 * scale, size=(dims[i], dims[i + 1]))
+        cores.append((A.astype(np.float32), B.astype(np.float32)))
+    pred = TensorTrainPredictor(cores)
+    bg = rng.normal(size=(32, M)).astype(np.float32)
+    X = rng.normal(size=(8, M)).astype(np.float32)
+    return pred, bg, X, {"family": "tn", "M": M, "rank": r,
+                         "n_bg": 32, "n_x": 8, "seed": seed}
+
+
+def build_tree_model(seed: int = 0):
+    """Small lifted GBT (exact-tree ground truth anchor): M=8 features,
+    sampled budgets below 2^8-2=254 genuinely sample."""
+
+    from sklearn.ensemble import HistGradientBoostingRegressor
+
+    rng = np.random.default_rng(seed)
+    M = 8
+    Xtr = rng.normal(size=(300, M))
+    y = (Xtr[:, 0] - np.where(Xtr[:, 2] > 0, 1.0, -1.0) * Xtr[:, 3]
+         + 0.5 * Xtr[:, 5])
+    gbr = HistGradientBoostingRegressor(max_iter=12,
+                                        random_state=seed).fit(Xtr, y)
+    bg = Xtr[:16].astype(np.float32)
+    X = Xtr[100:108].astype(np.float32)
+    # the 2^8-2=254 coalition space caps useful budgets well below the
+    # TN sweep's; this family brings its own so every point samples
+    return gbr.predict, bg, X, {"family": "tree", "M": M, "n_bg": 16,
+                                "n_x": 8, "seed": seed,
+                                "budgets_override": (32, 64, 128)}
+
+
+# --------------------------------------------------------------------- #
+# sweep
+
+
+def _phi_matrix(values) -> np.ndarray:
+    vals = values if isinstance(values, list) else [values]
+    return np.stack([np.asarray(v) for v in vals], 1)  # (B, K, M)
+
+
+def _timed_explain(explainer, X, reps: int = 3, **kw) -> float:
+    """Median wall seconds of ``explain`` (after the caller warmed it)."""
+
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        explainer.explain(X, silent=True, **kw)
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def sweep(builder, budgets, seed: int = 0, reps: int = 3) -> Dict:
+    """One model family's sweep: exact ground truth once, sampled phi +
+    wall per budget; returns errors, per-instance walls and the config
+    that fingerprints the measurement."""
+
+    from distributedkernelshap_tpu import KernelShap
+
+    pred, bg, X, config = builder(seed)
+    # budgets above the full coalition space silently enumerate (the
+    # parity regime tests pin); families whose space is small bring
+    # their own sweep so every point genuinely samples
+    budgets = config.pop("budgets_override", budgets)
+    config["budgets"] = list(map(int, budgets))
+
+    explainer = KernelShap(pred, seed=seed)
+    explainer.fit(bg)
+
+    explainer.explain(X, silent=True, nsamples="exact")  # compile
+    exact_wall = _timed_explain(explainer, X, reps=reps, nsamples="exact")
+    phi_exact = _phi_matrix(explainer.explain(
+        X, silent=True, nsamples="exact").shap_values)
+    scale = float(np.abs(phi_exact).max())
+
+    errors: Dict[int, float] = {}
+    walls: Dict[int, float] = {}
+    for b in budgets:
+        explainer.explain(X, silent=True, nsamples=b, l1_reg=False)
+        walls[b] = _timed_explain(explainer, X, reps=reps, nsamples=b,
+                                  l1_reg=False)
+        phi_b = _phi_matrix(explainer.explain(
+            X, silent=True, nsamples=b, l1_reg=False).shap_values)
+        errors[b] = float(np.abs(phi_b - phi_exact).max())
+
+    B = X.shape[0]
+    return {
+        "config": config,
+        "errors": errors,
+        "phi_scale": scale,
+        "exact_per_instance_s": exact_wall / B,
+        "sampled_per_instance_s": {b: w / B for b, w in walls.items()},
+        "kernel_path": explainer.kernel_path,
+    }
+
+
+# --------------------------------------------------------------------- #
+# gate
+
+
+def gate_accuracy(history_path: str = ACCURACY_HISTORY,
+                  max_err_regression: float = MAX_ERR_REGRESSION,
+                  abs_floor: float = ERR_ABS_FLOOR,
+                  baseline_n: int = BASELINE_N,
+                  recent_n: int = 10) -> Dict:
+    """Accuracy analog of ``regression_gate.gate``: for each benchmark
+    in the accuracy history, the newest run of every config fingerprint
+    in its trailing window is compared metric-by-metric (``err_n*``)
+    against the median of its last ``baseline_n`` same-config prior
+    runs.  Higher error than baseline by more than
+    ``max_err_regression`` (and above ``abs_floor``) fails; improving
+    never fails; first runs pass with a note."""
+
+    entries = load_history(history_path)
+    by_bench: Dict[str, List[Dict]] = {}
+    for e in entries:
+        by_bench.setdefault(e["bench"], []).append(e)
+    results = []
+    for _, runs in sorted(by_bench.items()):
+        newest_per_fp: Dict[str, Dict] = {}
+        for e in runs[-recent_n:]:
+            newest_per_fp[e.get("config_fp")] = e
+        for newest in sorted(newest_per_fp.values(), key=runs.index):
+            prior = runs[:runs.index(newest)]
+            baseline = [
+                e for e in prior
+                if e.get("config_fp") == newest.get("config_fp")
+                and e.get("extra", {}).get("checks_ok") is not False
+            ][-baseline_n:]
+            res = {"bench": newest["bench"],
+                   "config_fp": newest.get("config_fp"),
+                   "baseline_runs": len(baseline),
+                   "comparisons": {}, "ok": True}
+            if not baseline:
+                res["note"] = ("no prior run with this config "
+                               "fingerprint — recorded as the new "
+                               "baseline")
+                results.append(res)
+                continue
+            for metric, value in sorted(newest["metrics"].items()):
+                if not metric.startswith("err_"):
+                    continue
+                base_values = [e["metrics"][metric] for e in baseline
+                               if metric in e["metrics"]]
+                if not base_values:
+                    continue
+                base = _median(base_values)
+                regressed = (value > abs_floor
+                             and value > base * (1.0 + max_err_regression)
+                             and value - base > abs_floor)
+                res["comparisons"][metric] = {
+                    "value": value, "baseline_median": base,
+                    "regressed": regressed,
+                }
+                if regressed:
+                    res["ok"] = False
+            results.append(res)
+    report = {"history": history_path, "entries": len(entries),
+              "benches": results, "ok": all(r["ok"] for r in results)}
+    if not entries:
+        report["note"] = "empty history: nothing to gate"
+    return report
+
+
+def _record_sweep(history_path: str, bench: str, result: Dict,
+                  checks_ok: Optional[bool] = None) -> Dict:
+    metrics = {f"err_n{b}": e for b, e in result["errors"].items()}
+    metrics["exact_per_instance_s"] = result["exact_per_instance_s"]
+    extra = {"phi_scale": result["phi_scale"],
+             "sampled_per_instance_s": {
+                 str(b): w
+                 for b, w in result["sampled_per_instance_s"].items()},
+             "kernel_path": result["kernel_path"]}
+    if checks_ok is not None:
+        extra["checks_ok"] = checks_ok
+    return record_run(history_path, bench, result["config"], metrics,
+                      extra=extra)
+
+
+def _monotonic_ish(errors: Dict[int, float]) -> bool:
+    """Error must fall from the smallest to the largest budget overall,
+    with at most MONO_SLACK backsliding on any adjacent step (sampling
+    error is stochastic; strict monotonicity would flake)."""
+
+    budgets = sorted(errors)
+    if len(budgets) < 2:
+        return True
+    if not errors[budgets[-1]] < errors[budgets[0]]:
+        return False
+    return all(errors[budgets[i + 1]] <= errors[budgets[i]] * MONO_SLACK
+               for i in range(len(budgets) - 1))
+
+
+def _degraded_gate_drill(history_path: str) -> bool:
+    """Append a synthetic degraded-estimator entry (every error 3x the
+    newest real run's) to a THROWAWAY copy of the history and assert
+    the gate fails it — proof the gate would catch a real regression,
+    without poisoning the real baseline."""
+
+    entries = load_history(history_path)
+    if not entries:
+        return False
+    newest = entries[-1]
+    degraded_metrics = {
+        k: (v * 3.0 + 10 * ERR_ABS_FLOOR if k.startswith("err_") else v)
+        for k, v in newest["metrics"].items()}
+    tmpdir = tempfile.mkdtemp(prefix="dks_accuracy_drill_")
+    try:
+        tmp = os.path.join(tmpdir, "accuracy_history.jsonl")
+        shutil.copy(history_path, tmp)
+        record_run(tmp, newest["bench"], newest.get("config", {}),
+                   degraded_metrics, extra={"synthetic_drill": True})
+        report = gate_accuracy(tmp)
+        return report["ok"] is False
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+# --------------------------------------------------------------------- #
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--budgets", default=",".join(
+        map(str, DEFAULT_BUDGETS)),
+        help="comma-separated nsamples sweep")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--reps", type=int, default=3,
+                        help="timing repetitions per arm")
+    parser.add_argument("--history", default=ACCURACY_HISTORY,
+                        help="accuracy-history JSONL path")
+    parser.add_argument("--no-record", action="store_true",
+                        help="measure + gate without appending history")
+    parser.add_argument("--gate-only", action="store_true",
+                        help="gate the existing history, no new sweep")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 unless every criterion holds")
+    args = parser.parse_args(argv)
+
+    if args.gate_only:
+        report = gate_accuracy(args.history)
+        print(json.dumps(report))
+        return 0 if (report["ok"] or not args.check) else 1
+
+    budgets = [int(b) for b in args.budgets.split(",") if b.strip()]
+    tn = sweep(build_tn_model, budgets, seed=args.seed, reps=args.reps)
+    tree = sweep(build_tree_model, budgets, seed=args.seed,
+                 reps=args.reps)
+
+    # wall-clock criterion: at matched phi error the exact-TN path must
+    # beat the sampled path per instance.  The sampled arm's most
+    # accurate (largest) budget still carries more error than exact's
+    # zero, so its wall is the FLOOR of what matching exact accuracy
+    # would cost — exact beating it means exact dominates both axes.
+    best_budget = max(tn["sampled_per_instance_s"])
+    sampled_matched_s = tn["sampled_per_instance_s"][best_budget]
+    checks = {
+        "tn_error_monotonic_ish": _monotonic_ish(tn["errors"]),
+        "tree_error_monotonic_ish": _monotonic_ish(tree["errors"]),
+        "tn_exact_beats_sampled_wall": (
+            tn["exact_per_instance_s"] < sampled_matched_s),
+        "tn_exact_path_engaged": (
+            tn["kernel_path"].get("exact_phi") == "tn_dp"),
+    }
+
+    if not args.no_record:
+        _record_sweep(args.history, "estimator_accuracy_tn", tn,
+                      checks_ok=all(checks.values()))
+        _record_sweep(args.history, "estimator_accuracy_tree", tree,
+                      checks_ok=all(checks.values()))
+
+    gate_report = gate_accuracy(args.history)
+    checks["accuracy_gate_ok"] = bool(gate_report["ok"])
+    if not args.no_record and os.path.exists(args.history):
+        checks["degraded_entry_fails_gate"] = _degraded_gate_drill(
+            args.history)
+
+    if not args.no_record:
+        # perf-gate coverage of the wall criterion (PR 6 convention):
+        # wall_s is the exact-TN per-instance cost the criterion bounds
+        record_run(
+            DEFAULT_HISTORY, "estimator_accuracy",
+            dict(tn["config"], criterion="exact_vs_sampled_wall"),
+            {"wall_s": tn["exact_per_instance_s"],
+             "sampled_matched_per_instance_s": sampled_matched_s},
+            extra={"checks_ok": all(checks.values()),
+                   "matched_budget": int(best_budget)})
+
+    result = {
+        "bench": "estimator_accuracy",
+        "config_fp": config_fingerprint(tn["config"]),
+        "tn": {"errors": {str(b): e for b, e in tn["errors"].items()},
+               "phi_scale": tn["phi_scale"],
+               "exact_per_instance_s": round(
+                   tn["exact_per_instance_s"], 6),
+               "sampled_per_instance_s": {
+                   str(b): round(w, 6)
+                   for b, w in tn["sampled_per_instance_s"].items()},
+               "kernel_path": tn["kernel_path"]},
+        "tree": {"errors": {str(b): e
+                            for b, e in tree["errors"].items()},
+                 "phi_scale": tree["phi_scale"]},
+        "checks": checks,
+        "checks_ok": all(checks.values()),
+        "gate": gate_report,
+    }
+    print(json.dumps(result))
+    if args.check and not result["checks_ok"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -957,11 +957,17 @@ class KernelExplainerEngine:
 
             h = hashlib.sha256()
             linear = self.predictor.linear_decomposition
+            fp_bytes = getattr(self.predictor, 'fingerprint_bytes', None)
             if linear is not None:
                 W, b, activation = linear
                 h.update(np.asarray(W).tobytes())
                 h.update(np.asarray(b).tobytes())
                 h.update(activation.encode())
+            elif callable(fp_bytes):
+                # structured predictors (e.g. the tensor-train lift)
+                # publish their content bytes: equal bytes ARE the same
+                # device-cached contraction constants
+                h.update(fp_bytes())
             else:
                 h.update(repr(type(self.predictor)).encode())
             h.update(self.background.tobytes())
@@ -1181,18 +1187,44 @@ class KernelExplainerEngine:
 
         return finalize
 
+    def _exact_flavor(self) -> Optional[str]:
+        """Which closed-form exact path this engine's predictor admits:
+        ``'tree'`` (lifted ensemble, ``ops/treeshap.py``), ``'tn'``
+        (tensor-train structure, ``ops/tensor_shap.py``) or ``None``.
+        Trees win when a predictor somehow qualifies for both — the
+        packed path is the measured production route."""
+
+        from distributedkernelshap_tpu.ops.tensor_shap import supports_exact_tn
+        from distributedkernelshap_tpu.ops.treeshap import supports_exact
+
+        if supports_exact(self.predictor):
+            return 'tree'
+        if supports_exact_tn(self.predictor):
+            return 'tn'
+        return None
+
     def _exact_async_ready(self, interactions: bool = False) -> bool:
         """Whether ``nsamples='exact'`` can ride the pipelined hot path
-        (staging, donation, single packed D2H): a lifted tree ensemble
-        with identity link, off host-eval, phi-only.  Interactions stay on
-        the sync path (their fn computes phi + the pairwise matrices in
-        one program with a different output contract)."""
+        (staging, donation, single packed D2H): a lifted tree ensemble or
+        TT-structured predictor with identity link, off host-eval,
+        phi-only.  Interactions stay on the sync path (their fn computes
+        phi + the pairwise matrices in one program with a different
+        output contract; the TN path computes phi only)."""
 
         if interactions or self.config.host_eval:
             return False
-        from distributedkernelshap_tpu.ops.treeshap import supports_exact
+        flavor = self._exact_flavor()
+        if flavor == 'tree':
+            return self.config.link == 'identity'
+        if flavor == 'tn':
+            from distributedkernelshap_tpu.ops.tensor_shap import (
+                tn_exact_ready,
+            )
 
-        return supports_exact(self.predictor) and self.config.link == 'identity'
+            return tn_exact_ready(
+                self.predictor, self.config.link, self.G,
+                self.config.shap.target_chunk_elems) is None
+        return False
 
     def stage_rows(self, X: np.ndarray,
                    nsamples: Union[str, int, None] = None,
@@ -1430,11 +1462,16 @@ class KernelExplainerEngine:
             chunks = [X[i:i + c] for i in range(0, X.shape[0], c)]
 
         if nsamples == 'exact':
-            # sampling-free interventional TreeSHAP (ops/treeshap.py): no
-            # coalition plan, no WLS — the Shapley values of the lifted
-            # ensemble's raw margin in closed form
-            values = self._exact_tree_explanation(chunks, X, l1_reg,
-                                                  interactions=interactions)
+            # sampling-free closed-form Shapley: interventional TreeSHAP
+            # for lifted ensembles (ops/treeshap.py), the size-indexed DP
+            # contraction for tensor-train predictors (ops/tensor_shap.py)
+            # — no coalition plan, no WLS either way
+            if self._exact_flavor() == 'tn':
+                values = self._exact_tn_explanation(
+                    chunks, X, l1_reg, interactions=interactions)
+            else:
+                values = self._exact_tree_explanation(
+                    chunks, X, l1_reg, interactions=interactions)
             if batch_idx is not None:
                 return batch_idx, values
             return values
@@ -1660,8 +1697,12 @@ class KernelExplainerEngine:
         blocking ``finalize() -> {'shap_values', 'raw_prediction'}``.
         ``X`` may be a :class:`StagedRows` (its pre-uploaded, donatable
         device buffer feeds the entry directly — the serving staging
-        pipeline's zero-copy handoff, now covering exact requests too)."""
+        pipeline's zero-copy handoff, now covering exact requests too).
+        Tree and tensor-network flavors share this ONE dispatch contract
+        so the async serving path and the warmup ladder never branch."""
 
+        if self._exact_flavor() == 'tn':
+            return self._dispatch_exact_tn(X)
         from distributedkernelshap_tpu.ops.explain import (
             capture_kernel_paths,
         )
@@ -1695,6 +1736,137 @@ class KernelExplainerEngine:
             }
 
         return finalize
+
+    # ------------------------------------------------------------------ #
+    # exact tensor-network path (ops/tensor_shap.py)
+
+    def _exact_tn_consts(self):
+        """X-independent tensor-network contraction constants — the
+        padded TT cores/head, the Shapley size-weight Toeplitz table,
+        the background site values and normalised weights — device-
+        resident in the same content-fingerprint-keyed LRU cache as the
+        linear path's plan constants and the tree path's reach tensors
+        (identical invalidation contract: a refit builds a new engine;
+        in-place predictor mutation is not detected,
+        docs/PERFORMANCE.md)."""
+
+        reuse = self.config.plan_constant_cache is not False
+        key = ('exact_tn_consts', self.content_fingerprint())
+        if reuse and key in self._plan_consts_cache:
+            self._plan_consts_cache.move_to_end(key)
+            return self._plan_consts_cache[key]
+        from distributedkernelshap_tpu.ops.tensor_shap import weight_toeplitz
+
+        struct = self.predictor.tt_structure()
+        bgw = self.bg_weights.astype(np.float64)
+        consts = {
+            'A': struct['A'], 'B': struct['B'], 'head': struct['head'],
+            'Wt': jnp.asarray(weight_toeplitz(self.M)),
+            'bg': jnp.asarray(self.background),
+            'bgw': jnp.asarray((bgw / bgw.sum()).astype(np.float32)),
+        }
+        if reuse:
+            self._plan_consts_cache[key] = consts
+            while len(self._plan_consts_cache) > self._DEV_CACHE_MAX_ENTRIES:
+                self._plan_consts_cache.popitem(last=False)
+        return consts
+
+    def _exact_tn_fn(self):
+        """The jitted exact tensor-network batch entry ``(Xp, A, B, head,
+        Wt, bg, bgw) -> packed flat D2H vector`` — like :meth:`_exact_fn`
+        it is the ONE program behind the sync chunk loop, the async
+        serving path and the warmup ladder.  The per-call batch upload
+        (argnum 0) is donated; the consts arguments are cached device
+        buffers and never donated."""
+
+        td = self.config.shap.transfer_dtype
+        key = ('exact_tn_entry', td)
+        if key in self._fn_cache:
+            return self._fn_cache[key]
+        from distributedkernelshap_tpu.ops.tensor_shap import tensor_shap_phi
+
+        pred = self.predictor
+        precision = self.config.shap.matmul_precision
+
+        def fn(Xp, A, B, head, Wt, bg, bgw):
+            with jax.default_matmul_precision(precision):
+                phi = tensor_shap_phi(A, B, head, Wt, Xp, bg, bgw)
+                return pack_transfer(phi, pred(Xp), td)
+
+        self._fn_cache[key] = jit_batch_entry(fn, donate_argnums=(0,))
+        return self._fn_cache[key]
+
+    def _dispatch_exact_tn(self, X):
+        """TN counterpart of the tree :meth:`_dispatch_exact` body: same
+        StagedRows handling, same donated entry, same single packed
+        D2H and ``finalize`` contract."""
+
+        from distributedkernelshap_tpu.ops.explain import (
+            capture_kernel_paths,
+        )
+
+        if isinstance(X, StagedRows):
+            Xp, B = X.device, X.B
+            Bp = X.device.shape[0]
+        else:
+            Xp, B = self._pad_to_bucket(X)
+            Bp = Xp.shape[0]
+            Xp = jnp.asarray(Xp, jnp.float32)
+        consts = self._exact_tn_consts()
+        fn = self._exact_tn_fn()
+        td = self.config.shap.transfer_dtype
+        with capture_kernel_paths() as kp:
+            packed_out = fn(Xp, consts['A'], consts['B'], consts['head'],
+                            consts['Wt'], consts['bg'], consts['bgw'])
+        self._kernel_paths.update(kp)
+
+        def finalize() -> Dict[str, np.ndarray]:
+            K, M = self.predictor.n_outputs, self.M
+            phi, fx = unpack_transfer(packed_out, Bp * K * M, td)
+            return {
+                'shap_values': phi.reshape(Bp, K, M)[:B],
+                'raw_prediction': fx.reshape(Bp, K)[:B],
+            }
+
+        return finalize
+
+    def _exact_tn_explanation(self, chunks, X, l1_reg,
+                              interactions: bool = False):
+        """``nsamples='exact'`` for a tensor-train predictor: exact
+        Shapley values by the size-indexed DP contraction — no coalition
+        plan, no WLS, no sampling error.  Pipelined over instance chunks
+        exactly like the tree path."""
+
+        from distributedkernelshap_tpu.ops.tensor_shap import (
+            validate_exact_tn,
+        )
+
+        validate_exact_tn(self.predictor, self.config.link, self.G)
+        if interactions:
+            raise ValueError(
+                "interactions=True requires a lifted tree ensemble "
+                "(closed-form interaction matrices); the tensor-network "
+                "exact path computes phi only.")
+        if l1_reg not in (None, False, 0, 'auto'):
+            logger.warning(
+                "l1_reg=%r is ignored with nsamples='exact': there is no "
+                "sampling noise to regularise away.", l1_reg)
+
+        from distributedkernelshap_tpu.parallel.pipeline import (
+            resolve_window,
+            run_pipeline,
+        )
+
+        with profiler().phase('device_explain'):
+            results = run_pipeline(
+                chunks, self._dispatch_exact_tn, lambda fin: fin(),
+                window=resolve_window(self.config.dispatch_window,
+                                      n_items=len(chunks)))
+        phi = np.concatenate([r['shap_values'] for r in results], 0)
+        self.last_raw_prediction = np.concatenate(
+            [r['raw_prediction'] for r in results], 0)
+        self.last_X_fingerprint = _fingerprint(X)
+        return split_shap_values(phi, self.vector_out)
 
     def _exact_tree_explanation(self, chunks, X, l1_reg,
                                 interactions: bool = False):

@@ -14,6 +14,10 @@ from distributedkernelshap_tpu.models.svm import (  # noqa: F401
     SVMPredictor,
     lift_svm,
 )
+from distributedkernelshap_tpu.models.tensor_net import (  # noqa: F401
+    TensorTrainPredictor,
+    fit_tt_surrogate,
+)
 from distributedkernelshap_tpu.models.trees import (  # noqa: F401
     TreeEnsemblePredictor,
     lift_tree_ensemble,

@@ -1,0 +1,321 @@
+"""Sampling-free exact Shapley values for tensor-network predictors.
+
+For a predictor with tensor-train structure (``models/tensor_net.py``:
+``f(x) = e0 · Π_i (A_i + x_i B_i) · head``), the interventional Shapley
+values KernelSHAP *estimates* by sampling coalitions have a provably
+tractable closed form ("SHAP Meets Tensor Networks", arXiv:2510.21599).
+The derivation implemented here:
+
+* **Per background row the game is a product game.**  The masked-EY value
+  function is ``v(S) = Σ_n w_n f(x_S; z_n)`` with the composite row taking
+  ``x_i`` for sites in the coalition and ``z_{n,i}`` otherwise.  For one
+  background row the composite model value is the ordered matrix product
+  ``e0 · Π_i C_i · head`` with ``C_i = P_i := A_i + x_i B_i`` when site
+  ``i`` is in the coalition and ``C_i = Q_i := A_i + z_i B_i`` otherwise.
+  Shapley values are linear in the game, so ``phi = Σ_n w_n phi_n`` — the
+  background axis is an embarrassingly parallel sum (the mesh-sharding
+  axis, exactly how the exact TreeSHAP path decomposes).
+
+* **Size-indexed DP instead of 2^M enumeration.**  Shapley values only
+  need, for every site ``j`` and coalition size ``s``, the SUM over all
+  size-``s`` coalitions avoiding ``j`` of the product game's marginal —
+  and sums of ordered products factor through prefix/suffix recursions.
+  Sweeping sites once while carrying per-coalition-size accumulators:
+
+      L_j(a)  = Σ_{S ⊆ {1..j-1}, |S|=a}  e0 · Π_{i<j} C_i     (1, r)
+      T_j(b)  = Σ_{S ⊆ {j+1..M}, |S|=b}  Π_{i>j} C_i · head   (r, K)
+
+  with ``L_{j+1}(a) = L_j(a-1) P_j + L_j(a) Q_j`` (and the mirrored
+  suffix recursion), then
+
+      phi_j = Σ_s w_s Σ_{a+b=s} L_j(a) (P_j - Q_j) T_j(b),
+      w_s   = s! (M-1-s)! / M!
+
+  — exact marginals over ALL coalitions in ``O(M² r² K)`` per (instance,
+  background row) instead of ``2^M`` enumeration.  The kernel-SHAP
+  weighted-least-squares solve recovers exactly these ``w_s``-weighted
+  marginals when the coalition space is fully enumerated (pinned by
+  ``tests/test_tensor_shap.py``); here they are applied in closed form,
+  so there is no sampling error and no WLS solve.
+
+The batch entry vmaps instances, ``lax.map``s background rows (bounding
+the live DP intermediates to one row's worth) and contracts the weighted
+row sum with one einsum — which is also what makes the mesh-sharded
+variant (``parallel/``: rows sharded over the coalition axis, per-row
+phi all-gathered, the SAME final einsum replicated) bit-identical to the
+single-device run.
+
+Scope: identity link, identity grouping (each feature group is one
+tensor site, in column order) and raw TT outputs.  Everything else
+falls back to the sampled estimator, counted per reason in
+``dks_tensor_shap_fallback_total`` (mirroring the exact-TreeSHAP
+fallback accounting).
+"""
+
+import logging
+import threading
+from math import factorial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+# ---------------------------------------------------------------------- #
+# Fallback accounting (mirrors ops/treeshap.py): every reason the exact
+# tensor-network path declines a predictor that structurally has TT cores
+# is counted, so "why is this TN deployment still sampling?" is a metric,
+# not a debugging session.
+
+_fallback_lock = threading.Lock()
+_fallback_counts: Dict[str, float] = {}
+_fallback_logged: set = set()
+
+#: rank ceiling for the serving auto-selection: past this the O(M²r²K)
+#: DP stops being obviously cheaper than the sampled estimator and the
+#: per-row intermediates crowd VMEM/HBM — pin ``nsamples='exact'`` to
+#: force the path anyway
+TN_MAX_RANK = 64
+
+#: nominal batch size used by the X-independent footprint gate (the gate
+#: runs at auto-select time, before any request batch exists)
+_NOMINAL_GATE_B = 256
+
+
+def record_tn_fallback(reason: str, detail: str = "") -> None:
+    """Count one tensor-network exact-path demotion; warn on the first of
+    each reason."""
+
+    with _fallback_lock:
+        _fallback_counts[reason] = _fallback_counts.get(reason, 0.0) + 1.0
+        first = reason not in _fallback_logged
+        if first:
+            _fallback_logged.add(reason)
+    if first:
+        logger.warning(
+            "exact tensor-network Shapley declined a TT-structured "
+            "predictor (reason=%s%s); counted in "
+            "dks_tensor_shap_fallback_total — further occurrences are "
+            "counted silently", reason, f": {detail}" if detail else "")
+
+
+def tn_fallback_counts() -> Dict[Tuple[str, ...], float]:
+    """``{(reason,): count}`` — the registry-callback shape."""
+
+    with _fallback_lock:
+        return {(r,): n for r, n in _fallback_counts.items()}
+
+
+def attach_tensor_shap_metrics(registry) -> None:
+    """Register ``dks_tensor_shap_fallback_total{reason}`` on ``registry``
+    as a callback counter over the process-global fallback accounting."""
+
+    registry.counter(
+        "dks_tensor_shap_fallback_total",
+        "Exact tensor-network Shapley demotion EVENTS back to the sampled "
+        "estimator for predictors that carry TT cores, by reason "
+        "(grouping = non-identity feature grouping, link = non-identity "
+        "link would change the target quantity, rank = TT rank above "
+        "TN_MAX_RANK, footprint = DP intermediates exceed the chunk "
+        "budget).  Counted when the path decision is made (auto-select / "
+        "readiness probe), not per served request.",
+        labelnames=("reason",)).set_function(tn_fallback_counts)
+
+
+# ---------------------------------------------------------------------- #
+# Structure probes and gates
+
+
+def tt_structure(pred) -> Optional[Dict]:
+    """The predictor's padded tensor-train structure dict (``A``/``B``
+    ``(M, r, r)``, ``head (r, K)``, ``rank``, ``M``, ``K`` — see
+    ``models/tensor_net.py``) or ``None`` when the predictor has none.
+    Duck-typed on the ``tt_structure`` method so ops/ never imports
+    models/ at module scope."""
+
+    fn = getattr(pred, "tt_structure", None)
+    if fn is None:
+        return None
+    try:
+        return fn()
+    except Exception:  # a broken structure probe must never crash a path
+        logger.debug("tt_structure probe failed", exc_info=True)
+        return None
+
+
+def supports_exact_tn(pred) -> bool:
+    """Whether ``pred`` carries tensor-train structure with raw (identity)
+    outputs — the structural precondition of the exact contraction path
+    (gates beyond structure: :func:`tn_exact_ready`)."""
+
+    return (tt_structure(pred) is not None
+            and getattr(pred, "out_transform", "identity") == "identity")
+
+
+def _grouping_is_identity(G) -> bool:
+    G = np.asarray(G)
+    return (G.shape[0] == G.shape[1]
+            and np.array_equal(G, np.eye(G.shape[0], dtype=G.dtype)))
+
+
+def tn_exact_ready(pred, link: str, G,
+                   target_chunk_elems: Optional[int] = None
+                   ) -> Optional[str]:
+    """``None`` when the exact tensor-network path can serve this
+    (predictor, link, grouping), else the fallback reason string.  Shared
+    by the engine's async-readiness probe and the serving auto-selection
+    (which additionally records the reason)."""
+
+    struct = tt_structure(pred)
+    if (struct is None
+            or getattr(pred, "out_transform", "identity") != "identity"):
+        return "structure"
+    if link != "identity":
+        return "link"
+    if not _grouping_is_identity(G):
+        return "grouping"
+    r, M, K = struct["rank"], struct["M"], struct["K"]
+    if r > TN_MAX_RANK:
+        return "rank"
+    # footprint gate: the per-background-row DP intermediates (the stacked
+    # suffix accumulators dominate: B × M sites × M sizes × r × K plus the
+    # B × M × M × r prefixes) must fit the same chunk budget every other
+    # path honours
+    budget = target_chunk_elems or (1 << 25)
+    est = _NOMINAL_GATE_B * M * M * r * (max(K, 1) + 1)
+    if est > budget:
+        return "footprint"
+    return None
+
+
+def validate_exact_tn(pred, link: str, G) -> None:
+    """Raise with an actionable message when ``nsamples='exact'`` cannot
+    run the tensor-network contraction for this configuration."""
+
+    reason = tn_exact_ready(pred, link, G)
+    if reason is None:
+        return
+    detail = {
+        "structure": "the predictor exposes no tensor-train structure "
+                     "(lift it via models/tensor_net.py)",
+        "link": f"link={link!r} would change the target quantity; the "
+                "contraction explains the raw TT output — use "
+                "link='identity'",
+        "grouping": "the contraction treats each feature group as one "
+                    "tensor site in column order; non-identity groupings "
+                    "stay on the sampled path",
+        "rank": f"TT rank exceeds TN_MAX_RANK={TN_MAX_RANK}; pin a "
+                "sampled nsamples or refit a lower-rank surrogate",
+        "footprint": "the size-indexed DP intermediates exceed the chunk "
+                     "budget at this (M, rank); use the sampled path",
+    }[reason]
+    raise ValueError(
+        f"nsamples='exact' (tensor-network contraction) cannot apply: "
+        f"{detail}.")
+
+
+# ---------------------------------------------------------------------- #
+# Shapley size weights
+
+
+def shapley_size_weights(M: int) -> np.ndarray:
+    """``(M,)`` float32: ``w_s = s! (M-1-s)! / M!`` for ``s = 0..M-1`` —
+    the Shapley marginal weight of a size-``s`` coalition of the OTHER
+    ``M-1`` players.  Computed with exact integer arithmetic (Python
+    bigints; no lgamma rounding, no float64 overflow at any M) and
+    rounded once to float32."""
+
+    if M < 1:
+        raise ValueError(f"Need at least one site, got M={M}")
+    fM = factorial(M)
+    w = [factorial(s) * factorial(M - 1 - s) / fM for s in range(M)]
+    return np.asarray(w, dtype=np.float32)
+
+
+def weight_toeplitz(M: int) -> np.ndarray:
+    """``(M, M)`` float32 table ``Wt[a, b] = w_{a+b}`` (0 past ``M-1``):
+    the prefix-size × suffix-size weight coupling the DP contracts
+    against.  X-independent — cached device-resident by the engine."""
+
+    w = shapley_size_weights(M)
+    idx = np.arange(M)[:, None] + np.arange(M)[None, :]
+    return np.where(idx < M, w[np.minimum(idx, M - 1)], 0.0).astype(np.float32)
+
+
+# ---------------------------------------------------------------------- #
+# The size-indexed DP contraction
+
+
+def _phi_one(A, B, head, Wt, x, z):
+    """Exact Shapley values ``(K, M)`` of the product game for ONE
+    instance ``x`` against ONE background row ``z``.
+
+    ``A``/``B``: ``(M, r, r)`` padded TT cores, ``head``: ``(r, K)``,
+    ``Wt``: the :func:`weight_toeplitz` table.  One forward scan carries
+    the per-coalition-size prefix accumulators, one reverse scan the
+    suffixes; the site axis then contracts in three einsums — every op
+    is a dense matmul over ``(sizes, r)`` blocks, so the whole DP runs
+    on the MXU/VPU with no data-dependent control flow."""
+
+    M, r, _ = A.shape
+    K = head.shape[1]
+    P = A + x[:, None, None] * B                       # site in coalition
+    Q = A + z[:, None, None] * B                       # site from background
+
+    def lstep(L, PQ):
+        Pj, Qj = PQ
+        # L[a-1] enters via P (site joins the coalition), L[a] via Q
+        Lp = jnp.roll(L, 1, axis=0).at[0].set(0.0)
+        return Lp @ Pj + L @ Qj, L                     # emit L BEFORE site j
+
+    L0 = jnp.zeros((M, r), P.dtype).at[0, 0].set(1.0)  # e0: size-0 prefix
+    _, Ls = jax.lax.scan(lstep, L0, (P, Q))            # (M sites, M sizes, r)
+
+    def tstep(T, PQ):
+        Pj, Qj = PQ
+        Tp = jnp.roll(T, 1, axis=0).at[0].set(0.0)
+        Tnew = (jnp.einsum('rs,bsk->brk', Pj, Tp)
+                + jnp.einsum('rs,bsk->brk', Qj, T))
+        return Tnew, T                                 # emit T AFTER site j
+
+    T0 = jnp.zeros((M, r, K), P.dtype).at[0].set(head)
+    # reverse scan stacks outputs in forward site order: Ts[j] covers j+1..M
+    _, Ts = jax.lax.scan(tstep, T0, (P, Q), reverse=True)
+
+    D = P - Q                                          # the marginal's hole
+    Aj = jnp.einsum('jar,jrs->jas', Ls, D)             # (sites, sizes, r)
+    Ajw = jnp.einsum('ab,jas->jbs', Wt, Aj)            # weights folded in
+    return jnp.einsum('jbs,jbsk->kj', Ajw, Ts)         # (K, M)
+
+
+def tn_phi_rows(A, B, head, Wt, X, Z):
+    """Per-background-row exact phi: ``(N, B, K, M)``.
+
+    vmaps instances, ``lax.map``s background rows so only one row's DP
+    intermediates (``B·M²·r·(K+1)`` floats) are ever live — the memory
+    analog of the coalition-chunked sampled pipeline.  The row axis is
+    what the mesh shards: each rank runs this over its slice."""
+
+    from distributedkernelshap_tpu.ops.explain import record_kernel_path
+
+    record_kernel_path('exact_phi', 'tn_dp')
+
+    def one_row(z):
+        return jax.vmap(lambda x: _phi_one(A, B, head, Wt, x, z))(X)
+
+    return jax.lax.map(one_row, Z)
+
+
+def tensor_shap_phi(A, B, head, Wt, X, Z, bgw_n):
+    """Exact Shapley values ``(B, K, M)`` of the TT predictor for batch
+    ``X`` against the (weight-normalised) background ``Z``/``bgw_n``.
+
+    The final weighted row-sum is ONE einsum over the stacked per-row
+    phi — deliberately: the mesh-sharded variant all-gathers the rows
+    and replays this exact einsum replicated, which is what makes the
+    sharded run bit-identical to the single-device one."""
+
+    rows = tn_phi_rows(A, B, head, Wt, X, Z)           # (N, B, K, M)
+    return jnp.einsum('n,nbkm->bkm', bgw_n, rows)

@@ -143,8 +143,9 @@ def validate_exact(pred, link: str) -> None:
         raise ValueError(
             "nsamples='exact' requires a device-lifted tree ensemble "
             "with raw-margin outputs (out_transform='identity') and "
-            f"path tensors; this predictor is {type(pred).__name__}. "
-            "Use a sampled nsamples instead.")
+            "path tensors, or a tensor-train-structured predictor "
+            f"(models/tensor_net.py); this predictor is "
+            f"{type(pred).__name__}. Use a sampled nsamples instead.")
     if link != "identity":
         raise ValueError(
             "nsamples='exact' explains the ensemble's raw margin; "

@@ -602,11 +602,138 @@ class DistributedExplainer:
             out_shardings={'shap_values': shard, 'raw_prediction': shard})
         return jitted, args
 
-    def _explain_exact_sharded(self, X: np.ndarray, l1_reg,
-                               interactions: bool = False) -> Any:
-        from distributedkernelshap_tpu.ops.treeshap import validate_exact
+    def _exact_tn_sharded_fn(self):
+        """Exact tensor-network Shapley over the 2-D mesh: instances
+        shard over ``data``, the background-row axis — the contraction's
+        embarrassingly parallel sum, the same axis the tree path psums —
+        shards over ``coalition``.  Each rank runs the size-indexed DP
+        over ITS background slice; the per-row phi contributions are
+        all-gathered and the weighted row-sum einsum replays REPLICATED
+        in the exact single-device formulation, so the sharded run is
+        bit-identical to the single-device one (a psum of partial sums
+        would re-associate the float reduction)."""
+
+        key = 'exact_tn'
+        if key not in self._jit_cache:
+            from distributedkernelshap_tpu.ops.tensor_shap import (
+                tn_phi_rows,
+                weight_toeplitz,
+            )
+
+            engine = self.engine
+            pred = engine.predictor
+            precision = engine.config.shap.matmul_precision
+            n_coal = self.mesh.shape[COALITION_AXIS]
+            struct = pred.tt_structure()
+            # pad the background axis to a whole number of coalition
+            # shards with zero-weight rows: a 0.0-weighted term adds an
+            # exact +0.0 to the einsum, so padding never moves a bit
+            bg = np.asarray(engine.background, np.float32)
+            bgw0 = np.asarray(engine.bg_weights, np.float64)
+            bgw0 = (bgw0 / bgw0.sum()).astype(np.float32)
+            pad = (-bg.shape[0]) % n_coal
+            if pad:
+                bg = np.concatenate([bg, np.tile(bg[-1:], (pad, 1))], 0)
+                bgw0 = np.concatenate(
+                    [bgw0, np.zeros(pad, np.float32)], 0)
+
+            def body(Xl, bg_l, bgw_full, A, B, head, Wt):
+                with jax.default_matmul_precision(precision):
+                    rows_l = tn_phi_rows(A, B, head, Wt, Xl, bg_l)
+                    rows = jax.lax.all_gather(
+                        rows_l, COALITION_AXIS, axis=0, tiled=True)
+                    phi = jnp.einsum('n,nbkm->bkm', bgw_full, rows)
+                    return {'shap_values': phi,
+                            'raw_prediction': pred(Xl)}
+
+            sharded = compat.shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=(P(DATA_AXIS), P(COALITION_AXIS), P(), P(), P(),
+                          P(), P()),
+                out_specs={'shap_values': P(DATA_AXIS),
+                           'raw_prediction': P(DATA_AXIS)},
+                check_vma=False,
+            )
+            shard = NamedSharding(self.mesh, P(DATA_AXIS))
+            repl = NamedSharding(self.mesh, P())
+            coal = NamedSharding(self.mesh, P(COALITION_AXIS))
+            # commit the per-fit constants to their mesh shardings once
+            args = (jax.device_put(jnp.asarray(bg), coal),
+                    jax.device_put(jnp.asarray(bgw0), repl),
+                    jax.device_put(struct['A'], repl),
+                    jax.device_put(struct['B'], repl),
+                    jax.device_put(struct['head'], repl),
+                    jax.device_put(
+                        jnp.asarray(weight_toeplitz(engine.M)), repl))
+            jitted = jax.jit(
+                sharded,
+                in_shardings=(shard, coal, repl, repl, repl, repl, repl),
+                out_shardings={'shap_values': shard,
+                               'raw_prediction': shard})
+            self._jit_cache[key] = (jitted, args)
+        return self._jit_cache[key]
+
+    def _explain_exact_tn_sharded(self, X: np.ndarray, l1_reg,
+                                  interactions: bool = False) -> Any:
+        from distributedkernelshap_tpu.ops.tensor_shap import (
+            validate_exact_tn,
+        )
 
         engine = self.engine
+        validate_exact_tn(engine.predictor, engine.config.link, engine.G)
+        if interactions:
+            raise ValueError(
+                "interactions=True requires a lifted tree ensemble; the "
+                "tensor-network exact path computes phi only.")
+        if l1_reg not in (None, False, 0, 'auto'):
+            logger.warning("l1_reg=%r is ignored with nsamples='exact'.",
+                           l1_reg)
+
+        X = np.atleast_2d(np.asarray(X, dtype=np.float32))
+        B = X.shape[0]
+        slab = self._slab_size()
+        if self._needs_slabs(B):
+            padded, _ = pad_to_multiple(B, slab)
+            if padded != B:
+                X = np.concatenate(
+                    [X, np.tile(X[-1:], (padded - B, 1))], 0)
+            slabs = make_batches(X, batch_size=slab)
+        else:
+            slabs = [X]
+
+        fn, args = self._exact_tn_sharded_fn()
+        journal = self._journal_for(slabs, 'exact_tn', 'exact',
+                                    interactions=False)
+        results = self._run_slabs(
+            slabs, lambda s: self._dispatch_call(fn, s, args),
+            journal=journal)
+
+        phi = np.concatenate([r[0] for r in results], 0)[:B]
+        self.last_raw_prediction = np.concatenate(
+            [r[1] for r in results], 0)[:B]
+        self.last_interaction_values = None
+        from distributedkernelshap_tpu.kernel_shap import _fingerprint
+
+        self.last_X_fingerprint = _fingerprint(X[:B])
+        return split_shap_values(phi, engine.vector_out)
+
+    def _explain_exact_sharded(self, X: np.ndarray, l1_reg,
+                               interactions: bool = False) -> Any:
+        from distributedkernelshap_tpu.ops.treeshap import (
+            supports_exact,
+            validate_exact,
+        )
+
+        engine = self.engine
+        if not supports_exact(engine.predictor):
+            from distributedkernelshap_tpu.ops.tensor_shap import (
+                supports_exact_tn,
+            )
+
+            if supports_exact_tn(engine.predictor):
+                return self._explain_exact_tn_sharded(X, l1_reg,
+                                                      interactions)
         validate_exact(engine.predictor, engine.config.link)
         if l1_reg not in (None, False, 0, 'auto'):
             logger.warning("l1_reg=%r is ignored with nsamples='exact'.", l1_reg)

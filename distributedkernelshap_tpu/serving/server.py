@@ -590,6 +590,9 @@ class ExplainerServer:
         # sampled estimator) and the exact path's fallback accounting —
         # both process-global, rendered via callbacks like the compile
         # accountant
+        from distributedkernelshap_tpu.ops.tensor_shap import (
+            attach_tensor_shap_metrics,
+        )
         from distributedkernelshap_tpu.ops.treeshap import (
             attach_treeshap_metrics,
         )
@@ -599,6 +602,7 @@ class ExplainerServer:
 
         attach_path_metrics(reg)
         attach_treeshap_metrics(reg)
+        attach_tensor_shap_metrics(reg)
         # the scheduler registers its own dks_sched_* series (queue wait,
         # expiries) on the same registry so one page carries everything
         attach = getattr(self._sched, "attach_metrics", None)

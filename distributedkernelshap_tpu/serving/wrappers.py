@@ -33,7 +33,8 @@ EXACT_AUTO_ENV = "DKS_EXACT_AUTO"
 # registry can render it via a callback (same pattern as the compile
 # accountant): {'exact': n, 'sampled': n} requests answered per path
 _path_lock = threading.Lock()
-_path_counts: Dict[str, float] = {"exact": 0.0, "sampled": 0.0}
+_path_counts: Dict[str, float] = {"exact": 0.0, "exact_tn": 0.0,
+                                  "sampled": 0.0}
 
 
 def record_explain_path(path: str, n: int = 1) -> None:
@@ -56,8 +57,9 @@ def attach_path_metrics(registry) -> None:
     registry.counter(
         "dks_serve_explain_path_total",
         "Request slots explained by evaluation path (exact = closed-form "
-        "interventional TreeSHAP, sampled = KernelSHAP estimator); "
-        "includes warmup-ladder rungs, which drive the same entry points.",
+        "interventional TreeSHAP, exact_tn = exact tensor-network "
+        "contraction, sampled = KernelSHAP estimator); includes "
+        "warmup-ladder rungs, which drive the same entry points.",
         labelnames=("path",)).set_function(explain_path_counts)
 
 # explain options a deployment may pin for every request: the keys every
@@ -144,21 +146,31 @@ class KernelShapModel:
 
     def _resolve_explain_path(self) -> None:
         """Auto-select ``nsamples='exact'`` for deployments whose fitted
-        predictor is a lifted tree ensemble with raw-margin outputs and an
-        identity link (lgbm/xgb/sklearn-tree lifts): closed-form exact
-        Shapley values beat the sampled estimator on both wall-clock
-        (path-packed kernel) and exactness, so they are the default for
-        tree predictors.  A pinned ``nsamples`` key always wins (including
+        predictor admits a closed-form exact path: lifted tree ensembles
+        with raw-margin outputs (lgbm/xgb/sklearn-tree lifts — the packed
+        TreeSHAP route) and tensor-train-structured predictors
+        (``models/tensor_net.py`` — the DP contraction route), both at
+        identity link.  Exact Shapley values beat the sampled estimator
+        on both wall-clock and exactness there, so they are the default.
+        A pinned ``nsamples`` key always wins (including
         ``nsamples=None`` as an explicit opt-out), as does
         ``DKS_EXACT_AUTO=0``.  Sets ``explain_path`` (``'exact'`` |
-        ``'sampled'``) and ``explain_path_reason`` for the per-request
-        span/metric attribution."""
+        ``'exact_tn'`` | ``'sampled'``) and ``explain_path_reason`` for
+        the per-request span/metric attribution.  A TT predictor that
+        fails a readiness gate (grouping/link/rank/footprint) stays
+        sampled with the reason counted in
+        ``dks_tensor_shap_fallback_total``."""
 
         from distributedkernelshap_tpu.utils import resolve_bool_env
 
+        engine = self._serving_engine()
         if "nsamples" in self.explain_kwargs:
-            path = ("exact" if self.explain_kwargs["nsamples"] == "exact"
-                    else "sampled")
+            if self.explain_kwargs["nsamples"] == "exact":
+                flavor = (getattr(engine, "_exact_flavor", lambda: None)()
+                          if engine is not None else None)
+                path = "exact_tn" if flavor == "tn" else "exact"
+            else:
+                path = "sampled"
             self.explain_path, self.explain_path_reason = path, "pinned"
             return
         self.explain_path, self.explain_path_reason = "sampled", "default"
@@ -166,9 +178,13 @@ class KernelShapModel:
             self.explain_path_reason = "auto_disabled"
             return
         try:
+            from distributedkernelshap_tpu.ops.tensor_shap import (
+                record_tn_fallback,
+                supports_exact_tn,
+                tn_exact_ready,
+            )
             from distributedkernelshap_tpu.ops.treeshap import supports_exact
 
-            engine = self._serving_engine()
             if engine is None:
                 return
             if supports_exact(engine.predictor) \
@@ -180,6 +196,23 @@ class KernelShapModel:
                     "serving auto-selected the exact TreeSHAP path for a "
                     "lifted %s (set %s=0 or pin nsamples to opt out)",
                     type(engine.predictor).__name__, EXACT_AUTO_ENV)
+            elif supports_exact_tn(engine.predictor):
+                reason = tn_exact_ready(
+                    engine.predictor, engine.config.link, engine.G,
+                    engine.config.shap.target_chunk_elems)
+                if reason is None:
+                    self.explain_kwargs["nsamples"] = "exact"
+                    self.explain_path = "exact_tn"
+                    self.explain_path_reason = "auto"
+                    logger.info(
+                        "serving auto-selected the exact tensor-network "
+                        "path for a %s (set %s=0 or pin nsamples to opt "
+                        "out)", type(engine.predictor).__name__,
+                        EXACT_AUTO_ENV)
+                else:
+                    # a TN-structured deployment staying sampled is an
+                    # operational fact worth a counter, not a mystery
+                    record_tn_fallback(reason)
         except Exception:  # never fail a deployment over path selection
             logger.debug("exact-path auto-selection probe failed",
                          exc_info=True)

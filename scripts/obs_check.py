@@ -38,10 +38,11 @@ DOCS_PATH = os.path.join(REPO_ROOT, "docs", "OBSERVABILITY.md")
 #: the streaming hot path landed ``dks_wire_*``/``dks_staging_*``;
 #: ``treeshap`` when the exact path's fallback accounting landed
 #: ``dks_treeshap_*``; ``autoscale`` when the elastic-fleet scaler
-#: landed ``dks_autoscale_*``.
+#: landed ``dks_autoscale_*``; ``tensor_shap`` when the exact
+#: tensor-network path landed ``dks_tensor_shap_*``.
 _LITERAL_RE = re.compile(
     r"dks_(?:serve|fanin|sched|phase|slo|alerts|wire|staging|treeshap|"
-    r"autoscale)_[a-z0-9_]+")
+    r"tensor_shap|autoscale)_[a-z0-9_]+")
 
 #: directories never scanned for literals/renderers
 _SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "results", "data",

@@ -1,0 +1,555 @@
+"""Exact tensor-network Shapley (ops/tensor_shap.py + models/tensor_net.py
+and their engine / mesh / serving integration).
+
+Oracles: the size-indexed DP contraction is pinned against a float64
+brute-force enumeration of ALL 2^M coalitions at small M (tighter than
+the f32 phi it produces); the rank-1/linear lift is pinned against the
+closed-form linear Shapley values W_j (x_j - E z_j) and the linear fast
+path; the mesh-sharded run is pinned BIT-IDENTICAL to the single-device
+run (its engineered property — per-row phi all-gathered, the one final
+weighted-row-sum einsum replayed replicated); and the full-enumeration
+parity regime pins the sampled estimator against both exact paths
+end to end (``coalition_plan`` with ``total <= nsamples`` silently
+enumerates every coalition, so the WLS solve is exact by construction —
+nothing asserted that until now).
+"""
+
+import json
+import time
+from itertools import combinations
+from math import factorial
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu.kernel_shap import (
+    EngineConfig,
+    KernelExplainerEngine,
+    KernelShap,
+    StagedRows,
+)
+from distributedkernelshap_tpu.models.tensor_net import (
+    TensorTrainPredictor,
+    fit_tt_surrogate,
+)
+from distributedkernelshap_tpu.ops import tensor_shap as tns
+
+
+def _make_tt(M, r, seed=0, K=1, b_scale=0.3):
+    """A well-conditioned random TT predictor (per-site scale ~ r^-1/2
+    keeps the chained products O(1) over M sites)."""
+
+    rng = np.random.default_rng(seed)
+    dims = [1] + [r] * (M - 1) + [K]
+    scale = 1.0 / np.sqrt(r)
+    cores = [(rng.normal(scale=scale,
+                         size=(dims[i], dims[i + 1])).astype(np.float32),
+              rng.normal(scale=b_scale * scale,
+                         size=(dims[i], dims[i + 1])).astype(np.float32))
+             for i in range(M)]
+    return TensorTrainPredictor(cores)
+
+
+@pytest.fixture(scope="module")
+def small_tn():
+    rng = np.random.default_rng(3)
+    M = 6
+    pred = _make_tt(M, 3, seed=0, b_scale=0.5)
+    return dict(pred=pred, M=M,
+                bg=rng.normal(size=(5, M)).astype(np.float32),
+                X=rng.normal(size=(3, M)).astype(np.float32))
+
+
+@pytest.fixture(scope="module")
+def mid_tn():
+    rng = np.random.default_rng(7)
+    M = 8
+    pred = _make_tt(M, 4, seed=1)
+    return dict(pred=pred, M=M,
+                bg=rng.normal(size=(16, M)).astype(np.float32),
+                X=rng.normal(size=(5, M)).astype(np.float32))
+
+
+# --------------------------------------------------------------------- #
+# the DP contraction vs brute-force 2^M enumeration
+# --------------------------------------------------------------------- #
+
+
+def _brute_force_phi(pred, X, bg):
+    """float64 Shapley values by enumerating ALL coalitions: the masked-EY
+    value function v(S) = E_z f(x_S; z) evaluated through the HOST cores
+    in float64, marginals weighted by s!(M-1-s)!/M! — a higher-precision
+    oracle than the f32 DP under test."""
+
+    M = X.shape[1]
+    bg64 = np.asarray(bg, np.float64)
+
+    def f64(rows):
+        v = np.ones((rows.shape[0], 1))
+        for i, (A, B) in enumerate(pred._host_cores):
+            C = (A[None].astype(np.float64)
+                 + rows[:, i][:, None, None] * B[None].astype(np.float64))
+            v = np.einsum('br,brs->bs', v, C)
+        return v                                           # (n, K)
+
+    def value(S, x):
+        comp = np.tile(x, (bg64.shape[0], 1)).astype(np.float64)
+        keep = np.ones(M, bool)
+        keep[list(S)] = False
+        comp[:, keep] = bg64[:, keep]
+        return f64(comp).mean(0)
+
+    K = pred.n_outputs
+    phi = np.zeros((X.shape[0], K, M))
+    for bi, x in enumerate(X):
+        for j in range(M):
+            others = [i for i in range(M) if i != j]
+            for s in range(M):
+                w = factorial(s) * factorial(M - 1 - s) / factorial(M)
+                for S in combinations(others, s):
+                    phi[bi, :, j] += w * (value(set(S) | {j}, x)
+                                          - value(S, x))
+    return phi
+
+
+def test_dp_matches_brute_force_enumeration(small_tn):
+    """The size-indexed DP over all coalitions == the 2^M enumeration, to
+    f32 rounding of the DP itself (the float64 oracle carries ~1e-16
+    error; everything beyond ~1e-6 here would be a DP derivation bug,
+    not float noise)."""
+
+    s = small_tn
+    engine = KernelExplainerEngine(s["pred"], s["bg"], link="identity",
+                                   seed=0)
+    phi = np.asarray(engine.get_explanation(s["X"], nsamples="exact"))
+    assert engine.kernel_path.get("exact_phi") == "tn_dp"
+    want = _brute_force_phi(s["pred"], s["X"], s["bg"])
+    got = phi[0] if phi.ndim == 3 and want.shape[1] == 1 else phi
+    np.testing.assert_allclose(np.squeeze(got), np.squeeze(want),
+                               atol=1e-6)
+    # additivity: phi sums to f(x) - E f(z) (the Shapley efficiency axiom)
+    fx = np.asarray(s["pred"](s["X"]))
+    efz = np.asarray(s["pred"](s["bg"])).mean(0)
+    np.testing.assert_allclose(np.squeeze(got).sum(-1),
+                               np.squeeze(fx - efz[None]), atol=1e-5)
+
+
+def test_weight_table_exact_values():
+    w = tns.shapley_size_weights(5)
+    want = [factorial(s) * factorial(4 - s) / factorial(5) for s in range(5)]
+    np.testing.assert_allclose(w, np.asarray(want, np.float32), rtol=0)
+    Wt = tns.weight_toeplitz(4)
+    assert Wt.shape == (4, 4)
+    # Wt[a, b] = w_{a+b}, zero once a+b spills past M-1
+    w4 = tns.shapley_size_weights(4)
+    for a in range(4):
+        for b in range(4):
+            assert Wt[a, b] == (w4[a + b] if a + b < 4 else 0.0)
+
+
+# --------------------------------------------------------------------- #
+# rank-1 / linear lift == the linear fast path
+# --------------------------------------------------------------------- #
+
+
+def test_rank1_linear_lift_matches_linear_fast_path():
+    """A linear model lifted to TT form serves the SAME phi as the linear
+    fast path: both are exact, so they must agree to f32 rounding — and
+    both must match the closed form W_j (x_j - E z_j)."""
+
+    from distributedkernelshap_tpu.models.predictors import LinearPredictor
+
+    rng = np.random.default_rng(11)
+    D, K = 7, 2
+    W = rng.normal(size=(D, K)).astype(np.float32)
+    b = rng.normal(size=(K,)).astype(np.float32)
+    bg = rng.normal(size=(9, D)).astype(np.float32)
+    X = rng.normal(size=(4, D)).astype(np.float32)
+
+    tt = TensorTrainPredictor.from_linear(W, b)
+    # the lift reproduces the linear predictions exactly-to-rounding
+    np.testing.assert_allclose(np.asarray(tt(X)), X @ W + b, atol=1e-5)
+
+    closed = np.einsum('dk,bd->bkd', W, X - bg.mean(0, keepdims=True))
+
+    eng_tt = KernelExplainerEngine(tt, bg, link="identity", seed=0)
+    phi_tt = np.stack([np.asarray(v) for v in
+                       eng_tt.get_explanation(X, nsamples="exact")], 1)
+    np.testing.assert_allclose(phi_tt, closed, atol=2e-5)
+
+    lin = LinearPredictor(W, b, activation="identity")
+    eng_lin = KernelExplainerEngine(lin, bg, link="identity", seed=0)
+    full = 2 ** D - 2
+    phi_lin = np.stack([np.asarray(v) for v in
+                        eng_lin.get_explanation(X, nsamples=full,
+                                                l1_reg=False)], 1)
+    np.testing.assert_allclose(phi_tt, phi_lin, atol=2e-5)
+
+    # from_linear_predictor round-trips the fitted decomposition
+    tt2 = TensorTrainPredictor.from_linear_predictor(lin)
+    assert tt2.fingerprint_bytes() == tt.fingerprint_bytes()
+
+
+def test_cp_lift_predictions_exact():
+    rng = np.random.default_rng(13)
+    M, R, K = 5, 3, 2
+    a = rng.normal(size=(M, R)).astype(np.float32)
+    b = rng.normal(scale=0.4, size=(M, R)).astype(np.float32)
+    head = rng.normal(size=(R, K)).astype(np.float32)
+    X = rng.normal(size=(6, M)).astype(np.float32)
+    tt = TensorTrainPredictor.from_cp(a, b, head)
+    want = np.einsum('rk,br->bk',
+                     head.astype(np.float64),
+                     np.prod(a.T[None].astype(np.float64)
+                             + X[:, None, :] * b.T[None], axis=2))
+    np.testing.assert_allclose(np.asarray(tt(X)), want, atol=1e-4)
+
+
+def test_fit_tt_surrogate_recovers_tt_model(small_tn):
+    """ALS on samples of an actual TT model recovers a near-zero-MSE
+    surrogate (the A/B-constructor contract the accuracy bench leans on)."""
+
+    s = small_tn
+    rng = np.random.default_rng(17)
+    Xfit = rng.normal(size=(200, s["M"])).astype(np.float32)
+    sur = fit_tt_surrogate(lambda X: np.asarray(s["pred"](X)), Xfit,
+                           rank=3, n_sweeps=3, seed=0)
+    y = np.asarray(s["pred"](Xfit), np.float64)
+    var = float(np.var(y))
+    assert sur.fit_mse_ < 0.05 * var
+    assert tns.supports_exact_tn(sur)
+
+
+# --------------------------------------------------------------------- #
+# full-enumeration parity: sampled estimator == exact paths end to end
+# --------------------------------------------------------------------- #
+
+
+def test_sampled_full_enumeration_matches_exact_tn(mid_tn):
+    """``coalition_plan`` with ``total <= nsamples`` silently enumerates
+    all coalitions — the WLS solve is then exact by construction, so the
+    SAMPLED estimator must agree with exact-TN phi end to end (to the
+    f32 rounding of two different exact formulations, far below any
+    sampling error)."""
+
+    from distributedkernelshap_tpu.ops.coalitions import coalition_plan
+
+    s = mid_tn
+    full = 2 ** s["M"] - 2
+    plan = coalition_plan(s["M"], nsamples=full)
+    assert plan.exact and plan.n_enumerated == full
+
+    engine = KernelExplainerEngine(s["pred"], s["bg"], link="identity",
+                                   seed=0)
+    exact = np.asarray(engine.get_explanation(s["X"], nsamples="exact"))
+    scale = float(np.abs(exact).max())
+    for budget in (full, full + 100):   # at and past the space: both enumerate
+        sampled = np.asarray(engine.get_explanation(s["X"], nsamples=budget,
+                                                    l1_reg=False))
+        np.testing.assert_allclose(sampled, exact,
+                                   atol=max(1e-5, 2e-5 * scale))
+
+
+def test_sampled_full_enumeration_matches_exact_tree():
+    """Same parity pin for the tree family: full enumeration == exact
+    interventional TreeSHAP."""
+
+    sklearn = pytest.importorskip("sklearn")
+    from sklearn.ensemble import HistGradientBoostingRegressor
+
+    rng = np.random.default_rng(5)
+    M = 6
+    Xtr = rng.normal(size=(200, M))
+    y = Xtr[:, 0] - np.where(Xtr[:, 2] > 0, 1.0, -1.0) * Xtr[:, 3]
+    gbr = HistGradientBoostingRegressor(max_iter=8, random_state=0).fit(
+        Xtr, y)
+    bg = Xtr[:12].astype(np.float32)
+    X = Xtr[100:105].astype(np.float32)
+
+    engine = KernelExplainerEngine(gbr.predict, bg, link="identity", seed=0)
+    exact = np.asarray(engine.get_explanation(X, nsamples="exact"))
+    scale = float(np.abs(exact).max())
+    sampled = np.asarray(engine.get_explanation(X, nsamples=2 ** M - 2,
+                                                l1_reg=False))
+    np.testing.assert_allclose(sampled, exact, atol=max(1e-5, 2e-5 * scale))
+
+
+# --------------------------------------------------------------------- #
+# mesh sharding: bit-identical to single-device
+# --------------------------------------------------------------------- #
+
+
+def test_sharded_matches_single_device_bit_identical(mid_tn):
+    """Background rows sharded over the coalition axis, per-row phi
+    all-gathered, the final weighted-row-sum einsum replayed replicated:
+    the sharded run must be BIT-identical to the single-device one."""
+
+    from distributedkernelshap_tpu.parallel.distributed import (
+        DistributedExplainer,
+    )
+
+    s = mid_tn
+    seq = KernelExplainerEngine(s["pred"], s["bg"], link="identity", seed=0)
+    want = seq.get_explanation(s["X"], nsamples="exact")
+
+    for cp in (2, 4):
+        dist = DistributedExplainer(
+            {"n_devices": 8, "coalition_parallel": cp,
+             "algorithm": "kernel_shap"},
+            KernelExplainerEngine, (s["pred"], s["bg"]),
+            {"link": "identity", "seed": 0})
+        got = dist.get_explanation(s["X"], nsamples="exact")
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        np.testing.assert_allclose(
+            np.asarray(dist.last_raw_prediction),
+            np.asarray(seq.last_raw_prediction), atol=1e-6)
+        # staging declines for sharded explainers (mesh padding differs
+        # from the single-engine bucketing)
+        assert dist.stage_rows(s["X"], nsamples="exact") is None
+
+
+def test_sharded_pads_ragged_background(mid_tn):
+    """A background size not divisible by the coalition-parallel degree
+    pads with zero-WEIGHT rows — an exact +0.0 in the final einsum, so
+    the answer stays bit-identical to single-device."""
+
+    from distributedkernelshap_tpu.parallel.distributed import (
+        DistributedExplainer,
+    )
+
+    s = mid_tn
+    bg = s["bg"][:13]                   # 13 rows over cp=4: pad 3
+    seq = KernelExplainerEngine(s["pred"], bg, link="identity", seed=0)
+    want = seq.get_explanation(s["X"], nsamples="exact")
+    dist = DistributedExplainer(
+        {"n_devices": 8, "coalition_parallel": 4,
+         "algorithm": "kernel_shap"},
+        KernelExplainerEngine, (s["pred"], bg),
+        {"link": "identity", "seed": 0})
+    got = dist.get_explanation(s["X"], nsamples="exact")
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+# --------------------------------------------------------------------- #
+# engine: staged async == sync, device cache rekey/reset/bound
+# --------------------------------------------------------------------- #
+
+
+def test_engine_staged_async_matches_sync(mid_tn):
+    s = mid_tn
+    engine = KernelExplainerEngine(s["pred"], s["bg"], link="identity",
+                                   seed=0)
+    want = engine.get_explanation(s["X"], nsamples="exact")
+    staged = engine.stage_rows(s["X"], nsamples="exact")
+    assert isinstance(staged, StagedRows)
+    values, info = engine.get_explanation_async(staged, nsamples="exact")()
+    np.testing.assert_array_equal(np.asarray(values), np.asarray(want))
+    np.testing.assert_array_equal(info["raw_prediction"],
+                                  np.asarray(engine.last_raw_prediction))
+    # unstaged async (staging-off deployments) pads/buckets identically
+    values2, _ = engine.get_explanation_async(s["X"], nsamples="exact")()
+    np.testing.assert_array_equal(np.asarray(values2), np.asarray(want))
+    # interactions have no TN closed form: sync raises, staging declines
+    assert engine.stage_rows(s["X"], nsamples="exact",
+                             interactions=True) is None
+    with pytest.raises(ValueError, match="interactions"):
+        engine.get_explanation(s["X"], nsamples="exact", interactions=True)
+
+
+def test_device_cache_rekey_reset_and_bound(mid_tn):
+    s = mid_tn
+    engine = KernelExplainerEngine(s["pred"], s["bg"], link="identity",
+                                   seed=0)
+    c1 = engine._exact_tn_consts()
+    assert engine._exact_tn_consts() is c1          # cache hit
+    key = ('exact_tn_consts', engine.content_fingerprint())
+    assert key in engine._plan_consts_cache
+
+    # reset clears device state; the rebuild is a fresh dict
+    engine.reset_device_state()
+    assert key not in engine._plan_consts_cache
+    assert engine._exact_tn_consts() is not c1
+
+    # LRU bound: flooding the shared consts cache keeps it bounded (the
+    # trim runs on insert, so drop the live key first to force one)
+    for i in range(engine._DEV_CACHE_MAX_ENTRIES + 3):
+        engine._plan_consts_cache[("dummy", i)] = None
+    engine._plan_consts_cache.pop(key, None)
+    engine._exact_tn_consts()
+    assert (len(engine._plan_consts_cache)
+            <= engine._DEV_CACHE_MAX_ENTRIES)
+
+    # content rekey: equal core bytes ARE the same constants; any byte
+    # change is a different fingerprint (no id()-aliasing staleness)
+    clone = TensorTrainPredictor(
+        [(A.copy(), B.copy()) for A, B in s["pred"]._host_cores])
+    eng_clone = KernelExplainerEngine(clone, s["bg"], link="identity",
+                                      seed=0)
+    assert eng_clone.content_fingerprint() == engine.content_fingerprint()
+    bent = [(A.copy(), B.copy()) for A, B in s["pred"]._host_cores]
+    bent[0][0][0, 0] += 1.0
+    eng_bent = KernelExplainerEngine(TensorTrainPredictor(bent), s["bg"],
+                                     link="identity", seed=0)
+    assert eng_bent.content_fingerprint() != engine.content_fingerprint()
+
+    # plan_constant_cache=False bypasses the cache (recompute arm)
+    eng_off = KernelExplainerEngine(
+        s["pred"], s["bg"], link="identity", seed=0,
+        config=EngineConfig(plan_constant_cache=False))
+    eng_off._exact_tn_consts()
+    assert not eng_off._plan_consts_cache
+    got = eng_off.get_explanation(s["X"], nsamples="exact")
+    want = engine.get_explanation(s["X"], nsamples="exact")
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+# --------------------------------------------------------------------- #
+# readiness gates + fallback accounting
+# --------------------------------------------------------------------- #
+
+
+def test_readiness_gates_and_validation(mid_tn):
+    s = mid_tn
+    pred, M = s["pred"], s["M"]
+    G = np.eye(M, dtype=np.float32)
+    assert tns.tn_exact_ready(pred, "identity", G) is None
+    assert tns.tn_exact_ready(object(), "identity", G) == "structure"
+    assert tns.tn_exact_ready(pred, "logit", G) == "link"
+    grouped = np.zeros((M, M - 1), np.float32)
+    grouped[:M - 1] = np.eye(M - 1)
+    grouped[-1, -1] = 1.0
+    assert tns.tn_exact_ready(pred, "identity", grouped) == "grouping"
+    big = _make_tt(3, tns.TN_MAX_RANK + 1, seed=2)
+    assert tns.tn_exact_ready(big, "identity",
+                              np.eye(3, dtype=np.float32)) == "rank"
+    assert tns.tn_exact_ready(pred, "identity", G,
+                              target_chunk_elems=256) == "footprint"
+    with pytest.raises(ValueError, match="link='identity'"):
+        tns.validate_exact_tn(pred, "logit", G)
+    before = dict(tns.tn_fallback_counts())
+    tns.record_tn_fallback("rank")
+    after = tns.tn_fallback_counts()
+    assert after[("rank",)] == before.get(("rank",), 0.0) + 1.0
+
+
+# --------------------------------------------------------------------- #
+# serving: auto-selection, opt-outs, payload parity, path metric, warmup
+# --------------------------------------------------------------------- #
+
+
+def test_serving_auto_selects_exact_tn(mid_tn):
+    from distributedkernelshap_tpu.serving.wrappers import KernelShapModel
+
+    s = mid_tn
+    model = KernelShapModel(s["pred"], s["bg"], {"seed": 0}, {})
+    assert model.explain_path == "exact_tn"
+    assert model.explain_path_reason == "auto"
+    assert model.explain_kwargs == {"nsamples": "exact"}
+    # responses match a direct exact explain bit-for-bit
+    payloads = model.explain_batch(s["X"][:4], split_sizes=[2, 2])
+    direct = KernelShap(s["pred"], seed=0)
+    direct.fit(s["bg"])
+    want = np.asarray(direct.explain(s["X"][:4], silent=True,
+                                     nsamples="exact").shap_values)
+    want = want[0] if want.ndim == 3 else want
+    got = np.asarray(json.loads(payloads[0])["data"]["shap_values"])
+    np.testing.assert_array_equal(np.squeeze(got), want[:2])
+
+
+def test_serving_auto_select_opt_outs(mid_tn, monkeypatch):
+    from distributedkernelshap_tpu.serving.wrappers import KernelShapModel
+
+    s = mid_tn
+    pinned = KernelShapModel(s["pred"], s["bg"], {"seed": 0}, {},
+                             explain_kwargs={"nsamples": 100})
+    assert pinned.explain_path == "sampled"
+    assert pinned.explain_path_reason == "pinned"
+    opted = KernelShapModel(s["pred"], s["bg"], {"seed": 0}, {},
+                            explain_kwargs={"nsamples": None})
+    assert opted.explain_path == "sampled"
+    monkeypatch.setenv("DKS_EXACT_AUTO", "0")
+    off = KernelShapModel(s["pred"], s["bg"], {"seed": 0}, {})
+    assert off.explain_path == "sampled"
+    assert off.explain_path_reason == "auto_disabled"
+    assert "nsamples" not in off.explain_kwargs
+    monkeypatch.delenv("DKS_EXACT_AUTO")
+    # a failed readiness gate keeps the sampled path AND counts a reason
+    before = tns.tn_fallback_counts().get(("rank",), 0.0)
+    big = _make_tt(3, tns.TN_MAX_RANK + 1, seed=2)
+    bg3 = np.zeros((4, 3), np.float32)
+    gated = KernelShapModel(big, bg3, {"seed": 0}, {})
+    assert gated.explain_path == "sampled"
+    assert gated.explain_path_reason == "default"
+    assert tns.tn_fallback_counts()[("rank",)] == before + 1.0
+
+
+def test_serving_staged_async_matches_sync_payloads(mid_tn):
+    from distributedkernelshap_tpu.serving.wrappers import (
+        BatchKernelShapModel,
+    )
+
+    s = mid_tn
+    model = BatchKernelShapModel(s["pred"], s["bg"], {"seed": 0}, {})
+    assert model.explain_path == "exact_tn"
+    staged = model.stage_rows(s["X"][:4])
+    assert isinstance(staged, StagedRows)
+    sync = model.explain_batch(s["X"][:4], split_sizes=[2, 2])
+    got = model.explain_batch_async(staged, split_sizes=[2, 2])()
+    assert got == sync
+    # binary wire slots work on the exact-TN path too
+    staged2 = model.stage_rows(s["X"][:4])
+    binary = model.explain_batch_async(
+        staged2, split_sizes=[2, 2], formats=["binary", "json"])()
+    assert isinstance(binary[0], (bytes, bytearray))
+    assert binary[1] == sync[1]
+
+
+def test_explain_path_metric_counts_exact_tn(mid_tn):
+    from distributedkernelshap_tpu.serving import wrappers
+
+    s = mid_tn
+    model = wrappers.BatchKernelShapModel(s["pred"], s["bg"], {"seed": 0},
+                                          {})
+    before = wrappers.explain_path_counts().get(("exact_tn",), 0.0)
+    model.explain_batch(s["X"][:4], split_sizes=[2, 2])
+    after = wrappers.explain_path_counts()[("exact_tn",)]
+    assert after == before + 2          # one per request slot, not per row
+
+
+def test_warmup_ladder_covers_exact_tn_path(mid_tn):
+    """A warmup-enabled server over an auto-exact_tn deployment compiles
+    the TN entry per bucket (signatures carry the path), serves warm, and
+    renders the path/fallback metrics."""
+
+    from distributedkernelshap_tpu.runtime.compile_cache import (
+        compile_events,
+    )
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+    from distributedkernelshap_tpu.serving.wrappers import (
+        BatchKernelShapModel,
+    )
+
+    s = mid_tn
+    model = BatchKernelShapModel(s["pred"], s["bg"], {"seed": 0}, {})
+    assert model.explain_path == "exact_tn"
+    ce = compile_events()
+    before = ce.snapshot()
+    srv = ExplainerServer(model, host="127.0.0.1", port=0,
+                          max_batch_size=4, warmup=True,
+                          health_interval_s=0).start()
+    try:
+        deadline = time.monotonic() + 60
+        while srv.warmup_status()["state"] in ("pending", "running"):
+            assert time.monotonic() < deadline, "warmup never finished"
+            time.sleep(0.05)
+        st = srv.warmup_status()
+        assert st["state"] == "done"
+        assert st["completed_buckets"] == st["buckets"] != []
+        delta = ce.delta(before, ce.snapshot())
+        sigs = {sig for (_, sig) in delta["counts"]}
+        assert any(sig.endswith(",path=exact_tn") for sig in sigs), sigs
+        page = srv.metrics.render()
+        assert 'dks_serve_explain_path_total{path="exact_tn"}' in page
+        assert "dks_tensor_shap_fallback_total" in page
+    finally:
+        srv.stop()
