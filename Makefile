@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test tier1 collect fuzz bench configs serve sweep-pool sweep-serve analysis multihost-ci sched-bench chaos-bench obs-check health-check perf-gate warmup-bench stream-bench exact-bench autoscale-bench accuracy-gate
+.PHONY: test tier1 collect fuzz bench configs serve sweep-pool sweep-serve analysis multihost-ci sched-bench chaos-bench obs-check health-check perf-gate warmup-bench stream-bench exact-bench autoscale-bench accuracy-gate tenant-bench
 
 multihost-ci:    ## multi-host validation: 2-proc pool/phi/interactions, 4-proc 2x2 mesh, 2-proc serve (one JSON line, rc 0/1)
 	$(PY) benchmarks/multihost_ci.py
@@ -35,6 +35,9 @@ exact-bench:     ## exact-TreeSHAP arms: packed path-parallel schedule vs einsum
 
 autoscale-bench: ## elastic-fleet A/B: diurnal open-loop replay, autoscaled min=1..max=3 fleet vs static fleets (holds p99 SLO at >=30% fewer replica-seconds; scale-up first answer <=5s via the warmup ladder; drains lose/duplicate nothing)
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/autoscale_bench.py --check
+
+tenant-bench:    ## multi-tenant gateway: one fleet serving 3 model families concurrently (per-model phi bit-identical to dedicated deployments), hot-swap mid-run (zero lost/changed answers), noisy-tenant quota isolation; self-records for perf-gate
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/multitenant_bench.py --check
 
 obs-check:       ## observability drift lint: registry vs docs/OBSERVABILITY.md catalog, stray dks_ literals, ad-hoc exposition renderers
 	env JAX_PLATFORMS=cpu $(PY) scripts/obs_check.py

@@ -201,6 +201,11 @@ def run_benchmark(cpu_fallback: bool = False) -> int:
         # streaming bench gates on
         "wire_format": _wire_format_name(),
         "goodput_rows_per_s": round(X_explain.shape[0] / value, 1),
+        # model attribution (multi-tenant era): which registered model
+        # identity this measurement belongs to, so perf-history entries
+        # from multi-model fleets stay attributable per tenant
+        "model_id": "adult_lr",
+        "model_version": 1,
     }
     # compile accounting for the whole run (fit + warmup + timed loop):
     # fresh = XLA compiled, cache_hit = the persistent compile cache

@@ -1,0 +1,569 @@
+"""Multi-tenant gateway benchmark: one fleet, many models, hot-swappable
+(standalone, CPU backend, exits nonzero on ``--check`` fail).
+
+Four measured arms, one JSON line (ISSUE 10; ROADMAP item 4, grounded in
+ONNXExplainer's format-generic Shapley framework):
+
+1. **ONNX ingest** (run first so its compile events are fresh) — an
+   ONNX-style logistic-regression graph is lifted
+   (``registry/onnx_lift.py``), auto-classified onto the **linear fast
+   path**, registered, and served end-to-end: its warmup-ladder rungs
+   must appear in the compile accounting under ITS model namespace
+   (``model=<id>@v1`` signatures) and a duplicate request must hit the
+   result cache under ITS fingerprint.  Uses the real ``onnx`` package
+   when installed, else the framework-free ``GraphSpec`` form of the
+   same graph (reported as ``onnx_available``).
+2. **Multi-family fleet** — ≥3 model families (linear softmax, lifted
+   tree ensemble on the exact-TreeSHAP path, tensor-train on the exact
+   contraction path) served CONCURRENTLY by one server, routed by
+   ``X-DKS-Model``.  Every response must be bit-identical to a dedicated
+   single-model deployment of the same predictor answering the same row.
+3. **Hot swap mid-run** — version 2 of the linear tenant registers while
+   an open-loop stream is in flight: zero lost answers, every answer
+   bit-identical to EITHER v1 or v2 (never a mixture), and requests
+   arriving after the swap completes answer v2.
+4. **Noisy tenant** — a flooding tenant with a ``TenantQuota`` sheds
+   (429 ``tenant_*``) while two victim tenants keep an interactive p99
+   under the SLO bound and shed nothing.
+
+Every measured run self-records into ``results/perf_history.jsonl`` with
+``checks_ok`` (+ the model identities in the config fingerprint) so
+``make perf-gate`` covers it.
+
+    JAX_PLATFORMS=cpu python benchmarks/multitenant_bench.py --check
+"""
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO_ROOT = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO_ROOT)
+
+from benchmarks.scheduling_bench import (  # noqa: E402
+    open_loop,
+    percentile,
+    scrape_metrics,
+)
+
+D = 6  # feature width shared by the fleet families
+ONNX_D = 9  # distinct width for the ONNX arm: its ladder must TRACE fresh
+
+
+def _payload_data(payload: str):
+    return json.loads(payload)["data"]
+
+
+def _phi_of(payload: str):
+    return json.dumps(_payload_data(payload)["shap_values"])
+
+
+# --------------------------------------------------------------------- #
+# model families (each builder is deterministic, so calling it twice
+# yields the bit-identical "dedicated deployment" reference)
+# --------------------------------------------------------------------- #
+
+
+def build_linear(seed=1):
+    from distributedkernelshap_tpu.models import LinearPredictor
+    from distributedkernelshap_tpu.serving.wrappers import (
+        BatchKernelShapModel,
+    )
+
+    rng = np.random.default_rng(seed)
+    W = rng.normal(size=(D, 2)).astype(np.float32)
+    b = rng.normal(size=(2,)).astype(np.float32)
+    bg = np.random.default_rng(100).normal(size=(12, D)).astype(np.float32)
+    return BatchKernelShapModel(LinearPredictor(W, b, activation="softmax"),
+                                bg, {"link": "logit", "seed": 0}, {})
+
+
+def build_tree():
+    from sklearn.ensemble import HistGradientBoostingRegressor
+
+    from distributedkernelshap_tpu.serving.wrappers import (
+        BatchKernelShapModel,
+    )
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(200, D))
+    y = X[:, 0] * 2 - X[:, 1] + 0.5 * X[:, 2] * X[:, 3]
+    gbr = HistGradientBoostingRegressor(max_iter=10, max_depth=3,
+                                        random_state=0).fit(X, y)
+    bg = np.random.default_rng(101).normal(size=(12, D)).astype(np.float32)
+    return BatchKernelShapModel(gbr.predict, bg, {"seed": 0}, {})
+
+
+def build_tt():
+    from distributedkernelshap_tpu.models.tensor_net import (
+        TensorTrainPredictor,
+    )
+    from distributedkernelshap_tpu.serving.wrappers import (
+        BatchKernelShapModel,
+    )
+
+    rng = np.random.default_rng(9)
+    ranks = [1, 2, 2, 2, 2, 2, 1]
+    cores = [(rng.normal(scale=0.5,
+                         size=(ranks[i], ranks[i + 1])).astype(np.float32),
+              rng.normal(scale=0.5,
+                         size=(ranks[i], ranks[i + 1])).astype(np.float32))
+             for i in range(D)]
+    bg = np.random.default_rng(102).normal(size=(12, D)).astype(np.float32)
+    return BatchKernelShapModel(TensorTrainPredictor(cores), bg,
+                                {"seed": 0}, {})
+
+
+FAMILIES = {"lin": build_linear, "tree": build_tree, "tt": build_tt}
+
+
+def _serve_registry(registry, **kwargs):
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    defaults = dict(host="127.0.0.1", port=0, max_batch_size=8,
+                    batch_timeout_s=0.004, pipeline_depth=2)
+    defaults.update(kwargs)
+    return ExplainerServer(registry=registry, **defaults).start()
+
+
+def _wait_warm(server, timeout_s: float = 120.0) -> None:
+    """Wait out the readiness gate so first-compile time never pollutes
+    the measured request latencies (the fleet's real routers hold traffic
+    on the warming 503 the same way)."""
+
+    deadline = time.monotonic() + timeout_s
+    while server.warmup_status()["state"] in ("pending", "running") \
+            and time.monotonic() < deadline:
+        time.sleep(0.05)
+
+
+# --------------------------------------------------------------------- #
+# arm 1: ONNX ingest onto the linear fast path, end-to-end
+# --------------------------------------------------------------------- #
+
+
+def _logreg_graph_spec(W: np.ndarray, b: np.ndarray):
+    """The logistic-regression graph (Gemm -> Sigmoid), as a real ONNX
+    ModelProto when the package is installed (round-tripping through
+    serialized bytes, the customer hand-off shape), else as the
+    equivalent GraphSpec the same translator consumes."""
+
+    from distributedkernelshap_tpu.registry import (
+        GraphSpec,
+        NodeSpec,
+        lift_graph,
+        lift_onnx,
+    )
+
+    try:
+        import onnx
+        from onnx import TensorProto, helper, numpy_helper
+
+        graph = helper.make_graph(
+            [helper.make_node("Gemm", ["X", "W", "b"], ["z"]),
+             helper.make_node("Sigmoid", ["z"], ["y"])],
+            "logreg",
+            [helper.make_tensor_value_info(
+                "X", TensorProto.FLOAT, [None, W.shape[0]])],
+            [helper.make_tensor_value_info(
+                "y", TensorProto.FLOAT, [None, 1])],
+            initializer=[numpy_helper.from_array(W, "W"),
+                         numpy_helper.from_array(b, "b")])
+        model = helper.make_model(graph)
+        return lift_onnx(model.SerializeToString()), True
+    except ImportError:
+        spec = GraphSpec(
+            nodes=[NodeSpec("Gemm", ("X", "W", "b"), ("z",), {}),
+                   NodeSpec("Sigmoid", ("z",), ("y",), {})],
+            initializers={"W": W, "b": b},
+            input_name="X", output_name="y", input_dim=W.shape[0])
+        return lift_graph(spec), False
+
+
+def run_onnx_arm():
+    from distributedkernelshap_tpu.registry import ModelRegistry
+    from distributedkernelshap_tpu.serving.wrappers import (
+        BatchKernelShapModel,
+    )
+
+    rng = np.random.default_rng(11)
+    W = rng.normal(size=(ONNX_D, 1)).astype(np.float32)
+    b = rng.normal(size=(1,)).astype(np.float32)
+    pred, onnx_available = _logreg_graph_spec(W, b)
+
+    bg = rng.normal(size=(12, ONNX_D)).astype(np.float32)
+    serving = BatchKernelShapModel(pred, bg, {"link": "logit", "seed": 0},
+                                  {})
+    registry = ModelRegistry()
+    rm = registry.register("onnx_lr", serving)
+    server = _serve_registry(registry, max_batch_size=4, warmup=True,
+                             cache_bytes=1 << 20)
+    try:
+        # the ladder must finish (and stamp its model=... compile
+        # signatures) before the timed requests
+        _wait_warm(server, timeout_s=60)
+        row = rng.normal(size=(1, ONNX_D)).astype(np.float32)
+        results = open_loop(server, [
+            (0.0, row, {"X-DKS-Model": "onnx_lr"}, "first"),
+            (0.1, row, {"X-DKS-Model": "onnx_lr"}, "dup"),
+        ])
+        metrics = scrape_metrics(server)
+        statuses = sorted(s for _, s, _, _ in results)
+        payloads = {tag: p for tag, s, _, p in results if s == 200}
+        signed = [name for name in metrics
+                  if name.startswith("dks_compile_total")
+                  and "model=onnx_lr@v1" in name]
+        hits = metrics.get("dks_serve_cache_hits_total", 0)
+    finally:
+        server.stop()
+    # additivity of the served ONNX model (sanity that the lift is real)
+    data = _payload_data(payloads.get("first", '{"data": {}}'))
+    additive = False
+    if data.get("shap_values") is not None:
+        total = (np.asarray(data["shap_values"]).sum(-1)
+                 + np.asarray(data["expected_value"])[:, None])
+        additive = bool(np.allclose(
+            total, np.asarray(data["raw"]["raw_prediction"]).T, atol=1e-3))
+    return {
+        "onnx_available": onnx_available,
+        "classified_path": rm.path,
+        "statuses": statuses,
+        "warmup_state": server.warmup_status()["state"],
+        "namespace_signed_compiles": signed[:4],
+        "cache_hits": int(hits),
+        "dup_bit_identical": (payloads.get("first") == payloads.get("dup")
+                              and "first" in payloads),
+        "additivity_ok": additive,
+        "fingerprint": rm.fingerprint,
+    }
+
+
+# --------------------------------------------------------------------- #
+# arm 2: >=3 families served concurrently, phi vs dedicated deployments
+# --------------------------------------------------------------------- #
+
+
+def run_multifamily_arm(requests_per_family=24, rate_rps=60.0, pool=6,
+                        seed=0):
+    from distributedkernelshap_tpu.registry import ModelRegistry
+
+    registry = ModelRegistry()
+    for name, build in FAMILIES.items():
+        registry.register(name, build())
+    paths = {name: registry.resolve(name).path for name in FAMILIES}
+
+    rng = np.random.default_rng(seed)
+    rows = {name: rng.normal(size=(pool, 1, D)).astype(np.float32)
+            for name in FAMILIES}
+    # dedicated single-model deployments: fresh, separately constructed
+    # models from the same deterministic builders — the reference answers
+    dedicated = {name: build() for name, build in FAMILIES.items()}
+    expected = {}
+    for name in FAMILIES:
+        for i in range(pool):
+            expected[(name, i)] = _phi_of(
+                dedicated[name].explain_batch(rows[name][i])[0])
+
+    # max_batch_size=1: the bit-identity claim is that the GATEWAY adds
+    # zero numeric perturbation vs a dedicated deployment.  Coalescing
+    # changes f32 reduction order at the ~1-ULP level for B>1 batches (a
+    # pre-existing engine property, independent of multitenancy), so the
+    # parity arm pins every device call to the dedicated deployment's
+    # B=1 shape; tenants still interleave concurrently through the
+    # scheduler and the pipelined dispatcher.
+    server = _serve_registry(registry, max_batch_size=1, warmup=True)
+    try:
+        _wait_warm(server)
+        plan = []
+        n = requests_per_family * len(FAMILIES)
+        order = [name for name in FAMILIES] * requests_per_family
+        for k, name in enumerate(order):
+            i = int(rng.integers(pool))
+            plan.append((k / rate_rps, rows[name][i],
+                         {"X-DKS-Model": name}, (name, i)))
+        t0 = time.monotonic()
+        results = open_loop(server, plan)
+        wall = time.monotonic() - t0
+        metrics = scrape_metrics(server)
+    finally:
+        server.stop()
+
+    ok = [r for r in results if r[1] == 200]
+    mismatches = sum(1 for tag, s, _, payload in results
+                     if s == 200 and _phi_of(payload) != expected[tag])
+    per_model_counts = {
+        name: int(metrics.get(
+            f'dks_registry_requests_total{{model="{name}"}}', 0))
+        for name in FAMILIES}
+    return {
+        "wall_s": round(wall, 3),
+        "n": n,
+        "ok": len(ok),
+        "goodput_rps": round(len(ok) / wall, 2),
+        "paths": paths,
+        "phi_mismatches": mismatches,
+        "per_model_requests_total": per_model_counts,
+        "families_served": sorted(set(tag[0] for tag, s, _, _ in results
+                                      if s == 200)),
+    }
+
+
+# --------------------------------------------------------------------- #
+# arm 3: hot swap mid-run
+# --------------------------------------------------------------------- #
+
+
+def run_hotswap_arm(n_per_segment=24, rate_rps=40.0, seed=3):
+    """Two open-loop segments around a mid-run hot swap.
+
+    Segment A streams against v1 and TRIGGERS the swap after a few
+    requests, so v2's ladder warm-up, the atomic flip and v1's drain all
+    overlap live traffic (in-flight requests pinned v1 and must finish
+    on it).  After the swap thread joins, segment B streams again — by
+    then the flip is complete, so every segment-B answer must be
+    bit-identical v2.  ``max_batch_size=1`` for the same bit-identity
+    reason as the multi-family arm."""
+
+    from distributedkernelshap_tpu.registry import ModelRegistry
+
+    registry = ModelRegistry()
+    registry.register("lin", build_linear(seed=1))
+    rng = np.random.default_rng(seed)
+    row = rng.normal(size=(1, D)).astype(np.float32)
+    v1_phi = _phi_of(build_linear(seed=1).explain_batch(row)[0])
+    v2_phi = _phi_of(build_linear(seed=2).explain_batch(row)[0])
+
+    server = _serve_registry(registry, cache_bytes=0, max_batch_size=1,
+                             warmup=True)
+    swap_started = threading.Event()
+    swap_done = threading.Event()
+
+    def swap():
+        swap_started.wait()
+        # the gateway's hot-swap: warm v2's ladder, flip, drain v1 — all
+        # while segment A keeps firing
+        registry.register("lin", build_linear(seed=2))
+        swap_done.set()
+
+    swapper = threading.Thread(target=swap, daemon=True)
+    swapper.start()
+    try:
+        _wait_warm(server)
+        plan_a = []
+        for k in range(n_per_segment):
+            plan_a.append((k / rate_rps, row, {"X-DKS-Model": "lin"}, k))
+
+        def trigger():
+            time.sleep((n_per_segment // 4) / rate_rps)
+            swap_started.set()
+
+        threading.Thread(target=trigger, daemon=True).start()
+        results_a = open_loop(server, plan_a)
+        swapper.join(timeout=120)
+        overlapped = swap_done.is_set() and any(
+            s == 200 for _, s, _, _ in results_a)
+        results_b = open_loop(server, [
+            (k / rate_rps, row, {"X-DKS-Model": "lin"}, k)
+            for k in range(n_per_segment)])
+        v1_rm = registry._models["lin"]["versions"][1]
+        drained = v1_rm.state == "retired" and v1_rm.inflight == 0
+    finally:
+        server.stop()
+
+    results = results_a + results_b
+    lost = 2 * n_per_segment - sum(1 for _, s, _, _ in results if s == 200)
+    wrong = sum(1 for _, s, _, p in results
+                if s == 200 and _phi_of(p) not in (v1_phi, v2_phi))
+    # every request fired after the swap completed must answer v2 (the
+    # flip is atomic at admission; segment-A in-flights may be either)
+    post_swap_non_v2 = sum(1 for _, s, _, p in results_b
+                           if s == 200 and _phi_of(p) != v2_phi)
+    v2_answers = sum(1 for _, s, _, p in results
+                     if s == 200 and _phi_of(p) == v2_phi)
+    return {
+        "n": 2 * n_per_segment,
+        "lost": lost,
+        "changed_or_mixed": wrong,
+        "post_swap_non_v2": post_swap_non_v2,
+        "v2_answers": v2_answers,
+        "swap_completed": swap_done.is_set(),
+        "swap_overlapped_traffic": overlapped,
+        "v1_drained_retired": drained,
+    }
+
+
+# --------------------------------------------------------------------- #
+# arm 4: noisy tenant vs quota isolation
+# --------------------------------------------------------------------- #
+
+
+def run_noisy_arm(victim_requests=32, flood_requests=120,
+                  victim_rate=30.0, flood_rate=150.0, flood_rows=8,
+                  slo_p99_s=2.0, seed=4):
+    from distributedkernelshap_tpu.registry import ModelRegistry, TenantQuota
+
+    registry = ModelRegistry()
+    registry.register("victim_a", build_linear(seed=1))
+    registry.register("victim_b", build_tt())
+    # the quota's in-flight bound also caps how many flood requests the
+    # scheduler can COALESCE into one device batch (same tenant, same
+    # engine), so a victim never waits behind an unbounded same-model
+    # mega-batch — the queue-bound half of tenant isolation
+    registry.register("noisy", build_linear(seed=5),
+                      quota=TenantQuota(rate_per_s=5.0, burst=3,
+                                        max_inflight=3))
+    rng = np.random.default_rng(seed)
+    server = _serve_registry(registry, max_queue_per_class=10_000,
+                             warmup=True)
+    try:
+        # warm every tenant's ladder first: the victims' p99 must measure
+        # steady-state isolation, not the TT path's first-compile
+        _wait_warm(server)
+        plan = []
+        for k in range(victim_requests):
+            for name in ("victim_a", "victim_b"):
+                plan.append((k / victim_rate,
+                             rng.normal(size=(1, D)).astype(np.float32),
+                             {"X-DKS-Model": name,
+                              "X-DKS-Priority": "interactive"},
+                             name))
+        for k in range(flood_requests):
+            plan.append((k / flood_rate,
+                         rng.normal(size=(flood_rows, D)).astype(
+                             np.float32),
+                         {"X-DKS-Model": "noisy",
+                          "X-DKS-Priority": "interactive"},
+                         "noisy"))
+        results = open_loop(server, plan)
+        metrics = scrape_metrics(server)
+    finally:
+        server.stop()
+
+    by_tag = {}
+    for tag, status, latency, _ in results:
+        by_tag.setdefault(tag, []).append((status, latency))
+    summary = {}
+    for tag, rs in sorted(by_tag.items()):
+        lat_ok = [lat for s, lat in rs if s == 200]
+        summary[tag] = {
+            "n": len(rs), "ok": len(lat_ok),
+            "shed_429": sum(1 for s, _ in rs if s == 429),
+            "p99_s": round(percentile(lat_ok, 99), 4) if lat_ok else None,
+        }
+    tenant_sheds = {
+        name: sum(v for k, v in metrics.items()
+                  if k.startswith("dks_registry_sheds_total")
+                  and f'model="{name}"' in k)
+        for name in ("victim_a", "victim_b", "noisy")}
+    summary["victim_interactive_p99_s"] = max(
+        summary["victim_a"]["p99_s"] or 0.0,
+        summary["victim_b"]["p99_s"] or 0.0)
+    summary["slo_p99_s"] = slo_p99_s
+    summary["tenant_sheds"] = {k: int(v) for k, v in tenant_sheds.items()}
+    return summary
+
+
+# --------------------------------------------------------------------- #
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--requests_per_family", type=int, default=24)
+    parser.add_argument("--slo_p99_s", type=float, default=2.0,
+                        help="victims' interactive p99 bound in the "
+                             "noisy-tenant arm")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 unless the acceptance criteria hold")
+    parser.add_argument("--history", default=None,
+                        help="perf-history JSONL this run appends to "
+                             "(default: results/perf_history.jsonl)")
+    parser.add_argument("--no-record", action="store_true",
+                        help="skip the perf-history self-record")
+    args = parser.parse_args()
+
+    onnx_arm = run_onnx_arm()
+    multi = run_multifamily_arm(
+        requests_per_family=args.requests_per_family)
+    swap = run_hotswap_arm()
+    noisy = run_noisy_arm(slo_p99_s=args.slo_p99_s)
+
+    checks = {
+        # ONNX logistic regression lands on the linear fast path and is
+        # served end-to-end with namespace-scoped warmup + cache
+        "onnx_linear_fast_path": onnx_arm["classified_path"] == "linear",
+        "onnx_served_200": onnx_arm["statuses"] == [200, 200],
+        "onnx_warmup_namespace_signed":
+            len(onnx_arm["namespace_signed_compiles"]) > 0,
+        "onnx_cache_hit_scoped": (onnx_arm["cache_hits"] >= 1
+                                  and onnx_arm["dup_bit_identical"]),
+        "onnx_additivity_ok": onnx_arm["additivity_ok"],
+        # >=3 families concurrently, bit-identical to dedicated
+        "three_families_concurrent":
+            len(multi["families_served"]) >= 3,
+        "paths_diverse": sorted(set(multi["paths"].values())) == [
+            "exact_tn", "exact_tree", "linear"],
+        "phi_bit_identical_vs_dedicated": (multi["ok"] == multi["n"]
+                                           and multi["phi_mismatches"]
+                                           == 0),
+        # hot swap: zero lost, zero changed, post-swap answers are v2
+        "hotswap_zero_lost": swap["lost"] == 0,
+        "hotswap_zero_changed": swap["changed_or_mixed"] == 0,
+        "hotswap_post_swap_v2": (swap["swap_completed"]
+                                 and swap["swap_overlapped_traffic"]
+                                 and swap["post_swap_non_v2"] == 0
+                                 and swap["v2_answers"] > 0),
+        "hotswap_v1_drained": swap["v1_drained_retired"],
+        # noisy tenant: the flooder sheds, the victims hold their SLO
+        "noisy_tenant_sheds": (noisy["noisy"]["shed_429"] > 0
+                               and noisy["tenant_sheds"]["noisy"] > 0),
+        "victims_never_shed": (noisy["victim_a"]["shed_429"] == 0
+                               and noisy["victim_b"]["shed_429"] == 0
+                               and noisy["victim_a"]["ok"]
+                               == noisy["victim_a"]["n"]
+                               and noisy["victim_b"]["ok"]
+                               == noisy["victim_b"]["n"]),
+        "victims_hold_p99_slo": (noisy["victim_interactive_p99_s"]
+                                 <= args.slo_p99_s),
+    }
+    report = {
+        "bench": "multitenant",
+        "onnx": onnx_arm,
+        "multi_family": multi,
+        "hot_swap": swap,
+        "noisy_tenant": noisy,
+        "checks": checks,
+        "ok": all(checks.values()),
+    }
+    if not args.no_record:
+        from benchmarks.regression_gate import DEFAULT_HISTORY, record_run
+
+        entry = record_run(
+            args.history or DEFAULT_HISTORY, bench="multitenant",
+            config={"requests_per_family": args.requests_per_family,
+                    "slo_p99_s": args.slo_p99_s,
+                    # model identities: runs against a different roster
+                    # must not share a baseline (PR 10 satellite — the
+                    # gate fingerprint covers the whole config)
+                    "models": [
+                        {"model_id": name, "model_version": 1,
+                         "family": name} for name in FAMILIES]},
+            metrics={"wall_s": multi["wall_s"],
+                     "victim_interactive_p99_s":
+                         noisy["victim_interactive_p99_s"],
+                     "goodput_rps": multi["goodput_rps"]},
+            extra={"checks_ok": report["ok"],
+                   "paths": multi["paths"]})
+        report["perf_history"] = {"git_sha": entry["git_sha"],
+                                  "config_fp": entry["config_fp"]}
+    print(json.dumps(report))
+    if args.check and not report["ok"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -85,11 +85,21 @@ def git_sha() -> str:
 
 def record_run(history_path: str, bench: str, config: Dict,
                metrics: Dict[str, float],
-               extra: Optional[Dict] = None) -> Dict:
+               extra: Optional[Dict] = None,
+               model_id: Optional[str] = None,
+               model_version: Optional[int] = None) -> Dict:
     """Append one run to the history (fsync'd, one JSON line) and return
     the entry.  ``metrics`` should carry ``wall_s`` plus any ``*p99_s``
-    series the gate should watch."""
+    series the gate should watch.  ``model_id``/``model_version``
+    attribute the run to one registered model (multi-tenant fleets) —
+    they fold into ``config`` BEFORE fingerprinting, so runs against
+    different models (or versions) get distinct baselines instead of
+    polluting each other's medians."""
 
+    if model_id is not None:
+        config = dict(config, model_id=model_id)
+        if model_version is not None:
+            config["model_version"] = model_version
     entry = {
         "ts": time.time(),
         "bench": bench,

@@ -1,0 +1,18 @@
+from distributedkernelshap_tpu.registry.classify import (  # noqa: F401
+    ENGINE_PATHS,
+    PathDecision,
+    classify_path,
+)
+from distributedkernelshap_tpu.registry.onnx_lift import (  # noqa: F401
+    SUPPORTED_ONNX_OPS,
+    GraphSpec,
+    NodeSpec,
+    UnsupportedOpError,
+    lift_graph,
+    lift_onnx,
+)
+from distributedkernelshap_tpu.registry.registry import (  # noqa: F401
+    ModelRegistry,
+    RegisteredModel,
+    TenantQuota,
+)

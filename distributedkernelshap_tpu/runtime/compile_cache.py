@@ -56,20 +56,25 @@ _state_lock = threading.Lock()
 _enabled_dir: Optional[str] = None
 
 
-def shape_signature(rows: int, path: Optional[str] = None) -> str:
+def shape_signature(rows: int, path: Optional[str] = None,
+                    model: Optional[str] = None) -> str:
     """The ONE spelling of a declared compile-shape signature
-    (``rows=<bucket>[,path=<explain path>]``).  Today only the warmup
-    ladder declares signatures (live request compiles fold into
-    ``_unattributed``); ``path`` distinguishes the exact-TreeSHAP entry
-    from the sampled pipeline at the same bucket — they are distinct
-    executables, so a ladder that warmed only one of them shows up as
-    such in ``dks_compile_total`` instead of hiding behind a shared
-    label.  Any future live-dispatch attribution must spell its
-    signatures through this helper so the labels collide with the
-    matching rung's."""
+    (``[model=<id>,]rows=<bucket>[,path=<explain path>]``).  Today only
+    the warmup ladder declares signatures (live request compiles fold
+    into ``_unattributed``); ``path`` distinguishes the exact-TreeSHAP
+    entry from the sampled pipeline at the same bucket — they are
+    distinct executables, so a ladder that warmed only one of them shows
+    up as such in ``dks_compile_total`` instead of hiding behind a shared
+    label.  ``model`` is the multi-tenant registry's namespace prefix:
+    each registered ``(model_id, version)`` warms its OWN executables, so
+    its rungs must be attributable per tenant.  Any future live-dispatch
+    attribution must spell its signatures through this helper so the
+    labels collide with the matching rung's."""
 
     sig = f"rows={int(rows)}"
-    return sig if not path else f"{sig},path={path}"
+    if path:
+        sig = f"{sig},path={path}"
+    return sig if not model else f"model={model},{sig}"
 
 
 def enable_persistent_cache(cache_dir: Optional[str] = None,

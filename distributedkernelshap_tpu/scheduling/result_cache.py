@@ -22,11 +22,59 @@ so a hit is bit-identical to the original response — additivity and all.
 """
 
 import hashlib
+import logging
 import threading
 from collections import OrderedDict
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
+
+# weak-fingerprint accounting (process-global, rendered via a registry
+# callback like the explain-path counters): every model_fingerprint that
+# had to fall back to in-process identity for its predictor — the
+# stale-cache-across-restart hazard flagged since PR 2 — is counted here
+# and warned about loudly ONCE per process instead of silently.
+_weak_lock = threading.Lock()
+_weak_count = 0
+_weak_warned = False
+
+
+def record_weak_fingerprint(predictor) -> None:
+    global _weak_count, _weak_warned
+    with _weak_lock:
+        _weak_count += 1
+        first = not _weak_warned
+        _weak_warned = True
+    if first:
+        logger.warning(
+            "model fingerprint fell back to in-process identity for %s: "
+            "cache keys will NOT survive a restart and an in-place "
+            "predictor swap is undetectable.  Register the model through "
+            "the ModelRegistry (content fingerprints) or pin "
+            "model.fingerprint explicitly.  Counted in "
+            "dks_result_cache_weak_fingerprint_total.",
+            type(predictor).__name__)
+
+
+def weak_fingerprint_total() -> float:
+    with _weak_lock:
+        return float(_weak_count)
+
+
+def attach_weak_fingerprint_metric(registry) -> None:
+    """Register ``dks_result_cache_weak_fingerprint_total`` on
+    ``registry``: model fingerprints that fell back to in-process
+    predictor identity (restart-unstable cache keys)."""
+
+    registry.counter(
+        "dks_result_cache_weak_fingerprint_total",
+        "Model fingerprints derived from in-process predictor identity "
+        "(id()) because the predictor exposed no hashable content — such "
+        "cache keys do not survive a restart.  Registry-registered "
+        "models always get content fingerprints and never count here.",
+    ).set_function(weak_fingerprint_total)
 
 
 def array_fingerprint(array: np.ndarray) -> str:
@@ -63,16 +111,85 @@ def _update_structured(h, value) -> None:
         h.update(repr(value).encode())
 
 
-def model_fingerprint(model, explain_kwargs: Optional[dict] = None) -> str:
+def _is_array_like(value) -> bool:
+    """Numpy/JAX arrays (anything exposing shape+dtype that numpy can
+    materialise) — the content a predictor's fingerprint hashes."""
+
+    return hasattr(value, "shape") and hasattr(value, "dtype") \
+        and not np.isscalar(value)
+
+
+def _collect_content(value, h, depth: int = 0) -> int:
+    """Feed every array reachable from ``value`` (attr dicts, sequences,
+    nested predictors — bounded depth) into ``h``; returns how many
+    arrays were hashed."""
+
+    if depth > 4:
+        return 0
+    if value is None or isinstance(value, (str, bytes, bool, int, float)):
+        # scalar config (activation names, out_transform, offsets, ...)
+        # is part of the content — two predictors sharing arrays but
+        # differing in a plain attribute must NOT collide — but scalars
+        # alone do not make a fingerprint "content-based" (return 0):
+        # without parameter arrays the id() fallback still applies
+        h.update(repr(value).encode())
+        return 0
+    if _is_array_like(value):
+        try:
+            h.update(array_fingerprint(np.asarray(value)).encode())
+            return 1
+        except Exception:
+            return 0
+    if isinstance(value, (list, tuple)):
+        return sum(_collect_content(v, h, depth + 1) for v in value)
+    if isinstance(value, dict):
+        found = 0
+        for k in sorted(value, key=repr):
+            h.update(repr(k).encode())
+            found += _collect_content(value[k], h, depth + 1)
+        return found
+    attrs = getattr(value, "__dict__", None)
+    if attrs is not None and depth < 4 and hasattr(value, "n_outputs"):
+        # nested predictors (composite lifts hold member predictors)
+        found = 0
+        for key in sorted(attrs):
+            h.update(repr(key).encode())
+            found += _collect_content(attrs[key], h, depth + 1)
+        return found
+    return 0
+
+
+def predictor_fingerprint(predictor) -> Tuple[str, bool]:
+    """``(digest, weak)`` for a predictor: a content hash over its class
+    name and every parameter array reachable from its attributes
+    (coefficients, tree tensors, TT cores, MLP layers — stable across
+    restarts and across distinct-but-identical objects), or — when no
+    array content is reachable (host callbacks, stub models) — the
+    historical in-process identity with ``weak=True``."""
+
+    h = hashlib.sha256()
+    h.update(type(predictor).__qualname__.encode())
+    found = _collect_content(getattr(predictor, "__dict__", None) or {}, h)
+    if found:
+        return h.hexdigest(), False
+    return (f"{type(predictor).__qualname__}:{id(predictor)}", True)
+
+
+def model_fingerprint(model, explain_kwargs: Optional[dict] = None,
+                      count_weak: bool = True) -> str:
     """Fingerprint of everything besides the instance rows that determines
     an explanation: background digest, link, grouping, seed, pinned explain
     options and the predictor's in-process identity.
 
-    A model may pin its own ``fingerprint`` attribute (e.g. a hash of
-    checkpoint weights, so restarts share keys); otherwise the fingerprint
-    is derived by introspection.  Predictor identity falls back to
-    ``id(predictor)``, which is correct within one process — a *different*
-    predictor object can only cause misses, never wrong answers.
+    A model may pin its own ``fingerprint`` attribute (the registry does —
+    ``model_id@vN:<content digest>`` — so restarts share keys); otherwise
+    the fingerprint is derived by introspection.  Predictor identity is a
+    CONTENT hash of its parameter arrays when any are reachable
+    (:func:`predictor_fingerprint`); only parameterless predictors (host
+    callbacks, stubs) fall back to ``id(predictor)`` — correct within one
+    process (a different object can only cause misses, never wrong
+    answers) but restart-unstable, so the fallback is counted in
+    ``dks_result_cache_weak_fingerprint_total`` and warned about once.
     """
 
     explicit = getattr(model, "fingerprint", None)
@@ -95,7 +212,13 @@ def model_fingerprint(model, explain_kwargs: Optional[dict] = None) -> str:
     _update_structured(h, kwargs or {})
     predictor = getattr(engine, "predictor",
                         getattr(explainer, "predictor", None))
-    h.update(f"{type(predictor).__qualname__}:{id(predictor)}".encode())
+    digest, weak = predictor_fingerprint(predictor)
+    if weak and count_weak:
+        # count_weak=False is the registry's ingest path: it namespaces
+        # the digest under a declared (model_id, version), so even a
+        # parameterless predictor's keys are restart-stable
+        record_weak_fingerprint(predictor)
+    h.update(digest.encode())
     return h.hexdigest()
 
 

@@ -83,13 +83,14 @@ class _HTTPServer(ThreadingHTTPServer):
 class _Pending:
     __slots__ = ("array", "event", "response", "error", "t_enqueued", "done",
                  "klass", "deadline", "cache_key", "status_code", "cache_hit",
-                 "trace", "wire_format")
+                 "trace", "wire_format", "model")
 
     def __init__(self, array: np.ndarray, klass: str = "interactive",
                  deadline: Optional[float] = None,
                  cache_key: Optional[str] = None,
                  trace: Optional[_tracing.SpanContext] = None,
-                 wire_format: str = "json"):
+                 wire_format: str = "json",
+                 model=None):
         self.array = array
         self.event = threading.Event()
         self.response: Optional[str] = None
@@ -119,6 +120,11 @@ class _Pending:
         # document) or "binary" (serving/wire.py raw-bytes payload, asked
         # for via Accept and only granted when the model can produce it)
         self.wire_format = wire_format
+        # registry mode: the RegisteredModel PINNED at admission — a
+        # hot-swap mid-flight must not change this request's answer, so
+        # dispatch/caching/metrics all read the pinned version (None in
+        # single-model mode)
+        self.model = model
 
     @property
     def rows(self) -> int:
@@ -331,7 +337,7 @@ class ExplainerServer:
         as ``dks_staging_overlap_seconds_total``.
     """
 
-    def __init__(self, model, host: str = "0.0.0.0", port: int = 8000,
+    def __init__(self, model=None, host: str = "0.0.0.0", port: int = 8000,
                  max_batch_size: int = 1, batch_timeout_s: float = 0.01,
                  pipeline_depth: Optional[int] = None,
                  watchdog_timeout_s: float = 120.0,
@@ -348,7 +354,20 @@ class ExplainerServer:
                  health_interval_s: float = 1.0,
                  slos=None, alert_rules=None, alert_sinks=None,
                  warmup: Optional[bool] = None,
-                 staging: Optional[bool] = None):
+                 staging: Optional[bool] = None,
+                 registry=None):
+        # multi-tenant gateway mode (registry/registry.py): requests route
+        # by X-DKS-Model (or the JSON/wire `model` field) to the named
+        # tenant's ACTIVE version; ``model`` then only names the default
+        # deployment used for depth calibration and staging capability
+        # resolution (None = the registry's default model at start()).
+        # Without a registry the server is the historical single-model one.
+        if model is None and registry is None:
+            raise ValueError("ExplainerServer needs a model, a registry, "
+                             "or both")
+        self._registry = registry
+        if registry is not None:
+            registry.attach_server(self)
         self.model = model
         self.host = host
         self.port = port
@@ -510,7 +529,7 @@ class ExplainerServer:
             "Requests shed before dispatch, by reason.",
             labelnames=("reason",)).seed(
             "deadline_expired", "projected_wait", "queue_full",
-            "rate_limited")
+            "rate_limited", "tenant_queue_full", "tenant_rate_limited")
         # streaming hot path: payload bytes by negotiated wire format
         # (rx = request bodies, tx = success response payloads) and the
         # measured upload/compute overlap of the staging pipeline
@@ -622,6 +641,64 @@ class ExplainerServer:
                     labelnames=("phase",)).set_function(
             lambda: {(name,): s["count"]
                      for name, s in profiler().summary().items()})
+        # multi-tenant registry (registry/registry.py): per-model request /
+        # latency / quota-shed / swap accounting, rendered via callbacks
+        # into the attached registry (empty series in single-model mode —
+        # the families still register so the catalog is mode-independent)
+        self._register_registry_metrics(reg)
+        # weak-fingerprint accounting (scheduling/result_cache.py): model
+        # fingerprints that fell back to in-process identity — the
+        # stale-cache-across-restart hazard, now loud instead of silent
+        from distributedkernelshap_tpu.scheduling.result_cache import (
+            attach_weak_fingerprint_metric,
+        )
+
+        attach_weak_fingerprint_metric(reg)
+
+    def _register_registry_metrics(self, reg) -> None:
+        def from_registry(method):
+            def sample():
+                r = self._registry
+                return getattr(r, method)() if r is not None else {}
+            return sample
+
+        reg.gauge(
+            "dks_registry_models",
+            "Active (model, version) deployments by classified engine "
+            "path (1 per active version).",
+            labelnames=("model", "version", "path")).set_function(
+            from_registry("metric_models"))
+        reg.counter(
+            "dks_registry_requests_total",
+            "Requests answered per registered model (active versions; "
+            "counted on the version that admitted the request).",
+            labelnames=("model",)).set_function(
+            from_registry("metric_requests"))
+        reg.counter(
+            "dks_registry_request_seconds_total",
+            "Total queue+explain seconds per registered model.",
+            labelnames=("model",)).set_function(
+            from_registry("metric_seconds"))
+        reg.gauge(
+            "dks_registry_inflight",
+            "Requests currently pinned to each registered model "
+            "(queued + executing).",
+            labelnames=("model",)).set_function(
+            from_registry("metric_inflight"))
+        reg.counter(
+            "dks_registry_sheds_total",
+            "Requests shed by per-tenant quotas, by model and reason "
+            "(tenant_rate_limited = token bucket, tenant_queue_full = "
+            "in-flight bound); these also count in dks_serve_sheds_total "
+            "under the same reasons.",
+            labelnames=("model", "reason")).set_function(
+            from_registry("metric_sheds"))
+        reg.counter(
+            "dks_registry_swaps_total",
+            "Version registrations per model id (the first registration "
+            "counts too; value N means N-1 hot swaps).",
+            labelnames=("model",)).set_function(
+            from_registry("metric_swaps"))
 
     def _count_request(self, pending, error=None):
         """Per-request counter accounting, shared by _complete's live loop
@@ -639,11 +716,23 @@ class ExplainerServer:
         self._m_request_seconds.inc(elapsed)
         self._m_latency.observe(elapsed)
         self._m_class_latency.observe(elapsed, **{"class": pending.klass})
+        if pending.model is not None:
+            # per-tenant accounting on the version that ADMITTED the
+            # request (hot-swap safe: the pin, not the active pointer)
+            pending.model.record_answer(elapsed, error is not None)
 
     def _cache_key_for(self, array: np.ndarray,
-                       wire_format: str = "json") -> Optional[str]:
+                       wire_format: str = "json",
+                       rm=None) -> Optional[str]:
         if self._cache is None:
             return None
+        if rm is not None:
+            # registry mode: the (model_id, version, content) fingerprint
+            # the registry pinned at register time — cache hits are scoped
+            # to the tenant AND the version, so a hot-swap makes the old
+            # version's entries unreachable instead of stale
+            key = request_cache_key(array, rm.fingerprint)
+            return key if wire_format == "json" else f"{key}#{wire_format}"
         with self._model_fp_lock:
             model = self.model
             if self._model_fp is None or self._model_fp_model is not model:
@@ -818,6 +907,10 @@ class ExplainerServer:
         if self._cache is not None:
             detail["cache"] = self._cache.stats()
         detail["warmup"] = self.warmup_status()
+        if self._registry is not None:
+            # the multi-tenant panel: per-model active version, engine
+            # path, fingerprint, in-flight pins, quota and drain state
+            detail["registry"] = self._registry.statusz_panel()
         return detail
 
     def _split_batch_on_cache(self, batch):
@@ -897,6 +990,99 @@ class ExplainerServer:
             return sorted(sizes)
         return sorted({int(bucket(n)) for n in range(1, top + 1)})
 
+    @staticmethod
+    def _warmup_engine(model):
+        """The engine whose ``background``/``_bucket`` the warmup ladder
+        uses.  Looser than the classifier's ``serving_engine`` (which
+        requires a ``predictor``): warmup only needs rows to tile and a
+        bucket function, and test/stub models legitimately expose just
+        that."""
+
+        engine = getattr(getattr(model, "explainer", None),
+                         "_explainer", None)
+        if getattr(engine, "background", None) is None:
+            # DistributedExplainer wraps the real engine one level down;
+            # the ladder then comes from the inner engine's _bucket —
+            # bucketing is idempotent, so those rungs cover every shape
+            # _pad_sharded produces for real dispatches
+            engine = getattr(engine, "engine", None)
+        return engine
+
+    def _warmup_targets(self):
+        """``(label, serving model, rm)`` triples the start-time ladder
+        warms: every active registered model in registry mode (labels
+        feed the ``model=<id>@vN`` compile-signature namespace) that a
+        register-time ``_warm_model`` has not already warmed — the
+        device work per rung is real even when the compiles are cache
+        hits, so the ladder must not run twice per model — else the
+        single bound model with no label."""
+
+        if self._registry is not None:
+            return [(rm.label, rm.model, rm)
+                    for rm in self._registry.active_models()
+                    if not rm.warmed]
+        return [(None, self.model, None)]
+
+    def _warm_rung(self, model, label, b: int, row: np.ndarray,
+                   root=None) -> None:
+        """One ladder rung for one model: trace+compile the bucket-``b``
+        program under its declared compile signature
+        (``[model=<label>,]rows=<b>[,path=...]``)."""
+
+        from distributedkernelshap_tpu.runtime.compile_cache import (
+            compile_events,
+            shape_signature,
+        )
+
+        tr = self._tracer
+        span = (tr.begin("warmup.bucket", parent=root, rows=b,
+                         model=label)
+                if tr.enabled else None)
+        try:
+            # the declared signature carries the deployment's evaluation
+            # path AND (registry mode) its model namespace: the exact
+            # entry and the sampled pipeline are distinct executables at
+            # the same bucket, and so are two tenants' programs — the
+            # compile accounting must attribute each rung to the one it
+            # warmed
+            sig = shape_signature(b, getattr(model, "explain_path", None),
+                                  model=label)
+            with profiler().phase("warmup"), \
+                    compile_events().signature(sig):
+                model.explain_batch(np.tile(row, (b, 1)),
+                                    split_sizes=[b])
+        finally:
+            if span is not None:
+                tr.end(span)
+
+    def _warm_model(self, rm) -> None:
+        """Warm ONE registered model's full compile ladder — the
+        registry's hot-swap path: version N+1 compiles its executables
+        (under its own ``model=`` signature namespace) while version N
+        keeps serving, so the atomic flip lands on warm programs.  Runs
+        on the registering thread; the new version's engine is not yet
+        dispatched by anyone else, and concurrent device work from the
+        live dispatcher serialises at the device like any other caller."""
+
+        engine = self._warmup_engine(rm.model)
+        bg = getattr(engine, "background", None)
+        if bg is None or not hasattr(rm.model, "explain_batch"):
+            logger.warning("cannot warm %s: it exposes no engine "
+                           "background; it will serve cold", rm.label)
+            return
+        ladder = [int(b) for b in self._warmup_ladder(engine)]
+        row = np.asarray(bg[:1], dtype=np.float32)
+        t0 = time.monotonic()
+        for b in ladder:
+            if self._stop.is_set():
+                return
+            self._warm_rung(rm.model, rm.label, b, row)
+        rm.warmed = True  # the start-time ladder then skips this model
+        self._flight.record("warmup", component="server", state="done",
+                            model=rm.label, buckets=ladder)
+        logger.info("warmed %s: buckets %s in %.1fs", rm.label, ladder,
+                    time.monotonic() - t0)
+
     def _run_warmup(self) -> None:
         """Trace+compile the engine over the bucket ladder (dispatcher
         thread, before the batch loop — the engine's jit caches are
@@ -920,61 +1106,57 @@ class ExplainerServer:
         root = tr.begin("server.warmup") if tr.enabled else None
         state = "failed"
         try:
-            engine = getattr(getattr(self.model, "explainer", None),
-                             "_explainer", None)
-            bg = getattr(engine, "background", None)
-            if bg is None:
-                # DistributedExplainer wraps the real engine one level
-                # down; the ladder then comes from the inner engine's
-                # _bucket — bucketing is idempotent, so those rungs cover
-                # every shape _pad_sharded produces for real dispatches
-                engine = getattr(engine, "engine", None)
+            # registry mode warms EVERY active model's ladder (each with
+            # its own model=<label> compile signatures), so the whole
+            # roster is routable-warm when the readiness gate releases;
+            # single-model mode keeps the historical one-ladder behaviour
+            targets, warmable = self._warmup_targets(), 0
+            if not targets:
+                # every registered model was already warmed at register
+                # time: the gate releases with nothing to do
+                state = "done"
+                return
+            ladders = []
+            for label, model, rm in targets:
+                engine = self._warmup_engine(model)
                 bg = getattr(engine, "background", None)
-            if bg is None or not hasattr(self.model, "explain_batch"):
+                if bg is None or not hasattr(model, "explain_batch"):
+                    logger.warning(
+                        "warmup: %s exposes no engine background; "
+                        "serving it cold", label or "model")
+                    continue
+                ladders.append((label, model, rm,
+                                self._warmup_ladder(engine),
+                                np.asarray(bg[:1], dtype=np.float32)))
+                warmable += 1
+            if not warmable:
                 raise RuntimeError(
                     "model exposes no engine background to warm with")
-            ladder = self._warmup_ladder(engine)
             with self._warmup_lock:
                 st["state"] = "running"
-                st["buckets"] = list(ladder)
-            row = np.asarray(bg[:1], dtype=np.float32)
+                st["buckets"] = [int(b) for _, _, _, ladder, _ in ladders
+                                 for b in ladder]
             with _tracing.use_context(root.context if root is not None
                                       else None):
-                for b in ladder:
-                    if self._stop.is_set():
-                        state = "aborted"
-                        return
-                    with self._warmup_lock:
-                        st["current"] = int(b)
-                    span = (tr.begin("warmup.bucket", parent=root, rows=b)
-                            if tr.enabled else None)
-                    try:
-                        from distributedkernelshap_tpu.runtime.\
-                            compile_cache import shape_signature
-
-                        # the declared signature carries the deployment's
-                        # evaluation path: the exact-TreeSHAP entry and
-                        # the sampled pipeline are distinct executables
-                        # at the same bucket, and the compile accounting
-                        # must attribute each rung to the one it warmed
-                        sig = shape_signature(
-                            int(b), getattr(self.model, "explain_path",
-                                            None))
-                        with profiler().phase("warmup"), \
-                                ce.signature(sig):
-                            self.model.explain_batch(
-                                np.tile(row, (int(b), 1)),
-                                split_sizes=[int(b)])
-                    finally:
-                        if span is not None:
-                            tr.end(span)
-                    # warmup progress IS device progress — keep the
-                    # watchdog's view current through a long ladder
-                    self._last_progress = time.monotonic()
-                    with self._warmup_lock:
-                        st["completed_buckets"].append(int(b))
-                        st["current"] = None
-                        st["elapsed_s"] = round(time.monotonic() - t0, 3)
+                for label, model, rm, ladder, row in ladders:
+                    for b in ladder:
+                        if self._stop.is_set():
+                            state = "aborted"
+                            return
+                        with self._warmup_lock:
+                            st["current"] = int(b)
+                        self._warm_rung(model, label, int(b), row,
+                                        root=root)
+                        # warmup progress IS device progress — keep the
+                        # watchdog's view current through a long ladder
+                        self._last_progress = time.monotonic()
+                        with self._warmup_lock:
+                            st["completed_buckets"].append(int(b))
+                            st["current"] = None
+                            st["elapsed_s"] = round(
+                                time.monotonic() - t0, 3)
+                    if rm is not None:
+                        rm.warmed = True
             state = "done"
         except Exception as e:
             logger.exception("warmup ladder failed; serving cold")
@@ -1006,9 +1188,13 @@ class ExplainerServer:
 
     def _form_batch(self):
         """Pop one schedulable batch: expired requests are failed (504),
-        cache hits answered and in-batch duplicates collapsed.  Returns
-        ``(live, leaders, index_map, t_claim)`` or ``None`` when nothing
-        dispatchable came out (idle wakeup, all-expired, all-cached)."""
+        cache hits answered and in-batch duplicates collapsed.  Returns a
+        list of ``(live, leaders, index_map, t_claim, rm)`` groups — one
+        per registered model appearing in the popped batch (a device call
+        is one engine's program, so tenants never share a batch; ``rm`` is
+        ``None`` in single-model mode, where the list has one group) — or
+        ``None`` when nothing dispatchable came out (idle wakeup,
+        all-expired, all-cached)."""
 
         batch, expired = self._sched.next_batch(
             self.max_batch_size,
@@ -1027,21 +1213,31 @@ class ExplainerServer:
                               "(server overloaded)", 504)
         if not batch:
             return None
-        live, leaders, index_map = self._split_batch_on_cache(batch)
-        if not leaders:
-            return None
-        return live, leaders, index_map, t_claim
+        # group by pinned model, preserving EDF pop order within and
+        # across groups (dict preserves first-seen insertion order)
+        by_model = {}
+        for p in batch:
+            by_model.setdefault(id(p.model), (p.model, []))[1].append(p)
+        groups = []
+        for _, (rm, members) in by_model.items():
+            live, leaders, index_map = self._split_batch_on_cache(members)
+            if leaders:
+                groups.append((live, leaders, index_map, t_claim, rm))
+        return groups or None
 
     def _dispatch_batch(self, live, leaders, index_map, t_claim,
-                        stacked=None, staged=None):
+                        stacked=None, staged=None, rm=None):
         """Dispatch one formed batch to the device (dispatcher thread only:
         the engine's jit caches are single-dispatcher state).  ``stacked``
         /``staged`` come pre-built from the staging batcher; without them
-        the rows are stacked here (the classic single-thread path)."""
+        the rows are stacked here (the classic single-thread path).
+        ``rm`` is the batch's registered model (registry mode) — every
+        request in the batch pinned it at admission."""
 
         # read at dispatch: tests may swap self.model while the
         # dispatcher is parked in next_batch / the staging buffer
-        pipelined = hasattr(self.model, "explain_batch_async")
+        model = rm.model if rm is not None else self.model
+        pipelined = hasattr(model, "explain_batch_async")
         tr = self._tracer
         sizes = [p.array.shape[0] for p in leaders]
         with self._active_lock:
@@ -1071,7 +1267,7 @@ class ExplainerServer:
         # explain_batch(_async) without `formats` keep working for the
         # traffic they can serve.
         formats = ([p.wire_format for p in leaders]
-                   if getattr(self.model, "supports_wire_formats", False)
+                   if getattr(model, "supports_wire_formats", False)
                    else None)
         kwargs = ({"formats": formats} if formats is not None
                   and any(f != "json" for f in formats) else {})
@@ -1081,7 +1277,7 @@ class ExplainerServer:
                                          axis=0)
             if pipelined:
                 with _tracing.use_context(batch_ctx):
-                    finalize = self.model.explain_batch_async(
+                    finalize = model.explain_batch_async(
                         staged if staged is not None else stacked,
                         split_sizes=sizes, **kwargs)
                 self._inflight.put((live, finalize, index_map,
@@ -1089,7 +1285,7 @@ class ExplainerServer:
                                     batch_ctx))
             else:
                 with _tracing.use_context(batch_ctx):
-                    payloads = self.model.explain_batch(
+                    payloads = model.explain_batch(
                         stacked, split_sizes=sizes, **kwargs)
                 self._complete(
                     live, payloads,
@@ -1119,40 +1315,45 @@ class ExplainerServer:
             formed = self._form_batch()
             if formed is None:
                 continue
-            live, leaders, index_map, t_claim = formed
-            try:
-                stacked = np.concatenate([p.array for p in leaders],
-                                         axis=0)
-                staged = None
-                t0 = time.monotonic()
+            for live, leaders, index_map, t_claim, rm in formed:
+                model = rm.model if rm is not None else self.model
                 try:
-                    staged = self.model.stage_rows(stacked)
-                except Exception:
-                    # staging is an optimisation: a failed upload must
-                    # degrade to the classic dispatch-time H2D, never
-                    # fail the batch
-                    logger.exception(
-                        "stage_rows failed; dispatching unstaged")
-                if tr.enabled and staged is not None:
-                    batch_ctx = next((p.trace for p in leaders
-                                      if p.trace is not None), None)
-                    if batch_ctx is not None:
-                        tr.record_mono("staging.upload", t0,
-                                       time.monotonic(), parent=batch_ctx,
-                                       rows=int(stacked.shape[0]))
-            except Exception as e:
-                # from here on this frame OWNS the popped requests: any
-                # failure must answer them, not drop them
-                logger.exception("staging batcher: stacking failed")
-                self._complete(live, error=str(e))
-                continue
-            if not self._staged.put((live, leaders, index_map, t_claim,
-                                     stacked, staged), stop=self._stop):
-                # shutdown won the race for the staging slot: fail the
-                # batch like the scheduler drain would have
-                self._complete(live, error="server shutting down",
-                               status=503)
-                return
+                    stacked = np.concatenate([p.array for p in leaders],
+                                             axis=0)
+                    staged = None
+                    t0 = time.monotonic()
+                    stage = getattr(model, "stage_rows", None)
+                    try:
+                        if stage is not None:
+                            staged = stage(stacked)
+                    except Exception:
+                        # staging is an optimisation: a failed upload must
+                        # degrade to the classic dispatch-time H2D, never
+                        # fail the batch
+                        logger.exception(
+                            "stage_rows failed; dispatching unstaged")
+                    if tr.enabled and staged is not None:
+                        batch_ctx = next((p.trace for p in leaders
+                                          if p.trace is not None), None)
+                        if batch_ctx is not None:
+                            tr.record_mono("staging.upload", t0,
+                                           time.monotonic(),
+                                           parent=batch_ctx,
+                                           rows=int(stacked.shape[0]))
+                except Exception as e:
+                    # from here on this frame OWNS the popped requests: any
+                    # failure must answer them, not drop them
+                    logger.exception("staging batcher: stacking failed")
+                    self._complete(live, error=str(e))
+                    continue
+                if not self._staged.put((live, leaders, index_map, t_claim,
+                                         stacked, staged, rm),
+                                        stop=self._stop):
+                    # shutdown won the race for the staging slot: fail the
+                    # batch like the scheduler drain would have
+                    self._complete(live, error="server shutting down",
+                                   status=503)
+                    return
 
     def _dispatch_loop(self):
         """Form batches via the scheduler and dispatch one device call each.
@@ -1179,13 +1380,14 @@ class ExplainerServer:
                     if got is None:
                         break
                     (live, leaders, index_map, t_claim,
-                     stacked, staged), ready_s = got
+                     stacked, staged, rm), ready_s = got
                     # time the staged batch sat device-ready while this
                     # thread was busy with the previous one — the measured
                     # upload/compute overlap
                     self._m_staging_overlap.inc(ready_s)
                     self._dispatch_batch(live, leaders, index_map, t_claim,
-                                         stacked=stacked, staged=staged)
+                                         stacked=stacked, staged=staged,
+                                         rm=rm)
                 for item in self._staged.drain():
                     # staged but never dispatched (shutdown): fail like the
                     # scheduler drain so no handler thread leaks
@@ -1196,8 +1398,9 @@ class ExplainerServer:
                 formed = self._form_batch()
                 if formed is None:
                     continue
-                live, leaders, index_map, t_claim = formed
-                self._dispatch_batch(live, leaders, index_map, t_claim)
+                for live, leaders, index_map, t_claim, rm in formed:
+                    self._dispatch_batch(live, leaders, index_map,
+                                         t_claim, rm=rm)
         finally:
             # finalizers only exit once dispatch can no longer enqueue, so a
             # batch dispatched during shutdown is still fetched + answered
@@ -1276,6 +1479,10 @@ class ExplainerServer:
             drained = self._sched.drain()
             if drained:
                 self._complete(drained, error=msg, status=503)
+            if self._registry is not None:
+                # fleet-wide: every active tenant's device caches ride the
+                # same (possibly restarted) backend
+                self._registry.reset_all()
             reset = getattr(self.model, "reset", None)
             if reset is not None:
                 try:
@@ -1456,6 +1663,7 @@ class ExplainerServer:
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     body = self.rfile.read(length) or b"{}"
+                    req_model_id = None
                     if _wire.is_wire_content_type(
                             self.headers.get("Content-Type")):
                         # binary streaming ingest: one zero-copy
@@ -1463,12 +1671,15 @@ class ExplainerServer:
                         # row buffer — no JSON parse, no float-list
                         # re-materialisation
                         req_format = "binary"
-                        array = _wire.decode_request(body)
+                        array, req_model_id = _wire.decode_request_meta(
+                            body)
                     else:
                         req_format = "json"
                         payload = json.loads(body)
                         array = np.atleast_2d(
                             np.asarray(payload["array"], dtype=np.float32))
+                        if payload.get("model"):
+                            req_model_id = str(payload["model"])
                 except _wire.WireVersionError as e:
                     # well-formed framing, future protocol: 415 is the
                     # client's downgrade-to-JSON signal
@@ -1483,12 +1694,47 @@ class ExplainerServer:
                     return
                 server._m_wire_bytes.inc(len(body), format=req_format,
                                          direction="rx")
+                # multi-tenant routing: the X-DKS-Model header wins (the
+                # operator-facing knob a proxy can stamp), else the body's
+                # model field; resolution pins the ACTIVE version now so a
+                # hot-swap mid-flight cannot change this answer.  In
+                # single-model mode the field is ignored (pre-registry
+                # deployments never spoke it).
+                header_model = self.headers.get("X-DKS-Model")
+                if header_model:
+                    req_model_id = header_model.strip()
+                rm = None
+                model = server.model
+                if server._registry is not None:
+                    # pin=True: the in-flight pin is acquired ATOMICALLY
+                    # with the lookup, so a concurrent hot-swap's drain
+                    # can never observe zero pins between this request
+                    # resolving the version and dispatching on it (the
+                    # retire path releases the drained version's model)
+                    rm = server._registry.resolve(req_model_id, pin=True)
+                    if rm is None:
+                        self._reply(404, json.dumps({
+                            "error": f"unknown model {req_model_id!r}",
+                            "models": server._registry.model_ids()}))
+                        return
+                    model = rm.model
+                try:
+                    self._explain_resolved(array, rm, model)
+                finally:
+                    if rm is not None:
+                        rm.release()
+
+            def _explain_resolved(self, array, rm, model):
+                """The /explain path once the tenant (if any) is resolved
+                and pinned: negotiation, SLO headers, admission, enqueue,
+                reply.  The caller owns releasing the pin."""
+
                 # response negotiation: binary only on an EXPLICIT Accept
                 # and only when the served model can encode it — otherwise
                 # the historical JSON document (old clients, stub models)
                 wire_format = ("binary" if _wire.accepts_wire(
                     self.headers.get("Accept"))
-                    and getattr(server.model, "supports_wire_formats",
+                    and getattr(model, "supports_wire_formats",
                                 False) else "json")
                 tr = server._tracer
                 if tr.enabled:
@@ -1542,7 +1788,7 @@ class ExplainerServer:
                         "error": "server wedged: device made no progress "
                                  "within the watchdog timeout"}))
                     return
-                max_rows = getattr(server.model, "max_rows", None)
+                max_rows = getattr(model, "max_rows", None)
                 if max_rows and array.shape[0] > max_rows:
                     # a single request larger than the model's slot can
                     # never be served; reject IT without failing the batch
@@ -1554,10 +1800,11 @@ class ExplainerServer:
                 root = self.__dict__.get("_dks_root")
                 pending = _Pending(array, klass=klass, deadline=deadline,
                                    cache_key=server._cache_key_for(
-                                       array, wire_format),
+                                       array, wire_format, rm=rm),
                                    trace=root.context if root is not None
                                    else None,
-                                   wire_format=wire_format)
+                                   wire_format=wire_format,
+                                   model=rm)
                 # cache fast path: a duplicate of an already-served request
                 # is answered bit-identically without queueing at all
                 if pending.cache_key is not None:
@@ -1590,15 +1837,37 @@ class ExplainerServer:
                         "retry_after_s": round(decision.retry_after_s, 3)}),
                         headers={"Retry-After": str(retry_s)})
                     return
+                if rm is not None:
+                    # per-tenant quota (registry/registry.py): a flooding
+                    # tenant's token bucket / in-flight bound sheds ITS
+                    # requests with 429 while other tenants' admission is
+                    # untouched — checked last, like the per-client
+                    # bucket, so side-effect-free rejects don't charge it
+                    ok, reason, retry = server._registry.admit(
+                        rm, exclude_self=True)
+                    if not ok:
+                        server._shed(reason)
+                        self._reply(429, json.dumps({
+                            "error": f"request shed ({reason}) for model "
+                                     f"{rm.model_id!r}; retry after "
+                                     f"{retry:.2f}s",
+                            "reason": reason,
+                            "retry_after_s": round(retry, 3)}),
+                            headers={"Retry-After":
+                                     str(max(1, int(math.ceil(retry))))})
+                        return
                 if root is not None:
                     # header parse + wedge/size checks + admission gates,
                     # i.e. everything between body parse and enqueue
                     tr.record_mono("server.admission", t_admit0,
                                    time.monotonic(), parent=root.context,
                                    klass=klass)
+                # (the hot-swap pin was acquired at resolve time and is
+                # released by _handle's finally once the reply is sent)
                 server._sched.put(pending)
-                # re-check shutdown/wedge periodically so in-flight requests
-                # fail fast instead of hanging on a dead dispatcher
+                # re-check shutdown/wedge periodically so in-flight
+                # requests fail fast instead of hanging on a dead
+                # dispatcher
                 while not pending.event.wait(timeout=1.0):
                     if server._stop.is_set():
                         if pending.error is None:
@@ -1606,25 +1875,27 @@ class ExplainerServer:
                             pending.status_code = 503
                         break
                     if server._wedged.is_set():
-                        # catches requests the watchdog's scheduler drain
-                        # can't see (races with next_batch); claim under the
-                        # metrics lock so a late completion can't
-                        # double-answer
+                        # catches requests the watchdog's scheduler
+                        # drain can't see (races with next_batch);
+                        # claim under the metrics lock so a late
+                        # completion can't double-answer
                         with server._metrics_lock:
                             if not pending.done:
                                 pending.done = True
                                 pending.error = (
-                                    "server wedged: device made no progress "
-                                    "within the watchdog timeout")
-                                # 503 like the watchdog drain: this request
-                                # was never dispatched, so a fan-in proxy
-                                # can safely fail it over to a healthy
-                                # replica (500 would surface to the client)
+                                    "server wedged: device made no "
+                                    "progress within the watchdog "
+                                    "timeout")
+                                # 503 like the watchdog drain: this
+                                # request was never dispatched, so a
+                                # fan-in proxy can safely fail it over
+                                # to a healthy replica (500 would
+                                # surface to the client)
                                 pending.status_code = 503
                                 # this claim bypasses _complete's live
-                                # loop, so count it via the shared helper —
-                                # error counters matter most exactly during
-                                # wedge incidents
+                                # loop, so count it via the shared
+                                # helper — error counters matter most
+                                # exactly during wedge incidents
                                 server._count_request(pending,
                                                       pending.error)
                         if pending.error is not None:
@@ -1656,6 +1927,16 @@ class ExplainerServer:
         )
 
         enable_persistent_cache()
+        if self._registry is not None and self.model is None:
+            # registry mode with no explicit default deployment: the
+            # registry's default model anchors depth calibration, staging
+            # capability resolution and the single-model fallbacks
+            rm0 = self._registry.resolve()
+            if rm0 is None:
+                raise RuntimeError(
+                    "registry mode needs at least one registered model "
+                    "before start()")
+            self.model = rm0.model
         # bind + serve the socket FIRST: requests arriving during depth
         # calibration park in the scheduler (handlers wait on their response
         # events) instead of getting connection-refused on an unbound port

@@ -168,11 +168,39 @@ def decode_arrays(buf: bytes) -> Dict[str, np.ndarray]:
 # request / response payload helpers
 
 
-def encode_request(instance: np.ndarray) -> bytes:
-    """Binary /explain request body: the instance rows as float32."""
+def encode_request(instance: np.ndarray,
+                   model_id: Optional[str] = None) -> bytes:
+    """Binary /explain request body: the instance rows as float32, plus
+    an optional ``model`` field (utf-8 bytes as a u8 array) naming the
+    registry tenant the request targets — the wire twin of the
+    ``X-DKS-Model`` header / JSON ``model`` key.  Decoders without
+    registry support ignore the extra field, so the framing is
+    backward-compatible."""
 
     arr = np.atleast_2d(np.asarray(instance, dtype=np.float32))
-    return encode_arrays({"array": arr})
+    arrays = {"array": arr}
+    if model_id:
+        arrays["model"] = np.frombuffer(model_id.encode("utf-8"),
+                                        dtype=np.uint8)
+    return encode_arrays(arrays)
+
+
+def decode_request_meta(body: bytes):
+    """``(array, model_id)`` for a binary /explain request —
+    ``model_id`` is ``None`` when the body names no tenant."""
+
+    arrays = decode_arrays(body)
+    if "array" not in arrays:
+        raise WireError("binary request carries no 'array' field")
+    model_id = None
+    if "model" in arrays:
+        field = np.asarray(arrays["model"])
+        if field.dtype != np.uint8 or field.ndim != 1:
+            raise WireError(
+                f"'model' field must be a 1-D u8 utf-8 string, got "
+                f"{field.dtype} with shape {field.shape}")
+        model_id = field.tobytes().decode("utf-8", "replace")
+    return _check_instances(arrays["array"]), model_id
 
 
 def decode_request(body: bytes) -> np.ndarray:
@@ -180,10 +208,10 @@ def decode_request(body: bytes) -> np.ndarray:
     instance array — a zero-copy view when the body already carries
     float32 (the client encoder always does)."""
 
-    arrays = decode_arrays(body)
-    if "array" not in arrays:
-        raise WireError("binary request carries no 'array' field")
-    arr = arrays["array"]
+    return decode_request_meta(body)[0]
+
+
+def _check_instances(arr: np.ndarray) -> np.ndarray:
     if not np.issubdtype(arr.dtype, np.floating) and \
             not np.issubdtype(arr.dtype, np.integer):
         raise WireError(f"instance rows must be numeric, got {arr.dtype}")

@@ -139,10 +139,11 @@ class KernelShapModel:
         """The fitted engine behind this deployment's explainer (the
         DistributedExplainer wraps the real engine one level down)."""
 
-        engine = getattr(self.explainer, "_explainer", None)
-        if engine is not None and not hasattr(engine, "predictor"):
-            engine = getattr(engine, "engine", None)
-        return engine
+        from distributedkernelshap_tpu.registry.classify import (
+            serving_engine,
+        )
+
+        return serving_engine(self)
 
     def _resolve_explain_path(self) -> None:
         """Auto-select ``nsamples='exact'`` for deployments whose fitted
@@ -178,17 +179,18 @@ class KernelShapModel:
             self.explain_path_reason = "auto_disabled"
             return
         try:
-            from distributedkernelshap_tpu.ops.tensor_shap import (
-                record_tn_fallback,
-                supports_exact_tn,
-                tn_exact_ready,
+            # the ONE path classifier (registry/classify.py — factored
+            # out of this method when the multi-tenant registry landed,
+            # so ingest-time classification and serving auto-selection
+            # can never disagree)
+            from distributedkernelshap_tpu.registry.classify import (
+                classify_path,
             )
-            from distributedkernelshap_tpu.ops.treeshap import supports_exact
 
             if engine is None:
                 return
-            if supports_exact(engine.predictor) \
-                    and engine.config.link == "identity":
+            decision = classify_path(self)
+            if decision.path == "exact_tree":
                 self.explain_kwargs["nsamples"] = "exact"
                 self.explain_path = "exact"
                 self.explain_path_reason = "auto"
@@ -196,23 +198,23 @@ class KernelShapModel:
                     "serving auto-selected the exact TreeSHAP path for a "
                     "lifted %s (set %s=0 or pin nsamples to opt out)",
                     type(engine.predictor).__name__, EXACT_AUTO_ENV)
-            elif supports_exact_tn(engine.predictor):
-                reason = tn_exact_ready(
-                    engine.predictor, engine.config.link, engine.G,
-                    engine.config.shap.target_chunk_elems)
-                if reason is None:
-                    self.explain_kwargs["nsamples"] = "exact"
-                    self.explain_path = "exact_tn"
-                    self.explain_path_reason = "auto"
-                    logger.info(
-                        "serving auto-selected the exact tensor-network "
-                        "path for a %s (set %s=0 or pin nsamples to opt "
-                        "out)", type(engine.predictor).__name__,
-                        EXACT_AUTO_ENV)
-                else:
-                    # a TN-structured deployment staying sampled is an
-                    # operational fact worth a counter, not a mystery
-                    record_tn_fallback(reason)
+            elif decision.path == "exact_tn":
+                self.explain_kwargs["nsamples"] = "exact"
+                self.explain_path = "exact_tn"
+                self.explain_path_reason = "auto"
+                logger.info(
+                    "serving auto-selected the exact tensor-network "
+                    "path for a %s (set %s=0 or pin nsamples to opt "
+                    "out)", type(engine.predictor).__name__,
+                    EXACT_AUTO_ENV)
+            elif decision.tn_fallback is not None:
+                # a TN-structured deployment staying sampled is an
+                # operational fact worth a counter, not a mystery
+                from distributedkernelshap_tpu.ops.tensor_shap import (
+                    record_tn_fallback,
+                )
+
+                record_tn_fallback(decision.tn_fallback)
         except Exception:  # never fail a deployment over path selection
             logger.debug("exact-path auto-selection probe failed",
                          exc_info=True)

@@ -39,10 +39,12 @@ DOCS_PATH = os.path.join(REPO_ROOT, "docs", "OBSERVABILITY.md")
 #: ``treeshap`` when the exact path's fallback accounting landed
 #: ``dks_treeshap_*``; ``autoscale`` when the elastic-fleet scaler
 #: landed ``dks_autoscale_*``; ``tensor_shap`` when the exact
-#: tensor-network path landed ``dks_tensor_shap_*``.
+#: tensor-network path landed ``dks_tensor_shap_*``; ``registry`` and
+#: ``result_cache`` when the multi-tenant model registry landed
+#: ``dks_registry_*`` and the weak-fingerprint accounting.
 _LITERAL_RE = re.compile(
     r"dks_(?:serve|fanin|sched|phase|slo|alerts|wire|staging|treeshap|"
-    r"tensor_shap|autoscale)_[a-z0-9_]+")
+    r"tensor_shap|autoscale|registry|result_cache)_[a-z0-9_]+")
 
 #: directories never scanned for literals/renderers
 _SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "results", "data",

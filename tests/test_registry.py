@@ -1,0 +1,386 @@
+"""Registry subsystem unit tests: the ONE path classifier, content
+fingerprints (+ the weak-fallback accounting), registration / hot-swap /
+drain lifecycle, and per-tenant quotas."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu.models import LinearPredictor
+from distributedkernelshap_tpu.registry import (
+    ModelRegistry,
+    TenantQuota,
+    classify_path,
+)
+
+D = 5
+
+
+def _linear(seed=0, activation="softmax"):
+    rng = np.random.default_rng(seed)
+    return LinearPredictor(rng.normal(size=(D, 2)).astype(np.float32),
+                           rng.normal(size=(2,)).astype(np.float32),
+                           activation=activation)
+
+
+class StubServing:
+    """Minimal serving model for lifecycle tests (no jax work)."""
+
+    def __init__(self, tag):
+        self.tag = tag
+
+    def explain_batch(self, instances, split_sizes=None):
+        sizes = split_sizes or [1] * instances.shape[0]
+        return [f'{{"tag": "{self.tag}"}}' for _ in sizes]
+
+
+# --------------------------------------------------------------------- #
+# classify_path
+# --------------------------------------------------------------------- #
+
+
+def test_classify_linear_predictor():
+    decision = classify_path(_linear())
+    assert decision.path == "linear"
+    assert "plan-constant" in decision.reason
+
+
+def test_classify_tree_ensemble():
+    from sklearn.ensemble import HistGradientBoostingRegressor
+
+    from distributedkernelshap_tpu.models.predictors import as_predictor
+
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(120, D))
+    gbr = HistGradientBoostingRegressor(max_iter=6, max_depth=3,
+                                        random_state=0).fit(
+        X, X[:, 0] - X[:, 1])
+    pred = as_predictor(gbr.predict, example_dim=D)
+    assert classify_path(pred).path == "exact_tree"
+    # a non-identity link changes the target quantity: stays sampled
+    assert classify_path(pred, link="logit").path == "sampled"
+
+
+def test_classify_tensor_train():
+    from distributedkernelshap_tpu.models.tensor_net import (
+        TensorTrainPredictor,
+    )
+
+    rng = np.random.default_rng(2)
+    ranks = [1, 2, 2, 2, 2, 1]
+    cores = [(rng.normal(size=(ranks[i], ranks[i + 1])).astype(np.float32),
+              rng.normal(size=(ranks[i], ranks[i + 1])).astype(np.float32))
+             for i in range(D)]
+    decision = classify_path(TensorTrainPredictor(cores))
+    assert decision.path == "exact_tn"
+
+
+def test_classify_generic_callable_is_sampled():
+    from distributedkernelshap_tpu.models.predictors import (
+        CallbackPredictor,
+    )
+
+    pred = CallbackPredictor(lambda x: np.ones((x.shape[0], 1)),
+                             n_outputs=1)
+    assert classify_path(pred).path == "sampled"
+
+
+def test_classify_never_raises():
+    class Hostile:
+        @property
+        def linear_decomposition(self):
+            raise RuntimeError("boom")
+
+    decision = classify_path(Hostile())
+    assert decision.path == "sampled"
+    assert "probe failed" in decision.reason
+
+
+# --------------------------------------------------------------------- #
+# content fingerprints + the weak fallback
+# --------------------------------------------------------------------- #
+
+
+def test_predictor_fingerprint_is_content_stable():
+    from distributedkernelshap_tpu.scheduling.result_cache import (
+        predictor_fingerprint,
+    )
+
+    a, weak_a = predictor_fingerprint(_linear(seed=3))
+    b, weak_b = predictor_fingerprint(_linear(seed=3))
+    c, _ = predictor_fingerprint(_linear(seed=4))
+    assert not weak_a and not weak_b
+    assert a == b  # distinct objects, identical parameters
+    assert a != c  # different weights
+
+
+def test_predictor_fingerprint_hashes_scalar_config():
+    from distributedkernelshap_tpu.scheduling.result_cache import (
+        predictor_fingerprint,
+    )
+
+    rng = np.random.default_rng(5)
+    W = rng.normal(size=(D, 1)).astype(np.float32)
+    b = rng.normal(size=(1,)).astype(np.float32)
+    # same parameter arrays, different scalar config: MUST NOT collide
+    # (a collision here serves one model's cached phi for the other)
+    ident, w_i = predictor_fingerprint(
+        LinearPredictor(W, b, activation="identity"))
+    sig, w_s = predictor_fingerprint(
+        LinearPredictor(W, b, activation="sigmoid"))
+    assert not w_i and not w_s
+    assert ident != sig
+
+
+def test_weak_fingerprint_counts_and_warns_once():
+    from distributedkernelshap_tpu.models.predictors import (
+        CallbackPredictor,
+    )
+    from distributedkernelshap_tpu.scheduling.result_cache import (
+        predictor_fingerprint,
+        record_weak_fingerprint,
+        weak_fingerprint_total,
+    )
+
+    pred = CallbackPredictor(lambda x: np.ones((x.shape[0], 1)),
+                             n_outputs=1)
+    digest, weak = predictor_fingerprint(pred)
+    assert weak and str(id(pred)) in digest
+    before = weak_fingerprint_total()
+    record_weak_fingerprint(pred)
+    assert weak_fingerprint_total() == before + 1
+
+
+def test_model_fingerprint_counts_weak_for_stub_models():
+    from distributedkernelshap_tpu.scheduling.result_cache import (
+        model_fingerprint,
+        weak_fingerprint_total,
+    )
+
+    before = weak_fingerprint_total()
+    model_fingerprint(StubServing("x"))
+    assert weak_fingerprint_total() == before + 1
+    # the registry's ingest path namespaces instead of counting
+    model_fingerprint(StubServing("x"), count_weak=False)
+    assert weak_fingerprint_total() == before + 1
+
+
+# --------------------------------------------------------------------- #
+# registration / versions / hot swap / drain
+# --------------------------------------------------------------------- #
+
+
+def test_register_versions_and_resolve():
+    reg = ModelRegistry()
+    m1 = StubServing("v1")
+    rm1 = reg.register("m", m1)
+    assert rm1.version == 1 and reg.resolve("m") is rm1
+    assert reg.resolve() is rm1  # first id is the default
+    assert m1.fingerprint.startswith("m@v1:")
+    rm2 = reg.register("m", StubServing("v2"))
+    assert rm2.version == 2 and reg.resolve("m") is rm2
+    assert rm1.state == "retired"  # nothing in flight: drained instantly
+    assert reg.resolve("nope") is None
+    with pytest.raises(ValueError):
+        reg.register("m", StubServing("v2dup"), version=2)
+    with pytest.raises(ValueError):
+        reg.register("bad=id", StubServing("x"))
+    with pytest.raises(ValueError):
+        reg.register("m", object())  # no explain_batch
+
+
+def test_swap_records_flight_event_and_counts():
+    from distributedkernelshap_tpu.observability.flightrec import flightrec
+
+    reg = ModelRegistry()
+    reg.register("swapper", StubServing("v1"))
+    reg.register("swapper", StubServing("v2"))
+    events = [e for e in flightrec().to_payload()["events"]
+              if e["kind"] == "model_swap" and e.get("model") == "swapper"]
+    assert len(events) >= 2
+    assert events[-1]["from_version"] == 1
+    assert events[-1]["to_version"] == 2
+    assert reg.metric_swaps() == {("swapper",): 2.0}
+    assert reg.metric_models() == {("swapper", "2", "sampled"): 1.0}
+
+
+def test_per_model_counters_survive_hot_swap():
+    reg = ModelRegistry()
+    rm1 = reg.register("agg", StubServing("v1"))
+    rm1.record_answer(0.5, False)
+    rm1.record_answer(0.5, False)
+    assert reg.metric_requests() == {("agg",): 2.0}
+    reg.register("agg", StubServing("v2"))
+    # a hot swap must NOT reset the per-model counter (Prometheus would
+    # read it as a counter reset and lose v1's tallies from rates)
+    assert reg.metric_requests() == {("agg",): 2.0}
+    assert reg.metric_seconds() == {("agg",): 1.0}
+    reg.resolve("agg").record_answer(0.25, False)
+    assert reg.metric_requests() == {("agg",): 3.0}
+    assert reg.metric_seconds() == {("agg",): 1.25}
+
+
+def test_concurrent_registrations_allocate_distinct_versions():
+    reg = ModelRegistry()
+    errors = []
+
+    def one(i):
+        try:
+            reg.register("race", StubServing(f"m{i}"))
+        except Exception as e:  # pragma: no cover - failure detail
+            errors.append(e)
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    versions = reg._models["race"]["versions"]
+    assert sorted(versions) == [1, 2, 3, 4, 5, 6]  # nothing overwritten
+
+
+def test_registry_path_reflects_pinned_deployment():
+    from sklearn.ensemble import HistGradientBoostingRegressor
+
+    from distributedkernelshap_tpu.serving.wrappers import KernelShapModel
+
+    rng = np.random.default_rng(6)
+    X = rng.normal(size=(120, D))
+    gbr = HistGradientBoostingRegressor(max_iter=5, max_depth=3,
+                                        random_state=0).fit(
+        X, X[:, 0] - X[:, 1])
+    bg = X[:8].astype(np.float32)
+    auto = KernelShapModel(gbr.predict, bg, {"seed": 0}, {})
+    pinned = KernelShapModel(gbr.predict, bg, {"seed": 0}, {},
+                             explain_kwargs={"nsamples": 64})
+    reg = ModelRegistry()
+    assert reg.register("auto_tree", auto).path == "exact_tree"
+    rm = reg.register("pinned_tree", pinned)
+    # the deployment SERVES sampled (pinned nsamples): the registry must
+    # not advertise an exact path it does not run
+    assert rm.path == "sampled"
+    assert "structurally available" in rm.path_reason
+
+
+def test_drain_waits_for_pinned_requests():
+    reg = ModelRegistry(drain_timeout_s=5.0)
+    rm1 = reg.register("d", StubServing("v1"))
+    rm1.acquire()  # a request in flight on v1
+    done = threading.Event()
+
+    def swap():
+        reg.register("d", StubServing("v2"))
+        done.set()
+
+    t = threading.Thread(target=swap, daemon=True)
+    t.start()
+    # the swap FLIPS immediately (new requests already land on v2)...
+    deadline = time.monotonic() + 5
+    while reg.resolve("d").version != 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert reg.resolve("d").version == 2
+    # ...but the register call itself blocks in the drain until the
+    # pinned request releases
+    assert not done.wait(0.2)
+    assert rm1.state == "draining"
+    rm1.release()
+    assert done.wait(5)
+    assert rm1.state == "retired"
+
+
+def test_drain_timeout_leaves_version_draining():
+    reg = ModelRegistry(drain_timeout_s=0.1)
+    rm1 = reg.register("t", StubServing("v1"))
+    rm1.acquire()
+    reg.register("t", StubServing("v2"))  # drain times out
+    assert rm1.state == "draining"
+    rm1.release()
+
+
+# --------------------------------------------------------------------- #
+# per-tenant quotas
+# --------------------------------------------------------------------- #
+
+
+def test_quota_inflight_bound():
+    quota = TenantQuota(max_inflight=2)
+    assert quota.admit(0)[0] and quota.admit(1)[0]
+    ok, reason, retry = quota.admit(2)
+    assert not ok and reason == "tenant_queue_full" and retry > 0
+
+
+def test_quota_rate_bucket():
+    quota = TenantQuota(rate_per_s=1000.0, burst=2)
+    assert quota.admit(0)[0] and quota.admit(0)[0]
+    ok, reason, retry = quota.admit(0)
+    assert not ok and reason == "tenant_rate_limited" and retry > 0
+
+
+def test_default_quota_is_cloned_per_tenant():
+    reg = ModelRegistry(default_quota=TenantQuota(rate_per_s=1000.0,
+                                                  burst=1))
+    rm_a = reg.register("a", StubServing("a"))
+    rm_b = reg.register("b", StubServing("b"))
+    assert rm_a.quota is not rm_b.quota
+    # draining tenant a's bucket must not shed tenant b
+    assert reg.admit(rm_a)[0]
+    ok_a2, reason_a, _ = reg.admit(rm_a)
+    assert not ok_a2 and reason_a == "tenant_rate_limited"
+    assert reg.admit(rm_b)[0]
+
+
+def test_hot_swap_preserves_tenant_quota():
+    reg = ModelRegistry()
+    quota = TenantQuota(max_inflight=7)
+    reg.register("keep", StubServing("v1"), quota=quota)
+    rm2 = reg.register("keep", StubServing("v2"))  # routine model update
+    # the tenant's policy survives the swap (same object: bucket state
+    # carries across the flip); an explicit quota= still overrides
+    assert rm2.quota is quota
+    rm3 = reg.register("keep", StubServing("v3"),
+                       quota=TenantQuota(max_inflight=1))
+    assert rm3.quota is not quota and rm3.quota.max_inflight == 1
+
+
+def test_retired_version_releases_its_model():
+    reg = ModelRegistry()
+    rm1 = reg.register("leak", StubServing("v1"))
+    reg.register("leak", StubServing("v2"))
+    assert rm1.state == "retired"
+    # the engine is released (one model per nightly swap must not
+    # accumulate); the scalar tallies stay for the per-id metric sums
+    assert rm1.model is None
+    assert reg.metric_requests() == {("leak",): 0.0}
+
+
+def test_resolve_pin_is_atomic_with_lookup():
+    reg = ModelRegistry()
+    reg.register("pin", StubServing("v1"))
+    rm = reg.resolve("pin", pin=True)
+    assert rm.inflight == 1
+    rm.release()
+    assert rm.inflight == 0
+    assert reg.resolve("pin").inflight == 0  # plain resolve never pins
+    # admit() with exclude_self ignores the caller's own pin
+    reg2 = ModelRegistry()
+    rm2 = reg2.register("q", StubServing("v1"),
+                        quota=TenantQuota(max_inflight=1))
+    rm2.acquire()
+    assert reg2.admit(rm2, exclude_self=True)[0]
+    assert not reg2.admit(rm2)[0]
+    rm2.release()
+
+
+def test_registry_admit_counts_sheds_per_model():
+    reg = ModelRegistry()
+    rm = reg.register("q", StubServing("v1"),
+                      quota=TenantQuota(max_inflight=0))
+    ok, reason, _ = reg.admit(rm)
+    assert not ok and reason == "tenant_queue_full"
+    assert reg.metric_sheds() == {("q", "tenant_queue_full"): 1.0}
+    # a quota-less tenant never sheds
+    rm2 = reg.register("free", StubServing("v1"))
+    assert reg.admit(rm2) == (True, "", 0.0)

@@ -1,0 +1,288 @@
+"""Multi-tenant gateway serving tests: routing (header / JSON field /
+binary wire field), per-model cache + metric namespaces, hot-swap
+in-flight pinning, tenant quotas at the HTTP edge, the /statusz panel,
+and header forwarding through the fan-in proxy."""
+
+import http.client
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu.models import LinearPredictor
+from distributedkernelshap_tpu.registry import ModelRegistry, TenantQuota
+from distributedkernelshap_tpu.serving import wire
+from distributedkernelshap_tpu.serving.server import ExplainerServer
+from distributedkernelshap_tpu.serving.wrappers import BatchKernelShapModel
+
+D = 6
+
+
+def _linear_model(seed):
+    rng = np.random.default_rng(seed)
+    W = rng.normal(size=(D, 2)).astype(np.float32)
+    b = rng.normal(size=(2,)).astype(np.float32)
+    bg = np.random.default_rng(99).normal(size=(10, D)).astype(np.float32)
+    return BatchKernelShapModel(LinearPredictor(W, b, activation="softmax"),
+                                bg, {"link": "logit", "seed": 0}, {})
+
+
+def _post(host, port, body, headers=None, path="/explain"):
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    try:
+        conn.request("POST", path, body=body,
+                     headers={"Content-Type": "application/json",
+                              **(headers or {})})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _get(host, port, path):
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    try:
+        conn.request("GET", path)
+        return conn.getresponse().read().decode()
+    finally:
+        conn.close()
+
+
+def _json_body(array, model=None):
+    doc = {"array": np.asarray(array).tolist()}
+    if model is not None:
+        doc["model"] = model
+    return json.dumps(doc).encode()
+
+
+@pytest.fixture(scope="module")
+def gateway():
+    registry = ModelRegistry()
+    registry.register("alpha", _linear_model(1))
+    registry.register("beta", _linear_model(2))
+    server = ExplainerServer(registry=registry, host="127.0.0.1", port=0,
+                             max_batch_size=4, batch_timeout_s=0.003,
+                             pipeline_depth=2,
+                             cache_bytes=1 << 20).start()
+    try:
+        yield server, registry
+    finally:
+        server.stop()
+
+
+def test_routing_header_json_wire_and_default(gateway):
+    server, registry = gateway
+    row = np.random.default_rng(5).normal(size=(1, D)).astype(np.float32)
+    s1, p1 = _post(server.host, server.port, _json_body(row),
+                   headers={"X-DKS-Model": "alpha"})
+    s2, p2 = _post(server.host, server.port, _json_body(row, model="beta"))
+    s3, p3 = _post(server.host, server.port, _json_body(row))  # default
+    s4, p4 = _post(server.host, server.port,
+                   wire.encode_request(row, model_id="beta"),
+                   headers={"Content-Type": wire.CONTENT_TYPE})
+    assert (s1, s2, s3, s4) == (200, 200, 200, 200)
+    a1 = json.loads(p1)["data"]["shap_values"]
+    a2 = json.loads(p2)["data"]["shap_values"]
+    assert a1 != a2  # two tenants, two answers for the same row
+    assert json.loads(p3)["data"]["shap_values"] == a1  # default = first
+    assert json.loads(p4)["data"]["shap_values"] == a2  # wire field routes
+
+
+def test_header_wins_over_body_field(gateway):
+    server, _ = gateway
+    row = np.random.default_rng(6).normal(size=(1, D)).astype(np.float32)
+    _, p_beta = _post(server.host, server.port, _json_body(row, "beta"))
+    s, p = _post(server.host, server.port, _json_body(row, model="beta"),
+                 headers={"X-DKS-Model": "alpha"})
+    assert s == 200
+    _, p_alpha = _post(server.host, server.port,
+                       _json_body(row, model="alpha"))
+    assert json.loads(p)["data"]["shap_values"] \
+        == json.loads(p_alpha)["data"]["shap_values"]
+    assert json.loads(p)["data"]["shap_values"] \
+        != json.loads(p_beta)["data"]["shap_values"]
+
+
+def test_unknown_model_404_lists_roster(gateway):
+    server, _ = gateway
+    row = np.zeros((1, D), np.float32)
+    s, p = _post(server.host, server.port, _json_body(row, model="nope"))
+    assert s == 404
+    doc = json.loads(p)
+    assert "unknown model" in doc["error"]
+    assert doc["models"] == ["alpha", "beta"]
+
+
+def test_cache_is_scoped_per_model_fingerprint(gateway):
+    server, registry = gateway
+    row = np.random.default_rng(7).normal(size=(1, D)).astype(np.float32)
+    before = server._cache.stats()
+    s1, p1 = _post(server.host, server.port, _json_body(row, "alpha"))
+    s2, p2 = _post(server.host, server.port, _json_body(row, "beta"))
+    # same rows, different tenants: distinct keys, no cross-tenant hit
+    assert s1 == s2 == 200 and p1 != p2
+    mid = server._cache.stats()
+    assert mid["entries"] >= before["entries"] + 2
+    s3, p3 = _post(server.host, server.port, _json_body(row, "alpha"))
+    after = server._cache.stats()
+    assert s3 == 200 and p3 == p1  # duplicate: bit-identical
+    assert after["hits"] == mid["hits"] + 1
+    # the key namespace is the registry fingerprint (model@vN:content)
+    key = server._cache_key_for(row, rm=registry.resolve("alpha"))
+    assert key.startswith(registry.resolve("alpha").fingerprint)
+
+
+def test_per_model_metrics_and_statusz_panel(gateway):
+    server, registry = gateway
+    row = np.random.default_rng(8).normal(size=(1, D)).astype(np.float32)
+    _post(server.host, server.port, _json_body(row, "alpha"))
+    page = _get(server.host, server.port, "/metrics")
+    assert 'dks_registry_models{model="alpha",version="1",path="linear"}' \
+        in page
+    assert 'dks_registry_requests_total{model="alpha"}' in page
+    doc = json.loads(_get(server.host, server.port,
+                          "/statusz?format=json"))
+    panel = doc["detail"]["registry"]
+    assert panel["default_model_id"] == "alpha"
+    ids = {m["model_id"]: m for m in panel["models"]}
+    assert ids["alpha"]["path"] == "linear"
+    assert ids["alpha"]["fingerprint"].startswith("alpha@v1:")
+
+
+def test_single_model_server_ignores_model_field():
+    model = _linear_model(3)
+    server = ExplainerServer(model, host="127.0.0.1", port=0,
+                             max_batch_size=2, pipeline_depth=1).start()
+    try:
+        row = np.zeros((1, D), np.float32)
+        s, p = _post(server.host, server.port,
+                     _json_body(row, model="whatever"),
+                     headers={"X-DKS-Model": "also-ignored"})
+        assert s == 200 and json.loads(p)["data"]["shap_values"]
+    finally:
+        server.stop()
+
+
+# --------------------------------------------------------------------- #
+# hot swap with a pinned in-flight request (stub models: no jax cost)
+# --------------------------------------------------------------------- #
+
+
+class _GatedStub:
+    """Serving stub whose explain blocks until released."""
+
+    def __init__(self, tag, gate=None):
+        self.tag = tag
+        self.gate = gate
+
+    def explain_batch(self, instances, split_sizes=None):
+        if self.gate is not None:
+            assert self.gate.wait(timeout=30)
+        sizes = split_sizes or [1] * instances.shape[0]
+        return [json.dumps({"tag": self.tag}) for _ in sizes]
+
+
+def test_hot_swap_pins_inflight_requests_to_admitted_version():
+    gate = threading.Event()
+    registry = ModelRegistry(drain_timeout_s=30.0)
+    rm1 = registry.register("m", _GatedStub("v1", gate))
+    server = ExplainerServer(registry=registry, host="127.0.0.1", port=0,
+                             max_batch_size=2, pipeline_depth=1).start()
+    try:
+        results = []
+
+        def fire():
+            results.append(_post(server.host, server.port,
+                                 _json_body(np.zeros((1, 3), np.float32),
+                                            "m")))
+
+        t = threading.Thread(target=fire, daemon=True)
+        t.start()
+        # wait until the request is pinned to v1 (admitted, in flight)
+        deadline = threading.Event()
+        for _ in range(200):
+            if rm1.inflight >= 1:
+                break
+            deadline.wait(0.02)
+        assert rm1.inflight >= 1
+
+        swapped = threading.Event()
+
+        def swap():
+            registry.register("m", _GatedStub("v2"))  # drains v1
+            swapped.set()
+
+        threading.Thread(target=swap, daemon=True).start()
+        # flip is immediate, drain blocks on the pinned request
+        for _ in range(200):
+            if registry.resolve("m").version == 2:
+                break
+            deadline.wait(0.02)
+        assert registry.resolve("m").version == 2
+        assert not swapped.wait(0.2)
+        gate.set()  # let v1 finish its in-flight answer
+        t.join(timeout=30)
+        assert swapped.wait(30)
+        # the in-flight request answered on the version that ADMITTED it
+        assert results and results[0][0] == 200
+        assert json.loads(results[0][1])["tag"] == "v1"
+        assert rm1.state == "retired"
+        # post-swap requests answer v2
+        s, p = _post(server.host, server.port,
+                     _json_body(np.zeros((1, 3), np.float32), "m"))
+        assert s == 200 and json.loads(p)["tag"] == "v2"
+        page = _get(server.host, server.port, "/metrics")
+        assert 'dks_registry_swaps_total{model="m"} 2' in page
+    finally:
+        gate.set()
+        server.stop()
+
+
+def test_tenant_quota_sheds_at_the_edge():
+    registry = ModelRegistry()
+    registry.register("open", _GatedStub("open"))
+    registry.register("capped", _GatedStub("capped"),
+                      quota=TenantQuota(rate_per_s=0.001, burst=1))
+    server = ExplainerServer(registry=registry, host="127.0.0.1", port=0,
+                             max_batch_size=2, pipeline_depth=1).start()
+    try:
+        row = _json_body(np.zeros((1, 3), np.float32))
+        s1, _ = _post(server.host, server.port, row,
+                      headers={"X-DKS-Model": "capped"})
+        s2, p2 = _post(server.host, server.port, row,
+                       headers={"X-DKS-Model": "capped"})
+        assert s1 == 200 and s2 == 429
+        doc = json.loads(p2)
+        assert doc["reason"] == "tenant_rate_limited"
+        # the flooding tenant's quota never touches the other tenant
+        s3, _ = _post(server.host, server.port, row,
+                      headers={"X-DKS-Model": "open"})
+        assert s3 == 200
+        page = _get(server.host, server.port, "/metrics")
+        assert ('dks_registry_sheds_total{model="capped",'
+                'reason="tenant_rate_limited"} 1') in page
+        assert 'dks_serve_sheds_total{reason="tenant_rate_limited"} 1' \
+            in page
+    finally:
+        server.stop()
+
+
+def test_fanin_proxy_forwards_model_header():
+    from distributedkernelshap_tpu.serving.replicas import FanInProxy
+
+    registry = ModelRegistry()
+    registry.register("a", _GatedStub("a"))
+    registry.register("b", _GatedStub("b"))
+    server = ExplainerServer(registry=registry, host="127.0.0.1", port=0,
+                             max_batch_size=2, pipeline_depth=1).start()
+    proxy = FanInProxy([(server.host, server.port)],
+                       host="127.0.0.1", port=0).start()
+    try:
+        s, p = _post(proxy.host, proxy.port,
+                     _json_body(np.zeros((1, 3), np.float32)),
+                     headers={"X-DKS-Model": "b"})
+        assert s == 200 and json.loads(p)["tag"] == "b"
+    finally:
+        proxy.stop()
+        server.stop()
