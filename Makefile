@@ -36,8 +36,8 @@ exact-bench:     ## exact-TreeSHAP arms: packed path-parallel schedule vs einsum
 autoscale-bench: ## elastic-fleet A/B: diurnal open-loop replay, autoscaled min=1..max=3 fleet vs static fleets (holds p99 SLO at >=30% fewer replica-seconds; scale-up first answer <=5s via the warmup ladder; drains lose/duplicate nothing)
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/autoscale_bench.py --check
 
-tenant-bench:    ## multi-tenant gateway: one fleet serving 3 model families concurrently (per-model phi bit-identical to dedicated deployments), hot-swap mid-run (zero lost/changed answers), noisy-tenant quota isolation; self-records for perf-gate
-	env JAX_PLATFORMS=cpu $(PY) benchmarks/multitenant_bench.py --check
+tenant-bench:    ## multi-tenant gateway: 3 families served concurrently (phi bit-identical to dedicated), hot-swap mid-run, noisy-tenant quota isolation, PLUS the cross-tenant batching sweep (1->8 mixed-path tenants >=85% of the single-tenant ceiling, shared-program parity); self-records for perf-gate
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/multitenant_bench.py --arm all --check
 
 obs-check:       ## observability drift lint: registry vs docs/OBSERVABILITY.md catalog, stray dks_ literals, ad-hoc exposition renderers
 	env JAX_PLATFORMS=cpu $(PY) scripts/obs_check.py

@@ -57,6 +57,33 @@ def serving_engine(model):
     return engine if hasattr(engine, "predictor") else None
 
 
+def share_eligible(model):
+    """The fitted engine behind ``model`` IF the deployment qualifies for
+    cross-tenant shared-program dispatch, else ``None``.
+
+    Sharing coalesces several tenants' request rows into ONE padded
+    device call, so eligibility is exactly the bit-identity gate
+    (docs/MULTITENANCY.md): the serving wrapper must declare per-row
+    reduction scope (``per_row_reduction`` — every request's phi depends
+    only on its own rows plus X-independent constants, true of all four
+    engine paths but NOT of arbitrary stub models), and the pinned
+    explain options must be limited to ``nsamples`` — ``interactions``
+    and active ``l1_reg`` ride sync fallbacks with request-coupled
+    control flow.  The engine itself carries the compatibility facts
+    (content fingerprint, plan seed, config) that
+    :func:`~distributedkernelshap_tpu.ops.explain.shared_program_key`
+    digests into the share key two tenants must MATCH on."""
+
+    if not getattr(model, "per_row_reduction", False):
+        return None
+    kwargs = getattr(model, "explain_kwargs", None)
+    if kwargs is None:
+        return None
+    if any(v for k, v in kwargs.items() if k != "nsamples"):
+        return None
+    return serving_engine(model)
+
+
 def classify_path(model, link: Optional[str] = None, G=None,
                   target_chunk_elems: Optional[int] = None) -> PathDecision:
     """Classify ``model`` onto its engine path.

@@ -112,6 +112,12 @@ class RegisteredModel:
         self.path_reason = path_reason
         self.quota = quota
         self.state = "active"
+        # cross-tenant shared-program identity (ops/explain.
+        # shared_program_key): tenants with EQUAL keys dispatch the
+        # identical compiled program over identical device constants, so
+        # the server may coalesce their rows into one padded device call
+        # bit-identically.  None = never share.
+        self.share_key: Optional[str] = None
         # set once a server ladder has compiled this version's programs
         # (register-time warm or the start-time ladder) — the start-time
         # ladder skips already-warm models instead of re-running them
@@ -176,6 +182,10 @@ class RegisteredModel:
                 "inflight": self._inflight, "requests": self.requests,
                 "errors": self.errors,
                 "quota": self.quota.describe() if self.quota else None,
+                # truncated like the fingerprint: enough for an operator
+                # to SEE which tenants coalesce, not a secret
+                "share_key": (self.share_key[:16]
+                              if self.share_key else None),
             }
 
 
@@ -219,6 +229,11 @@ class ModelRegistry:
         # shed / swap accounting for the dks_registry_* callbacks
         self._sheds: Dict[Tuple[str, str], float] = {}
         self._swaps: Dict[str, float] = {}
+        # ACTIVE versions per shared-program key: the server coalesces a
+        # tenant onto a share group only when it actually has peers
+        # (share_peers > 1) — a lone eligible tenant keeps its per-model
+        # group identity, so its quota keeps capping its per-cycle take
+        self._share_counts: Dict[str, int] = {}
 
     # -- serving attachment ------------------------------------------- #
 
@@ -280,6 +295,18 @@ class ModelRegistry:
             model_id, version, model,
             fingerprint=f"{model_id}@v{version}:{content[:24]}",
             path=path, path_reason=reason, quota=quota)
+        try:
+            # shared-program eligibility probe (never fails an ingest):
+            # content-identical tenants land on EQUAL keys and may share
+            # padded device calls (cross-tenant continuous batching)
+            from distributedkernelshap_tpu.ops.explain import (
+                shared_program_key,
+            )
+
+            rm.share_key = shared_program_key(model)
+        except Exception:
+            logger.debug("shared-program probe failed for %s", rm.label,
+                         exc_info=True)
         # the pinned attribute is what scheduling/result_cache's
         # model_fingerprint returns, so every cache key is scoped to this
         # (model_id, version, content) — and survives a restart
@@ -303,6 +330,18 @@ class ModelRegistry:
             if model_id not in self._order:
                 self._order.append(model_id)
             self._swaps[model_id] = self._swaps.get(model_id, 0.0) + 1.0
+            # share-peer accounting tracks ACTIVE versions only: the
+            # displaced version leaves its share group at the flip (its
+            # still-pinned requests dispatch under their per-model key)
+            if prev is not None and prev.share_key:
+                n = self._share_counts.get(prev.share_key, 0) - 1
+                if n > 0:
+                    self._share_counts[prev.share_key] = n
+                else:
+                    self._share_counts.pop(prev.share_key, None)
+            if rm.share_key:
+                self._share_counts[rm.share_key] = \
+                    self._share_counts.get(rm.share_key, 0) + 1
         self._flight.record("model_swap", model=model_id,
                             from_version=(prev.version if prev else None),
                             to_version=version, path=rm.path,
@@ -399,6 +438,17 @@ class ModelRegistry:
                 key = (rm.model_id, reason)
                 self._sheds[key] = self._sheds.get(key, 0.0) + 1.0
         return ok, reason, retry
+
+    def share_peers(self, share_key: Optional[str]) -> int:
+        """ACTIVE versions currently carrying ``share_key`` — the server
+        coalesces tenants onto one shared-program dispatch group only
+        when this exceeds 1 (a lone eligible tenant keeps its per-model
+        group, so its quota's per-cycle packing cap still applies)."""
+
+        if not share_key:
+            return 0
+        with self._lock:
+            return self._share_counts.get(share_key, 0)
 
     def model_ids(self) -> List[str]:
         with self._lock:

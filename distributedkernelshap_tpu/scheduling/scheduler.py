@@ -38,6 +38,7 @@ import heapq
 import queue
 import threading
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 PRIORITY_CLASSES = ("interactive", "batch", "best_effort")
@@ -84,6 +85,12 @@ class SLOScheduler:
         self._depths: Dict[str, int] = {k: 0 for k in PRIORITY_CLASSES}
         self._queued_rows = 0
         self._stopped = False
+        # deficit-round-robin state for tenant-aware packing (grouped
+        # next_batch only): group key -> row deficit.  A group passed over
+        # this cycle keeps its credit and leads a later one, so a
+        # high-rate tenant can never starve another tenant's batch past
+        # its share.  Bounded (LRU) — keys are tenant/share identities.
+        self._drr: "OrderedDict[object, float]" = OrderedDict()
         # observability (attach_metrics): None until the owner attaches a
         # registry — the scheduler is also used standalone in unit tests
         self._m_enqueued = None
@@ -113,7 +120,11 @@ class SLOScheduler:
             labelnames=("class",)).seed(*[(k,) for k in PRIORITY_CLASSES])
         self._m_pushbacks = registry.counter(
             "dks_sched_row_budget_pushbacks_total",
-            "Items deferred by row-budget packing to a later batch.")
+            "Items deferred to a later batch by packing: the row budget, "
+            "or — under tenant-aware grouped formation — bucket-boundary "
+            "trims, deficit-round-robin displacement and quota-yield "
+            "caps (routine under healthy multi-tenant load, not a "
+            "pressure signal there).")
 
     # -- ordering hooks (FIFOScheduler overrides) ----------------------- #
 
@@ -185,7 +196,7 @@ class SLOScheduler:
     def next_batch(self, max_batch_size: int, max_rows: Optional[int] = None,
                    batch_timeout_s: float = 0.0,
                    stop: Optional[threading.Event] = None,
-                   idle_wait_s: float = 0.5):
+                   idle_wait_s: float = 0.5, grouping=None):
         """Form one batch.  Returns ``(batch, expired)``.
 
         Blocks (condition-variable wait, bounded by ``idle_wait_s`` per
@@ -196,6 +207,13 @@ class SLOScheduler:
         deadline had already passed: the caller owns failing them (they
         must not cost device work).  Returns ``(None, [])`` when stopped
         while idle.
+
+        ``grouping`` (None = the historical tenant-blind formation) is a
+        policy object with ``key(item)`` / ``bucket(key, rows)`` /
+        ``limit(key)`` — see :meth:`_fill_grouped` — that turns formation
+        tenant-aware: items of one group are packed contiguously up to a
+        compile-bucket boundary before another group's items are admitted,
+        under deficit-round-robin fairness across groups.
         """
 
         with self._cond:
@@ -203,6 +221,9 @@ class SLOScheduler:
                 if self._stopped or (stop is not None and stop.is_set()):
                     return None, []
                 self._cond.wait(timeout=idle_wait_s)
+            if grouping is not None:
+                return self._fill_grouped(max_batch_size, max_rows,
+                                          batch_timeout_s, stop, grouping)
             batch: List[object] = []
             expired: List[object] = []
             counted_pushback: set = set()
@@ -268,6 +289,184 @@ class SLOScheduler:
                 # woken early by put(): loop re-scans the heap
                 self._cond.wait(timeout=remaining)
             return batch, expired
+
+    def _fill_grouped(self, max_batch_size: int, max_rows: Optional[int],
+                      batch_timeout_s: float,
+                      stop: Optional[threading.Event], grouping):
+        """Tenant-aware batch formation (cross-tenant continuous batching).
+        Caller holds ``self._cond`` and guarantees a non-empty heap.
+
+        ``grouping`` supplies three hooks:
+
+        * ``key(item)`` — hashable tenant / shared-program identity; items
+          with equal keys dispatch as ONE device group.
+        * ``bucket(key, rows)`` — the compile-bucket ``rows`` pads to for
+          that group's engine.  Formation fills one group's sub-batch to a
+          bucket boundary before opening the next, so a cycle of N tiny
+          tenant groups no longer pads N buckets.
+        * ``limit(key)`` — optional per-cycle item cap (the tenant's
+          in-flight quota bound): a capped tenant YIELDS its slots to
+          other groups instead of fragmenting the cycle.
+
+        Fairness is deficit round robin over rows: every group with queued
+        work earns a per-cycle quantum; groups are served in deficit order
+        (ties resolve to the EDF-earliest item) and a group's take spends
+        its credit, so a flooding tenant that filled this batch sorts
+        behind the tenants it displaced on the next one.  Items not taken
+        are pushed back with their ORIGINAL heap keys — the same
+        starvation-free deferral contract as row-budget pushback.
+        """
+
+        batch: List[object] = []
+        expired: List[object] = []
+        counted_pushback: set = set()
+        rows = 0
+        group_rows: Dict[object, int] = {}
+        group_items: Dict[object, int] = {}
+        fill_deadline = self._now() + (batch_timeout_s
+                                       if max_batch_size > 1 else 0.0)
+        while True:
+            now = self._now()
+            # bounded EDF-prefix scan: pop live candidates (expiry and
+            # done handling identical to the plain path); anything beyond
+            # the scan window stays heap-resident untouched
+            scan_limit = max(16, 4 * max_batch_size)
+            candidates: List[Tuple[float, int, object]] = []
+            while self._heap and len(candidates) < scan_limit:
+                eff, seq, item = heapq.heappop(self._heap)
+                if getattr(item, "done", False):
+                    self._account_pop(item)
+                    continue
+                if self._is_expired(item, now):
+                    self._account_pop(item)
+                    expired.append(item)
+                    if self._m_expired is not None:
+                        self._m_expired.inc(**{
+                            "class": getattr(item, "klass", "batch")})
+                    continue
+                candidates.append((eff, seq, item))
+            groups: Dict[object, List[Tuple[float, int, object]]] = {}
+            for entry in candidates:
+                try:
+                    key = grouping.key(entry[2])
+                except Exception:
+                    key = None
+                groups.setdefault(key, []).append(entry)
+            # DRR credit: every group with queued work earns a row
+            # quantum (capped so an idle-then-bursty group cannot hoard
+            # unbounded credit); state is LRU-bounded across tenant churn
+            quantum = float(max(1, max_rows or max_batch_size)) \
+                / max(1, len(groups))
+            for key in groups:
+                self._drr[key] = max(
+                    min(self._drr.get(key, 0.0) + quantum, 4.0 * quantum),
+                    -4.0 * quantum)
+                self._drr.move_to_end(key)
+            while len(self._drr) > 256:
+                self._drr.popitem(last=False)
+            serve_order = sorted(
+                groups, key=lambda k: (-self._drr.get(k, 0.0),
+                                       groups[k][0][:2]))
+            pushback: List[Tuple[float, int, object]] = []
+            for gi, key in enumerate(serve_order):
+                entries = groups[key]
+                try:
+                    cap = grouping.limit(key)
+                except Exception:
+                    cap = None
+                # EDF-ordered prefix of this group that fits the global
+                # capacity, the row budget and the tenant's per-cycle cap
+                fit_n, total = 0, rows
+                for eff, seq, item in entries:
+                    if len(batch) + fit_n >= max_batch_size:
+                        break
+                    if max_rows and total >= max_rows:
+                        break
+                    if cap is not None and \
+                            group_items.get(key, 0) + fit_n >= cap:
+                        break
+                    if (batch or fit_n) and max_rows \
+                            and total + item.rows > max_rows:
+                        break
+                    fit_n += 1
+                    total += item.rows
+                # bucket-boundary trim: while OTHER groups still have
+                # work, cut this group at the largest prefix landing
+                # exactly on its compile bucket — padding one tenant's
+                # sub-batch while another tenant's real rows wait is the
+                # waste this packer exists to remove.  No boundary
+                # reachable (or last group standing): take the full fit.
+                more_elsewhere = bool(pushback) or any(
+                    groups[k2] for k2 in serve_order[gi + 1:])
+                if fit_n and more_elsewhere:
+                    base = group_rows.get(key, 0)
+                    cum, best = base, None
+                    for i in range(fit_n):
+                        cum += entries[i][2].rows
+                        try:
+                            boundary = grouping.bucket(key, cum) == cum
+                        except Exception:
+                            boundary = True
+                        if boundary:
+                            best = i + 1
+                    if best is not None:
+                        fit_n = best
+                for eff, seq, item in entries[:fit_n]:
+                    self._account_pop(item)
+                    if self._m_queue_wait is not None:
+                        self._m_queue_wait.observe(
+                            max(0.0, now - item.t_enqueued),
+                            **{"class": getattr(item, "klass", "batch")})
+                    batch.append(item)
+                    rows += item.rows
+                    group_rows[key] = group_rows.get(key, 0) + item.rows
+                    group_items[key] = group_items.get(key, 0) + 1
+                    self._drr[key] = self._drr.get(key, 0.0) - item.rows
+                pushback.extend(entries[fit_n:])
+            if not batch and pushback:
+                # progress guarantee: every group capped out (limit()=0
+                # misconfiguration, boundary trims) must never spin the
+                # dispatcher on an empty batch — take the EDF-earliest
+                # candidate unconditionally, with the SAME per-item
+                # accounting as a normal take (queue-wait observation,
+                # DRR debit) so the guarantee path cannot skew either
+                entry = min(pushback, key=lambda e: e[:2])
+                pushback.remove(entry)
+                item = entry[2]
+                self._account_pop(item)
+                if self._m_queue_wait is not None:
+                    self._m_queue_wait.observe(
+                        max(0.0, now - item.t_enqueued),
+                        **{"class": getattr(item, "klass", "batch")})
+                batch.append(item)
+                rows += item.rows
+                try:
+                    key = grouping.key(item)
+                except Exception:
+                    key = None
+                group_rows[key] = group_rows.get(key, 0) + item.rows
+                group_items[key] = group_items.get(key, 0) + 1
+                self._drr[key] = self._drr.get(key, 0.0) - item.rows
+            for entry in pushback:
+                heapq.heappush(self._heap, entry)
+            if pushback and self._m_pushbacks is not None:
+                fresh = [e for e in pushback
+                         if id(e[2]) not in counted_pushback]
+                counted_pushback.update(id(e[2]) for e in fresh)
+                if fresh:
+                    self._m_pushbacks.inc(len(fresh))
+            if len(batch) >= max_batch_size:
+                break
+            if max_rows and rows >= max_rows:
+                break
+            remaining = fill_deadline - self._now()
+            if remaining <= 0:
+                break
+            if self._stopped or (stop is not None and stop.is_set()):
+                break
+            # woken early by put(): loop re-scans the heap
+            self._cond.wait(timeout=remaining)
+        return batch, expired
 
     def drain(self) -> List[object]:
         """Remove and return every queued (not-done) item — the server's

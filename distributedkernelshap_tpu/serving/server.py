@@ -38,6 +38,7 @@ import os
 import queue
 import threading
 import time
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 
@@ -83,7 +84,7 @@ class _HTTPServer(ThreadingHTTPServer):
 class _Pending:
     __slots__ = ("array", "event", "response", "error", "t_enqueued", "done",
                  "klass", "deadline", "cache_key", "status_code", "cache_hit",
-                 "trace", "wire_format", "model")
+                 "trace", "wire_format", "model", "group_key")
 
     def __init__(self, array: np.ndarray, klass: str = "interactive",
                  deadline: Optional[float] = None,
@@ -125,6 +126,11 @@ class _Pending:
         # dispatch/caching/metrics all read the pinned version (None in
         # single-model mode)
         self.model = model
+        # memoised dispatch-group identity (server._group_key_for):
+        # computed once per request by the grouping policy's first
+        # sighting — share-peer lookups take the registry lock, and the
+        # scheduler calls key() inside its own critical section
+        self.group_key = None
 
     @property
     def rows(self) -> int:
@@ -235,6 +241,91 @@ def resolve_staging_env(default: bool) -> bool:
     return resolve_bool_env("DKS_STAGING", default)
 
 
+def resolve_shared_batch_env(default: bool) -> bool:
+    """The ONE ``DKS_SHARED_BATCH`` parser (same contract as
+    :func:`resolve_warmup_env`).  ``DKS_SHARED_BATCH=0`` is the
+    cross-tenant-batching escape hatch: registry-mode batch formation
+    reverts to the PR-10 tenant-blind EDF pop + per-(model, version)
+    group split, with no shared-program coalescing."""
+
+    from distributedkernelshap_tpu.utils import resolve_bool_env
+
+    return resolve_bool_env("DKS_SHARED_BATCH", default)
+
+
+class _TenantGrouping:
+    """Adapter between the server's tenant facts and the scheduler's
+    grouped batch formation (``SLOScheduler._fill_grouped``): ``key`` maps
+    a pending request to its dispatch-group identity (shared-program key
+    when eligible, else the stable ``(model_id, version)``), ``bucket``
+    exposes the group engine's compile-bucket ladder so packing can fill
+    a tenant's sub-batch to a bucket boundary, and ``limit`` surfaces the
+    tenant's in-flight quota bound as a per-cycle cap (a tenant at its
+    bound yields its slots instead of fragmenting the cycle)."""
+
+    _MAX_META = 128  # group keys remembered (rm + bucket fn); LRU
+
+    def __init__(self, server):
+        self._server = server
+        # key -> (rm, bucket_fn_or_None); true LRU (move_to_end on every
+        # sighting) so version churn evicts IDLE keys, never the busiest
+        # tenants' — a FIFO-by-first-sighting bound would thrash exactly
+        # the longest-registered, highest-traffic groups
+        self._meta: "OrderedDict[object, tuple]" = OrderedDict()
+
+    def _remember(self, key, rm) -> None:
+        # REFRESHED on every sighting, not first-seen: the cached rm
+        # drives limit(), and a share key survives a content-identical
+        # hot swap — a quota tightened at swap time must bite the very
+        # next cycle, and a retired version must not linger here
+        prev = self._meta.get(key)
+        if prev is not None and prev[0] is rm:
+            self._meta.move_to_end(key)
+            return
+        bucket = (self._server._bucket_fn(rm.model)
+                  if rm.model is not None else None)
+        self._meta[key] = (rm, bucket)
+        self._meta.move_to_end(key)
+        while len(self._meta) > self._MAX_META:
+            self._meta.popitem(last=False)
+
+    def key(self, item):
+        rm = getattr(item, "model", None)
+        if rm is None:
+            return None
+        # memoised per request: the share-peer lookup takes the registry
+        # lock and this runs per scanned candidate inside the
+        # scheduler's critical section
+        k = getattr(item, "group_key", None)
+        if k is None:
+            k = self._server._group_key_for(rm)
+            try:
+                item.group_key = k
+            except AttributeError:
+                pass  # foreign item types just recompute next time
+        self._remember(k, rm)
+        return k
+
+    def bucket(self, key, rows: int) -> int:
+        meta = self._meta.get(key)
+        if meta is None or meta[1] is None:
+            return rows
+        return int(meta[1](rows))
+
+    def limit(self, key):
+        # shared-program groups span tenants, so no single tenant's
+        # in-flight bound may cap the GROUP (each tenant's own bound is
+        # already enforced at admission — its queued requests can never
+        # exceed it — and throttling tenant B by tenant A's quota would
+        # be arbitrary cross-tenant interference)
+        if not isinstance(key, tuple) or key[0] != "model":
+            return None
+        meta = self._meta.get(key)
+        quota = getattr(meta[0], "quota", None) if meta is not None else None
+        bound = getattr(quota, "max_inflight", None)
+        return int(bound) if bound else None
+
+
 class ExplainerServer:
     """Serves a fitted serving model over HTTP on ``/explain``.
 
@@ -335,6 +426,28 @@ class ExplainerServer:
         ``explain_batch_async`` (the serving wrappers); otherwise the
         single-thread dispatch loop runs unchanged.  Overlap is measured
         as ``dks_staging_overlap_seconds_total``.
+    shared_batching
+        Cross-tenant continuous batching (registry mode only;
+        docs/MULTITENANCY.md): batch formation becomes tenant-aware
+        (bucket-boundary packing + deficit-round-robin fairness in
+        ``scheduling/scheduler.py``) and tenants whose deployments
+        dispatch the IDENTICAL compiled program over IDENTICAL device
+        constants (equal ``RegisteredModel.share_key``) coalesce into ONE
+        device call, with per-leader ``split_sizes`` carrying the tenant
+        boundaries — phi bit-identical to dedicated dispatch at the same
+        padded shape.  ``None`` (default) resolves from the
+        ``DKS_SHARED_BATCH`` env (ON unless falsy); ``False`` restores
+        the PR-10 serialized per-model dispatch byte-identically.
+        Single-model servers are unaffected either way.
+    staging_depth
+        Staged batches the staging buffer may hold at once, and how many
+        groups AHEAD of the dispatcher the batcher runs their
+        host→device uploads (so in-flight staged device buffers stay
+        bounded by roughly twice this knob).  ``None`` (default): 1 in
+        single-model mode (the classic double buffer), else the
+        active-tenant count capped at 4 — a cycle's tenant groups upload
+        while earlier groups compute, instead of the batcher blocking
+        after staging one group.
     """
 
     def __init__(self, model=None, host: str = "0.0.0.0", port: int = 8000,
@@ -355,6 +468,8 @@ class ExplainerServer:
                  slos=None, alert_rules=None, alert_sinks=None,
                  warmup: Optional[bool] = None,
                  staging: Optional[bool] = None,
+                 shared_batching: Optional[bool] = None,
+                 staging_depth: Optional[int] = None,
                  registry=None):
         # multi-tenant gateway mode (registry/registry.py): requests route
         # by X-DKS-Model (or the JSON/wire `model` field) to the named
@@ -478,6 +593,15 @@ class ExplainerServer:
         self._staging_requested = bool(staging)
         self._staging_enabled = False
         self._staged: Optional[StagingBuffer] = None
+        self.staging_depth = (None if staging_depth is None
+                              else max(1, int(staging_depth)))
+        # cross-tenant continuous batching (registry mode only; see the
+        # ``shared_batching`` parameter): tenant-aware packing in the
+        # scheduler + shared-program coalescing in _form_batch
+        if shared_batching is None:
+            shared_batching = resolve_shared_batch_env(default=True)
+        self._shared_batching = bool(shared_batching)
+        self._grouping = _TenantGrouping(self)
         # (batch, finalize) pairs already dispatched to the device; bounded so
         # a slow host can't pile up unbounded in-flight device work (the
         # queue is created in start(), once the depth is known)
@@ -545,6 +669,20 @@ class ExplainerServer:
             "Seconds staged batches sat device-ready before dispatch "
             "(host-to-device upload overlapped with the previous batch's "
             "compute).")
+        # cross-tenant batching density: device groups per scheduler
+        # cycle (1 = fully coalesced; tenant-count = fully serialized)
+        # and the bucket-padding rows each dispatch actually paid — the
+        # waste the tenant-aware packer + shared programs remove
+        self._m_batch_groups = reg.histogram(
+            "dks_serve_batch_groups",
+            "Per-model device groups formed per scheduler cycle "
+            "(multi-tenant dispatch density; 1 = fully coalesced).",
+            buckets=(1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0))
+        self._m_padded_rows = reg.counter(
+            "dks_serve_padded_rows_total",
+            "Bucket-padding rows dispatched to the device per model "
+            "(rows the engine padded on top of real request rows).",
+            labelnames=("model",))
         self._m_latency = reg.histogram(
             "dks_serve_request_latency_seconds",
             "Queue+explain latency of answered requests.",
@@ -780,7 +918,7 @@ class ExplainerServer:
     def _complete(self, batch, payloads=None, error=None, status: int = 500,
                   index_map=None, device_rows: int = 0,
                   t_dispatch: Optional[float] = None,
-                  t_fetch: Optional[float] = None):
+                  t_fetch: Optional[float] = None, span_attrs=None):
         # counters update BEFORE the response events: a client that gets
         # its answer and immediately scrapes /metrics must see itself
         # counted.  Claiming happens under the metrics lock so a batch the
@@ -860,8 +998,12 @@ class ExplainerServer:
                 tr.record_mono("server.device_explain", t_dispatch,
                                end_fetch, parent=p.trace,
                                batch_rows=device_rows,
-                               path=getattr(self.model, "explain_path",
-                                            None),
+                               # path (+ shared= for registry dispatches)
+                               # from the dispatching deployment; legacy
+                               # callers fall back to the bound model
+                               **(span_attrs if span_attrs is not None
+                                  else {"path": getattr(
+                                      self.model, "explain_path", None)}),
                                error=error is not None)
                 tr.record_mono("server.finalize", end_fetch,
                                time.monotonic(), parent=p.trace)
@@ -886,6 +1028,10 @@ class ExplainerServer:
             "max_batch_size": self.max_batch_size,
             "admission_control": self._admission is not None,
             "staging": self._staging_enabled,
+            # cross-tenant continuous batching actually in effect (the
+            # knob only bites in registry mode)
+            "shared_batching": (self._registry is not None
+                                and self._shared_batching),
         }
         # the autoscaler's queue-pressure inputs: the admission EWMA's
         # device throughput and the EDF-aware projected wait per class
@@ -1007,6 +1153,21 @@ class ExplainerServer:
             # _pad_sharded produces for real dispatches
             engine = getattr(engine, "engine", None)
         return engine
+
+    @classmethod
+    def _bucket_fn(cls, model):
+        """The served model's engine compile-bucket function, or ``None``
+        when its batches are not bucketed — the ONE resolution shared by
+        the tenant-grouping policy (bucket-boundary packing) and the
+        padded-rows accounting, so the eligibility rule cannot drift
+        between them."""
+
+        engine = cls._warmup_engine(model)
+        bucket = getattr(engine, "_bucket", None)
+        if bucket is None or not getattr(
+                getattr(engine, "config", None), "bucket_batches", False):
+            return None
+        return bucket
 
     def _warmup_targets(self):
         """``(label, serving model, rm)`` triples the start-time ladder
@@ -1186,20 +1347,47 @@ class ExplainerServer:
                     compile_summary["cache_hit"],
                     compile_summary["seconds"])
 
+    def _group_key_for(self, rm):
+        """The dispatch-group identity of a pinned tenant version:
+        ``("share", key)`` for shared-program-eligible deployments when
+        cross-tenant batching is on (content-identical tenants coalesce
+        onto one device call), else the stable ``("model", id, version)``
+        — deterministic across runs and a usable metric/trace label,
+        unlike the historical ``id(p.model)`` key (alive-safe via the
+        pin, but non-reproducible).  ``None`` in single-model mode."""
+
+        if rm is None:
+            return None
+        share = getattr(rm, "share_key", None)
+        if self._shared_batching and share and self._registry is not None \
+                and self._registry.share_peers(share) > 1:
+            # only with a live peer: a lone eligible tenant keeps its
+            # per-model group so its quota's per-cycle cap still bites
+            return ("share", share)
+        return ("model", rm.model_id, rm.version)
+
     def _form_batch(self):
         """Pop one schedulable batch: expired requests are failed (504),
         cache hits answered and in-batch duplicates collapsed.  Returns a
-        list of ``(live, leaders, index_map, t_claim, rm)`` groups — one
-        per registered model appearing in the popped batch (a device call
-        is one engine's program, so tenants never share a batch; ``rm`` is
-        ``None`` in single-model mode, where the list has one group) — or
-        ``None`` when nothing dispatchable came out (idle wakeup,
-        all-expired, all-cached)."""
+        list of ``(live, leaders, index_map, t_claim, rm, shared)``
+        groups — one per dispatch-group key appearing in the popped batch
+        (a device call is one engine's program; with cross-tenant
+        batching on, content-identical tenants SHARE a group and ``rm``
+        is the EDF-first member's pinned version, whose engine serves the
+        whole group's constants bit-identically; ``shared`` flags a group
+        actually spanning >1 tenant) — or ``None`` when nothing
+        dispatchable came out (idle wakeup, all-expired, all-cached).
+        ``rm`` is ``None`` in single-model mode, where the list has one
+        group."""
 
+        grouping = (self._grouping
+                    if self._registry is not None and self._shared_batching
+                    else None)
         batch, expired = self._sched.next_batch(
             self.max_batch_size,
             max_rows=getattr(self.model, "max_rows", None),
-            batch_timeout_s=self.batch_timeout_s, stop=self._stop)
+            batch_timeout_s=self.batch_timeout_s, stop=self._stop,
+            grouping=grouping)
         tr = self._tracer
         t_claim = time.monotonic()
         for p in expired:
@@ -1213,26 +1401,47 @@ class ExplainerServer:
                               "(server overloaded)", 504)
         if not batch:
             return None
-        # group by pinned model, preserving EDF pop order within and
-        # across groups (dict preserves first-seen insertion order)
-        by_model = {}
+        # group by dispatch key, preserving EDF pop order within and
+        # across groups (dict preserves first-seen insertion order); the
+        # grouped scheduler path memoised each request's key already
+        by_key = {}
         for p in batch:
-            by_model.setdefault(id(p.model), (p.model, []))[1].append(p)
+            key = getattr(p, "group_key", None)
+            if key is None:
+                key = self._group_key_for(p.model)
+            by_key.setdefault(key, []).append(p)
         groups = []
-        for _, (rm, members) in by_model.items():
+        for key, members in by_key.items():
             live, leaders, index_map = self._split_batch_on_cache(members)
             if leaders:
-                groups.append((live, leaders, index_map, t_claim, rm))
+                # the dispatching version must come from a LIVE leader:
+                # its pin is held until _complete answers it, so a
+                # hot-swap drain can never retire/release the engine
+                # under this device call (a cache-answered members[0]
+                # would already have released its pin)
+                rm = leaders[0].model
+                shared = None if rm is None else (
+                    key[0] == "share"
+                    and len({(m.model.model_id, m.model.version)
+                             for m in live}) > 1)
+                groups.append((live, leaders, index_map, t_claim, rm,
+                               shared))
+        if groups:
+            self._m_batch_groups.observe(len(groups))
         return groups or None
 
     def _dispatch_batch(self, live, leaders, index_map, t_claim,
-                        stacked=None, staged=None, rm=None):
+                        stacked=None, staged=None, rm=None, shared=None):
         """Dispatch one formed batch to the device (dispatcher thread only:
         the engine's jit caches are single-dispatcher state).  ``stacked``
         /``staged`` come pre-built from the staging batcher; without them
         the rows are stacked here (the classic single-thread path).
-        ``rm`` is the batch's registered model (registry mode) — every
-        request in the batch pinned it at admission."""
+        ``rm`` is the batch's registered model (registry mode) — for a
+        shared-program group, the EDF-first member's pinned version,
+        whose engine runs the whole group (every member pinned its OWN
+        version at admission, so accounting and the drain contract are
+        per-tenant regardless).  ``shared`` flags a group spanning >1
+        tenant (the ``shared=`` span attribute)."""
 
         # read at dispatch: tests may swap self.model while the
         # dispatcher is parked in next_batch / the staging buffer
@@ -1246,6 +1455,20 @@ class ExplainerServer:
             self._active[id(live)] = live
         t_dispatch = time.monotonic()
         device_rows = sum(sizes)
+        # bucket-padding accounting: the rows the engine will pad on top
+        # of the real request rows (the waste the cross-tenant packer
+        # minimizes), attributed to the dispatching tenant
+        bucket = self._bucket_fn(model)
+        if bucket is not None:
+            try:
+                self._m_padded_rows.inc(
+                    max(0, int(bucket(device_rows)) - device_rows),
+                    model=rm.model_id if rm is not None else "default")
+            except Exception:
+                pass
+        span_attrs = {"path": getattr(model, "explain_path", None)}
+        if shared is not None:
+            span_attrs["shared"] = bool(shared)
         if tr.enabled:
             for p in live:
                 if p.trace is not None:
@@ -1282,7 +1505,7 @@ class ExplainerServer:
                         split_sizes=sizes, **kwargs)
                 self._inflight.put((live, finalize, index_map,
                                     device_rows, t_dispatch,
-                                    batch_ctx))
+                                    batch_ctx, span_attrs))
             else:
                 with _tracing.use_context(batch_ctx):
                     payloads = model.explain_batch(
@@ -1291,7 +1514,7 @@ class ExplainerServer:
                     live, payloads,
                     index_map=index_map, device_rows=device_rows,
                     t_dispatch=t_dispatch,
-                    t_fetch=time.monotonic())
+                    t_fetch=time.monotonic(), span_attrs=span_attrs)
         except Exception as e:  # surface errors to waiting requests
             logger.exception("explain batch failed")
             self._complete(live, error=str(e))
@@ -1315,24 +1538,48 @@ class ExplainerServer:
             formed = self._form_batch()
             if formed is None:
                 continue
-            for live, leaders, index_map, t_claim, rm in formed:
-                model = rm.model if rm is not None else self.model
+            # per-tenant device-stream overlap for N-group cycles: stack
+            # every group on the host first, then run the H2D uploads as
+            # a pipeline staying ``staging_slots`` groups AHEAD of the
+            # blocking buffer puts — tenant B's (and C's...) uploads are
+            # in flight while tenant A's group computes, yet in-flight
+            # staged device buffers stay bounded by the configured depth
+            # (stage-everything-upfront would hold one buffer per group
+            # regardless of the knob)
+            items = []
+            for live, leaders, index_map, t_claim, rm, shared in formed:
                 try:
                     stacked = np.concatenate([p.array for p in leaders],
                                              axis=0)
-                    staged = None
-                    t0 = time.monotonic()
+                except Exception as e:
+                    # from here on this frame OWNS the popped requests:
+                    # any failure must answer them, not drop them
+                    logger.exception("staging batcher: stacking failed")
+                    self._complete(live, error=str(e))
+                    continue
+                items.append([live, leaders, index_map, t_claim,
+                              stacked, None, rm, shared])
+
+            def _stage(item):
+                # NOTHING may escape: staging is an optimisation — any
+                # failure (upload, span recording, capability probe)
+                # must degrade to the classic dispatch-time H2D, never
+                # fail the batch or kill this thread (the batcher is the
+                # sole batch former while staging is on)
+                try:
+                    leaders, stacked, rm = item[1], item[4], item[6]
+                    model = rm.model if rm is not None else self.model
                     stage = getattr(model, "stage_rows", None)
+                    if stage is None:
+                        return
+                    t0 = time.monotonic()
                     try:
-                        if stage is not None:
-                            staged = stage(stacked)
+                        item[5] = stage(stacked)
                     except Exception:
-                        # staging is an optimisation: a failed upload must
-                        # degrade to the classic dispatch-time H2D, never
-                        # fail the batch
                         logger.exception(
                             "stage_rows failed; dispatching unstaged")
-                    if tr.enabled and staged is not None:
+                        return
+                    if tr.enabled and item[5] is not None:
                         batch_ctx = next((p.trace for p in leaders
                                           if p.trace is not None), None)
                         if batch_ctx is not None:
@@ -1340,20 +1587,25 @@ class ExplainerServer:
                                            time.monotonic(),
                                            parent=batch_ctx,
                                            rows=int(stacked.shape[0]))
-                except Exception as e:
-                    # from here on this frame OWNS the popped requests: any
-                    # failure must answer them, not drop them
-                    logger.exception("staging batcher: stacking failed")
-                    self._complete(live, error=str(e))
-                    continue
-                if not self._staged.put((live, leaders, index_map, t_claim,
-                                         stacked, staged, rm),
-                                        stop=self._stop):
-                    # shutdown won the race for the staging slot: fail the
-                    # batch like the scheduler drain would have
-                    self._complete(live, error="server shutting down",
-                                   status=503)
+                except Exception:
+                    logger.exception("staging probe failed; "
+                                     "dispatching unstaged")
+
+            ahead = getattr(self, "_staging_slots", 1)
+            for i in range(min(ahead, len(items))):
+                _stage(items[i])
+            for i, item in enumerate(items):
+                if not self._staged.put(tuple(item), stop=self._stop):
+                    # shutdown won the race for the staging slot: fail
+                    # this and every remaining staged batch like the
+                    # scheduler drain would have
+                    for it in items[i:]:
+                        self._complete(it[0],
+                                       error="server shutting down",
+                                       status=503)
                     return
+                if i + ahead < len(items):
+                    _stage(items[i + ahead])
 
     def _dispatch_loop(self):
         """Form batches via the scheduler and dispatch one device call each.
@@ -1380,14 +1632,14 @@ class ExplainerServer:
                     if got is None:
                         break
                     (live, leaders, index_map, t_claim,
-                     stacked, staged, rm), ready_s = got
+                     stacked, staged, rm, shared), ready_s = got
                     # time the staged batch sat device-ready while this
                     # thread was busy with the previous one — the measured
                     # upload/compute overlap
                     self._m_staging_overlap.inc(ready_s)
                     self._dispatch_batch(live, leaders, index_map, t_claim,
                                          stacked=stacked, staged=staged,
-                                         rm=rm)
+                                         rm=rm, shared=shared)
                 for item in self._staged.drain():
                     # staged but never dispatched (shutdown): fail like the
                     # scheduler drain so no handler thread leaks
@@ -1398,9 +1650,9 @@ class ExplainerServer:
                 formed = self._form_batch()
                 if formed is None:
                     continue
-                for live, leaders, index_map, t_claim, rm in formed:
+                for live, leaders, index_map, t_claim, rm, shared in formed:
                     self._dispatch_batch(live, leaders, index_map,
-                                         t_claim, rm=rm)
+                                         t_claim, rm=rm, shared=shared)
         finally:
             # finalizers only exit once dispatch can no longer enqueue, so a
             # batch dispatched during shutdown is still fetched + answered
@@ -1413,7 +1665,8 @@ class ExplainerServer:
         while not (self._dispatch_done.is_set() and self._inflight.empty()):
             try:
                 (batch, finalize, index_map, device_rows,
-                 t_dispatch, batch_ctx) = self._inflight.get(timeout=0.1)
+                 t_dispatch, batch_ctx,
+                 span_attrs) = self._inflight.get(timeout=0.1)
             except queue.Empty:
                 continue
             try:
@@ -1422,7 +1675,8 @@ class ExplainerServer:
                 self._complete(batch, payloads, index_map=index_map,
                                device_rows=device_rows,
                                t_dispatch=t_dispatch,
-                               t_fetch=time.monotonic())
+                               t_fetch=time.monotonic(),
+                               span_attrs=span_attrs)
             except Exception as e:
                 logger.exception("finalize batch failed")
                 self._complete(batch, error=str(e))
@@ -1951,21 +2205,42 @@ class ExplainerServer:
                 logger.exception("depth calibration failed; defaulting to 8")
                 self.pipeline_depth = 8
         self._inflight = queue.Queue(maxsize=self.pipeline_depth)
-        # staging resolves against the model's actual capabilities here:
-        # it needs the pipelined path plus the stage_rows hook (serving
-        # wrappers), and stage_rows itself may still decline per call
-        # (exact/interactions/l1 deployments return None → unstaged path)
-        self._staging_enabled = (
-            self._staging_requested
-            and hasattr(self.model, "stage_rows")
-            and hasattr(self.model, "explain_batch_async"))
-        if self._staging_requested and not self._staging_enabled:
-            logger.warning(
-                "staging requested but the model exposes no "
-                "stage_rows/explain_batch_async; serving unstaged")
+        # single-model staging resolves against the model's actual
+        # capabilities here: it needs the pipelined path plus the
+        # stage_rows hook (serving wrappers), and stage_rows itself may
+        # still decline per call (exact/interactions/l1 deployments
+        # return None → unstaged path).  Registry mode runs the batcher
+        # whenever staging is requested: per-group staging degrades
+        # gracefully for tenants without the hooks (staged=None →
+        # classic dispatch-time H2D), and a staging-capable tenant
+        # registered AFTER start() must get the pipeline too — a
+        # roster-at-start capability check would freeze it out.
+        if self._registry is not None:
+            self._staging_enabled = self._staging_requested
+            staging_models = [rm.model
+                              for rm in self._registry.active_models()]
+        else:
+            staging_models = [self.model]
+            self._staging_enabled = (
+                self._staging_requested
+                and hasattr(self.model, "stage_rows")
+                and hasattr(self.model, "explain_batch_async"))
+            if self._staging_requested and not self._staging_enabled:
+                logger.warning(
+                    "staging requested but the model exposes no "
+                    "stage_rows/explain_batch_async; serving unstaged")
         t_batcher = None
         if self._staging_enabled:
-            self._staged = StagingBuffer(depth=1)
+            # one staging slot per active tenant (capped): a cycle's N
+            # tenant groups can all be device-resident before the
+            # dispatcher needs them, so the batcher never blocks one
+            # tenant's upload behind another tenant's compute
+            depth = self.staging_depth
+            if depth is None:
+                depth = (min(4, max(1, len(staging_models)))
+                         if self._registry is not None else 1)
+            self._staging_slots = depth
+            self._staged = StagingBuffer(depth=depth)
             t_batcher = threading.Thread(target=self._batcher_loop,
                                          daemon=True)
         t_disp = threading.Thread(target=self._dispatch_loop, daemon=True)

@@ -249,6 +249,14 @@ class KernelShapModel:
     #: keep the historical JSON-only contract
     supports_wire_formats = True
 
+    #: per-row reduction scope: each request's phi depends only on its own
+    #: rows plus X-independent constants — no engine path reduces across
+    #: request rows — so content-identical tenants may share one padded
+    #: device call bit-identically (cross-tenant continuous batching;
+    #: ``registry/classify.share_eligible`` gates on this declaration, so
+    #: stub models without it are never coalesced across tenants)
+    per_row_reduction = True
+
     def _resplit_payloads(self, instances: np.ndarray, shap_values,
                           expected_value, raw_predictions: np.ndarray,
                           split_sizes: List[int],

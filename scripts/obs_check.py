@@ -41,7 +41,9 @@ DOCS_PATH = os.path.join(REPO_ROOT, "docs", "OBSERVABILITY.md")
 #: landed ``dks_autoscale_*``; ``tensor_shap`` when the exact
 #: tensor-network path landed ``dks_tensor_shap_*``; ``registry`` and
 #: ``result_cache`` when the multi-tenant model registry landed
-#: ``dks_registry_*`` and the weak-fingerprint accounting.
+#: ``dks_registry_*`` and the weak-fingerprint accounting.  The
+#: cross-tenant batching series (``dks_serve_batch_groups``,
+#: ``dks_serve_padded_rows_total``) ride the existing ``serve`` prefix.
 _LITERAL_RE = re.compile(
     r"dks_(?:serve|fanin|sched|phase|slo|alerts|wire|staging|treeshap|"
     r"tensor_shap|autoscale|registry|result_cache)_[a-z0-9_]+")

@@ -1,0 +1,564 @@
+"""Cross-tenant continuous batching (ISSUE 11): tenant-aware EDF packing
+(bucket-boundary fill, deficit-round-robin fairness, quota-aware yield),
+shared padded-program coalescing with the bit-identity gate, staged
+multi-group dispatch (no lost requests, mid-cycle hot swap), and the
+``DKS_SHARED_BATCH=0`` escape hatch."""
+
+import http.client
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu.scheduling.scheduler import SLOScheduler
+
+D = 6
+
+
+# --------------------------------------------------------------------- #
+# scheduler units: grouped batch formation (no jax, fabricated items)
+# --------------------------------------------------------------------- #
+
+
+class _Item:
+    def __init__(self, tenant, rows=1, klass="interactive", deadline=None,
+                 t=None):
+        self.tenant = tenant
+        self.rows = rows
+        self.klass = klass
+        self.deadline = deadline
+        self.t_enqueued = 0.0 if t is None else t
+        self.done = False
+
+    def __repr__(self):
+        return f"<{self.tenant}:{self.rows}>"
+
+
+class _Grouping:
+    """Test grouping policy: key by ``item.tenant``, power-of-two compile
+    buckets, optional per-tenant item caps."""
+
+    def __init__(self, limits=None):
+        self.limits = limits or {}
+
+    def key(self, item):
+        return item.tenant
+
+    def bucket(self, key, rows):
+        b = 1
+        while b < rows:
+            b *= 2
+        return b
+
+    def limit(self, key):
+        return self.limits.get(key)
+
+
+def _sched(now=None):
+    clock = {"t": 100.0}
+    s = SLOScheduler(now=lambda: clock["t"])
+    return s, clock
+
+
+def test_grouped_packs_tenants_contiguously_to_bucket_boundary():
+    s, _ = _sched()
+    # interleaved arrival: a, b, a, b, a — tenant-blind EDF would pop it
+    # interleaved (2 fragmented groups of 3 + 2 padding to 4 + 2)
+    for t in ("a", "b", "a", "b", "a"):
+        s.put(_Item(t))
+    batch, expired = s.next_batch(4, grouping=_Grouping())
+    assert expired == []
+    assert [i.tenant for i in batch] == ["a", "a", "b", "b"]
+    # the 3rd 'a' was trimmed at a's bucket boundary (2) so b's real rows
+    # fill the cycle instead of a's padding; it stays queued, not lost
+    assert s.qsize() == 1
+
+
+def test_grouped_takes_everything_when_one_tenant():
+    s, _ = _sched()
+    for _ in range(3):
+        s.put(_Item("a"))
+    batch, _ = s.next_batch(4, grouping=_Grouping())
+    # last group standing is never boundary-trimmed: padding is
+    # unavoidable and capacity must not idle
+    assert len(batch) == 3
+
+
+def test_grouped_plain_equivalence_when_grouping_none():
+    s, _ = _sched()
+    for t in ("a", "b", "a"):
+        s.put(_Item(t))
+    batch, _ = s.next_batch(4)
+    assert [i.tenant for i in batch] == ["a", "b", "a"]  # arrival order
+
+
+def test_deficit_round_robin_rotates_leadership():
+    s, _ = _sched()
+    g = _Grouping()
+    for _ in range(8):
+        s.put(_Item("a"))
+    for _ in range(2):
+        s.put(_Item("b"))
+    first, _ = s.next_batch(4, grouping=g)
+    # cycle 1: a leads (EDF tie-break) and fills the batch to its bucket
+    assert [i.tenant for i in first] == ["a"] * 4
+    second, _ = s.next_batch(4, grouping=g)
+    # cycle 2: b's accumulated deficit outranks the flooding tenant —
+    # b is served FIRST, then a back-fills
+    assert [i.tenant for i in second] == ["b", "b", "a", "a"]
+
+
+def test_quota_limit_caps_group_and_yields_slots():
+    s, _ = _sched()
+    g = _Grouping(limits={"a": 1})
+    for t in ("a", "a", "a", "b", "b", "b"):
+        s.put(_Item(t))
+    batch, _ = s.next_batch(4, grouping=g)
+    tenants = [i.tenant for i in batch]
+    # a is capped at 1 per cycle (its in-flight quota bound): it yields
+    # its slots to b instead of fragmenting the cycle
+    assert tenants.count("a") == 1
+    assert tenants.count("b") >= 2
+
+
+def test_progress_guarantee_when_every_group_is_capped():
+    s, _ = _sched()
+    g = _Grouping(limits={"a": 0, "b": 0})
+    s.put(_Item("a"))
+    s.put(_Item("b"))
+    batch, _ = s.next_batch(4, grouping=g)
+    assert len(batch) == 1  # never an empty-batch spin
+
+
+def test_grouped_expires_deadlined_items():
+    s, clock = _sched()
+    s.put(_Item("a", deadline=50.0))  # already past at t=100
+    s.put(_Item("b"))
+    batch, expired = s.next_batch(4, grouping=_Grouping())
+    assert [i.tenant for i in expired] == ["a"]
+    assert [i.tenant for i in batch] == ["b"]
+
+
+def test_grouped_respects_row_budget():
+    s, _ = _sched()
+    for t in ("a", "a", "b"):
+        s.put(_Item(t, rows=3))
+    batch, _ = s.next_batch(8, max_rows=6, grouping=_Grouping())
+    assert sum(i.rows for i in batch) <= 6
+    assert s.qsize() == 1
+
+
+def test_grouped_multirow_oversized_first_item_dispatches_alone():
+    s, _ = _sched()
+    s.put(_Item("a", rows=10))
+    batch, _ = s.next_batch(4, max_rows=6, grouping=_Grouping())
+    assert len(batch) == 1 and batch[0].rows == 10
+
+
+# --------------------------------------------------------------------- #
+# server integration: shared programs, staging, escape hatch
+# --------------------------------------------------------------------- #
+
+
+#: fitted serving models reused across tests: registering one model
+#: object in several (sequential) registries/servers is supported — the
+#: bench does the same — and reuse keeps each engine's jit cache warm,
+#: saving ~1s of compile per avoided rebuild in the tier-1 budget.
+#: (seed, copy) so content-identical DISTINCT objects are still possible.
+_MODEL_CACHE = {}
+
+
+def _linear_model(seed, copy=0):
+    key = (seed, copy)
+    if key in _MODEL_CACHE:
+        return _MODEL_CACHE[key]
+    from distributedkernelshap_tpu.models import LinearPredictor
+    from distributedkernelshap_tpu.serving.wrappers import (
+        BatchKernelShapModel,
+    )
+
+    rng = np.random.default_rng(seed)
+    W = rng.normal(size=(D, 2)).astype(np.float32)
+    b = rng.normal(size=(2,)).astype(np.float32)
+    bg = np.random.default_rng(99).normal(size=(10, D)).astype(np.float32)
+    model = BatchKernelShapModel(
+        LinearPredictor(W, b, activation="softmax"),
+        bg, {"link": "logit", "seed": 0}, {})
+    _MODEL_CACHE[key] = model
+    return model
+
+
+def _post(server, body, model, headers=None):
+    conn = http.client.HTTPConnection(server.host, server.port, timeout=60)
+    try:
+        conn.request("POST", "/explain", body=body,
+                     headers={"Content-Type": "application/json",
+                              "X-DKS-Model": model, **(headers or {})})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _scrape(server, name):
+    conn = http.client.HTTPConnection(server.host, server.port, timeout=30)
+    try:
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+    finally:
+        conn.close()
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.rsplit(" ", 1)[-1])
+    return 0.0
+
+
+def _body(rows):
+    return json.dumps({"array": np.asarray(rows).tolist()}).encode()
+
+
+def _phi(payload):
+    return json.loads(payload)["data"]["shap_values"]
+
+
+def _fire_pair(server, specs):
+    """POST ``[(body, model), ...]`` concurrently; returns results in
+    spec order."""
+
+    out = [None] * len(specs)
+
+    def fire(i, body, model):
+        out[i] = _post(server, body, model)
+
+    threads = [threading.Thread(target=fire, args=(i, *s), daemon=True)
+               for i, s in enumerate(specs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    return out
+
+
+def test_share_keys_match_only_for_identical_content():
+    from distributedkernelshap_tpu.registry import ModelRegistry
+
+    reg = ModelRegistry()
+    r1 = reg.register("t1", _linear_model(1))
+    r2 = reg.register("t2", _linear_model(1, copy=1))  # distinct object, same content
+    r3 = reg.register("t3", _linear_model(2))  # different weights
+    assert r1.share_key and r1.share_key == r2.share_key
+    assert r3.share_key != r1.share_key
+    assert reg.resolve("t1").describe()["share_key"] is not None
+    # peer accounting: only keys carried by >1 ACTIVE tenant coalesce (a
+    # lone eligible tenant keeps its per-model group + quota cap)
+    assert reg.share_peers(r1.share_key) == 2
+    assert reg.share_peers(r3.share_key) == 1
+    assert reg.share_peers(None) == 0
+
+
+def test_generic_predictors_never_get_share_keys():
+    """Predictors whose content cannot be hashed (host callbacks) must
+    never share — a type-only fingerprint would coalesce two DIFFERENT
+    models and serve one tenant with the other's engine."""
+
+    from distributedkernelshap_tpu.registry import ModelRegistry
+    from distributedkernelshap_tpu.serving.wrappers import (
+        BatchKernelShapModel,
+    )
+
+    bg = np.random.default_rng(99).normal(size=(10, D)).astype(np.float32)
+
+    def opaque(x):
+        return np.asarray(x, dtype=np.float32)[:, :1] * 2.0
+
+    model = BatchKernelShapModel(opaque, bg, {"seed": 0}, {})
+    reg = ModelRegistry()
+    rm = reg.register("cb", model)
+    assert rm.share_key is None
+
+
+def test_shared_program_coalesces_bit_identically():
+    """Two content-identical tenants' concurrent requests land in ONE
+    device call, and each slot's phi is bit-identical to a dedicated
+    single-model deployment dispatched at the same padded shape — the
+    bit-identity gate the sharing eligibility rule guarantees."""
+
+    from distributedkernelshap_tpu.registry import ModelRegistry
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    reg = ModelRegistry()
+    reg.register("t1", _linear_model(1))
+    reg.register("t2", _linear_model(1, copy=1))
+    dedicated = _linear_model(1, copy=2)
+    server = ExplainerServer(registry=reg, host="127.0.0.1", port=0,
+                             max_batch_size=2, batch_timeout_s=0.5,
+                             pipeline_depth=1).start()
+    try:
+        rng = np.random.default_rng(5)
+        # warm the compiled program so the coalesce window isn't
+        # compile-bound on the first attempt
+        _post(server, _body(rng.normal(size=(1, D)).astype(np.float32)),
+              "t1")
+        coalesced = False
+        for _ in range(5):
+            r_a = rng.normal(size=(1, D)).astype(np.float32)
+            r_b = rng.normal(size=(1, D)).astype(np.float32)
+            b0 = _scrape(server, "dks_serve_batches_total")
+            res = _fire_pair(server, [(_body(r_a), "t1"),
+                                      (_body(r_b), "t2")])
+            assert all(s == 200 for s, _ in res)
+            if _scrape(server, "dks_serve_batches_total") - b0 != 1:
+                continue  # the two arrivals missed the coalesce window
+            coalesced = True
+            ded = dedicated.explain_batch(
+                np.concatenate([r_a, r_b], axis=0), split_sizes=[1, 1])
+            assert _phi(res[0][1]) == _phi(ded[0])
+            assert _phi(res[1][1]) == _phi(ded[1])
+            break
+        assert coalesced, "no attempt coalesced the two tenants"
+        # the density histogram observed the cycles
+        assert _scrape(server, "dks_serve_batch_groups_count") >= 1
+    finally:
+        server.stop()
+
+
+def test_distinct_content_tenants_never_share_a_device_call():
+    from distributedkernelshap_tpu.registry import ModelRegistry
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    reg = ModelRegistry()
+    reg.register("t1", _linear_model(1))
+    reg.register("t2", _linear_model(2))
+    server = ExplainerServer(registry=reg, host="127.0.0.1", port=0,
+                             max_batch_size=2, batch_timeout_s=0.3,
+                             pipeline_depth=1).start()
+    try:
+        rng = np.random.default_rng(6)
+        row = rng.normal(size=(1, D)).astype(np.float32)
+        _post(server, _body(row), "t1")
+        _post(server, _body(row), "t2")  # warm both programs
+        b0 = _scrape(server, "dks_serve_batches_total")
+        res = _fire_pair(server, [(_body(row), "t1"), (_body(row), "t2")])
+        assert all(s == 200 for s, _ in res)
+        assert _scrape(server, "dks_serve_batches_total") - b0 == 2
+        # padding attributed per tenant (B=1 buckets pad nothing, but the
+        # series must exist for both)
+        for tenant in ("t1", "t2"):
+            _scrape(server,
+                    f'dks_serve_padded_rows_total{{model="{tenant}"}}')
+    finally:
+        server.stop()
+
+
+def test_shared_batch_escape_hatch_restores_serialized_dispatch():
+    from distributedkernelshap_tpu.registry import ModelRegistry
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    reg = ModelRegistry()
+    reg.register("t1", _linear_model(1))
+    reg.register("t2", _linear_model(1, copy=1))  # shareable content...
+    server = ExplainerServer(registry=reg, host="127.0.0.1", port=0,
+                             max_batch_size=2, batch_timeout_s=0.3,
+                             pipeline_depth=1,
+                             shared_batching=False).start()  # ...but off
+    try:
+        rng = np.random.default_rng(7)
+        row = rng.normal(size=(1, D)).astype(np.float32)
+        _post(server, _body(row), "t1")
+        b0 = _scrape(server, "dks_serve_batches_total")
+        res = _fire_pair(server, [(_body(row), "t1"), (_body(row), "t2")])
+        assert all(s == 200 for s, _ in res)
+        # PR-10 behaviour: one device group per (model, version)
+        assert _scrape(server, "dks_serve_batches_total") - b0 == 2
+    finally:
+        server.stop()
+
+
+def test_device_explain_span_carries_shared_attr(monkeypatch):
+    import distributedkernelshap_tpu.observability.tracing as tracing
+    from distributedkernelshap_tpu.registry import ModelRegistry
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    tr = tracing.tracer()
+    monkeypatch.setattr(tr, "enabled", True)
+    tr.clear()
+    reg = ModelRegistry()
+    reg.register("t1", _linear_model(1))
+    server = ExplainerServer(registry=reg, host="127.0.0.1", port=0,
+                             max_batch_size=2, pipeline_depth=1).start()
+    try:
+        row = np.zeros((1, D), np.float32)
+        assert _post(server, _body(row), "t1")[0] == 200
+        spans = [s for s in tr.spans() if s.name == "server.device_explain"]
+        assert spans and spans[-1].attrs.get("shared") is False
+    finally:
+        server.stop()
+        tr.clear()
+
+
+# --------------------------------------------------------------------- #
+# staged multi-group dispatch (registry × staging intersection)
+# --------------------------------------------------------------------- #
+
+
+def test_staged_multigroup_dispatch_bit_identical_no_lost():
+    """Multiple registered tenants in one staged cycle: every request is
+    answered and each B=1 group's phi is bit-identical to a dedicated
+    deployment at the same shape."""
+
+    from distributedkernelshap_tpu.registry import ModelRegistry
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    reg = ModelRegistry()
+    reg.register("t1", _linear_model(1))
+    reg.register("t2", _linear_model(2))
+    dedicated = {"t1": _linear_model(1, copy=2), "t2": _linear_model(2, copy=1)}
+    server = ExplainerServer(registry=reg, host="127.0.0.1", port=0,
+                             max_batch_size=1, batch_timeout_s=0.002,
+                             pipeline_depth=2, staging=True).start()
+    try:
+        assert server._staging_enabled
+        rng = np.random.default_rng(8)
+        rows = {t: rng.normal(size=(1, D)).astype(np.float32)
+                for t in ("t1", "t2")}
+        specs = [(_body(rows[t]), t) for t in ("t1", "t2")] * 3
+        res = _fire_pair(server, specs)
+        assert all(r is not None and r[0] == 200 for r in res)  # no lost
+        for (body, tenant), (status, payload) in zip(specs, res):
+            ded = dedicated[tenant].explain_batch(rows[tenant])[0]
+            assert _phi(payload) == _phi(ded)
+    finally:
+        server.stop()
+
+
+def test_form_batch_dispatch_rm_comes_from_a_live_leader():
+    """A shared group whose EDF-first member was answered out-of-band
+    (wedge claim / became-cached) must dispatch via a LIVE leader's
+    pinned version — the first member's pin may already be released, so
+    a hot-swap drain could retire its version mid-dispatch."""
+
+    from distributedkernelshap_tpu.registry import ModelRegistry
+    from distributedkernelshap_tpu.serving.server import (
+        ExplainerServer,
+        _Pending,
+    )
+
+    reg = ModelRegistry()
+    rm_a = reg.register("t1", _linear_model(1))
+    rm_b = reg.register("t2", _linear_model(1, copy=1))  # same share key
+    assert rm_a.share_key == rm_b.share_key
+    server = ExplainerServer(registry=reg, host="127.0.0.1", port=0,
+                             max_batch_size=2, batch_timeout_s=0.0,
+                             pipeline_depth=1)  # never started: no threads
+    row = np.zeros((1, D), np.float32)
+    p_a = _Pending(row, model=rm_a)
+    p_a.done = True  # answered out-of-band before formation
+    p_b = _Pending(row, model=rm_b)
+    server._sched.put(p_a)
+    server._sched.put(p_b)
+    formed = server._form_batch()
+    assert formed is not None and len(formed) == 1
+    live, leaders, index_map, _t, rm, shared = formed[0]
+    assert leaders == [p_b] and rm is rm_b  # the pinned, live version
+    assert shared is False  # one live tenant: nothing actually coalesced
+
+
+class _AsyncStub:
+    """Pipelined serving stub (stage_rows + explain_batch_async) whose
+    finalize optionally blocks — drives the staged batcher without jax."""
+
+    def __init__(self, tag, gate=None):
+        self.tag = tag
+        self.gate = gate
+
+    def stage_rows(self, rows):
+        return None  # decline staging per call; the batcher path still runs
+
+    def _payloads(self, instances, split_sizes):
+        sizes = split_sizes or [1] * instances.shape[0]
+        return [json.dumps({"tag": self.tag}) for _ in sizes]
+
+    def explain_batch(self, instances, split_sizes=None):
+        return self._payloads(instances, split_sizes)
+
+    def explain_batch_async(self, instances, split_sizes=None):
+        payloads = self._payloads(instances, split_sizes)
+
+        def finalize():
+            if self.gate is not None:
+                assert self.gate.wait(timeout=30)
+            return payloads
+
+        return finalize
+
+
+def test_staged_multigroup_hot_swap_mid_cycle_loses_nothing():
+    """A hot swap landing while staged multi-tenant groups are in flight:
+    in-flight requests answer on the version that admitted them, post-swap
+    requests answer the new version, the other tenant is untouched, and
+    nothing is lost."""
+
+    from distributedkernelshap_tpu.registry import ModelRegistry
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    gate = threading.Event()
+    reg = ModelRegistry(drain_timeout_s=30.0)
+    reg.register("m", _AsyncStub("v1", gate))
+    reg.register("other", _AsyncStub("other"))
+    # generous coalesce window + finalizer headroom: if the two gated v1
+    # posts land in SEPARATE batches on a loaded box, they must not pin
+    # every finalizer thread and starve the 'other' tenant's answer
+    server = ExplainerServer(registry=reg, host="127.0.0.1", port=0,
+                             max_batch_size=2, batch_timeout_s=0.25,
+                             pipeline_depth=4, staging=True).start()
+    try:
+        assert server._staging_enabled
+        row = _body(np.zeros((1, 3), np.float32))
+        pre = []
+        threads = [threading.Thread(
+            target=lambda: pre.append(_post(server, row, "m")), daemon=True)
+            for _ in range(2)]
+        for t in threads:
+            t.start()
+        # wait until both are pinned to v1 (admitted, staged/in flight)
+        v1 = reg._models["m"]["versions"][1]
+        for _ in range(300):
+            if v1.inflight >= 2:
+                break
+            threading.Event().wait(0.01)
+        assert v1.inflight >= 2
+        swapped = threading.Event()
+
+        def swap():
+            reg.register("m", _AsyncStub("v2"))  # drain blocks on v1 pins
+            swapped.set()
+
+        threading.Thread(target=swap, daemon=True).start()
+        for _ in range(300):
+            if reg.resolve("m").version == 2:
+                break
+            threading.Event().wait(0.01)
+        assert reg.resolve("m").version == 2  # flip is immediate
+        # the other tenant keeps serving through the blocked drain
+        s, p = _post(server, row, "other")
+        assert s == 200 and json.loads(p)["tag"] == "other"
+        # post-swap request answers v2 while v1's groups are still gated
+        post_res = []
+        t_post = threading.Thread(
+            target=lambda: post_res.append(_post(server, row, "m")),
+            daemon=True)
+        t_post.start()
+        gate.set()  # release v1's staged groups
+        for t in threads:
+            t.join(30)
+        t_post.join(30)
+        assert swapped.wait(30)
+        assert len(pre) == 2 and all(s == 200 for s, _ in pre)  # no lost
+        assert all(json.loads(p)["tag"] == "v1" for _, p in pre)
+        assert post_res and post_res[0][0] == 200
+        assert json.loads(post_res[0][1])["tag"] == "v2"
+        assert v1.state == "retired"
+    finally:
+        gate.set()
+        server.stop()
