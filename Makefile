@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test tier1 collect fuzz bench configs serve sweep-pool sweep-serve analysis multihost-ci sched-bench chaos-bench obs-check health-check perf-gate warmup-bench stream-bench exact-bench autoscale-bench accuracy-gate tenant-bench
+.PHONY: test tier1 collect fuzz bench configs serve sweep-pool sweep-serve analysis multihost-ci sched-bench chaos-bench obs-check health-check perf-gate warmup-bench stream-bench exact-bench autoscale-bench accuracy-gate tenant-bench deepshap-bench
 
 multihost-ci:    ## multi-host validation: 2-proc pool/phi/interactions, 4-proc 2x2 mesh, 2-proc serve (one JSON line, rc 0/1)
 	$(PY) benchmarks/multihost_ci.py
@@ -48,8 +48,11 @@ health-check:    ## alert-engine golden test: replay the committed SLO fixture, 
 perf-gate:       ## perf-regression gate: newest recorded benchmark runs vs their trailing same-config baselines (results/perf_history.jsonl)
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/regression_gate.py --check
 
-accuracy-gate:   ## estimator-accuracy gate: sampled estimator swept vs exact-TN/exact-tree ground truth across nsamples budgets; gates error regressions like perf-gate gates wall time (results/accuracy_history.jsonl)
+accuracy-gate:   ## estimator-accuracy gate: sampled estimator swept vs exact-TN/exact-tree/DeepSHAP ground truth across nsamples budgets; gates error regressions like perf-gate gates wall time (results/accuracy_history.jsonl)
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/estimator_accuracy.py --check
+
+deepshap-bench:  ## deep-model attribution: DeepSHAP vs brute-force exact Shapley on piecewise-linear nets, certified matched-error >=10x speedup vs the sampled estimator, CNN image tenant served end-to-end over the binary wire at interactive SLO; self-records for perf-gate
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/deepshap_bench.py --check
 
 fuzz:            ## 3x fresh-seed hypothesis property sweeps (new examples per run)
 	for i in 1 2 3; do \

@@ -1,0 +1,584 @@
+"""DeepSHAP attribution bench + acceptance gate (``make deepshap-bench``).
+
+Three phases over the deep-model attribution engine (ISSUE 12,
+``attribution/deepshap.py``), each riding the REAL engine/serving paths:
+
+1. **Exactness** — DeepSHAP phi through the fitted engine
+   (``nsamples='exact'``) vs brute-force ``2^M`` Shapley enumeration (an
+   independent numpy oracle) on piecewise-linear nets at small M: a
+   non-negative Conv/Relu/Dense CNN over superpixel groups
+   (coalition-stable ⇒ exact) and a feature-wise Relu MLP with
+   mixed-sign weights (additive ⇒ exact, Relus genuinely clip); plus
+   exact completeness on a general mixed-sign Conv+BN+MaxPool net where
+   DeepSHAP is the documented approximation.
+
+2. **Matched-error speedup** — on a coalition-stable 28×28 CNN of the
+   MNIST architecture (two conv layers, M=16 superpixels) the sampled
+   estimator is swept across budgets with ground truth from the
+   full-enumeration plan (``plan.exact``: WLS over all 2^M-2 coalitions
+   — exact Shapley, PR 9's parity regime).  DeepSHAP must sit at the
+   f32-rounding floor against that truth, and the ≥10× criterion
+   follows PR 9's matched-error convention: DeepSHAP's error is
+   deterministic, while a sampled estimate's error is a random variable
+   the estimator can only CERTIFY down to analytic level by enumerating
+   — so the certified matched-error arm is the enumeration plan, and
+   its per-instance wall is what matching DeepSHAP's certainty actually
+   costs.  Sub-enumeration budgets on this (secretly linear-in-mask)
+   game also floor out — a property of the degenerate game, not
+   something the estimator can know without the very enumeration it
+   skipped — and the bench reports that uncertified floor-match ratio
+   alongside (measured ≈11× at n=128), so nothing hides behind the
+   convention.
+
+3. **Serving** — the dormant vision scenario opened end to end: a
+   trained MNIST-scale CNN tenant (logits head) with superpixel
+   grouping, registered through ``ModelRegistry``, warmed through the
+   ladder (compile signatures ``model=mnist_cnn@v1,rows=<b>,
+   path=deepshap``), explained over the BINARY wire protocol at
+   interactive SLO; group phi sums to ``f(x) - E[f]`` on the wire,
+   repeats are bit-identical via the content-fingerprint result cache,
+   and ``dks_serve_explain_path_total{path="deepshap"}`` attributes the
+   traffic.
+
+``--check`` exits nonzero unless every criterion holds; every measured
+run self-records into ``results/perf_history.jsonl`` with ``checks_ok``
+so ``make perf-gate`` covers attribution-path regressions.
+
+    JAX_PLATFORMS=cpu python benchmarks/deepshap_bench.py --check
+"""
+
+import argparse
+import http.client
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO_ROOT = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO_ROOT)
+
+from benchmarks.regression_gate import (  # noqa: E402
+    DEFAULT_HISTORY,
+    record_run,
+)
+from benchmarks.scheduling_bench import (  # noqa: E402
+    percentile,
+    scrape_metrics,
+)
+
+#: interactive SLO bound on the serving phase's warm p95 (seconds) —
+#: matches the repo's interactive latency SLO threshold
+SERVING_P95_SLO_S = 0.5
+#: exactness tolerance, relative to the phi scale
+EXACT_RTOL = 1e-4
+#: required per-instance speedup of DeepSHAP over the matched-error
+#: sampled arm (the acceptance criterion's floor)
+MIN_SPEEDUP = 10.0
+
+
+# --------------------------------------------------------------------- #
+# model builders (deterministic; graphs via registry/onnx_lift so the
+# bench exercises the exact structures ONNX ingest produces)
+# --------------------------------------------------------------------- #
+
+
+def _superpixel_G(side, patch, channels=1):
+    from distributedkernelshap_tpu.ops.explain import groups_to_matrix
+    from distributedkernelshap_tpu.ops.image import superpixel_groups
+
+    groups, names = superpixel_groups(side, side, patch=patch,
+                                      channels=channels)
+    return groups, names, groups_to_matrix(groups,
+                                           side * side * channels)
+
+
+def build_stable_cnn_spec(side, seed=0, K=3, channels_out=(4,),
+                          nonneg=True, batchnorm=False, maxpool=False):
+    """Conv/Relu(+BN/MaxPool)/Dense graph over ``side×side`` pixels.
+    ``nonneg=True`` keeps conv weights/biases non-negative: over
+    non-negative pixels every pre-activation stays non-negative across
+    the WHOLE coalition cube, the Relus never switch, and DeepSHAP is
+    exactly Shapley (the coalition-stable regime)."""
+
+    from distributedkernelshap_tpu.registry.onnx_lift import (
+        GraphSpec,
+        NodeSpec,
+    )
+
+    rng = np.random.default_rng(seed)
+
+    def maybe(a):
+        return np.abs(a) if nonneg else a
+
+    D = side * side
+    inits = {"shape_img": np.asarray([0, side, side, 1], np.int64)}
+    nodes = [
+        NodeSpec("Reshape", ("x", "shape_img"), ("img",), {}),
+        NodeSpec("Transpose", ("img",), ("t0",), {"perm": [0, 3, 1, 2]}),
+    ]
+    tensor, c_in, feat = "t0", 1, side
+    for i, c_out in enumerate(channels_out):
+        inits[f"W{i}"] = maybe(rng.normal(
+            scale=0.4, size=(c_out, c_in, 3, 3))).astype(np.float32)
+        inits[f"b{i}"] = maybe(rng.normal(
+            scale=0.1, size=c_out)).astype(np.float32)
+        nodes.append(NodeSpec("Conv", (tensor, f"W{i}", f"b{i}"),
+                              (f"c{i}",),
+                              {"strides": [2, 2], "pads": [1, 1, 1, 1]},
+                              f"conv{i}"))
+        tensor, c_in, feat = f"c{i}", c_out, -(-feat // 2)
+        if batchnorm:
+            inits.update({
+                f"s{i}": rng.uniform(0.5, 1.5, c_out).astype(np.float32),
+                f"o{i}": rng.normal(scale=0.1,
+                                    size=c_out).astype(np.float32),
+                f"m{i}": rng.normal(scale=0.1,
+                                    size=c_out).astype(np.float32),
+                f"v{i}": rng.uniform(0.5, 1.5, c_out).astype(np.float32)})
+            nodes.append(NodeSpec(
+                "BatchNormalization",
+                (tensor, f"s{i}", f"o{i}", f"m{i}", f"v{i}"),
+                (f"n{i}",), {"epsilon": 1e-5}))
+            tensor = f"n{i}"
+        nodes.append(NodeSpec("Relu", (tensor,), (f"r{i}",), {}))
+        tensor = f"r{i}"
+    if maxpool:
+        nodes.append(NodeSpec("MaxPool", (tensor,), ("mp",),
+                              {"kernel_shape": [2, 2], "strides": [2, 2]}))
+        tensor, feat = "mp", feat // 2
+    nodes.append(NodeSpec("Flatten", (tensor,), ("fl",), {"axis": 1}))
+    inits["Wd"] = rng.normal(scale=0.3, size=(c_in * feat * feat,
+                                              K)).astype(np.float32)
+    inits["bd"] = rng.normal(scale=0.1, size=K).astype(np.float32)
+    nodes.append(NodeSpec("Gemm", ("fl", "Wd", "bd"), ("y",), {}))
+    return GraphSpec(nodes, inits, "x", "y", D)
+
+
+def build_additive_mlp_spec(seed=0, M=12, H=24, K=2):
+    """Feature-wise Relu MLP (each hidden unit reads ONE feature),
+    mixed-sign: additive across features, so DeepSHAP is exact while the
+    Relus genuinely clip (a nonlinearity the stable CNN never exercises)."""
+
+    from distributedkernelshap_tpu.registry.onnx_lift import (
+        GraphSpec,
+        NodeSpec,
+    )
+
+    rng = np.random.default_rng(seed)
+    W1 = np.zeros((M, H), np.float32)
+    for j in range(H):
+        W1[j % M, j] = rng.normal()
+    return GraphSpec(
+        [NodeSpec("Gemm", ("x", "W1", "b1"), ("h",), {}),
+         NodeSpec("Relu", ("h",), ("a",), {}),
+         NodeSpec("Gemm", ("a", "W2", "b2"), ("y",), {})],
+        {"W1": W1, "b1": rng.normal(size=H).astype(np.float32),
+         "W2": rng.normal(scale=0.5, size=(H, K)).astype(np.float32),
+         "b2": rng.normal(size=K).astype(np.float32)},
+        "x", "y", M)
+
+
+def _fit_engine(spec, bg, seed=0, groups=None, names=None):
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.registry.onnx_lift import lift_graph
+
+    ex = KernelShap(lift_graph(spec), seed=seed)
+    ex.fit(bg, groups=groups, group_names=names)
+    return ex
+
+
+def _phi_matrix(values):
+    vals = values if isinstance(values, list) else [values]
+    return np.stack([np.asarray(v) for v in vals], 1)  # (B, K, M)
+
+
+# --------------------------------------------------------------------- #
+# phase 1: exactness vs the independent brute-force oracle
+# --------------------------------------------------------------------- #
+
+
+def run_exactness_phase():
+    from distributedkernelshap_tpu.attribution.deepshap import (
+        brute_force_shapley,
+    )
+    from distributedkernelshap_tpu.registry.onnx_lift import (
+        run_graph_reference,
+    )
+
+    rng = np.random.default_rng(42)
+    out = {}
+
+    # (a) coalition-stable Conv/Relu/Dense CNN over superpixel groups
+    spec = build_stable_cnn_spec(side=6, seed=1, nonneg=True)
+    groups, names, G = _superpixel_G(6, patch=2)     # M = 9 -> 2^9 oracle
+    bg = rng.uniform(0, 1, size=(3, 36)).astype(np.float32)
+    X = rng.uniform(0, 1, size=(2, 36)).astype(np.float32)
+    ex = _fit_engine(spec, bg, groups=groups, names=names)
+    phi = _phi_matrix(ex.explain(X, nsamples="exact", silent=True)
+                      .shap_values)
+    errs = []
+    for i in range(X.shape[0]):
+        ref = brute_force_shapley(
+            lambda r: run_graph_reference(spec, r), X[i], bg, G=G)
+        errs.append(float(np.abs(phi[i] - ref).max()
+                          / max(np.abs(ref).max(), 1e-9)))
+    out["stable_cnn_rel_err"] = max(errs)
+    out["stable_cnn_path"] = ex.kernel_path.get("exact_phi")
+
+    # (b) additive mixed-sign Relu MLP (the Relus actively clip)
+    spec_mlp = build_additive_mlp_spec(seed=2)
+    bg2 = rng.normal(size=(4, 12)).astype(np.float32)
+    X2 = rng.normal(size=(2, 12)).astype(np.float32)
+    ex2 = _fit_engine(spec_mlp, bg2)
+    phi2 = _phi_matrix(ex2.explain(X2, nsamples="exact", silent=True)
+                       .shap_values)
+    errs2 = []
+    for i in range(X2.shape[0]):
+        ref = brute_force_shapley(
+            lambda r: run_graph_reference(spec_mlp, r), X2[i], bg2)
+        errs2.append(float(np.abs(phi2[i] - ref).max()
+                           / max(np.abs(ref).max(), 1e-9)))
+    out["additive_mlp_rel_err"] = max(errs2)
+
+    # (c) general mixed-sign net with BN + MaxPool: approximation regime,
+    # but completeness (sum phi = f(x) - E[f]) must hold exactly
+    spec_gen = build_stable_cnn_spec(side=6, seed=3, nonneg=False,
+                                     batchnorm=True, maxpool=False,
+                                     channels_out=(4,))
+    # maxpool via a second variant (stride==kernel, disjoint windows)
+    spec_mp = build_stable_cnn_spec(side=8, seed=4, nonneg=False,
+                                    maxpool=True, channels_out=(4,))
+    comp_errs = []
+    for s, d in ((spec_gen, 36), (spec_mp, 64)):
+        bgc = rng.uniform(0, 1, size=(3, d)).astype(np.float32)
+        Xc = rng.uniform(0, 1, size=(3, d)).astype(np.float32)
+        exc = _fit_engine(s, bgc)
+        phic = _phi_matrix(exc.explain(Xc, nsamples="exact", silent=True)
+                           .shap_values)
+        fx = run_graph_reference(s, Xc)
+        ef = run_graph_reference(s, bgc).mean(0)
+        comp_errs.append(float(np.abs(phic.sum(2) - (fx - ef)).max()
+                               / max(np.abs(fx).max(), 1e-9)))
+    out["completeness_rel_err"] = max(comp_errs)
+    return out
+
+
+# --------------------------------------------------------------------- #
+# phase 2: matched-error speedup vs the sampled estimator
+# --------------------------------------------------------------------- #
+
+
+def _timed(fn, reps):
+    walls = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        walls.append(time.perf_counter() - t0)
+    return float(np.median(walls))
+
+
+def _timed_interleaved(arms: dict, reps: int) -> dict:
+    """Min-of-``reps`` wall per arm, arms interleaved every pass so
+    box-load drift hits all of them symmetrically (the 1-core bench
+    host's jitter exceeds the small batches' walls; min is the
+    least-noise estimator and the same for every arm)."""
+
+    walls = {name: [] for name in arms}
+    for _ in range(reps):
+        for name, fn in arms.items():
+            t0 = time.perf_counter()
+            fn()
+            walls[name].append(time.perf_counter() - t0)
+    return {name: float(min(w)) for name, w in walls.items()}
+
+
+def run_speedup_phase(budgets=(128, 512), n_instances=8, reps=3,
+                      seed=0):
+    """MNIST-architecture CNN (two conv layers, 16+32 channels) in the
+    coalition-stable regime over M=16 superpixels.  The certified
+    matched-error arm is the enumeration plan (see module docstring);
+    the swept budgets' uncertified (error, wall) pairs are reported
+    alongside, including their own floor-match ratio."""
+
+    side = 28
+    spec = build_stable_cnn_spec(side=side, seed=seed, nonneg=True,
+                                 channels_out=(16, 32), K=3)
+    groups, names, _ = _superpixel_G(side, patch=7)  # M = 16
+    M = len(groups)
+    rng = np.random.default_rng(seed + 5)
+    bg = rng.uniform(0, 1, size=(1, side * side)).astype(np.float32)
+    X = rng.uniform(0, 1, size=(n_instances,
+                                side * side)).astype(np.float32)
+
+    ex = _fit_engine(spec, bg, groups=groups, names=names)
+    ex.explain(X, nsamples="exact", silent=True)      # compile
+    for b in budgets:                                 # compile
+        ex.explain(X, nsamples=b, l1_reg=False, silent=True)
+    arms = {"deepshap": lambda: ex.explain(X, nsamples="exact",
+                                           silent=True)}
+    for b in budgets:
+        arms[str(b)] = (lambda n: lambda: ex.explain(
+            X, nsamples=n, l1_reg=False, silent=True))(b)
+    timed = _timed_interleaved(arms, max(reps, 3))
+    ds_wall = timed["deepshap"]
+    phi_ds = _phi_matrix(ex.explain(X, nsamples="exact",
+                                    silent=True).shap_values)
+
+    # ground truth AND the certified matched-error arm: the
+    # full-enumeration plan (nsamples >= 2^M-2 -> plan.exact; WLS over
+    # every coalition IS exact Shapley — PR 9's pinned parity regime).
+    # 2^16 composites through the real CNN is expensive, so truth (and
+    # the enumeration wall) is established on a 2-instance slice.
+    n_truth = 2
+    n_enum = (1 << M)
+    ex.explain(X[:n_truth], nsamples=n_enum, l1_reg=False,
+               silent=True)  # compile
+    t0 = time.perf_counter()
+    truth = ex.explain(X[:n_truth], nsamples=n_enum, l1_reg=False,
+                       silent=True)
+    enum_wall_per_inst = (time.perf_counter() - t0) / n_truth
+    phi_exact = _phi_matrix(truth.shap_values)
+    scale = float(np.abs(phi_exact).max())
+    ds_err = float(np.abs(phi_ds[:n_truth] - phi_exact).max())
+
+    errors, walls = {}, {}
+    for b in budgets:
+        walls[b] = timed[str(b)]
+        phi_b = _phi_matrix(ex.explain(X, nsamples=b, l1_reg=False,
+                                       silent=True).shap_values)
+        errors[b] = float(np.abs(phi_b[:n_truth] - phi_exact).max())
+
+    # uncertified floor match: the cheapest swept budget whose realised
+    # error reached DeepSHAP's floor on this degenerate game — reported
+    # for transparency, never the gated arm (see module docstring)
+    floor = [b for b in sorted(budgets)
+             if errors[b] <= max(ds_err, EXACT_RTOL * scale)]
+    B = n_instances
+    ds_per_inst = ds_wall / B
+    return {
+        "M": M,
+        "deepshap_per_instance_s": ds_per_inst,
+        "deepshap_err_vs_exact": ds_err,
+        "phi_scale": scale,
+        "sampled_errors": {str(b): errors[b] for b in budgets},
+        "sampled_per_instance_s": {str(b): walls[b] / B for b in budgets},
+        "matched_arm": f"enumeration(n={n_enum})",
+        "matched_per_instance_s": enum_wall_per_inst,
+        "speedup_x": enum_wall_per_inst / ds_per_inst,
+        "uncertified_floor_match": {
+            "arm": str(floor[0]) if floor else None,
+            "speedup_x": ((walls[floor[0]] / B) / ds_per_inst
+                          if floor else None)},
+        "kernel_path": ex.kernel_path,
+    }
+
+
+# --------------------------------------------------------------------- #
+# phase 3: the CNN image tenant, served over the binary wire protocol
+# --------------------------------------------------------------------- #
+
+
+def _post_binary(host, port, body, headers, timeout=60.0):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("POST", "/explain", body=body, headers=headers)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def run_serving_phase(n_requests=24, rate_rps=20.0, seed=0):
+    from distributedkernelshap_tpu.models.cnn import train_mnist_cnn
+    from distributedkernelshap_tpu.registry import ModelRegistry
+    from distributedkernelshap_tpu.serving import wire
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+    from distributedkernelshap_tpu.serving.wrappers import (
+        BatchKernelShapModel,
+    )
+    from distributedkernelshap_tpu.ops.image import image_background
+    from scripts.process_mnist_data import (
+        _class_templates,
+        _synthetic_digits,
+    )
+
+    rng = np.random.default_rng(seed)
+    templates = _class_templates(rng)
+    images, labels = _synthetic_digits(800, rng, templates)
+    pred = train_mnist_cnn(images, labels, epochs=1, batch_size=128,
+                           output="logits")
+    groups, names, _ = _superpixel_G(28, patch=7)
+    bg = image_background(images, mode="mean")
+    model = BatchKernelShapModel(
+        pred, bg, {"seed": 0},
+        {"groups": groups, "group_names": names})
+    registry = ModelRegistry()
+    rm = registry.register("mnist_cnn", model)
+    server = ExplainerServer(registry=registry, host="127.0.0.1", port=0,
+                             max_batch_size=4, batch_timeout_s=0.004,
+                             warmup=True, cache_bytes=1 << 22).start()
+    try:
+        deadline = time.monotonic() + 180
+        while server.warmup_status()["state"] in ("pending", "running") \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        warm_state = server.warmup_status()["state"]
+
+        test = _synthetic_digits(n_requests, rng, templates)[0]
+        rows = test.reshape(n_requests, -1).astype(np.float32)
+        headers = {"Content-Type": wire.CONTENT_TYPE,
+                   "Accept": wire.CONTENT_TYPE,
+                   "X-DKS-Priority": "interactive"}
+        results = [None] * n_requests
+        t0 = time.monotonic()
+
+        def fire(i):
+            delay = t0 + i / rate_rps - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            body = wire.encode_request(rows[i:i + 1],
+                                       model_id="mnist_cnn")
+            sent = time.monotonic()
+            status, payload = _post_binary(server.host, server.port,
+                                           body, headers)
+            results[i] = (status, time.monotonic() - sent, payload)
+
+        threads = [threading.Thread(target=fire, args=(i,), daemon=True)
+                   for i in range(n_requests)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+
+        # duplicate of request 0: content-fingerprint result cache must
+        # answer bit-identically
+        body0 = wire.encode_request(rows[:1], model_id="mnist_cnn")
+        s_a, p_a = _post_binary(server.host, server.port, body0, headers)
+        s_b, p_b = _post_binary(server.host, server.port, body0, headers)
+
+        metrics = scrape_metrics(server)
+        ds_requests = sum(
+            v for k, v in metrics.items()
+            if k.startswith("dks_serve_explain_path_total")
+            and 'path="deepshap"' in k)
+        signed = [k for k in metrics
+                  if k.startswith("dks_compile_total")
+                  and "model=mnist_cnn@v1" in k and "path=deepshap" in k]
+        cache_hits = metrics.get("dks_serve_cache_hits_total", 0)
+    finally:
+        server.stop()
+
+    done = [r for r in results if r is not None]
+    statuses = [s for s, _, _ in done]
+    lat = [w for s, w, _ in done if s == 200]
+    # completeness over the wire, on the decoded binary payload
+    additive = False
+    ok_payloads = [p for s, _, p in done if s == 200]
+    if ok_payloads:
+        doc = wire.decode_explanation(ok_payloads[0])
+        total = (np.stack(doc["shap_values"], 1).sum(-1)
+                 + doc["expected_value"][None, :])
+        additive = bool(np.allclose(total, doc["raw_prediction"],
+                                    atol=1e-3))
+    return {
+        "classified_path": rm.path,
+        "warmup_state": warm_state,
+        "answered": sum(1 for s in statuses if s == 200),
+        "n_requests": n_requests,
+        "p50_s": percentile(lat, 50),
+        "p95_s": percentile(lat, 95),
+        "deepshap_request_slots": ds_requests,
+        "ladder_signed_compiles": signed[:3],
+        "cache_hits_after_dup": int(cache_hits),
+        "dup_bit_identical": (s_a == s_b == 200 and p_a == p_b),
+        "wire_additivity_ok": additive,
+        "fingerprint": rm.fingerprint,
+    }
+
+
+# --------------------------------------------------------------------- #
+
+
+def run_checks(exact, speed, serving) -> dict:
+    return {
+        "stable_cnn_matches_brute_force":
+            exact["stable_cnn_rel_err"] <= EXACT_RTOL,
+        "additive_mlp_matches_brute_force":
+            exact["additive_mlp_rel_err"] <= EXACT_RTOL,
+        "completeness_exact":
+            exact["completeness_rel_err"] <= EXACT_RTOL,
+        "deepshap_path_engaged":
+            exact["stable_cnn_path"] == "deepshap"
+            and speed["kernel_path"].get("exact_phi") == "deepshap",
+        "deepshap_matches_enumerated_exact":
+            speed["deepshap_err_vs_exact"]
+            <= EXACT_RTOL * speed["phi_scale"],
+        "certified_matched_error_speedup_10x":
+            speed["speedup_x"] >= MIN_SPEEDUP,
+        "tenant_classified_deepshap":
+            serving["classified_path"] == "deepshap",
+        "tenant_warmed": serving["warmup_state"] == "done",
+        "ladder_rungs_signed_deepshap":
+            len(serving["ladder_signed_compiles"]) > 0,
+        "all_answered":
+            serving["answered"] == serving["n_requests"],
+        "interactive_p95_slo":
+            serving["p95_s"] is not None
+            and serving["p95_s"] <= SERVING_P95_SLO_S,
+        "path_metric_attributes_traffic":
+            serving["deepshap_request_slots"] >= serving["n_requests"],
+        "dup_bit_identical_via_cache":
+            serving["dup_bit_identical"]
+            and serving["cache_hits_after_dup"] >= 1,
+        "wire_additivity": serving["wire_additivity_ok"],
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 unless every criterion holds")
+    parser.add_argument("--reps", type=int, default=3)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--requests", type=int, default=24)
+    parser.add_argument("--no-record", action="store_true",
+                        help="measure without appending perf history")
+    args = parser.parse_args(argv)
+
+    exact = run_exactness_phase()
+    speed = run_speedup_phase(reps=args.reps, seed=args.seed)
+    serving = run_serving_phase(n_requests=args.requests, seed=args.seed)
+    checks = run_checks(exact, speed, serving)
+    checks_ok = all(checks.values())
+
+    if not args.no_record:
+        record_run(
+            DEFAULT_HISTORY, "deepshap",
+            {"M": speed["M"], "side": 28, "seed": args.seed,
+             "requests": args.requests,
+             "slo_s": SERVING_P95_SLO_S},
+            {"wall_s": speed["deepshap_per_instance_s"],
+             "serving_p95_s": serving["p95_s"] or 0.0,
+             "speedup_x": speed["speedup_x"]},
+            extra={"checks_ok": checks_ok,
+                   "matched_arm": speed["matched_arm"],
+                   "deepshap_err_vs_exact":
+                       speed["deepshap_err_vs_exact"]})
+
+    result = {
+        "bench": "deepshap",
+        "exactness": exact,
+        "speedup": {k: v for k, v in speed.items()
+                    if k != "kernel_path"},
+        "serving": serving,
+        "checks": checks,
+        "checks_ok": checks_ok,
+    }
+    print(json.dumps(result))
+    return 0 if (checks_ok or not args.check) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,23 @@
+"""Deep-model attribution engine (DeepSHAP/DeepLIFT backprop).
+
+The sampled KernelSHAP estimator treats every predictor as a black box;
+for lifted neural graphs the graph ITSELF is the cheaper explainer:
+propagating DeepLIFT multipliers from output to input costs one
+forward+backward pair per (instance, background row) instead of
+``nsamples`` forward passes over synthetic coalitions (ONNXExplainer,
+arXiv 2309.16916).  ``attribution/deepshap.py`` implements the layer-rule
+engine over ``registry/onnx_lift.GraphSpec`` graphs; the serving stack
+promotes it to a first-class engine path (``path="deepshap"``) alongside
+linear / exact_tree / exact_tn.
+"""
+
+from distributedkernelshap_tpu.attribution.deepshap import (  # noqa: F401
+    attach_deepshap_metrics,
+    brute_force_shapley,
+    build_deepshap_fn,
+    deepshap_fallback_counts,
+    deepshap_ready,
+    record_deepshap_fallback,
+    supports_deepshap,
+    validate_deepshap,
+)

@@ -637,6 +637,9 @@ class KernelExplainerEngine:
 
         self._plan_cache: Dict[Any, Any] = {}
         self._fn_cache: Dict[Any, Any] = {}
+        # memoised analytic-path readiness verdicts ({interactions: bool}
+        # — fixed per fitted engine; the deepshap probe is host work)
+        self._ready_cache: Dict[bool, bool] = {}
         # device-resident per-plan constants, keyed by CONTENT fingerprint
         # (id(plan) keys could alias a recycled address after GC and serve
         # a different plan's constants); OrderedDict = LRU, entry-bounded
@@ -958,16 +961,19 @@ class KernelExplainerEngine:
             h = hashlib.sha256()
             linear = self.predictor.linear_decomposition
             fp_bytes = getattr(self.predictor, 'fingerprint_bytes', None)
+            # structured predictors (tensor-train lift, lifted neural
+            # graphs, param-carrying JaxPredictors) publish their content
+            # bytes: equal bytes ARE the same device-cached constants.
+            # None (a JaxPredictor without params) means "no content
+            # identity" — fall through to the type repr.
+            content = fp_bytes() if callable(fp_bytes) else None
             if linear is not None:
                 W, b, activation = linear
                 h.update(np.asarray(W).tobytes())
                 h.update(np.asarray(b).tobytes())
                 h.update(activation.encode())
-            elif callable(fp_bytes):
-                # structured predictors (e.g. the tensor-train lift)
-                # publish their content bytes: equal bytes ARE the same
-                # device-cached contraction constants
-                h.update(fp_bytes())
+            elif content is not None:
+                h.update(content)
             else:
                 h.update(repr(type(self.predictor)).encode())
             h.update(self.background.tobytes())
@@ -1188,12 +1194,19 @@ class KernelExplainerEngine:
         return finalize
 
     def _exact_flavor(self) -> Optional[str]:
-        """Which closed-form exact path this engine's predictor admits:
-        ``'tree'`` (lifted ensemble, ``ops/treeshap.py``), ``'tn'``
-        (tensor-train structure, ``ops/tensor_shap.py``) or ``None``.
-        Trees win when a predictor somehow qualifies for both — the
-        packed path is the measured production route."""
+        """Which analytic (sampling-free) path this engine's predictor
+        admits under ``nsamples='exact'``: ``'tree'`` (lifted ensemble,
+        ``ops/treeshap.py``), ``'tn'`` (tensor-train structure,
+        ``ops/tensor_shap.py``), ``'deepshap'`` (lifted neural graph,
+        ``attribution/deepshap.py`` — exact Shapley for coalition-stable
+        piecewise-linear nets, the DeepLIFT-multiplier approximation
+        with exact completeness otherwise) or ``None``.  Trees win when
+        a predictor somehow qualifies for several — the packed path is
+        the measured production route."""
 
+        from distributedkernelshap_tpu.attribution.deepshap import (
+            supports_deepshap,
+        )
         from distributedkernelshap_tpu.ops.tensor_shap import supports_exact_tn
         from distributedkernelshap_tpu.ops.treeshap import supports_exact
 
@@ -1201,16 +1214,30 @@ class KernelExplainerEngine:
             return 'tree'
         if supports_exact_tn(self.predictor):
             return 'tn'
+        if supports_deepshap(self.predictor):
+            return 'deepshap'
         return None
 
     def _exact_async_ready(self, interactions: bool = False) -> bool:
         """Whether ``nsamples='exact'`` can ride the pipelined hot path
-        (staging, donation, single packed D2H): a lifted tree ensemble or
-        TT-structured predictor with identity link, off host-eval,
-        phi-only.  Interactions stay on the sync path (their fn computes
-        phi + the pairwise matrices in one program with a different
-        output contract; the TN path computes phi only)."""
+        (staging, donation, single packed D2H): a lifted tree ensemble,
+        TT-structured or graph-bearing predictor with identity link, off
+        host-eval, phi-only.  Interactions stay on the sync path (their
+        fn computes phi + the pairwise matrices in one program with a
+        different output contract; the TN and deepshap paths compute phi
+        only).  Memoised: every input (predictor structure, link, G,
+        chunk budget) is fixed once the engine is fitted, and the
+        deepshap readiness probe runs a host-side reference forward that
+        must not recur per staged request."""
 
+        key = bool(interactions)
+        cached = self._ready_cache.get(key)
+        if cached is None:
+            cached = self._exact_async_ready_uncached(interactions)
+            self._ready_cache[key] = cached
+        return cached
+
+    def _exact_async_ready_uncached(self, interactions: bool) -> bool:
         if interactions or self.config.host_eval:
             return False
         flavor = self._exact_flavor()
@@ -1222,6 +1249,14 @@ class KernelExplainerEngine:
             )
 
             return tn_exact_ready(
+                self.predictor, self.config.link, self.G,
+                self.config.shap.target_chunk_elems) is None
+        if flavor == 'deepshap':
+            from distributedkernelshap_tpu.attribution.deepshap import (
+                deepshap_ready,
+            )
+
+            return deepshap_ready(
                 self.predictor, self.config.link, self.G,
                 self.config.shap.target_chunk_elems) is None
         return False
@@ -1462,12 +1497,17 @@ class KernelExplainerEngine:
             chunks = [X[i:i + c] for i in range(0, X.shape[0], c)]
 
         if nsamples == 'exact':
-            # sampling-free closed-form Shapley: interventional TreeSHAP
+            # sampling-free analytic Shapley: interventional TreeSHAP
             # for lifted ensembles (ops/treeshap.py), the size-indexed DP
-            # contraction for tensor-train predictors (ops/tensor_shap.py)
-            # — no coalition plan, no WLS either way
-            if self._exact_flavor() == 'tn':
+            # contraction for tensor-train predictors (ops/tensor_shap.py),
+            # DeepSHAP multiplier backprop for lifted neural graphs
+            # (attribution/deepshap.py) — no coalition plan, no WLS
+            flavor = self._exact_flavor()
+            if flavor == 'tn':
                 values = self._exact_tn_explanation(
+                    chunks, X, l1_reg, interactions=interactions)
+            elif flavor == 'deepshap':
+                values = self._deepshap_explanation(
                     chunks, X, l1_reg, interactions=interactions)
             else:
                 values = self._exact_tree_explanation(
@@ -1698,11 +1738,15 @@ class KernelExplainerEngine:
         ``X`` may be a :class:`StagedRows` (its pre-uploaded, donatable
         device buffer feeds the entry directly — the serving staging
         pipeline's zero-copy handoff, now covering exact requests too).
-        Tree and tensor-network flavors share this ONE dispatch contract
-        so the async serving path and the warmup ladder never branch."""
+        Tree, tensor-network and deepshap flavors share this ONE dispatch
+        contract so the async serving path and the warmup ladder never
+        branch."""
 
-        if self._exact_flavor() == 'tn':
+        flavor = self._exact_flavor()
+        if flavor == 'tn':
             return self._dispatch_exact_tn(X)
+        if flavor == 'deepshap':
+            return self._dispatch_deepshap(X)
         from distributedkernelshap_tpu.ops.explain import (
             capture_kernel_paths,
         )
@@ -1860,6 +1904,140 @@ class KernelExplainerEngine:
         with profiler().phase('device_explain'):
             results = run_pipeline(
                 chunks, self._dispatch_exact_tn, lambda fin: fin(),
+                window=resolve_window(self.config.dispatch_window,
+                                      n_items=len(chunks)))
+        phi = np.concatenate([r['shap_values'] for r in results], 0)
+        self.last_raw_prediction = np.concatenate(
+            [r['raw_prediction'] for r in results], 0)
+        self.last_X_fingerprint = _fingerprint(X)
+        return split_shap_values(phi, self.vector_out)
+
+    # ------------------------------------------------------------------ #
+    # DeepSHAP backprop path (attribution/deepshap.py)
+
+    def _deepshap_consts(self):
+        """X-independent DeepSHAP attribution constants — the lifted
+        graph's float initializers, the background rows and normalised
+        weights, and the group matrix — device-resident in the same
+        content-fingerprint-keyed LRU cache as the linear path's plan
+        constants and the tree/TN paths' tensors (identical invalidation
+        contract: a refit builds a new engine; in-place predictor
+        mutation is not detected, docs/PERFORMANCE.md)."""
+
+        reuse = self.config.plan_constant_cache is not False
+        key = ('deepshap_consts', self.content_fingerprint())
+        if reuse and key in self._plan_consts_cache:
+            self._plan_consts_cache.move_to_end(key)
+            return self._plan_consts_cache[key]
+        spec = self.predictor.graph_spec()
+        bgw = self.bg_weights.astype(np.float64)
+        params = {name: jnp.asarray(arr, jnp.float32)
+                  for name, arr in spec.initializers.items()
+                  if np.asarray(arr).dtype.kind == 'f'}
+        consts = {
+            'params': params,
+            'bg': jnp.asarray(self.background),
+            'bgw': jnp.asarray((bgw / bgw.sum()).astype(np.float32)),
+            'G': jnp.asarray(self.G),
+        }
+        if reuse:
+            self._plan_consts_cache[key] = consts
+            while len(self._plan_consts_cache) > self._DEV_CACHE_MAX_ENTRIES:
+                self._plan_consts_cache.popitem(last=False)
+        return consts
+
+    def _deepshap_fn(self):
+        """The jitted DeepSHAP batch entry ``(Xp, params, bg, bgw, G) ->
+        packed flat D2H vector`` — like :meth:`_exact_fn` /
+        :meth:`_exact_tn_fn` it is the ONE program behind the sync chunk
+        loop, the async serving path and the warmup ladder.  The
+        per-call batch upload (argnum 0) is donated; the consts
+        arguments are cached device buffers and never donated."""
+
+        td = self.config.shap.transfer_dtype
+        key = ('deepshap_entry', td)
+        if key in self._fn_cache:
+            return self._fn_cache[key]
+        from distributedkernelshap_tpu.attribution.deepshap import (
+            build_deepshap_fn,
+        )
+
+        pred = self.predictor
+        precision = self.config.shap.matmul_precision
+        phi_fn = build_deepshap_fn(pred.graph_spec(), pred.n_outputs)
+
+        def fn(Xp, params, bg, bgw, G):
+            with jax.default_matmul_precision(precision):
+                phi = phi_fn(Xp, params, bg, bgw, G)
+                return pack_transfer(phi, pred(Xp), td)
+
+        self._fn_cache[key] = jit_batch_entry(fn, donate_argnums=(0,))
+        return self._fn_cache[key]
+
+    def _dispatch_deepshap(self, X):
+        """DeepSHAP counterpart of the tree :meth:`_dispatch_exact` body:
+        same StagedRows handling, same donated entry, same single packed
+        D2H and ``finalize`` contract."""
+
+        from distributedkernelshap_tpu.ops.explain import (
+            capture_kernel_paths,
+        )
+
+        if isinstance(X, StagedRows):
+            Xp, B = X.device, X.B
+            Bp = X.device.shape[0]
+        else:
+            Xp, B = self._pad_to_bucket(X)
+            Bp = Xp.shape[0]
+            Xp = jnp.asarray(Xp, jnp.float32)
+        consts = self._deepshap_consts()
+        fn = self._deepshap_fn()
+        td = self.config.shap.transfer_dtype
+        with capture_kernel_paths() as kp:
+            packed_out = fn(Xp, consts['params'], consts['bg'],
+                            consts['bgw'], consts['G'])
+        self._kernel_paths.update(kp)
+
+        def finalize() -> Dict[str, np.ndarray]:
+            K, M = self.predictor.n_outputs, self.M
+            phi, fx = unpack_transfer(packed_out, Bp * K * M, td)
+            return {
+                'shap_values': phi.reshape(Bp, K, M)[:B],
+                'raw_prediction': fx.reshape(Bp, K)[:B],
+            }
+
+        return finalize
+
+    def _deepshap_explanation(self, chunks, X, l1_reg,
+                              interactions: bool = False):
+        """``nsamples='exact'`` for a lifted neural graph: DeepSHAP
+        multiplier backprop — no coalition plan, no WLS, no sampling.
+        Pipelined over instance chunks exactly like the tree and TN
+        paths."""
+
+        from distributedkernelshap_tpu.attribution.deepshap import (
+            validate_deepshap,
+        )
+
+        validate_deepshap(self.predictor, self.config.link, self.G)
+        if interactions:
+            raise ValueError(
+                "interactions=True requires a lifted tree ensemble "
+                "(closed-form interaction matrices); the DeepSHAP "
+                "backprop path computes phi only.")
+        if l1_reg not in (None, False, 0, 'auto'):
+            logger.warning(
+                "l1_reg=%r is ignored with nsamples='exact': there is no "
+                "sampling noise to regularise away.", l1_reg)
+
+        from distributedkernelshap_tpu.parallel.pipeline import (
+            resolve_window,
+            run_pipeline,
+        )
+
+        with profiler().phase('device_explain'):
+            results = run_pipeline(
+                chunks, self._dispatch_deepshap, lambda fin: fin(),
                 window=resolve_window(self.config.dispatch_window,
                                       n_items=len(chunks)))
         phi = np.concatenate([r['shap_values'] for r in results], 0)

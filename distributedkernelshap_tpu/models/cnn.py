@@ -36,14 +36,24 @@ class _CNN(nn.Module):
         return nn.Dense(self.n_classes)(x)
 
 
+def _same_pads(size: int, stride: int, kernel: int) -> Tuple[int, int]:
+    """Flax/XLA 'SAME' padding for one spatial dim: ``(low, high)``."""
+
+    out = -(-size // stride)
+    total = max((out - 1) * stride + kernel - size, 0)
+    return total // 2, total - total // 2
+
+
 class CNNPredictor(JaxPredictor):
-    """Image classifier predictor: flattened pixels in, class probs out."""
+    """Image classifier predictor: flattened pixels in, class probs out
+    (``output='logits'`` serves the raw margins — the form the DeepSHAP
+    attribution path explains at identity link)."""
 
     def __init__(self, params, image_shape: Tuple[int, int, int],
                  n_classes: int = 10, output: str = "probs"):
-        self.params = params
         self.image_shape = image_shape
         self.output = output
+        self.n_classes = n_classes
         module = _CNN(n_classes=n_classes)
 
         def fn(flat):
@@ -51,17 +61,87 @@ class CNNPredictor(JaxPredictor):
             logits = module.apply({"params": params}, imgs)
             return jax.nn.softmax(logits, -1) if output == "probs" else logits
 
-        super().__init__(fn, n_outputs=n_classes, vector_out=True)
+        # params joins the predictor protocol: fingerprint_bytes content-
+        # hashes the pytree, so CNN tenants get restart-stable cache keys
+        super().__init__(fn, n_outputs=n_classes, vector_out=True,
+                         params=params)
+        self._graph_spec = None
+
+    def graph_spec(self):
+        """Export the fitted CNN as a ``registry/onnx_lift.GraphSpec``
+        (ONNX conventions: NCHW data, OIHW conv weights, explicit SAME
+        pads) — the lifted-graph structure the DeepSHAP attribution
+        engine consumes.  Numerically equal to the flax evaluation to
+        f32 rounding (pinned by tests/test_deepshap.py); with
+        ``output='probs'`` the trailing Softmax keeps the graph off the
+        attribution path (serve logits to explain with DeepSHAP)."""
+
+        if self._graph_spec is not None:
+            return self._graph_spec
+        from distributedkernelshap_tpu.registry.onnx_lift import (
+            GraphSpec,
+            NodeSpec,
+        )
+
+        H, W, C = self.image_shape
+        D = H * W * C
+        inits = {"shape_img": np.asarray([0, H, W, C], np.int64)}
+        nodes = [
+            NodeSpec("Reshape", ("x", "shape_img"), ("img",), {}),
+            NodeSpec("Transpose", ("img",), ("nchw",), {"perm": [0, 3, 1, 2]}),
+        ]
+        tensor, size = "nchw", (H, W)
+        for i, layer in enumerate(("Conv_0", "Conv_1")):
+            kern = np.asarray(self.params[layer]["kernel"], np.float32)
+            kh, kw = int(kern.shape[0]), int(kern.shape[1])
+            stride = 2
+            ph = _same_pads(size[0], stride, kh)
+            pw = _same_pads(size[1], stride, kw)
+            inits[f"W{i}"] = kern.transpose(3, 2, 0, 1)  # HWIO -> OIHW
+            inits[f"b{i}"] = np.asarray(self.params[layer]["bias"],
+                                        np.float32)
+            nodes.append(NodeSpec(
+                "Conv", (tensor, f"W{i}", f"b{i}"), (f"c{i}",),
+                {"strides": [stride, stride],
+                 "pads": [ph[0], pw[0], ph[1], pw[1]]}, layer))
+            nodes.append(NodeSpec("Relu", (f"c{i}",), (f"r{i}",), {}))
+            tensor = f"r{i}"
+            size = (-(-size[0] // stride), -(-size[1] // stride))
+        # flax flattens NHWC: transpose back before Flatten so the dense
+        # weights see the training-time column order
+        nodes.append(NodeSpec("Transpose", (tensor,), ("nhwc",),
+                              {"perm": [0, 2, 3, 1]}))
+        nodes.append(NodeSpec("Flatten", ("nhwc",), ("flat",), {"axis": 1}))
+        tensor = "flat"
+        for i, layer in enumerate(("Dense_0", "Dense_1")):
+            inits[f"Wd{i}"] = np.asarray(self.params[layer]["kernel"],
+                                         np.float32)
+            inits[f"bd{i}"] = np.asarray(self.params[layer]["bias"],
+                                         np.float32)
+            nodes.append(NodeSpec("Gemm", (tensor, f"Wd{i}", f"bd{i}"),
+                                  (f"d{i}",), {}, layer))
+            tensor = f"d{i}"
+            if i == 0:
+                nodes.append(NodeSpec("Relu", (tensor,), ("rd0",), {}))
+                tensor = "rd0"
+        if self.output == "probs":
+            nodes.append(NodeSpec("Softmax", (tensor,), ("probs",),
+                                  {"axis": -1}))
+            tensor = "probs"
+        self._graph_spec = GraphSpec(nodes, inits, "x", tensor, D)
+        return self._graph_spec
 
 
 def train_mnist_cnn(images: np.ndarray, labels: np.ndarray,
                     image_shape: Tuple[int, int, int] = (28, 28, 1),
                     n_classes: int = 10, epochs: int = 2,
                     batch_size: int = 256, lr: float = 1e-3,
-                    seed: int = 0) -> CNNPredictor:
+                    seed: int = 0, output: str = "probs") -> CNNPredictor:
     """Train the small CNN and wrap it as a predictor.
 
     ``images``: ``(n, H*W)`` or ``(n, H, W[, C])`` float in [0, 1].
+    ``output='logits'`` serves raw margins — the DeepSHAP-attributable
+    form (a Softmax head keeps the graph off the attribution path).
     """
 
     rng = np.random.default_rng(seed)
@@ -90,4 +170,5 @@ def train_mnist_cnn(images: np.ndarray, labels: np.ndarray,
             params, opt_state, loss = step(params, opt_state,
                                            jnp.asarray(flat[idx]),
                                            jnp.asarray(labels[idx]))
-    return CNNPredictor(params, image_shape, n_classes=n_classes)
+    return CNNPredictor(params, image_shape, n_classes=n_classes,
+                        output=output)

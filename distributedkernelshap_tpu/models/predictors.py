@@ -193,18 +193,93 @@ class LinearPredictor(BasePredictor):
 
 
 class JaxPredictor(BasePredictor):
-    """Wraps a user-supplied jittable function ``(n, D) -> (n, K)``."""
+    """Wraps a user-supplied jittable function ``(n, D) -> (n, K)``.
 
-    def __init__(self, fn: Callable, n_outputs: int, vector_out: bool = True):
+    ``params`` (optional) is the function's parameter pytree (e.g. flax
+    ``params``): when provided, :meth:`fingerprint_bytes` content-hashes
+    its leaves, so the engine's device caches, the serving result cache
+    and the cross-tenant share key all get a restart-stable CONTENT key
+    for the deployment instead of the loud ``id()`` weak-fingerprint
+    fallback (two processes serving byte-equal weights share cache
+    entries; two differently-trained models never collide)."""
+
+    def __init__(self, fn: Callable, n_outputs: int, vector_out: bool = True,
+                 params=None):
         self.fn = fn
         self.n_outputs = int(n_outputs)
         self.vector_out = vector_out
+        self.params = params
 
     def __call__(self, X):
         out = self.fn(X)
         if out.ndim == 1:
             out = out[:, None]
         return out
+
+    @staticmethod
+    def _code_bytes(fn) -> Optional[bytes]:
+        """Restart-stable identity of a plain Python function: its
+        bytecode plus scalar constants (nested code objects recurse into
+        their bytecode — never their repr, which embeds an address).
+        ``None`` for exotic callables with no ``__code__``."""
+
+        code = getattr(fn, "__code__", None)
+        if code is None:
+            return None
+        parts = [getattr(fn, "__module__", "") or "",
+                 getattr(fn, "__qualname__", "") or ""]
+        stack = [code]
+        while stack:
+            c = stack.pop()
+            parts.append(c.co_code.hex())
+            for const in c.co_consts:
+                if hasattr(const, "co_code"):
+                    stack.append(const)
+                elif isinstance(const, (str, bytes, int, float, bool,
+                                        type(None))):
+                    parts.append(repr(const))
+        return "\x00".join(parts).encode()
+
+    def fingerprint_bytes(self) -> Optional[bytes]:
+        """Content bytes of the parameter pytree plus the predictor's
+        scalar configuration AND the wrapped function's code identity
+        (``None`` without params, or for an exotic callable whose code
+        cannot be hashed — consumers then fall back to their
+        weak-identity handling).
+
+        All three components MUST be part of the identity: two
+        predictors sharing one param pytree but differing in a plain
+        attribute (``CNNPredictor``'s ``output='logits'`` vs
+        ``'probs'``) or in the function itself (a relu net vs a tanh net
+        over the same weights) compute different models and must never
+        collide in the result cache or the cross-tenant share key."""
+
+        if self.params is None:
+            return None
+        code = self._code_bytes(self.fn)
+        if code is None:
+            # a callable object's behaviour is not captured by params +
+            # scalars; claiming content identity here could coalesce two
+            # different models — stay on the safe weak fallback
+            return None
+        config = []
+        for key in sorted(self.__dict__):
+            if key.startswith("_") or key in ("fn", "params"):
+                continue
+            value = self.__dict__[key]
+            if isinstance(value, (str, int, float, bool, type(None))):
+                config.append((key, value))
+            elif isinstance(value, tuple) and all(
+                    isinstance(e, (str, int, float, bool)) for e in value):
+                config.append((key, value))
+        parts = [b"jax-params", code, repr(config).encode(),
+                 repr(jax.tree_util.tree_structure(self.params)).encode()]
+        for leaf in jax.tree_util.tree_leaves(self.params):
+            arr = np.asarray(leaf)
+            parts.append(str(arr.shape).encode())
+            parts.append(str(arr.dtype).encode())
+            parts.append(arr.tobytes())
+        return b"".join(parts)
 
 
 _MLP_HIDDEN_ACTIVATIONS = {
@@ -284,6 +359,18 @@ class MLPPredictor(BasePredictor):
         # terms are N·M·H
         H = int(self.layers[0][0].shape[1])
         return N * M * H <= 4 * budget
+
+    def fingerprint_bytes(self) -> bytes:
+        """Content bytes for the engine's device-cache fingerprint (two
+        MLPs with equal layer bytes and activations ARE the same
+        deployment — mirrors the TT and graph predictors' keys)."""
+
+        parts = [b"mlp", self.hidden_activation.encode(),
+                 self.out_activation.encode()]
+        for W, b in self.layers:
+            parts.append(np.asarray(W).tobytes())
+            parts.append(np.asarray(b).tobytes())
+        return b"".join(parts)
 
     def masked_ey(self, X, bg, bgw_n, mask, G, target_chunk_elems=None,
                   coalition_chunk=None):

@@ -22,26 +22,33 @@ Paths (:data:`ENGINE_PATHS`):
 * ``exact_tn`` — tensor-train-structured predictor passing every
   readiness gate (``ops/tensor_shap.tn_exact_ready``): exact Shapley by
   DP contraction.
+* ``deepshap`` — predictor carrying a lifted neural graph whose every
+  node has an attribution rule (``attribution/deepshap.py``): DeepSHAP
+  multiplier backprop, sampling-free — exact Shapley for
+  coalition-stable piecewise-linear nets, exact-completeness DeepLIFT
+  attribution otherwise.
 * ``sampled`` — the generic masked-EY KernelSHAP estimator (everything
-  else, including TT predictors that fail a readiness gate — the reason
-  is carried so callers can count it).
+  else, including TT predictors and neural graphs that fail a readiness
+  gate — the reason is carried so callers can count it).
 """
 
 from typing import NamedTuple, Optional
 
-ENGINE_PATHS = ("linear", "exact_tree", "exact_tn", "sampled")
+ENGINE_PATHS = ("linear", "exact_tree", "exact_tn", "deepshap", "sampled")
 
 
 class PathDecision(NamedTuple):
     """``path`` is one of :data:`ENGINE_PATHS`; ``reason`` is a short
     human phrase for /statusz and logs; ``tn_fallback`` carries the
     ``tn_exact_ready`` reason when a TT-structured predictor stays
-    sampled (callers decide whether to count it — the serving wrapper
-    does, a pure classification probe does not)."""
+    sampled, ``deepshap_fallback`` the ``deepshap_ready`` reason when a
+    graph-bearing predictor does (callers decide whether to count them —
+    the serving wrapper does, a pure classification probe does not)."""
 
     path: str
     reason: str
     tn_fallback: Optional[str] = None
+    deepshap_fallback: Optional[str] = None
 
 
 def serving_engine(model):
@@ -153,6 +160,22 @@ def _classify(model, link, G, target_chunk_elems) -> PathDecision:
         return PathDecision(
             "sampled", f"TT structure present but not exact-ready "
                        f"({reason})", tn_fallback=reason)
+    from distributedkernelshap_tpu.attribution.deepshap import (
+        graph_spec_of,
+        deepshap_ready,
+    )
+
+    if graph_spec_of(pred) is not None:
+        reason = deepshap_ready(pred, link, G, target_chunk_elems)
+        if reason is None:
+            spec = pred.graph_spec()
+            return PathDecision(
+                "deepshap",
+                f"lifted neural graph ({len(spec.nodes)} nodes, "
+                f"D={spec.input_dim}): DeepSHAP backprop attribution")
+        return PathDecision(
+            "sampled", f"neural graph present but not DeepSHAP-ready "
+                       f"({reason})", deepshap_fallback=reason)
     if getattr(pred, "linear_decomposition", None) is not None:
         W, _, activation = pred.linear_decomposition
         return PathDecision(

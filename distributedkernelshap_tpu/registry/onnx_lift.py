@@ -23,9 +23,23 @@ Two layers, deliberately separated:
 
 Supported ops (:data:`SUPPORTED_ONNX_OPS`): ``Gemm``, ``MatMul``,
 ``Add``, ``Relu``, ``Sigmoid``, ``Tanh``, ``Softmax``, ``Identity``,
-``Reshape``, ``Flatten``.  Anything else raises a typed
+``Reshape``, ``Flatten``, ``Transpose``, and — since the deep-model
+attribution engine landed — the CNN block ops ``Conv``, ``MaxPool``,
+``AveragePool`` and ``BatchNormalization`` (inference mode, i.e. the
+folded affine transform).  Anything else raises a typed
 :class:`UnsupportedOpError` listing EVERY unsupported op in the graph
-(one round trip to learn the full gap, not one per op).
+with its node name and position (one round trip to learn the full gap,
+not one per op — and a multi-Conv graph's offending node is locatable
+from the message alone).
+
+Convolutional graphs follow ONNX layout conventions: ``NCHW`` data,
+``OIHW`` conv weights, with a leading ``Reshape``/``Transpose`` pair
+lifting the engine's flattened ``(batch, features)`` rows into image
+form.  These graphs are NOT lowered to the linear fast path (a Relu
+between affine ops breaks row-wise affinity); they lift to an
+:class:`ONNXPredictor`, which the registry classifier then promotes to
+the DeepSHAP backprop path (``attribution/deepshap.py``) when every
+node is rule-covered.
 
 Linear extraction: a graph whose compute is purely affine
 (Gemm/MatMul/Add/Identity) with at most one trailing ``Sigmoid`` /
@@ -38,14 +52,20 @@ path: plan-constant device cache, masked-EY einsums, ``classify_path ==
 """
 
 import logging
-from typing import Dict, List, NamedTuple, Optional, Sequence
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
+
+from distributedkernelshap_tpu.models.predictors import (
+    BasePredictor as _BasePredictor,
+)
 
 logger = logging.getLogger(__name__)
 
 SUPPORTED_ONNX_OPS = ("Gemm", "MatMul", "Add", "Relu", "Sigmoid", "Tanh",
-                      "Softmax", "Identity", "Reshape", "Flatten")
+                      "Softmax", "Identity", "Reshape", "Flatten",
+                      "Transpose", "Conv", "MaxPool", "AveragePool",
+                      "BatchNormalization")
 
 #: ops that keep a row-wise affine function affine (the linear-extraction
 #: closure); a trailing Sigmoid/Softmax on top still maps onto a
@@ -56,13 +76,17 @@ _LINEAR_HEADS = {"Sigmoid": "sigmoid", "Softmax": "softmax"}
 
 class UnsupportedOpError(ValueError):
     """The graph uses ops outside the supported subset.  ``ops`` lists
-    every offending op type (sorted, deduplicated) so the caller learns
-    the full translation gap from one error."""
+    every offending op type (sorted, deduplicated) and ``sites`` every
+    offending node as ``"Op (node 'name', #position)"`` so the caller
+    learns the full translation gap — and WHERE it sits in a multi-node
+    graph — from one error."""
 
-    def __init__(self, ops: Sequence[str]):
+    def __init__(self, ops: Sequence[str],
+                 sites: Optional[Sequence[str]] = None):
         self.ops = sorted(set(ops))
+        self.sites = list(sites) if sites is not None else list(self.ops)
         super().__init__(
-            f"ONNX graph uses unsupported op(s) {self.ops}; this "
+            f"ONNX graph uses unsupported op(s) {self.sites}; this "
             f"translator speaks {list(SUPPORTED_ONNX_OPS)}")
 
 
@@ -71,6 +95,9 @@ class NodeSpec(NamedTuple):
     inputs: tuple
     outputs: tuple
     attrs: dict
+    #: the ONNX node name (optional in the format; empty for hand-built
+    #: specs) — carried so errors can point AT the node, not just its type
+    name: str = ""
 
 
 class GraphSpec(NamedTuple):
@@ -85,16 +112,128 @@ class GraphSpec(NamedTuple):
     input_dim: int
 
 
+def node_site(node: NodeSpec, position: Optional[int] = None) -> str:
+    """``"Op (node 'name'[, #position])"`` — how errors locate a node.
+    A nameless node (names are optional in ONNX) is identified by its
+    first output, which IS unique in a well-formed graph; the position
+    segment is omitted when the caller does not know it (eval-time
+    rejections see one node, not the whole graph)."""
+
+    label = node.name or (node.outputs[0] if node.outputs else "?")
+    pos = f", #{position}" if position is not None else ""
+    return f"{node.op} (node {label!r}{pos})"
+
+
 def _check_ops(spec: GraphSpec) -> None:
-    bad = [n.op for n in spec.nodes if n.op not in SUPPORTED_ONNX_OPS]
+    bad = [(n.op, node_site(n, i)) for i, n in enumerate(spec.nodes)
+           if n.op not in SUPPORTED_ONNX_OPS]
     if bad:
-        raise UnsupportedOpError(bad)
+        raise UnsupportedOpError([op for op, _ in bad],
+                                 sites=[site for _, site in bad])
+
+
+def _attr_ints(attrs: dict, key: str, default) -> tuple:
+    value = attrs.get(key, default)
+    return tuple(int(v) for v in value)
+
+
+def _attr_str(attrs: dict, key: str, default: str) -> str:
+    value = attrs.get(key, default)
+    return value.decode() if isinstance(value, (bytes, bytearray)) \
+        else str(value)
+
+
+def conv_pads(node: NodeSpec) -> Tuple[tuple, tuple]:
+    """Resolve a Conv/pool node's explicit spatial padding to
+    ``((top, bottom), (left, right))``.  Only ``auto_pad=NOTSET`` (the
+    ONNX default, explicit ``pads``) is spoken — exporters that emit
+    SAME_*/VALID auto_pad get a located error instead of silently wrong
+    geometry."""
+
+    if _attr_str(node.attrs, "auto_pad", "NOTSET") != "NOTSET":
+        raise ValueError(
+            f"{node.op} auto_pad is not supported (export with explicit "
+            f"pads): {node_site(node)}")
+    pads = _attr_ints(node.attrs, "pads", (0, 0, 0, 0))
+    if len(pads) != 4:
+        raise ValueError(
+            f"{node.op} expects 2 spatial dims (pads of length 4, got "
+            f"{list(pads)}): {node_site(node)}")
+    # ONNX order: [top, left, bottom, right]
+    return (pads[0], pads[2]), (pads[1], pads[3])
+
+
+def _np_conv(X, W, bias, strides, pads, dilations, group):
+    """Reference NCHW/OIHW convolution in plain numpy: strided-slice
+    accumulation over kernel taps (exact, loop count = kH*kW — the parity
+    oracle for the jax route, not a performance path)."""
+
+    N, C, H, Wd = X.shape
+    O, Cg, kH, kW = W.shape
+    sh, sw = strides
+    dh, dw = dilations
+    Xp = np.pad(X, ((0, 0), (0, 0), pads[0], pads[1]))
+    Hp, Wp = Xp.shape[2], Xp.shape[3]
+    Ho = (Hp - ((kH - 1) * dh + 1)) // sh + 1
+    Wo = (Wp - ((kW - 1) * dw + 1)) // sw + 1
+    Og = O // group
+    out = np.zeros((N, O, Ho, Wo), dtype=np.float32)
+    for g in range(group):
+        Xg = Xp[:, g * Cg:(g + 1) * Cg]
+        Wg = W[g * Og:(g + 1) * Og]
+        for i in range(kH):
+            for j in range(kW):
+                patch = Xg[:, :, i * dh:i * dh + (Ho - 1) * sh + 1:sh,
+                           j * dw:j * dw + (Wo - 1) * sw + 1:sw]
+                out[:, g * Og:(g + 1) * Og] += np.einsum(
+                    "nchw,oc->nohw", patch, Wg[:, :, i, j])
+    if bias is not None:
+        out += np.asarray(bias).reshape(1, -1, 1, 1)
+    return out.astype(np.float32)
+
+
+def _np_pool(X, kernel, strides, reduce_fn):
+    """Reference 2-D windowed pooling (zero pads only — enforced by the
+    caller): loops output positions, fine at oracle scale."""
+
+    N, C, H, W = X.shape
+    kh, kw = kernel
+    sh, sw = strides
+    Ho = (H - kh) // sh + 1
+    Wo = (W - kw) // sw + 1
+    out = np.empty((N, C, Ho, Wo), dtype=np.float32)
+    for i in range(Ho):
+        for j in range(Wo):
+            win = X[:, :, i * sh:i * sh + kh, j * sw:j * sw + kw]
+            out[:, :, i, j] = reduce_fn(win, axis=(2, 3))
+    return out
+
+
+def _pool_geometry(node: NodeSpec):
+    """``(kernel, strides)`` for a MaxPool/AveragePool node; rejects the
+    attribute corners (pads, dilation, ceil rounding) whose semantics the
+    attribution rules do not model, with the node located in the error."""
+
+    kernel = _attr_ints(node.attrs, "kernel_shape", ())
+    if len(kernel) != 2:
+        raise ValueError(f"{node.op} expects a 2-D kernel_shape: "
+                         f"{node_site(node)}")
+    strides = _attr_ints(node.attrs, "strides", kernel)
+    pads = conv_pads(node)
+    if any(p for pair in pads for p in pair) \
+            or _attr_ints(node.attrs, "dilations", (1, 1)) != (1, 1) \
+            or int(node.attrs.get("ceil_mode", 0)):
+        raise ValueError(
+            f"{node.op} supports only unpadded, undilated, floor-mode "
+            f"windows: {node_site(node)}")
+    return kernel, strides
 
 
 def _eval_node(xp, node: NodeSpec, values: dict):
     """Evaluate one node with array module ``xp`` (numpy or jax.numpy);
     the single op-semantics implementation shared by the device callable,
-    the linear-extraction probe and the output-shape probe."""
+    the linear-extraction probe, the output-shape probe and the DeepSHAP
+    attribution engine's forward/VJP passes."""
 
     op, attrs = node.op, node.attrs
     args = [values[name] for name in node.inputs]
@@ -132,7 +271,55 @@ def _eval_node(xp, node: NodeSpec, values: dict):
         axis = int(attrs.get("axis", 1))
         lead = int(np.prod(data_shape(args[0])[:axis])) if axis else 1
         return xp.reshape(args[0], (lead, -1))
-    raise UnsupportedOpError([op])  # unreachable after _check_ops
+    if op == "Transpose":
+        perm = _attr_ints(attrs, "perm",
+                          tuple(reversed(range(args[0].ndim))))
+        return xp.transpose(args[0], perm)
+    if op == "Conv":
+        X, W = args[0], args[1]
+        bias = args[2] if len(args) > 2 else None
+        strides = _attr_ints(attrs, "strides", (1, 1))
+        dilations = _attr_ints(attrs, "dilations", (1, 1))
+        group = int(attrs.get("group", 1))
+        pads = conv_pads(node)
+        if xp is np:
+            return _np_conv(np.asarray(X, np.float32),
+                            np.asarray(W, np.float32), bias, strides,
+                            pads, dilations, group)
+        from jax import lax
+
+        y = lax.conv_general_dilated(
+            X, W, window_strides=strides, padding=list(pads),
+            rhs_dilation=dilations, feature_group_count=group,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        if bias is not None:
+            y = y + xp.reshape(bias, (1, -1, 1, 1))
+        return y
+    if op in ("MaxPool", "AveragePool"):
+        X = args[0]
+        kernel, strides = _pool_geometry(node)
+        if xp is np:
+            fn = np.max if op == "MaxPool" else np.mean
+            return _np_pool(np.asarray(X, np.float32), kernel, strides, fn)
+        from jax import lax
+
+        dims = (1, 1) + kernel
+        strd = (1, 1) + strides
+        if op == "MaxPool":
+            return lax.reduce_window(X, -xp.inf, lax.max, dims, strd,
+                                     "VALID")
+        total = lax.reduce_window(X, 0.0, lax.add, dims, strd, "VALID")
+        return total / float(kernel[0] * kernel[1])
+    if op == "BatchNormalization":
+        X, scale, bias, mean, var = args[:5]
+        eps = float(attrs.get("epsilon", 1e-5))
+        shape = (1, -1) + (1,) * (X.ndim - 2)
+        scale, bias, mean, var = (xp.reshape(xp.asarray(a), shape)
+                                  for a in (scale, bias, mean, var))
+        # inference-mode BN is the folded per-channel affine transform
+        return (X - mean) * (scale / xp.sqrt(var + eps)) + bias
+    raise UnsupportedOpError([op], sites=[node_site(node)])
+    # unreachable after _check_ops
 
 
 def data_shape(arr) -> tuple:
@@ -214,11 +401,15 @@ def _try_linear(spec: GraphSpec):
                            vector_out=W.shape[1] > 1)
 
 
-class ONNXPredictor:
+class ONNXPredictor(_BasePredictor):
     """Generic lifted ONNX graph: a jittable ``(n, D) -> (n, K)``
     callable over the graph's initializers (kept on-device as jnp
     constants).  Built only for graphs the linear lowering declines —
-    MLPs and friends — and classified onto the sampled masked-EY path."""
+    MLPs, CNNs and friends.  A real :class:`BasePredictor` (not just
+    duck-typed), so ``as_predictor`` passes it through intact and the
+    engine sees :meth:`graph_spec` — the hook the DeepSHAP attribution
+    path (``attribution/deepshap.py``) classifies on; graphs it cannot
+    rule-cover ride the sampled masked-EY path as before."""
 
     vector_out = True
     supports_masked_ey = False
@@ -228,8 +419,14 @@ class ONNXPredictor:
 
         self.spec = spec
         self._jnp = jnp
-        self._consts = {name: jnp.asarray(arr, jnp.float32)
-                        for name, arr in spec.initializers.items()}
+        # float weights live on device; integer initializers (Reshape
+        # shape vectors) stay host-side numpy — shapes are static under
+        # jit, so they must remain concrete, never traced
+        self._consts = {
+            name: (jnp.asarray(arr, jnp.float32)
+                   if np.asarray(arr).dtype.kind == "f"
+                   else np.asarray(arr))
+            for name, arr in spec.initializers.items()}
         probe = run_graph_reference(spec,
                                     np.zeros((2, spec.input_dim), np.float32))
         self.n_outputs = int(probe.shape[1]) if probe.ndim > 1 else 1
@@ -248,6 +445,31 @@ class ONNXPredictor:
     def host_fn(self, X: np.ndarray) -> np.ndarray:
         out = run_graph_reference(self.spec, X)
         return out[:, None] if out.ndim == 1 else out
+
+    def graph_spec(self) -> GraphSpec:
+        """The lifted graph — the structure the DeepSHAP attribution
+        engine consumes (``attribution/deepshap.py`` duck-types on this
+        method, like ``tt_structure`` for the tensor-network path)."""
+
+        return self.spec
+
+    def fingerprint_bytes(self) -> bytes:
+        """Content bytes for the engine's device-cache / share-key
+        fingerprints: two lifted graphs with equal topology and equal
+        initializer bytes ARE the same compiled attribution program."""
+
+        parts = [b"onnx-graph",
+                 repr([(n.op, n.inputs, n.outputs, sorted(n.attrs.items(),
+                                                          key=repr))
+                       for n in self.spec.nodes]).encode(),
+                 self.spec.input_name.encode(),
+                 self.spec.output_name.encode()]
+        for name in sorted(self.spec.initializers):
+            arr = np.asarray(self.spec.initializers[name])
+            parts.append(name.encode())
+            parts.append(str(arr.shape).encode())
+            parts.append(arr.tobytes())
+        return b"".join(parts)
 
 
 def lift_graph(spec: GraphSpec):
@@ -318,7 +540,7 @@ def graph_spec_from_onnx(model) -> GraphSpec:
         attrs = {a.name: onnx.helper.get_attribute_value(a)
                  for a in node.attribute}
         nodes.append(NodeSpec(node.op_type, tuple(node.input),
-                              tuple(node.output), attrs))
+                              tuple(node.output), attrs, node.name))
     return GraphSpec(nodes, initializers, inp.name, graph.output[0].name,
                      int(dims[1].dim_value))
 

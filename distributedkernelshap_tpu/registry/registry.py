@@ -390,7 +390,9 @@ class ModelRegistry:
             return "exact_tree", decision.reason
         if served == "exact_tn":
             return "exact_tn", decision.reason
-        if decision.path in ("exact_tree", "exact_tn") \
+        if served == "deepshap":
+            return "deepshap", decision.reason
+        if decision.path in ("exact_tree", "exact_tn", "deepshap") \
                 and served == "sampled":
             return "sampled", (f"{decision.path} structurally available "
                                f"but deployment serves sampled "

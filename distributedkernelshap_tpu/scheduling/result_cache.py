@@ -169,6 +169,19 @@ def predictor_fingerprint(predictor) -> Tuple[str, bool]:
 
     h = hashlib.sha256()
     h.update(type(predictor).__qualname__.encode())
+    # predictors that publish their own content bytes (TT cores, lifted
+    # neural graphs, param-carrying JaxPredictors) are authoritative:
+    # the declared bytes ARE the deployment identity (None means the
+    # predictor has no content — fall through to introspection)
+    fp_bytes = getattr(predictor, "fingerprint_bytes", None)
+    if callable(fp_bytes):
+        try:
+            declared = fp_bytes()
+        except Exception:
+            declared = None
+        if declared is not None:
+            h.update(declared)
+            return h.hexdigest(), False
     found = _collect_content(getattr(predictor, "__dict__", None) or {}, h)
     if found:
         return h.hexdigest(), False

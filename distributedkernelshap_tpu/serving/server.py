@@ -744,9 +744,12 @@ class ExplainerServer:
 
         compile_events().attach_metrics(reg)
         # evaluation-path attribution (exact closed-form TreeSHAP vs the
-        # sampled estimator) and the exact path's fallback accounting —
-        # both process-global, rendered via callbacks like the compile
+        # sampled estimator) and the analytic paths' fallback accounting —
+        # all process-global, rendered via callbacks like the compile
         # accountant
+        from distributedkernelshap_tpu.attribution.deepshap import (
+            attach_deepshap_metrics,
+        )
         from distributedkernelshap_tpu.ops.tensor_shap import (
             attach_tensor_shap_metrics,
         )
@@ -760,6 +763,7 @@ class ExplainerServer:
         attach_path_metrics(reg)
         attach_treeshap_metrics(reg)
         attach_tensor_shap_metrics(reg)
+        attach_deepshap_metrics(reg)
         # the scheduler registers its own dks_sched_* series (queue wait,
         # expiries) on the same registry so one page carries everything
         attach = getattr(self._sched, "attach_metrics", None)

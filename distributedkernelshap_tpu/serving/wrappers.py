@@ -29,12 +29,18 @@ logger = logging.getLogger(__name__)
 #: closed-form exact Shapley values instead of the sampled estimator
 EXACT_AUTO_ENV = "DKS_EXACT_AUTO"
 
+#: env opt-out for the DeepSHAP auto-selection specifically (default ON;
+#: the global EXACT_AUTO_ENV also applies): a served lifted neural graph
+#: answers every request with backprop attribution instead of the
+#: sampled estimator
+DEEPSHAP_AUTO_ENV = "DKS_DEEPSHAP_AUTO"
+
 # per-request explain-path accounting, process-global so the serving
 # registry can render it via a callback (same pattern as the compile
 # accountant): {'exact': n, 'sampled': n} requests answered per path
 _path_lock = threading.Lock()
 _path_counts: Dict[str, float] = {"exact": 0.0, "exact_tn": 0.0,
-                                  "sampled": 0.0}
+                                  "deepshap": 0.0, "sampled": 0.0}
 
 
 def record_explain_path(path: str, n: int = 1) -> None:
@@ -58,7 +64,8 @@ def attach_path_metrics(registry) -> None:
         "dks_serve_explain_path_total",
         "Request slots explained by evaluation path (exact = closed-form "
         "interventional TreeSHAP, exact_tn = exact tensor-network "
-        "contraction, sampled = KernelSHAP estimator); includes "
+        "contraction, deepshap = DeepSHAP multiplier backprop for lifted "
+        "neural graphs, sampled = KernelSHAP estimator); includes "
         "warmup-ladder rungs, which drive the same entry points.",
         labelnames=("path",)).set_function(explain_path_counts)
 
@@ -147,20 +154,23 @@ class KernelShapModel:
 
     def _resolve_explain_path(self) -> None:
         """Auto-select ``nsamples='exact'`` for deployments whose fitted
-        predictor admits a closed-form exact path: lifted tree ensembles
-        with raw-margin outputs (lgbm/xgb/sklearn-tree lifts — the packed
-        TreeSHAP route) and tensor-train-structured predictors
-        (``models/tensor_net.py`` — the DP contraction route), both at
-        identity link.  Exact Shapley values beat the sampled estimator
-        on both wall-clock and exactness there, so they are the default.
-        A pinned ``nsamples`` key always wins (including
-        ``nsamples=None`` as an explicit opt-out), as does
-        ``DKS_EXACT_AUTO=0``.  Sets ``explain_path`` (``'exact'`` |
-        ``'exact_tn'`` | ``'sampled'``) and ``explain_path_reason`` for
-        the per-request span/metric attribution.  A TT predictor that
-        fails a readiness gate (grouping/link/rank/footprint) stays
-        sampled with the reason counted in
-        ``dks_tensor_shap_fallback_total``."""
+        predictor admits an analytic (sampling-free) path: lifted tree
+        ensembles with raw-margin outputs (lgbm/xgb/sklearn-tree lifts —
+        the packed TreeSHAP route), tensor-train-structured predictors
+        (``models/tensor_net.py`` — the DP contraction route) and lifted
+        neural graphs (``attribution/deepshap.py`` — the DeepSHAP
+        backprop route), all at identity link.  The analytic paths beat
+        the sampled estimator on both wall-clock and determinism there,
+        so they are the default.  A pinned ``nsamples`` key always wins
+        (including ``nsamples=None`` as an explicit opt-out), as does
+        ``DKS_EXACT_AUTO=0`` (all paths) and ``DKS_DEEPSHAP_AUTO=0``
+        (the backprop path only).  Sets ``explain_path`` (``'exact'`` |
+        ``'exact_tn'`` | ``'deepshap'`` | ``'sampled'``) and
+        ``explain_path_reason`` for the per-request span/metric
+        attribution.  A TT predictor or neural graph that fails a
+        readiness gate stays sampled with the reason counted in
+        ``dks_tensor_shap_fallback_total`` /
+        ``dks_deepshap_fallback_total``."""
 
         from distributedkernelshap_tpu.utils import resolve_bool_env
 
@@ -169,7 +179,8 @@ class KernelShapModel:
             if self.explain_kwargs["nsamples"] == "exact":
                 flavor = (getattr(engine, "_exact_flavor", lambda: None)()
                           if engine is not None else None)
-                path = "exact_tn" if flavor == "tn" else "exact"
+                path = {"tn": "exact_tn",
+                        "deepshap": "deepshap"}.get(flavor, "exact")
             else:
                 path = "sampled"
             self.explain_path, self.explain_path_reason = path, "pinned"
@@ -207,6 +218,26 @@ class KernelShapModel:
                     "path for a %s (set %s=0 or pin nsamples to opt "
                     "out)", type(engine.predictor).__name__,
                     EXACT_AUTO_ENV)
+            elif decision.path == "deepshap":
+                from distributedkernelshap_tpu.attribution.deepshap import (
+                    record_deepshap_fallback,
+                )
+
+                if not resolve_bool_env(DEEPSHAP_AUTO_ENV, True):
+                    # its own opt-out on top of the global one, and an
+                    # operational fact worth a counter either way
+                    self.explain_path_reason = "auto_disabled"
+                    record_deepshap_fallback("auto_disabled")
+                else:
+                    self.explain_kwargs["nsamples"] = "exact"
+                    self.explain_path = "deepshap"
+                    self.explain_path_reason = "auto"
+                    logger.info(
+                        "serving auto-selected the DeepSHAP backprop "
+                        "path for a %s (set %s=0 or %s=0 or pin "
+                        "nsamples to opt out)",
+                        type(engine.predictor).__name__,
+                        DEEPSHAP_AUTO_ENV, EXACT_AUTO_ENV)
             elif decision.tn_fallback is not None:
                 # a TN-structured deployment staying sampled is an
                 # operational fact worth a counter, not a mystery
@@ -215,6 +246,13 @@ class KernelShapModel:
                 )
 
                 record_tn_fallback(decision.tn_fallback)
+            elif decision.deepshap_fallback is not None:
+                # same accounting for graph-bearing deployments
+                from distributedkernelshap_tpu.attribution.deepshap import (
+                    record_deepshap_fallback,
+                )
+
+                record_deepshap_fallback(decision.deepshap_fallback)
         except Exception:  # never fail a deployment over path selection
             logger.debug("exact-path auto-selection probe failed",
                          exc_info=True)

@@ -44,9 +44,11 @@ DOCS_PATH = os.path.join(REPO_ROOT, "docs", "OBSERVABILITY.md")
 #: ``dks_registry_*`` and the weak-fingerprint accounting.  The
 #: cross-tenant batching series (``dks_serve_batch_groups``,
 #: ``dks_serve_padded_rows_total``) ride the existing ``serve`` prefix.
+#: (``deepshap`` joined when the deep-model attribution engine landed
+#: its fallback accounting, ``dks_deepshap_*``.)
 _LITERAL_RE = re.compile(
     r"dks_(?:serve|fanin|sched|phase|slo|alerts|wire|staging|treeshap|"
-    r"tensor_shap|autoscale|registry|result_cache)_[a-z0-9_]+")
+    r"tensor_shap|autoscale|registry|result_cache|deepshap)_[a-z0-9_]+")
 
 #: directories never scanned for literals/renderers
 _SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "results", "data",

@@ -1,0 +1,498 @@
+"""DeepSHAP attribution engine (ISSUE 12): layer rules vs brute-force
+Shapley enumeration, completeness, readiness gates and fallback
+accounting, engine/serving promotion (auto-select, device cache, staged
+async, warmup-ladder coverage, path metrics) and the CNN graph export.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributedkernelshap_tpu.attribution.deepshap import (
+    brute_force_shapley,
+    build_deepshap_fn,
+    deepshap_fallback_counts,
+    deepshap_ready,
+    supports_deepshap,
+)
+from distributedkernelshap_tpu.ops.explain import groups_to_matrix
+from distributedkernelshap_tpu.ops.image import superpixel_groups
+from distributedkernelshap_tpu.registry.onnx_lift import (
+    GraphSpec,
+    NodeSpec,
+    run_graph_reference,
+)
+
+
+def _run_phi(spec, K, X, bg, bgw=None, G=None):
+    D = spec.input_dim
+    bgw = (np.ones(bg.shape[0], np.float32) / bg.shape[0]
+           if bgw is None else bgw)
+    G = np.eye(D, dtype=np.float32) if G is None else G
+    fn = jax.jit(build_deepshap_fn(spec, K))
+    params = {k: jnp.asarray(v) for k, v in spec.initializers.items()
+              if np.asarray(v).dtype.kind == "f"}
+    return np.asarray(fn(jnp.asarray(X), params, jnp.asarray(bg),
+                         jnp.asarray(bgw), jnp.asarray(G)))
+
+
+def _additive_relu_spec(seed=0, D=6, H=8, K=2):
+    """Each hidden unit reads ONE input feature — the model is additive
+    across features, where the rescale rule IS the Shapley marginal."""
+
+    rng = np.random.default_rng(seed)
+    W1 = np.zeros((D, H), np.float32)
+    for j in range(H):
+        W1[j % D, j] = rng.normal()
+    spec = GraphSpec(
+        [NodeSpec("Gemm", ("x", "W1", "b1"), ("h",), {}),
+         NodeSpec("Relu", ("h",), ("a",), {}),
+         NodeSpec("Gemm", ("a", "W2", "b2"), ("y",), {})],
+        {"W1": W1, "b1": rng.normal(size=H).astype(np.float32),
+         "W2": rng.normal(size=(H, K)).astype(np.float32),
+         "b2": rng.normal(size=K).astype(np.float32)},
+        "x", "y", D)
+    return spec, K
+
+
+def _conv_spec(seed=1, side=6, K=3, nonneg=False, maxpool=False,
+               batchnorm=False, pool_strides=(2, 2)):
+    rng = np.random.default_rng(seed)
+    D = side * side
+
+    def maybe(a):
+        return np.abs(a) if nonneg else a
+
+    Wc = maybe(rng.normal(scale=0.4, size=(4, 1, 3, 3))).astype(np.float32)
+    bc = maybe(rng.normal(scale=0.1, size=4)).astype(np.float32)
+    nodes = [
+        NodeSpec("Reshape", ("x", "shape_img"), ("img",), {}),
+        NodeSpec("Transpose", ("img",), ("nchw",), {"perm": [0, 3, 1, 2]}),
+        NodeSpec("Conv", ("nchw", "Wc", "bc"), ("c1",),
+                 {"strides": [1, 1], "pads": [1, 1, 1, 1]}, "conv1"),
+    ]
+    inits = {"shape_img": np.asarray([0, side, side, 1], np.int64),
+             "Wc": Wc, "bc": bc}
+    tensor = "c1"
+    if batchnorm:
+        inits.update(scale=rng.uniform(0.5, 1.5, 4).astype(np.float32),
+                     bias=rng.normal(scale=0.1, size=4).astype(np.float32),
+                     mean=rng.normal(scale=0.1, size=4).astype(np.float32),
+                     var=rng.uniform(0.5, 1.5, 4).astype(np.float32))
+        nodes.append(NodeSpec(
+            "BatchNormalization", (tensor, "scale", "bias", "mean", "var"),
+            ("bn",), {"epsilon": 1e-5}))
+        tensor = "bn"
+    nodes.append(NodeSpec("Relu", (tensor,), ("r1",), {}))
+    tensor, feat_side = "r1", side
+    if maxpool:
+        nodes.append(NodeSpec("MaxPool", (tensor,), ("p1",),
+                              {"kernel_shape": [2, 2],
+                               "strides": list(pool_strides)}))
+        tensor, feat_side = "p1", side // 2
+    nodes.append(NodeSpec("Flatten", (tensor,), ("fl",), {"axis": 1}))
+    Wd = rng.normal(scale=0.3,
+                    size=(4 * feat_side * feat_side, K)).astype(np.float32)
+    nodes.append(NodeSpec("Gemm", ("fl", "Wd", "bd"), ("y",), {}))
+    inits.update(Wd=Wd,
+                 bd=rng.normal(scale=0.1, size=K).astype(np.float32))
+    return GraphSpec(nodes, inits, "x", "y", D), K
+
+
+class _GraphPred:
+    def __init__(self, spec):
+        self._spec = spec
+
+    def graph_spec(self):
+        return self._spec
+
+
+# --------------------------------------------------------------------- #
+# rule engine vs brute-force Shapley enumeration
+
+
+def test_additive_relu_net_matches_brute_force():
+    spec, K = _additive_relu_spec()
+    rng = np.random.default_rng(10)
+    X = rng.normal(size=(3, spec.input_dim)).astype(np.float32)
+    bg = rng.normal(size=(4, spec.input_dim)).astype(np.float32)
+    phi = _run_phi(spec, K, X, bg)
+    for i in range(X.shape[0]):
+        ref = brute_force_shapley(
+            lambda r: run_graph_reference(spec, r), X[i], bg)
+        np.testing.assert_allclose(phi[i], ref, atol=2e-6)
+
+
+def test_stable_conv_relu_net_matches_brute_force_grouped():
+    """Non-negative Conv/Relu stack over non-negative pixels: every
+    pre-activation stays non-negative over the whole coalition cube, so
+    the piecewise-linear net is coalition-stable and DeepSHAP equals
+    exact Shapley — on superpixel groups too."""
+
+    spec, K = _conv_spec(nonneg=True)
+    rng = np.random.default_rng(11)
+    X = rng.uniform(0, 1, size=(2, spec.input_dim)).astype(np.float32)
+    bg = rng.uniform(0, 1, size=(3, spec.input_dim)).astype(np.float32)
+    groups, _ = superpixel_groups(6, 6, patch=2)
+    G = groups_to_matrix(groups, spec.input_dim)
+    phi = _run_phi(spec, K, X, bg, G=G)
+    for i in range(X.shape[0]):
+        ref = brute_force_shapley(
+            lambda r: run_graph_reference(spec, r), X[i], bg, G=G)
+        np.testing.assert_allclose(phi[i], ref, atol=2e-6)
+
+
+def test_completeness_on_general_cnn_with_bn_and_maxpool():
+    """Arbitrary-sign Conv+BN+Relu+MaxPool net: phi is the DeepLIFT
+    approximation there, but completeness (sum phi = f(x) - E[f]) holds
+    exactly — including through the maxpool rule's argmax routing."""
+
+    spec, K = _conv_spec(seed=2, maxpool=True, batchnorm=True)
+    rng = np.random.default_rng(12)
+    X = rng.uniform(0, 1, size=(4, spec.input_dim)).astype(np.float32)
+    bg = rng.uniform(0, 1, size=(3, spec.input_dim)).astype(np.float32)
+    phi = _run_phi(spec, K, X, bg)
+    fx = run_graph_reference(spec, X)
+    ef = run_graph_reference(spec, bg).mean(0)
+    np.testing.assert_allclose(phi.sum(2), fx - ef, atol=5e-6)
+
+
+def test_rescale_zero_delta_is_finite_and_complete():
+    """Features equal between x and background: the rescale rule's
+    difference quotient degenerates there; the midpoint-derivative limit
+    must keep phi finite (and zero for untouched features)."""
+
+    spec, K = _additive_relu_spec(seed=3)
+    rng = np.random.default_rng(13)
+    bg = rng.normal(size=(2, spec.input_dim)).astype(np.float32)
+    X = bg[:1].copy()
+    X[0, 0] += 1.0  # only feature 0 differs from background row 0
+    phi = _run_phi(spec, K, X, bg)
+    assert np.isfinite(phi).all()
+    fx = run_graph_reference(spec, X)
+    ef = run_graph_reference(spec, bg).mean(0)
+    np.testing.assert_allclose(phi.sum(2), fx - ef, atol=5e-6)
+
+
+def test_brute_force_refuses_oracle_scale():
+    with pytest.raises(ValueError, match="2\\^M"):
+        brute_force_shapley(lambda r: r, np.zeros(17), np.zeros((1, 17)))
+
+
+# --------------------------------------------------------------------- #
+# readiness gates
+
+
+def test_readiness_gates_and_reasons():
+    spec, _ = _conv_spec(seed=4)
+    pred = _GraphPred(spec)
+    assert supports_deepshap(pred)
+    assert deepshap_ready(pred, "identity") is None
+    assert deepshap_ready(pred, "logit") == "link"
+    assert deepshap_ready(pred, "identity",
+                          target_chunk_elems=1024) == "footprint"
+    assert deepshap_ready(object(), "identity") == "structure"
+
+    softmax = GraphSpec(
+        spec.nodes + [NodeSpec("Softmax", ("y",), ("p",), {})],
+        spec.initializers, "x", "p", spec.input_dim)
+    assert deepshap_ready(_GraphPred(softmax), "identity") == "rule"
+    assert not supports_deepshap(_GraphPred(softmax))
+
+    bilinear = GraphSpec(
+        [NodeSpec("MatMul", ("x", "h"), ("y",), {}),
+         NodeSpec("Gemm", ("x", "W"), ("h",), {})][::-1],
+        {"W": np.eye(4, dtype=np.float32)}, "x", "y", 4)
+    assert deepshap_ready(_GraphPred(bilinear), "identity") == "bilinear"
+
+    # BatchNormalization is affine ONLY for constant scale/mean/var: a
+    # graph-produced parameter input makes it a product — the linear
+    # rule would silently break even completeness, so it must gate
+    dyn_bn = GraphSpec(
+        [NodeSpec("Gemm", ("x", "W"), ("s",), {}),
+         NodeSpec("BatchNormalization", ("x", "s", "o", "m", "v"),
+                  ("y",), {})],
+        {"W": np.eye(4, dtype=np.float32),
+         "o": np.zeros(4, np.float32), "m": np.zeros(4, np.float32),
+         "v": np.ones(4, np.float32)}, "x", "y", 4)
+    assert deepshap_ready(_GraphPred(dyn_bn), "identity") == "bilinear"
+
+
+def test_overlapping_maxpool_fails_readiness():
+    spec, _ = _conv_spec(seed=5, maxpool=True, pool_strides=(1, 1))
+    assert deepshap_ready(_GraphPred(spec), "identity") == "pool_overlap"
+
+
+def test_classify_path_deepshap_and_fallback():
+    from distributedkernelshap_tpu.registry.classify import classify_path
+
+    spec, _ = _conv_spec(seed=6)
+    decision = classify_path(_GraphPred(spec))
+    assert decision.path == "deepshap"
+    assert "neural graph" in decision.reason
+
+    softmax = GraphSpec(
+        spec.nodes + [NodeSpec("Softmax", ("y",), ("p",), {})],
+        spec.initializers, "x", "p", spec.input_dim)
+    fallback = classify_path(_GraphPred(softmax))
+    assert fallback.path == "sampled"
+    assert fallback.deepshap_fallback == "rule"
+
+
+# --------------------------------------------------------------------- #
+# fingerprints (satellite: JaxPredictor-family content identity)
+
+
+def test_jaxpredictor_fingerprint_bytes():
+    from distributedkernelshap_tpu.models.predictors import JaxPredictor
+    from distributedkernelshap_tpu.scheduling.result_cache import (
+        predictor_fingerprint,
+    )
+
+    def identity_fn(x):
+        return x
+
+    params = {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    a = JaxPredictor(identity_fn, n_outputs=3, params=params)
+    b = JaxPredictor(identity_fn, n_outputs=3,
+                     params={"w": params["w"].copy()})
+    assert a.fingerprint_bytes() == b.fingerprint_bytes()
+    da, weak_a = predictor_fingerprint(a)
+    db, weak_b = predictor_fingerprint(b)
+    assert (da, weak_a) == (db, False) and not weak_b
+    other = JaxPredictor(identity_fn, n_outputs=3,
+                         params={"w": params["w"] + 1.0})
+    assert predictor_fingerprint(other)[0] != da
+    # a DIFFERENT function over the same params is a different model —
+    # the fn's code identity is part of the content, so two such
+    # tenants can never coalesce via the share key or the result cache
+    different_fn = JaxPredictor(lambda x: x + 1.0, n_outputs=3,
+                                params={"w": params["w"].copy()})
+    assert different_fn.fingerprint_bytes() != a.fingerprint_bytes()
+    # no params, or a callable OBJECT whose behaviour code hashing
+    # cannot see: no content identity — the loud weak fallback stays
+    bare = JaxPredictor(identity_fn, n_outputs=3)
+    assert bare.fingerprint_bytes() is None
+    assert predictor_fingerprint(bare)[1] is True
+
+    class _Callable:
+        def __call__(self, x):
+            return x
+
+    opaque = JaxPredictor(_Callable(), n_outputs=3, params=params)
+    assert opaque.fingerprint_bytes() is None
+
+
+def test_cnn_predictor_is_share_eligible_content():
+    from distributedkernelshap_tpu.scheduling.result_cache import (
+        predictor_fingerprint,
+    )
+
+    pred = _tiny_cnn(seed=0)
+    digest, weak = predictor_fingerprint(pred)
+    assert not weak
+    assert predictor_fingerprint(_tiny_cnn(seed=0)) == (digest, False)
+    assert predictor_fingerprint(_tiny_cnn(seed=1))[0] != digest
+    # same params, different head: different FUNCTIONS — the scalar
+    # config is part of the content identity, so these must never
+    # collide in the result cache or the cross-tenant share key
+    assert predictor_fingerprint(_tiny_cnn(seed=0,
+                                           output="probs"))[0] != digest
+
+
+# --------------------------------------------------------------------- #
+# CNN graph export + engine/serving integration
+
+
+def _tiny_cnn(seed=0, output="logits", side=12):
+    pytest.importorskip("flax")
+
+    from distributedkernelshap_tpu.models.cnn import _CNN, CNNPredictor
+
+    module = _CNN(n_classes=4)
+    params = module.init(
+        jax.random.PRNGKey(seed),
+        jnp.zeros((1, side, side, 1), jnp.float32))["params"]
+    return CNNPredictor(params, (side, side, 1), n_classes=4,
+                        output=output)
+
+
+@pytest.fixture(scope="module")
+def cnn_setup():
+    rng = np.random.default_rng(21)
+    pred = _tiny_cnn()
+    bg = rng.uniform(0, 1, size=(2, 144)).astype(np.float32)
+    Xe = rng.uniform(0, 1, size=(4, 144)).astype(np.float32)
+    groups, names = superpixel_groups(12, 12, patch=4)  # 9 superpixels
+    return dict(pred=pred, bg=bg, Xe=Xe, groups=groups, names=names)
+
+
+def test_cnn_graph_spec_matches_flax_eval(cnn_setup):
+    s = cnn_setup
+    spec = s["pred"].graph_spec()
+    ref = run_graph_reference(spec, s["Xe"])
+    got = np.asarray(s["pred"](jnp.asarray(s["Xe"])))
+    np.testing.assert_allclose(ref, got, atol=2e-5)
+    # probs head exports with a Softmax tail — correct eval, off-path
+    probs = _tiny_cnn(output="probs")
+    ref_p = run_graph_reference(probs.graph_spec(), s["Xe"])
+    np.testing.assert_allclose(ref_p, np.asarray(probs(jnp.asarray(s["Xe"]))),
+                               atol=2e-5)
+    assert deepshap_ready(probs, "identity") == "rule"
+
+
+def _fit_model(s, **kw):
+    from distributedkernelshap_tpu.serving.wrappers import (
+        BatchKernelShapModel,
+    )
+
+    return BatchKernelShapModel(
+        s["pred"], s["bg"], {"seed": 0},
+        {"groups": s["groups"], "group_names": s["names"]}, **kw)
+
+
+def test_auto_selects_deepshap_for_cnn_tenant(cnn_setup):
+    s = cnn_setup
+    model = _fit_model(s)
+    assert model.explain_path == "deepshap"
+    assert model.explain_path_reason == "auto"
+    assert model.explain_kwargs == {"nsamples": "exact"}
+    payloads = model.explain_batch(s["Xe"], split_sizes=[2, 2])
+    doc = json.loads(payloads[0])
+    phi = np.asarray(doc["data"]["shap_values"])  # (K, 2, M)
+    assert phi.shape == (4, 2, 9)
+    # additivity over the wire: group phi sums to f(x) - E[f]
+    total = phi.sum(-1).T + np.asarray(doc["data"]["expected_value"])[None]
+    np.testing.assert_allclose(
+        total, np.asarray(doc["data"]["raw"]["raw_prediction"]), atol=1e-4)
+
+
+def test_deepshap_opt_outs(cnn_setup, monkeypatch):
+    from distributedkernelshap_tpu.serving.wrappers import KernelShapModel
+
+    s = cnn_setup
+    pinned = KernelShapModel(s["pred"], s["bg"], {"seed": 0}, {},
+                             explain_kwargs={"nsamples": 64})
+    assert pinned.explain_path == "sampled"
+    assert pinned.explain_path_reason == "pinned"
+    # pinned 'exact' resolves to the deepshap flavor for attribution
+    exact = KernelShapModel(s["pred"], s["bg"], {"seed": 0}, {},
+                            explain_kwargs={"nsamples": "exact"})
+    assert exact.explain_path == "deepshap"
+    assert exact.explain_path_reason == "pinned"
+    # its own kill switch, counted in the fallback accounting
+    before = deepshap_fallback_counts().get(("auto_disabled",), 0.0)
+    monkeypatch.setenv("DKS_DEEPSHAP_AUTO", "0")
+    off = KernelShapModel(s["pred"], s["bg"], {"seed": 0}, {})
+    assert off.explain_path == "sampled"
+    assert off.explain_path_reason == "auto_disabled"
+    assert deepshap_fallback_counts()[("auto_disabled",)] == before + 1
+    monkeypatch.delenv("DKS_DEEPSHAP_AUTO")
+    # the global exact-path switch applies too
+    monkeypatch.setenv("DKS_EXACT_AUTO", "0")
+    off2 = KernelShapModel(s["pred"], s["bg"], {"seed": 0}, {})
+    assert off2.explain_path == "sampled"
+    assert off2.explain_path_reason == "auto_disabled"
+
+
+def test_engine_deepshap_device_cache_and_reset(cnn_setup):
+    s = cnn_setup
+    model = _fit_model(s)
+    engine = model.explainer._explainer
+    model.explain_batch(s["Xe"])
+    key = ("deepshap_consts", engine.content_fingerprint())
+    assert key in engine._plan_consts_cache
+    consts = engine._plan_consts_cache[key]
+    model.explain_batch(s["Xe"])
+    assert engine._plan_consts_cache[key] is consts  # served from cache
+    engine.reset_device_state()
+    assert not engine._plan_consts_cache
+    # bit-identical across the rebuild (same program, same constants)
+    a = model.explain_batch(s["Xe"])
+    b = model.explain_batch(s["Xe"])
+    assert a == b
+
+
+def test_deepshap_staged_async_matches_sync(cnn_setup):
+    from distributedkernelshap_tpu.kernel_shap import StagedRows
+
+    s = cnn_setup
+    model = _fit_model(s)
+    staged = model.stage_rows(s["Xe"])
+    assert isinstance(staged, StagedRows)
+    sync = model.explain_batch(s["Xe"], split_sizes=[2, 2])
+    got = model.explain_batch_async(staged, split_sizes=[2, 2])()
+    assert got == sync
+    staged2 = model.stage_rows(s["Xe"])
+    binary = model.explain_batch_async(
+        staged2, split_sizes=[2, 2], formats=["binary", "json"])()
+    assert isinstance(binary[0], (bytes, bytearray))
+    assert binary[1] == sync[1]
+
+
+def test_group_phi_is_summed_feature_phi(cnn_setup):
+    """Superpixel phi is the sum of member-pixel phi — the image-SHAP
+    grouping convention, implemented as one einsum against G."""
+
+    from distributedkernelshap_tpu import KernelShap
+
+    s = cnn_setup
+    grouped = KernelShap(s["pred"], seed=0)
+    grouped.fit(s["bg"], groups=s["groups"], group_names=s["names"])
+    flat = KernelShap(s["pred"], seed=0)
+    flat.fit(s["bg"])
+    X = s["Xe"][:2]
+    phi_g = np.stack(grouped.explain(X, nsamples="exact",
+                                     silent=True).shap_values, 1)
+    phi_f = np.stack(flat.explain(X, nsamples="exact",
+                                  silent=True).shap_values, 1)
+    G = groups_to_matrix(s["groups"], 144)
+    np.testing.assert_allclose(phi_g, phi_f @ G.T, atol=1e-5)
+
+
+def test_path_metric_counts_deepshap(cnn_setup):
+    from distributedkernelshap_tpu.serving import wrappers
+
+    s = cnn_setup
+    model = _fit_model(s)
+    before = wrappers.explain_path_counts().get(("deepshap",), 0.0)
+    model.explain_batch(s["Xe"], split_sizes=[2, 2])
+    assert wrappers.explain_path_counts()[("deepshap",)] == before + 2
+
+
+def test_warmup_ladder_covers_deepshap_path(cnn_setup):
+    import time
+
+    from distributedkernelshap_tpu.runtime.compile_cache import (
+        compile_events,
+    )
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    s = cnn_setup
+    model = _fit_model(s)
+    assert model.explain_path == "deepshap"
+    ce = compile_events()
+    before = ce.snapshot()
+    srv = ExplainerServer(model, host="127.0.0.1", port=0,
+                          max_batch_size=4, warmup=True,
+                          health_interval_s=0).start()
+    try:
+        deadline = time.monotonic() + 60
+        while srv.warmup_status()["state"] in ("pending", "running"):
+            assert time.monotonic() < deadline, "warmup never finished"
+            time.sleep(0.05)
+        st = srv.warmup_status()
+        assert st["state"] == "done"
+        assert st["completed_buckets"] == st["buckets"] != []
+        delta = ce.delta(before, ce.snapshot())
+        sigs = {sig for (_, sig) in delta["counts"]}
+        assert any(sig.endswith(",path=deepshap") for sig in sigs), sigs
+        page = srv.metrics.render()
+        assert 'dks_serve_explain_path_total{path="deepshap"}' in page
+        assert "dks_deepshap_fallback_total" in page
+    finally:
+        srv.stop()

@@ -23,6 +23,44 @@ def test_superpixel_groups_ragged_and_channels():
     assert sorted(c for g in groups3 for c in g) == list(range(48))
 
 
+def test_superpixel_ragged_edge_patch_membership():
+    """Ragged edges (patch does not divide H/W): the edge patches are
+    exactly the leftover rows/columns, named by their patch grid cell."""
+
+    groups, names = superpixel_groups(5, 5, patch=2)
+    by_name = dict(zip(names, groups))
+    # interior patch: full 2x2 block, row-major pixel order
+    assert by_name["patch_0_0"] == [0, 1, 5, 6]
+    # right edge: 2 rows x 1 leftover column (x = 4)
+    assert by_name["patch_0_2"] == [4, 9]
+    # bottom edge: 1 leftover row (y = 4) x 2 columns
+    assert by_name["patch_2_0"] == [20, 21]
+    # corner: the single leftover pixel
+    assert by_name["patch_2_2"] == [24]
+    assert [len(g) for g in groups] == [4, 4, 2, 4, 4, 2, 2, 2, 1]
+
+
+def test_superpixel_multichannel_column_order_matches_flatten():
+    """Multi-channel groups list columns in the SAME (y, x, c) row-major
+    interleave that ``images.reshape(n, -1)`` (and ``image_background``)
+    produce — each patch owns every channel of its pixels, adjacent in
+    memory."""
+
+    groups, names = superpixel_groups(4, 4, patch=2, channels=3)
+    by_name = dict(zip(names, groups))
+    # pixel (y, x) channel c flattens to (y*4 + x)*3 + c
+    assert by_name["patch_0_1"] == [
+        (y * 4 + x) * 3 + c
+        for y in (0, 1) for x in (2, 3) for c in (0, 1, 2)]
+    # cross-check against an actual image: each patch's columns pick out
+    # exactly its pixels' channel values from the flattened row
+    img = np.arange(4 * 4 * 3, dtype=np.float32).reshape(1, 4, 4, 3)
+    img[0, :, :, 1] += 100.0  # make channels distinguishable
+    flat = img.reshape(1, -1)
+    got = flat[0, by_name["patch_1_0"]].reshape(2, 2, 3)
+    np.testing.assert_array_equal(got, img[0, 2:4, 0:2, :])
+
+
 def test_image_background_modes():
     rng = np.random.default_rng(0)
     imgs = rng.random((10, 8, 8)).astype(np.float32)

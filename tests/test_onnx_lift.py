@@ -169,21 +169,41 @@ def test_multiclass_affine_graph_lowers_to_linear():
 
 def test_unsupported_ops_listed_exhaustively():
     spec = _graph(
-        [NodeSpec("Conv", ("X",), ("a",), {}),
+        [NodeSpec("LSTM", ("X",), ("a",), {}),
          NodeSpec("Relu", ("a",), ("b",), {}),
-         NodeSpec("MaxPool", ("b",), ("c",), {}),
-         NodeSpec("Conv", ("c",), ("y",), {})],
+         NodeSpec("Resize", ("b",), ("c",), {}),
+         NodeSpec("LSTM", ("c",), ("y",), {})],
         {}, 4, "y")
     with pytest.raises(UnsupportedOpError) as exc:
         lift_graph(spec)
-    assert exc.value.ops == ["Conv", "MaxPool"]  # deduped + sorted
-    assert "Conv" in str(exc.value)
+    assert exc.value.ops == ["LSTM", "Resize"]  # deduped + sorted
+    assert "LSTM" in str(exc.value)
+
+
+def test_unsupported_op_error_locates_the_node():
+    """A multi-node graph's offending op is locatable from the message
+    alone: node name (or its output when nameless) and position."""
+
+    spec = _graph(
+        [NodeSpec("Gemm", ("X", "W"), ("a",), {}),
+         NodeSpec("LSTM", ("a",), ("b",), {}, "recurrent_1"),
+         NodeSpec("Resize", ("b",), ("y",), {})],
+        {"W": np.eye(4, dtype=np.float32)}, 4, "y")
+    with pytest.raises(UnsupportedOpError) as exc:
+        lift_graph(spec)
+    msg = str(exc.value)
+    assert "LSTM (node 'recurrent_1', #1)" in msg
+    # nameless node: identified by its (unique) first output + position
+    assert "Resize (node 'y', #2)" in msg
 
 
 def test_supported_op_list_is_the_issue_contract():
     assert set(SUPPORTED_ONNX_OPS) == {
         "Gemm", "MatMul", "Add", "Relu", "Sigmoid", "Tanh", "Softmax",
-        "Identity", "Reshape", "Flatten"}
+        "Identity", "Reshape", "Flatten",
+        # the deep-model attribution engine's CNN block (ISSUE 12)
+        "Transpose", "Conv", "MaxPool", "AveragePool",
+        "BatchNormalization"}
 
 
 # --------------------------------------------------------------------- #
@@ -241,3 +261,131 @@ def test_lift_onnx_without_package_raises_importerror(monkeypatch):
     monkeypatch.setattr(builtins, "__import__", no_onnx)
     with pytest.raises(ImportError, match="requirements_advanced"):
         lift_onnx(b"not-a-model")
+
+
+# --------------------------------------------------------------------- #
+# CNN-block ops (ISSUE 12): parity vs hand-written numpy, independently
+# of the translator's own numpy reference evaluator
+# --------------------------------------------------------------------- #
+
+
+def _img_graph(nodes, inits, side, out, channels=1):
+    inits = dict(inits)
+    inits["shape_img"] = np.asarray([0, channels, side, side], np.int64)
+    reshape = NodeSpec("Reshape", ("X", "shape_img"), ("img",), {})
+    return GraphSpec([reshape] + nodes, inits, "X", out,
+                     channels * side * side)
+
+
+def test_conv_parity_strides_pads_bias():
+    Wc = rng.normal(size=(2, 1, 3, 3)).astype(np.float32)
+    bc = rng.normal(size=(2,)).astype(np.float32)
+    spec = _img_graph(
+        [NodeSpec("Conv", ("img", "Wc", "bc"), ("c",),
+                  {"strides": [2, 2], "pads": [0, 0, 1, 1]}),
+         NodeSpec("Flatten", ("c",), ("y",), {"axis": 1})],
+        {"Wc": Wc, "bc": bc}, 5, "y")
+    Xi = rng.normal(size=(3, 25)).astype(np.float32)
+    img = Xi.reshape(3, 1, 5, 5)
+    pad = np.pad(img, ((0, 0), (0, 0), (0, 1), (0, 1)))
+    # padded 6x6, stride 2, kernel 3 -> floor((6-3)/2)+1 = 2 per dim
+    want = np.zeros((3, 2, 2, 2), np.float32)
+    for o in range(2):
+        for i in range(2):
+            for j in range(2):
+                win = pad[:, 0, 2 * i:2 * i + 3, 2 * j:2 * j + 3]
+                want[:, o, i, j] = (win * Wc[o, 0]).sum((1, 2)) + bc[o]
+    np.testing.assert_allclose(_lifted_out(spec, Xi),
+                               want.reshape(3, -1), atol=1e-4)
+
+
+def test_conv_grouped_and_dilated_parity_vs_reference():
+    """Grouped/dilated conv: the jax route must agree with the numpy
+    reference evaluator (which itself is loop-built per kernel tap)."""
+
+    from distributedkernelshap_tpu.registry.onnx_lift import (
+        run_graph_reference,
+    )
+
+    Wc = rng.normal(size=(4, 1, 2, 2)).astype(np.float32)  # group=2
+    spec = _img_graph(
+        [NodeSpec("Conv", ("img", "Wc"), ("c",),
+                  {"strides": [1, 1], "pads": [1, 0, 0, 1],
+                   "dilations": [2, 2], "group": 2}),
+         NodeSpec("Flatten", ("c",), ("y",), {"axis": 1})],
+        {"Wc": Wc}, 6, "y", channels=2)
+    Xi = rng.normal(size=(2, 72)).astype(np.float32)
+    np.testing.assert_allclose(_lifted_out(spec, Xi),
+                               run_graph_reference(spec, Xi), atol=1e-4)
+
+
+def test_pool_parity():
+    spec_max = _img_graph(
+        [NodeSpec("MaxPool", ("img",), ("p",),
+                  {"kernel_shape": [2, 2], "strides": [2, 2]}),
+         NodeSpec("Flatten", ("p",), ("y",), {"axis": 1})], {}, 4, "y")
+    spec_avg = _img_graph(
+        [NodeSpec("AveragePool", ("img",), ("p",),
+                  {"kernel_shape": [2, 2], "strides": [2, 2]}),
+         NodeSpec("Flatten", ("p",), ("y",), {"axis": 1})], {}, 4, "y")
+    Xi = rng.normal(size=(3, 16)).astype(np.float32)
+    img = Xi.reshape(3, 1, 4, 4)
+    wins = img.reshape(3, 2, 2, 2, 2).transpose(0, 1, 3, 2, 4)
+    np.testing.assert_allclose(
+        _lifted_out(spec_max, Xi),
+        wins.max((3, 4)).reshape(3, -1), atol=1e-6)
+    np.testing.assert_allclose(
+        _lifted_out(spec_avg, Xi),
+        wins.mean((3, 4)).reshape(3, -1), atol=1e-6)
+
+
+def test_batchnorm_parity():
+    scale = rng.uniform(0.5, 1.5, 2).astype(np.float32)
+    bias = rng.normal(size=(2,)).astype(np.float32)
+    mean = rng.normal(size=(2,)).astype(np.float32)
+    var = rng.uniform(0.5, 1.5, 2).astype(np.float32)
+    spec = _img_graph(
+        [NodeSpec("BatchNormalization",
+                  ("img", "scale", "bias", "mean", "var"), ("n",),
+                  {"epsilon": 1e-3}),
+         NodeSpec("Flatten", ("n",), ("y",), {"axis": 1})],
+        {"scale": scale, "bias": bias, "mean": mean, "var": var},
+        3, "y", channels=2)
+    Xi = rng.normal(size=(2, 18)).astype(np.float32)
+    img = Xi.reshape(2, 2, 3, 3)
+    r = (1, 2, 1, 1)
+    want = ((img - mean.reshape(r)) * scale.reshape(r)
+            / np.sqrt(var.reshape(r) + 1e-3) + bias.reshape(r))
+    np.testing.assert_allclose(_lifted_out(spec, Xi),
+                               want.reshape(2, -1), atol=1e-5)
+
+
+def test_transpose_parity():
+    spec = _img_graph(
+        [NodeSpec("Transpose", ("img",), ("t",), {"perm": [0, 2, 3, 1]}),
+         NodeSpec("Flatten", ("t",), ("y",), {"axis": 1})], {}, 3, "y",
+        channels=2)
+    Xi = rng.normal(size=(2, 18)).astype(np.float32)
+    want = Xi.reshape(2, 2, 3, 3).transpose(0, 2, 3, 1).reshape(2, -1)
+    np.testing.assert_allclose(_lifted_out(spec, Xi), want, atol=1e-6)
+
+
+def test_pool_and_conv_attribute_corners_rejected():
+    for attrs in ({"kernel_shape": [2, 2], "pads": [1, 0, 0, 0]},
+                  {"kernel_shape": [2, 2], "ceil_mode": 1},
+                  {"kernel_shape": [2, 2], "dilations": [2, 2]}):
+        spec = _img_graph(
+            [NodeSpec("MaxPool", ("img",), ("p",), attrs, "pool_k"),
+             NodeSpec("Flatten", ("p",), ("y",), {"axis": 1})],
+            {}, 4, "y")
+        with pytest.raises(ValueError, match="pool_k"):
+            _lifted_out(spec, rng.normal(size=(1, 16)).astype(np.float32))
+    # auto_pad on conv: located rejection, never silent geometry
+    Wc = rng.normal(size=(1, 1, 3, 3)).astype(np.float32)
+    spec = _img_graph(
+        [NodeSpec("Conv", ("img", "Wc"), ("c",),
+                  {"auto_pad": b"SAME_UPPER"}, "conv_k"),
+         NodeSpec("Flatten", ("c",), ("y",), {"axis": 1})],
+        {"Wc": Wc}, 4, "y")
+    with pytest.raises(ValueError, match="conv_k"):
+        _lifted_out(spec, rng.normal(size=(1, 16)).astype(np.float32))
