@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test tier1 collect fuzz bench configs serve sweep-pool sweep-serve analysis multihost-ci sched-bench chaos-bench obs-check health-check perf-gate warmup-bench stream-bench exact-bench autoscale-bench accuracy-gate tenant-bench deepshap-bench
+.PHONY: test tier1 collect fuzz bench configs serve sweep-pool sweep-serve analysis multihost-ci sched-bench chaos-bench obs-check health-check perf-gate warmup-bench stream-bench exact-bench autoscale-bench accuracy-gate tenant-bench deepshap-bench cost-bench
 
 multihost-ci:    ## multi-host validation: 2-proc pool/phi/interactions, 4-proc 2x2 mesh, 2-proc serve (one JSON line, rc 0/1)
 	$(PY) benchmarks/multihost_ci.py
@@ -38,6 +38,9 @@ autoscale-bench: ## elastic-fleet A/B: diurnal open-loop replay, autoscaled min=
 
 tenant-bench:    ## multi-tenant gateway: 3 families served concurrently (phi bit-identical to dedicated), hot-swap mid-run, noisy-tenant quota isolation, PLUS the cross-tenant batching sweep (1->8 mixed-path tenants >=85% of the single-tenant ceiling, shared-program parity); self-records for perf-gate
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/multitenant_bench.py --arm all --check
+
+cost-bench:      ## tenant cost attribution: per-tenant device-seconds sum to the directly-measured dispatch total (shared AND serialized batching), metering overhead <=1%, /fleetz == sum of per-replica scrapes, SLO-breach exemplar -> Perfetto; self-records for perf-gate
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/cost_attribution_bench.py --check
 
 obs-check:       ## observability drift lint: registry vs docs/OBSERVABILITY.md catalog, stray dks_ literals, ad-hoc exposition renderers
 	env JAX_PLATFORMS=cpu $(PY) scripts/obs_check.py

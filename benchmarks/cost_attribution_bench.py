@@ -1,0 +1,561 @@
+"""Tenant cost-attribution bench: the meter's books must balance
+(standalone, CPU backend, exits nonzero on ``--check`` fail).
+
+Four measured arms, one JSON line (ISSUE 13):
+
+1. **Attribution** — a 4-tenant mixed-path fleet (two content-identical
+   linear tenants — the shared-program pair — an exact-TN tensor-train
+   tenant and a sampled callable tenant) serves an open-loop burst
+   stream twice: once with cross-tenant shared batching ON, once
+   serialized (``shared_batching=False``).  In BOTH modes the sum of
+   ``dks_device_seconds_total`` over every ``(model, version, path)``
+   must land within 5% of the **directly measured** dispatch total — an
+   independent per-call dispatch→fetch clock wrapped around each
+   model's ``explain_batch(_async)`` by the bench itself (compile delta
+   subtracted on both sides), so the meter cannot grade its own
+   homework.
+2. **Metering overhead** — one live server, the meter toggled PER
+   REQUEST (strict on/off alternation, so drift hits both pools
+   identically — the drift-robust refinement of the PR-4
+   sampler-overhead methodology): the metered pool's median request
+   latency must sit within 1% of the unmetered pool's.  The ON median
+   self-records as ``metered_median_s`` so ``make perf-gate`` covers
+   metering-overhead regressions.
+3. **Fleet rollup** — two in-process replicas behind a ``FanInProxy``
+   serve the tenants; after the stream quiesces, ``/fleetz`` per-tenant
+   device-seconds must EQUAL the sum of the per-replica ``/metrics``
+   scrapes (and ``/metrics?federate=1`` must re-validate under
+   ``validate_exposition``).
+4. **Exemplar round trip** — a deliberately-breaching per-tenant
+   latency SLO (5 ms threshold, seconds-scale windows) must fire on
+   ``/statusz``, and a trace exemplar pulled from ``/debugz`` for the
+   breaching tenant must resolve to followable spans that survive the
+   Perfetto ``trace_event`` conversion round trip.
+
+Self-records into ``results/perf_history.jsonl`` with ``checks_ok``.
+
+    JAX_PLATFORMS=cpu python benchmarks/cost_attribution_bench.py --check
+"""
+
+import argparse
+import json
+import statistics
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO_ROOT = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO_ROOT)
+
+from benchmarks.multitenant_bench import (  # noqa: E402
+    build_linear,
+    build_sampled,
+    build_tt,
+    _wait_warm,
+)
+
+D = 6  # the multitenant builders' feature width
+
+
+# --------------------------------------------------------------------- #
+# direct dispatch-time instrumentation (the meter's independent check)
+# --------------------------------------------------------------------- #
+
+
+class DispatchClock:
+    """Independent dispatch→fetch wall accounting, shared by every
+    instrumented model.  The serving meter measures the same boundary
+    from the server side; this clock measures it from the model side,
+    so agreement is a real cross-check, not a tautology."""
+
+    def __init__(self):
+        self.measuring = False
+        self.total = 0.0
+        self.calls = 0
+        self._lock = threading.Lock()
+
+    def add(self, seconds: float) -> None:
+        with self._lock:
+            if self.measuring:
+                self.total += seconds
+                self.calls += 1
+
+    def reset(self) -> None:
+        with self._lock:
+            self.total = 0.0
+            self.calls = 0
+
+
+def instrument(model, clock: DispatchClock):
+    """Shadow ``explain_batch(_async)`` with timing closures on the
+    INSTANCE (the class, its engine and the share-eligibility probes are
+    untouched).  Idempotent per model."""
+
+    if getattr(model, "_dks_bench_clock", None) is clock:
+        return model
+    orig_async = model.explain_batch_async
+    orig_sync = model.explain_batch
+
+    def timed_async(instances, **kw):
+        t0 = time.monotonic()
+        fin = orig_async(instances, **kw)
+
+        def timed_fin():
+            try:
+                return fin()
+            finally:
+                clock.add(time.monotonic() - t0)
+
+        return timed_fin
+
+    def timed_sync(instances, **kw):
+        t0 = time.monotonic()
+        try:
+            return orig_sync(instances, **kw)
+        finally:
+            clock.add(time.monotonic() - t0)
+
+    model.explain_batch_async = timed_async
+    model.explain_batch = timed_sync
+    model._dks_bench_clock = clock
+    return model
+
+
+# --------------------------------------------------------------------- #
+# fleet plumbing
+# --------------------------------------------------------------------- #
+
+
+ROSTER = (("lin0", lambda: build_linear(seed=1)),
+          ("lin1", lambda: build_linear(seed=1)),  # content-identical pair
+          ("tt0", build_tt),
+          ("samp0", build_sampled))
+
+_MODELS = {}
+
+
+def roster_models(clock):
+    """Build (once) and instrument the 4-tenant roster; reused across
+    arms so each engine compiles its ladder once."""
+
+    for name, builder in ROSTER:
+        if name not in _MODELS:
+            _MODELS[name] = instrument(builder(), clock)
+    return [(name, _MODELS[name]) for name, _ in ROSTER]
+
+
+def serve_fleet(models, shared=True, **kwargs):
+    from distributedkernelshap_tpu.registry import ModelRegistry
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    registry = ModelRegistry()
+    for name, model in models:
+        registry.register(name, model)
+    defaults = dict(host="127.0.0.1", port=0, max_batch_size=8,
+                    batch_timeout_s=0.004, pipeline_depth=2,
+                    shared_batching=shared, warmup=True)
+    defaults.update(kwargs)
+    server = ExplainerServer(registry=registry, **defaults).start()
+    _wait_warm(server)
+    return server, registry
+
+
+def post_explain(host, port, row, model=None, timeout=60):
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        headers = {"Content-Type": "application/json"}
+        if model is not None:
+            headers["X-DKS-Model"] = model
+        conn.request("POST", "/explain",
+                     body=json.dumps({"array": row.tolist()}).encode(),
+                     headers=headers)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def http_get(host, port, path, timeout=60):
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        return conn.getresponse().read().decode()
+    finally:
+        conn.close()
+
+
+def burst_stream(server, tenants, bursts, rng, record=None):
+    """``bursts`` rounds of one-concurrent-request-per-tenant (the
+    coalescing shape shared batching exists for); every answer must be
+    200.  ``record`` collects (tenant, latency_s)."""
+
+    errors = []
+
+    def fire(tenant, row):
+        t0 = time.monotonic()
+        status, payload = post_explain(server.host, server.port, row,
+                                       model=tenant)
+        if status != 200:
+            errors.append((tenant, status, payload[:120]))
+        elif record is not None:
+            record.append((tenant, time.monotonic() - t0))
+
+    for _ in range(bursts):
+        threads = [threading.Thread(
+            target=fire, args=(tenant,
+                               rng.normal(size=(1, D)).astype(np.float32)))
+            for tenant, _ in tenants]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    return errors
+
+
+def metered_device_seconds(server):
+    """Sum (and per-tenant split of) dks_device_seconds_total."""
+
+    metric = server.metrics.get("dks_device_seconds_total")
+    series = metric.collect()["series"]
+    per_tenant = {}
+    for (model, version, path), value in series.items():
+        per_tenant[model] = per_tenant.get(model, 0.0) + value
+    return sum(per_tenant.values()), per_tenant
+
+
+# --------------------------------------------------------------------- #
+# arm 1: attribution (shared + serialized)
+# --------------------------------------------------------------------- #
+
+
+def run_attribution_arm(clock, bursts=24, seed=11):
+    from distributedkernelshap_tpu.runtime.compile_cache import (
+        compile_events,
+    )
+
+    out = {}
+    for mode, shared in (("shared", True), ("serialized", False)):
+        models = roster_models(clock)
+        server, registry = serve_fleet(models, shared=shared)
+        rng = np.random.default_rng(seed)
+        try:
+            # one untimed pass settles any residual first-shape work
+            errors = burst_stream(server, models, 2, rng)
+            assert not errors, errors
+            base_total, _ = metered_device_seconds(server)
+            compile0 = compile_events().total_seconds()
+            clock.reset()
+            clock.measuring = True
+            errors = burst_stream(server, models, bursts, rng)
+            clock.measuring = False
+            assert not errors, errors
+            compile_delta = compile_events().total_seconds() - compile0
+            direct = max(1e-9, clock.total - compile_delta)
+            total, per_tenant = metered_device_seconds(server)
+            total -= base_total
+            gap = abs(total - direct) / direct
+            groups = server.metrics.get("dks_serve_batch_groups").value()
+            out[mode] = {
+                "direct_dispatch_s": round(direct, 4),
+                "metered_total_s": round(total, 4),
+                "attribution_gap": round(gap, 4),
+                "compile_excluded_s": round(compile_delta, 4),
+                "per_tenant_s": {k: round(v, 4)
+                                 for k, v in sorted(per_tenant.items())},
+                "dispatch_calls": clock.calls,
+                "batch_group_cycles": groups["count"],
+                "all_tenants_attributed": all(
+                    per_tenant.get(name, 0.0) > 0 for name, _ in models),
+            }
+        finally:
+            server.stop()
+    return out
+
+
+# --------------------------------------------------------------------- #
+# arm 2: metering overhead (off/on/on/off)
+# --------------------------------------------------------------------- #
+
+
+def run_overhead_arm(clock, requests=400, seed=13):
+    """Meter overhead on ONE live server, toggling the meter's enabled
+    flag PER REQUEST (strict on/off alternation).  One server means one
+    engine, one process state, one HTTP stack, and per-request
+    alternation means any drift profile hits both pools identically —
+    the only difference between the pooled medians is the meter's
+    write path, which is exactly what the ≤1% criterion is about.
+    (Separate servers per arm measured 10%+ "overhead" that was
+    entirely spin-up drift; pass-granular toggling still aliased
+    multi-second drift waves into a 2 ms phantom.)  ``requests`` is the
+    per-arm count; at ~11 ms per request the median's standard error is
+    ≈0.4% of it, comfortably inside the 1% bound for a meter whose
+    measured compute cost is ~40 µs."""
+
+    lin = _MODELS.get("lin0") or instrument(build_linear(seed=1), clock)
+    _MODELS.setdefault("lin0", lin)
+    server, registry = serve_fleet([("lin0", lin)], shared=True)
+    lat = {"on": [], "off": []}
+    rng = np.random.default_rng(seed)
+    try:
+        for _ in range(10):  # untimed warm pass
+            post_explain(server.host, server.port,
+                         rng.normal(size=(1, D)).astype(np.float32),
+                         model="lin0")
+        for i in range(2 * requests):
+            arm = "on" if i % 2 == 0 else "off"
+            server._costmeter.enabled = (arm == "on")
+            row = rng.normal(size=(1, D)).astype(np.float32)
+            t0 = time.monotonic()
+            status, _ = post_explain(server.host, server.port, row,
+                                     model="lin0")
+            assert status == 200
+            lat[arm].append(time.monotonic() - t0)
+    finally:
+        server._costmeter.enabled = True
+        server.stop()
+    med_on = statistics.median(lat["on"])
+    med_off = statistics.median(lat["off"])
+    return {"median_on_s": round(med_on, 6),
+            "median_off_s": round(med_off, 6),
+            "overhead_frac": round(med_on / med_off - 1.0, 4),
+            "requests_per_arm": requests}
+
+
+# --------------------------------------------------------------------- #
+# arm 3: federated fleet rollup
+# --------------------------------------------------------------------- #
+
+
+def run_fleet_arm(clock, bursts=10, seed=17):
+    from distributedkernelshap_tpu.observability.metrics import (
+        parse_exposition,
+        validate_exposition,
+    )
+    from distributedkernelshap_tpu.serving.replicas import FanInProxy
+
+    models = roster_models(clock)[:2]  # lin pair is plenty for the sums
+    replicas, proxy = [], None
+    try:
+        replicas = [serve_fleet(models, shared=True) for _ in range(2)]
+        proxy = FanInProxy([("127.0.0.1", srv.port)
+                            for srv, _ in replicas]).start()
+        rng = np.random.default_rng(seed)
+        errors = []
+        for i in range(bursts):
+            for tenant, _ in models:
+                status, payload = post_explain(
+                    "127.0.0.1", proxy.port,
+                    rng.normal(size=(1, D)).astype(np.float32),
+                    model=tenant)
+                if status != 200:
+                    errors.append((tenant, status, payload[:120]))
+        assert not errors, errors
+        # quiesced: counters static, so the two scrape passes see the
+        # same values and equality is exact up to the rollup's rounding
+        direct = {}
+        for srv, _ in replicas:
+            page = parse_exposition(http_get(srv.host, srv.port,
+                                             "/metrics"))
+            for _, labels, value in \
+                    page["dks_device_seconds_total"]["samples"]:
+                direct[labels["model"]] = \
+                    direct.get(labels["model"], 0.0) + value
+        fleetz = json.loads(http_get("127.0.0.1", proxy.port, "/fleetz"))
+        fed_page = http_get("127.0.0.1", proxy.port, "/metrics?federate=1")
+        fed_problems = validate_exposition(fed_page)
+        rollup_gap = max(
+            abs(fleetz["tenants"].get(m, {}).get("device_seconds", 0.0)
+                - v) for m, v in direct.items())
+        return {
+            "per_tenant_direct_s": {k: round(v, 4)
+                                    for k, v in sorted(direct.items())},
+            "per_tenant_fleetz_s": {
+                m: round(t.get("device_seconds", 0.0), 4)
+                for m, t in sorted(fleetz["tenants"].items())},
+            "rollup_matches_direct_sum": rollup_gap < 1e-5,
+            "federated_page_valid": fed_problems == [],
+            "federated_problems": fed_problems[:5],
+            "replicas_scraped": int(
+                proxy.metrics.get("dks_fleet_replicas_scraped").value()),
+        }
+    finally:
+        if proxy is not None:
+            proxy.stop()
+        for srv, _ in replicas:
+            srv.stop()
+
+
+# --------------------------------------------------------------------- #
+# arm 4: SLO-breach exemplar → Perfetto round trip
+# --------------------------------------------------------------------- #
+
+
+def run_exemplar_arm(clock, requests=16, seed=19):
+    import distributedkernelshap_tpu.observability.tracing as tracing
+    from distributedkernelshap_tpu.observability.slo import (
+        BurnRateWindow,
+        default_server_slos,
+        tenant_slos,
+    )
+
+    tracer = tracing.tracer()
+    was_enabled = tracer.enabled
+    tracer.enable()
+    # seconds-scale windows + a 5 ms threshold: real request latencies
+    # (tens of ms on this engine) breach within a couple of health ticks
+    fast = (BurnRateWindow(long_s=6.0, short_s=2.0, factor=1.0),)
+    slos = default_server_slos(windows=fast) + tenant_slos(
+        ["lin0"], windows=fast, latency_target=(0.005, 0.90))
+    models = roster_models(clock)[:1]
+    server, registry = serve_fleet(models, shared=True, slos=slos,
+                                   health_interval_s=0.2)
+    rng = np.random.default_rng(seed)
+    try:
+        # traffic keeps flowing WHILE the poller watches: the breach
+        # condition needs burn >= factor in the SHORT window too, so the
+        # stream must still be violating when /statusz evaluates it (a
+        # fire-then-poll shape can watch the short window drain empty
+        # before the first poll)
+        stop_traffic = threading.Event()
+        sent = [0]
+
+        def traffic():
+            while not stop_traffic.is_set():
+                status, _ = post_explain(server.host, server.port,
+                                         rng.normal(size=(1, D)).astype(
+                                             np.float32), model="lin0")
+                if status == 200:
+                    sent[0] += 1
+                time.sleep(0.05)
+
+        feeder = threading.Thread(target=traffic, daemon=True)
+        feeder.start()
+        breached = False
+        deadline = time.monotonic() + 20.0
+        try:
+            while time.monotonic() < deadline and not breached:
+                doc = json.loads(http_get(server.host, server.port,
+                                          "/statusz?format=json"))
+                breached = any(s["name"] == "tenant:lin0_latency"
+                               and s["breached"] for s in doc["slos"])
+                if not breached:
+                    time.sleep(0.3)
+        finally:
+            stop_traffic.set()
+            feeder.join(timeout=5.0)
+        assert sent[0] >= requests // 2, f"only {sent[0]} answered"
+        dbg = json.loads(http_get(server.host, server.port, "/debugz"))
+        breach_ex = [e for e in dbg["exemplars"]
+                     if e["metric"] == "dks_tenant_latency_seconds"
+                     and e["labels"].get("model") == "lin0"
+                     and e["value"] > 0.005]
+        followable = round_trips = False
+        if breach_ex:
+            trace_id = breach_ex[0]["trace_id"]
+            spans = [s for s in tracer.spans() if s.trace_id == trace_id]
+            followable = any(s.name == "server.request" for s in spans)
+            restored = tracing.from_chrome_trace(tracing.chrome_trace(spans))
+            round_trips = (
+                len(restored) == len(spans)
+                and {s.span_id for s in restored}
+                == {s.span_id for s in spans}
+                and all(s.trace_id == trace_id for s in restored))
+        return {"slo_breached": breached,
+                "breach_exemplars": len(breach_ex),
+                "exemplar_trace_followable": followable,
+                "perfetto_round_trips": round_trips}
+    finally:
+        server.stop()
+        if not was_enabled:
+            tracer.disable()
+
+
+# --------------------------------------------------------------------- #
+
+
+def run_checks(result):
+    att = result["attribution"]
+    ovh = result["overhead"]
+    flz = result["fleet"]
+    exm = result["exemplar"]
+    return {
+        "attribution_sum_shared": att["shared"]["attribution_gap"] <= 0.05,
+        "attribution_sum_serialized":
+            att["serialized"]["attribution_gap"] <= 0.05,
+        "all_tenants_attributed": (
+            att["shared"]["all_tenants_attributed"]
+            and att["serialized"]["all_tenants_attributed"]),
+        "metering_overhead_le_1pct": ovh["overhead_frac"] <= 0.01,
+        "fleetz_equals_replica_sum": flz["rollup_matches_direct_sum"],
+        "federated_page_valid": flz["federated_page_valid"],
+        "slo_breach_exemplar_followable": (
+            exm["slo_breached"] and exm["breach_exemplars"] > 0
+            and exm["exemplar_trace_followable"]),
+        "perfetto_round_trips": exm["perfetto_round_trips"],
+    }
+
+
+def record(result, checks_ok, no_record=False):
+    if no_record:
+        return
+    from benchmarks.regression_gate import DEFAULT_HISTORY, record_run
+
+    record_run(
+        DEFAULT_HISTORY, "cost_attribution",
+        config={"bursts": result["config"]["bursts"],
+                "overhead_requests": result["config"]["overhead_requests"],
+                "tenants": [name for name, _ in ROSTER]},
+        metrics={"wall_s": result["wall_s"],
+                 # the metering-overhead sentinel perf-gate watches: the
+                 # metered arm's median request latency (a meter that
+                 # got expensive moves it)
+                 "metered_median_s": result["overhead"]["median_on_s"]},
+        extra={"checks_ok": checks_ok,
+               "attribution_gap_shared":
+                   result["attribution"]["shared"]["attribution_gap"],
+               "overhead_frac": result["overhead"]["overhead_frac"]})
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--check", action="store_true",
+                        help="exit nonzero unless every criterion holds")
+    parser.add_argument("--bursts", type=int, default=24)
+    parser.add_argument("--overhead-requests", type=int, default=400,
+                        help="requests per overhead arm (per-request "
+                             "on/off alternation on one server)")
+    parser.add_argument("--no-record", action="store_true",
+                        help="skip the perf-history self-record")
+    args = parser.parse_args()
+
+    t0 = time.monotonic()
+    clock = DispatchClock()
+    result = {"config": {"bursts": args.bursts,
+                         "overhead_requests": args.overhead_requests}}
+    result["attribution"] = run_attribution_arm(clock, bursts=args.bursts)
+    result["overhead"] = run_overhead_arm(
+        clock, requests=args.overhead_requests)
+    result["fleet"] = run_fleet_arm(clock)
+    result["exemplar"] = run_exemplar_arm(clock)
+    result["wall_s"] = round(time.monotonic() - t0, 2)
+    checks = run_checks(result)
+    result["checks"] = checks
+    checks_ok = all(checks.values())
+    result["checks_ok"] = checks_ok
+    record(result, checks_ok, no_record=args.no_record)
+    print(json.dumps(result))
+    if args.check and not checks_ok:
+        failed = [k for k, v in checks.items() if not v]
+        print(f"cost_attribution_bench: FAILED {failed}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

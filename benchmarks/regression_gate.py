@@ -160,6 +160,12 @@ def _threshold_for(metric: str, max_wall: float,
         # open-loop trace, so replica-seconds is the number a scaler
         # regression would move — gated as tightly as wall time
         return max_wall
+    if metric == "metered_median_s":
+        # the cost-attribution bench's metering-overhead sentinel: the
+        # metered arm's median request latency (a meter that got
+        # expensive moves it); a median is far more stable than a p99,
+        # so gate it like wall time
+        return max_wall
     if metric.endswith("p99_s"):
         return max_p99
     return None  # informational metric: recorded, never gated

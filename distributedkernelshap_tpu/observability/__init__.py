@@ -55,6 +55,13 @@ from distributedkernelshap_tpu.observability.alerts import (  # noqa: F401
 from distributedkernelshap_tpu.observability.flightrec import (  # noqa: F401
     FlightRecorder,
 )
+from distributedkernelshap_tpu.observability.costmeter import (  # noqa: F401
+    CostMeter,
+)
+from distributedkernelshap_tpu.observability.fleet import (  # noqa: F401
+    fleet_rollup,
+    merge_expositions,
+)
 from distributedkernelshap_tpu.observability.slo import (  # noqa: F401
     AvailabilitySLO,
     BurnRateWindow,
@@ -63,6 +70,7 @@ from distributedkernelshap_tpu.observability.slo import (  # noqa: F401
     StalenessSLO,
     default_proxy_slos,
     default_server_slos,
+    tenant_slos,
 )
 from distributedkernelshap_tpu.observability.statusz import (  # noqa: F401
     HealthEngine,

@@ -227,6 +227,32 @@ class AlertManager:
                 raise ValueError(f"duplicate alert rule {rule.name!r}")
             self._alerts[rule.name] = _AlertInstance(rule)
 
+    def set_rules(self, rules: Sequence[AlertRule]) -> None:
+        """Replace the rule set wholesale (the health engine's dynamic
+        SLO refresh: tenant SLOs come and go with registry hot-swaps).
+        Rules whose NAME survives keep their alert-instance state — a
+        firing alert must not silently reset to inactive because an
+        unrelated tenant registered; removed rules drop with their
+        state."""
+
+        rules = list(rules)
+        names = [r.name for r in rules]
+        if len(set(names)) != len(names):
+            # validated BEFORE any mutation: a bad rule list must leave
+            # the current set (and its alert state) fully untouched
+            dup = next(n for n in names if names.count(n) > 1)
+            raise ValueError(f"duplicate alert rule {dup!r}")
+        with self._lock:
+            replacement: Dict[str, _AlertInstance] = {}
+            for rule in rules:
+                inst = self._alerts.get(rule.name)
+                if inst is not None:
+                    inst.rule = rule
+                    replacement[rule.name] = inst
+                else:
+                    replacement[rule.name] = _AlertInstance(rule)
+            self._alerts = replacement
+
     def silence(self, pattern: str, duration_s: float,
                 now: Optional[float] = None) -> Silence:
         """Suppress sink notifications for rules matching ``pattern``

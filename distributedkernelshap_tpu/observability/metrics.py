@@ -38,9 +38,16 @@ import logging
 import math
 import re
 import threading
+import time
+from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 logger = logging.getLogger(__name__)
+
+#: default last-K exemplars kept per histogram bucket (bounded: the
+#: exemplar store can never grow a serving process — K recent trace ids
+#: per bucket per series, nothing more)
+DEFAULT_EXEMPLAR_SLOTS = 4
 
 _METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -96,6 +103,13 @@ class _Metric:
         self._lock = threading.Lock()
         self._values: Dict[Tuple[str, ...], float] = {}
         self._fn: Optional[Callable] = None
+        # cardinality declaration (the obs-check label-cardinality lint):
+        # metrics carrying tenant-shaped labels (``model``) must either
+        # declare a hard series cap (``bound_cardinality``) or a retire
+        # hook (``MetricsRegistry.declare_retirement``) so deleted
+        # tenants cannot grow the label space forever.  ``None`` = no
+        # declaration (fails the lint for model-labeled metrics).
+        self.cardinality: Optional[str] = None
         if not self.labelnames:
             # an unlabeled metric renders from birth (``..._total 0``) —
             # scrapers and the string assertions in the test suite expect
@@ -137,6 +151,45 @@ class _Metric:
 
         return self._sampled().get(self._key(labels), 0.0)
 
+    def bound_cardinality(self, bound: int) -> "_Metric":
+        """Declare a hard cap on this metric's distinct label values (the
+        writer enforces it, typically with an ``_overflow`` bucket); the
+        obs-check lint accepts either this or a retire hook for
+        model-labeled metrics."""
+
+        self.cardinality = f"capped({int(bound)})"
+        return self
+
+    def _match_positions(self, match: Dict[str, str]):
+        """``[(index, value), ...]`` for label names present in this
+        metric's schema, or ``None`` when any match key is unknown."""
+
+        positions = []
+        for ln, lv in match.items():
+            if ln not in self.labelnames:
+                return None
+            positions.append((self.labelnames.index(ln), str(lv)))
+        return positions
+
+    def retire_labels(self, match: Dict[str, str]) -> int:
+        """Drop every series whose label values match ``match`` (a subset
+        of the label schema — ``{"model": "m1"}`` retires all of m1's
+        series whatever the other labels).  Returns the count removed.
+        Callback-backed metrics are a no-op (their truth lives elsewhere;
+        the owner retires it at the source)."""
+
+        if self._fn is not None or not match:
+            return 0
+        positions = self._match_positions(match)
+        if positions is None:
+            return 0
+        with self._lock:
+            doomed = [k for k in self._values
+                      if all(k[i] == v for i, v in positions)]
+            for k in doomed:
+                del self._values[k]
+        return len(doomed)
+
     def render(self) -> List[str]:
         lines = [f"# HELP {self.name} {_escape_help(self.help)}",
                  f"# TYPE {self.name} {self.kind}"]
@@ -147,7 +200,8 @@ class _Metric:
 
     def describe(self) -> Dict[str, object]:
         return {"name": self.name, "type": self.kind,
-                "labels": list(self.labelnames), "help": self.help}
+                "labels": list(self.labelnames), "help": self.help,
+                "cardinality": self.cardinality}
 
     def collect(self) -> Dict[str, object]:
         """Structured snapshot for programmatic consumers (the time-series
@@ -216,19 +270,31 @@ class Histogram(_Metric):
     kind = "histogram"
 
     def __init__(self, name: str, help: str, buckets: Sequence[float],
-                 labelnames: Sequence[str] = ()):
+                 labelnames: Sequence[str] = (), exemplar_slots: int = 0):
         super().__init__(name, help, labelnames)
         if not buckets or list(buckets) != sorted(buckets):
             raise ValueError("histogram buckets must be sorted and non-empty")
         self.buckets = tuple(float(b) for b in buckets)
         # per-series state: ([per-bucket counts + +Inf slot], sum, count)
         self._series: Dict[Tuple[str, ...], List] = {}
+        # trace exemplars: last-K per (series, bucket) — an SLO breach on
+        # this histogram links straight to the trace ids that landed in
+        # its slow buckets.  0 disables (no storage, no overhead beyond
+        # one int compare per observe).  Not rendered into the text
+        # exposition (format 0.0.4 has no exemplar syntax); exposed via
+        # :meth:`exemplars` → ``/debugz`` and ``/fleetz``.
+        self.exemplar_slots = int(exemplar_slots)
+        self._exemplars: Dict[Tuple[Tuple[str, ...], int], deque] = {}
         if not self.labelnames:
             # like the scalar metrics: an unlabeled histogram renders its
             # (all-zero) buckets from birth
             self._series[()] = [[0] * (len(self.buckets) + 1), 0.0, 0]
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None,
+                **labels) -> None:
+        """Record one observation; ``exemplar`` (a trace id) is kept in
+        the observation's bucket when exemplar slots are enabled."""
+
         key = self._key(labels)
         with self._lock:
             state = self._series.get(key)
@@ -239,11 +305,54 @@ class Histogram(_Metric):
             for i, bound in enumerate(self.buckets):
                 if value <= bound:
                     counts[i] += 1
+                    slot = i
                     break
             else:
                 counts[-1] += 1
+                slot = len(self.buckets)
             state[1] += value
             state[2] += 1
+            if exemplar and self.exemplar_slots:
+                ring = self._exemplars.get((key, slot))
+                if ring is None:
+                    ring = self._exemplars[(key, slot)] = deque(
+                        maxlen=self.exemplar_slots)
+                ring.append((str(exemplar), float(value), time.time()))
+
+    def exemplars(self) -> List[Dict[str, object]]:
+        """Bounded exemplar snapshot: one entry per stored exemplar —
+        ``{"metric", "labels", "le", "trace_id", "value", "ts"}`` with
+        ``le`` the observation's bucket upper bound (``"+Inf"`` for the
+        overflow slot)."""
+
+        with self._lock:
+            snap = {k: list(v) for k, v in self._exemplars.items()}
+        out = []
+        for (key, slot), entries in snap.items():
+            le = ("+Inf" if slot >= len(self.buckets)
+                  else str(self.buckets[slot]))
+            labels = dict(zip(self.labelnames, key))
+            for trace_id, value, ts in entries:
+                out.append({"metric": self.name, "labels": labels,
+                            "le": le, "trace_id": trace_id,
+                            "value": value, "ts": ts})
+        return out
+
+    def retire_labels(self, match: Dict[str, str]) -> int:
+        if self._fn is not None or not match:
+            return 0
+        positions = self._match_positions(match)
+        if positions is None:
+            return 0
+        with self._lock:
+            doomed = [k for k in self._series
+                      if all(k[i] == v for i, v in positions)]
+            for k in doomed:
+                del self._series[k]
+            for ex_key in [ek for ek in self._exemplars
+                           if ek[0] in set(doomed)]:
+                del self._exemplars[ex_key]
+        return len(doomed)
 
     def value(self, **labels):
         key = self._key(labels)
@@ -324,12 +433,47 @@ class MetricsRegistry:
         return self._register(Gauge(name, help, labelnames))
 
     def histogram(self, name: str, help: str, buckets: Sequence[float],
-                  labelnames: Sequence[str] = ()) -> Histogram:
-        return self._register(Histogram(name, help, buckets, labelnames))
+                  labelnames: Sequence[str] = (),
+                  exemplar_slots: int = 0) -> Histogram:
+        return self._register(Histogram(name, help, buckets, labelnames,
+                                        exemplar_slots=exemplar_slots))
 
     def get(self, name: str) -> Optional[_Metric]:
         with self._lock:
             return self._metrics.get(name)
+
+    def declare_retirement(self, name: str) -> None:
+        """Declare that some owner retires this metric's stale label
+        values (``retire_labels`` on writes, or source-side removal for
+        callback metrics) — the obs-check cardinality lint's alternative
+        to a hard cap."""
+
+        metric = self.get(name)
+        if metric is None:
+            raise ValueError(f"declare_retirement: unknown metric {name}")
+        metric.cardinality = "retire-hook"
+
+    def retire_labels(self, name: str, match: Dict[str, str]) -> int:
+        """Drop every series of ``name`` whose labels match ``match``
+        (subset match); returns the count removed, 0 for unknown metrics
+        or label names — retiring is cleanup, never an error path."""
+
+        metric = self.get(name)
+        if metric is None:
+            return 0
+        return metric.retire_labels(match)
+
+    def exemplars(self) -> List[Dict[str, object]]:
+        """Every histogram's stored trace exemplars (bounded: last-K per
+        bucket per series) — the ``/debugz`` exemplar payload."""
+
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out: List[Dict[str, object]] = []
+        for m in metrics:
+            if isinstance(m, Histogram):
+                out.extend(m.exemplars())
+        return out
 
     def render(self) -> str:
         with self._lock:

@@ -206,12 +206,81 @@ CLASS_LATENCY_TARGETS: Dict[str, Tuple[float, float]] = {
     "best_effort": (60.0, 0.50),
 }
 
+#: default per-tenant objectives (the templated SLOs of
+#: :func:`tenant_slos`): latency over ``dks_tenant_latency_seconds`` —
+#: threshold must stay at or below that histogram's largest finite
+#: bucket, same contract as CLASS_LATENCY_TARGETS — and availability
+#: over the tenant request/error counter pair
+TENANT_LATENCY_TARGET: Tuple[float, float] = (0.5, 0.90)
+TENANT_AVAILABILITY_TARGET: float = 0.99
+
+#: bounded-cardinality guard on SLO templating: each tenant adds two
+#: SLOs (and two derived burn-rate rules + four dks_slo_* gauge series),
+#: all re-evaluated per health tick — a tenant flood must not turn the
+#: sampler tick into an O(tenants x windows) ring scan storm.  Tenants
+#: past the cap get no per-tenant SLO (logged once per refresh); the
+#: fleet-level class SLOs still cover their traffic.
+MAX_TENANT_SLOS = 32
+
+
+def tenant_slos(tenants: Sequence,
+                windows: Sequence[BurnRateWindow] = DEFAULT_WINDOWS,
+                latency_target: Tuple[float, float] = TENANT_LATENCY_TARGET,
+                availability_target: float = TENANT_AVAILABILITY_TARGET,
+                max_tenants: int = MAX_TENANT_SLOS) -> List[SLO]:
+    """Template per-tenant latency + availability objectives over the
+    cost meter's tenant families.  ``tenants`` holds model ids (or
+    ``(model_id, version)`` pairs — the version only names the SLO; the
+    underlying series are per-model, so a hot-swap keeps burning against
+    one history).  Bounded by ``max_tenants`` (see MAX_TENANT_SLOS)."""
+
+    slos: List[SLO] = []
+    seen = set()
+    for entry in tenants:
+        if isinstance(entry, (tuple, list)):
+            model_id, version = entry[0], entry[1]
+            label = f"{model_id}@v{version}"
+        else:
+            model_id, label = str(entry), str(entry)
+        if model_id in seen:
+            continue
+        seen.add(model_id)
+        if len(slos) // 2 >= max_tenants:
+            logger.warning(
+                "tenant SLO cap (%d) reached; %r (and later tenants) get "
+                "no per-tenant SLO — fleet-level class SLOs still apply",
+                max_tenants, model_id)
+            break
+        threshold_s, target = latency_target
+        slos.append(LatencySLO(
+            f"tenant:{model_id}_latency",
+            histogram="dks_tenant_latency_seconds",
+            labels={"model": model_id}, threshold_s=threshold_s,
+            target=target, windows=windows,
+            description=f"tenant {label} requests finishing within "
+                        f"{threshold_s:g}s"))
+        slos.append(AvailabilitySLO(
+            f"tenant:{model_id}_availability",
+            total="dks_tenant_requests_total",
+            bad="dks_tenant_errors_total",
+            total_labels={"model": model_id},
+            bad_labels={"model": model_id},
+            target=availability_target, windows=windows,
+            description=f"tenant {label} answered requests that are "
+                        f"not errors"))
+    return slos
+
 
 def default_server_slos(
-        windows: Sequence[BurnRateWindow] = DEFAULT_WINDOWS) -> List[SLO]:
+        windows: Sequence[BurnRateWindow] = DEFAULT_WINDOWS,
+        tenants: Sequence = ()) -> List[SLO]:
     """The server's standard SLO set: availability, one latency SLO per
-    priority class (over ``dks_serve_class_latency_seconds``), and an
-    in-flight staleness SLO feeding off the watchdog's progress gauge."""
+    priority class (over ``dks_serve_class_latency_seconds``), an
+    in-flight staleness SLO feeding off the watchdog's progress gauge,
+    and — multi-tenant gateways — per-tenant latency/availability
+    objectives templated for every id in ``tenants`` (bounded; see
+    :func:`tenant_slos`).  The server refreshes the tenant portion on
+    registry hot-swap/removal so stale tenants stop being evaluated."""
 
     slos: List[SLO] = [
         AvailabilitySLO(
@@ -232,6 +301,8 @@ def default_server_slos(
         "inflight_progress", gauge="dks_serve_last_progress_age_seconds",
         max_staleness_s=30.0, target=0.90, windows=windows,
         description="dispatched work progressing within 30s"))
+    if tenants:
+        slos.extend(tenant_slos(tenants, windows=windows))
     return slos
 
 

@@ -105,6 +105,10 @@ class HealthEngine:
         self.interval_s = float(interval_s)
         self.sampler = RegistrySampler(self.store, [registry],
                                        interval_s=self.interval_s)
+        # whether the rule set is DERIVED from the SLOs (one burn rule
+        # each): only then may set_slos rebuild it — an explicit rules
+        # override is the caller's contract and stays put
+        self._rules_derived = rules is None
         if rules is None:
             rules = [slo_burn_rule(slo) for slo in self.slos]
         if sinks is None:
@@ -169,6 +173,21 @@ class HealthEngine:
         them from the store."""
 
         return self._statuses(now)
+
+    def set_slos(self, slos: Sequence) -> None:
+        """Replace the evaluated SLO set at runtime (the server's
+        per-tenant SLO refresh on registry hot-swap/removal).  When the
+        alert rules were derived from the SLOs, they are rebuilt to
+        match — rules whose name survives keep their alert state (see
+        ``AlertManager.set_rules``); an explicit ``rules`` override is
+        left untouched.  The status memo is invalidated so the next
+        scrape/tick evaluates the new set."""
+
+        self.slos = list(slos)
+        if self._rules_derived:
+            self.alerts.set_rules([slo_burn_rule(slo) for slo in self.slos])
+        with self._status_lock:
+            self._status_cache = (0.0, None)
 
     def _budget_series(self) -> Dict[tuple, float]:
         out = {}

@@ -214,12 +214,24 @@ class Tracer:
         ``None`` reads ``DKS_TRACE_DIR``.  When set, every finished span
         is appended (flushed) to ``<dir>/spans-<pid>.jsonl`` so a
         SIGKILLed worker loses at most the span in flight.
+    sink_max_bytes, sink_max_age_s
+        Sink rotation bounds (``DKS_TRACE_MAX_BYTES`` — default 64 MiB —
+        and ``DKS_TRACE_MAX_AGE_S`` — default off).  A long-lived
+        replica's sink file used to grow without limit; when either
+        bound trips, the current file rotates to
+        ``spans-<pid>.jsonl.1`` (ONE kept generation — the previous
+        ``.1``'s spans are deleted and counted in
+        :attr:`sink_dropped_total`) and a fresh file opens.  The
+        per-span flush is unchanged, so the SIGKILL-safety contract
+        holds across rotations.  ``0`` disables the respective bound.
     """
 
     def __init__(self, capacity: int = 8192,
                  enabled: Optional[bool] = None,
                  proc: Optional[str] = None,
-                 sink_dir: Optional[str] = None):
+                 sink_dir: Optional[str] = None,
+                 sink_max_bytes: Optional[int] = None,
+                 sink_max_age_s: Optional[float] = None):
         if enabled is None:
             enabled = _truthy_env("DKS_TRACE")
         self.enabled = bool(enabled)
@@ -235,6 +247,25 @@ class Tracer:
                           else os.environ.get("DKS_TRACE_DIR") or None)
         self._sink_fh = None
         self._sink_broken = False
+        if sink_max_bytes is None:
+            sink_max_bytes = int(os.environ.get("DKS_TRACE_MAX_BYTES",
+                                                64 << 20) or 0)
+        if sink_max_age_s is None:
+            sink_max_age_s = float(os.environ.get("DKS_TRACE_MAX_AGE_S",
+                                                  0) or 0)
+        self.sink_max_bytes = max(0, int(sink_max_bytes))
+        self.sink_max_age_s = max(0.0, float(sink_max_age_s))
+        self._sink_bytes = 0
+        self._sink_spans = 0
+        self._sink_opened_mono = 0.0
+        # spans living in the kept ``.1`` generation: deleted (and folded
+        # into sink_dropped_total) when the NEXT rotation displaces it
+        self._rotated_spans = 0
+        self.sink_rotations_total = 0
+        #: spans this process wrote to the sink and later deleted by
+        #: rotation (the ``dks_trace_dropped_total`` source) — in-memory
+        #: like ``recorded_total``; other processes' files are untouched
+        self.sink_dropped_total = 0
 
     # ------------------------------------------------------------------ #
 
@@ -246,6 +277,35 @@ class Tracer:
         self.enabled = False
         return self
 
+    def _sink_path(self) -> str:
+        return os.path.join(self._sink_dir, f"spans-{os.getpid()}.jsonl")
+
+    def _maybe_rotate_sink(self) -> None:
+        """Rotate the sink file when a size/age bound trips (caller holds
+        the lock and owns an open sink).  ONE generation is kept: the
+        current file becomes ``.1``; the displaced ``.1``'s spans are
+        deleted and counted as dropped."""
+
+        over_bytes = (self.sink_max_bytes
+                      and self._sink_bytes >= self.sink_max_bytes)
+        over_age = (self.sink_max_age_s
+                    and time.monotonic() - self._sink_opened_mono
+                    >= self.sink_max_age_s)
+        if not (over_bytes or over_age):
+            return
+        path = self._sink_path()
+        self._sink_fh.close()
+        self._sink_fh = None
+        # the displaced kept generation is gone for good — its spans are
+        # the ones this rotation actually drops (os.replace overwrites)
+        if os.path.exists(path + ".1"):
+            self.sink_dropped_total += self._rotated_spans
+        os.replace(path, path + ".1")
+        self._rotated_spans = self._sink_spans
+        self._sink_bytes = 0
+        self._sink_spans = 0
+        self.sink_rotations_total += 1
+
     def _append(self, span: Span) -> None:
         with self._lock:
             self._buf.append(span)
@@ -254,11 +314,16 @@ class Tracer:
                 try:
                     if self._sink_fh is None:
                         os.makedirs(self._sink_dir, exist_ok=True)
-                        path = os.path.join(self._sink_dir,
-                                            f"spans-{os.getpid()}.jsonl")
-                        self._sink_fh = open(path, "a", encoding="utf-8")
-                    self._sink_fh.write(json.dumps(span.to_dict()) + "\n")
+                        self._sink_fh = open(self._sink_path(), "a",
+                                             encoding="utf-8")
+                        self._sink_bytes = self._sink_fh.tell()
+                        self._sink_opened_mono = time.monotonic()
+                    line = json.dumps(span.to_dict()) + "\n"
+                    self._sink_fh.write(line)
                     self._sink_fh.flush()
+                    self._sink_bytes += len(line)
+                    self._sink_spans += 1
+                    self._maybe_rotate_sink()
                 except OSError:
                     # a full/unwritable disk must not take serving down
                     self._sink_broken = True

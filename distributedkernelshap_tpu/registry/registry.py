@@ -365,13 +365,112 @@ class ModelRegistry:
                 # duplicate-version check) but a nightly-swapping tenant
                 # must not accumulate one full model per swap
                 prev.model = None
+                # stale label retirement: the retired version's
+                # version-labeled series (device-seconds) stop rendering
+                # — a nightly-swapping tenant must not grow the metric
+                # registry by one version's label set per swap
+                self._retire_tenant_labels(model_id, version=prev.version)
             else:
                 logger.warning(
                     "drain of %s did not complete within %.0fs (%d "
                     "requests still pinned); they will still answer on "
                     "their admitted version", prev.label,
                     self.drain_timeout_s, prev.inflight)
+        self._notify_server_roster_changed()
         return rm
+
+    def unregister(self, model_id: str,
+                   drain_timeout_s: Optional[float] = None) -> bool:
+        """Remove one tenant for good: the id leaves the roster FIRST
+        (no new resolves — routed requests 404 with the remaining
+        roster), the active version drains (in-flight pinned requests
+        still answer), its device caches drop, and every metric series
+        labeled with the tenant retires (``MetricsRegistry.
+        retire_labels`` via the cost meter) so deleted tenants stop
+        accumulating label space forever.  Returns whether the drain
+        completed inside the timeout (the removal happens either way;
+        an incomplete drain's stragglers still answer on their pinned
+        version)."""
+
+        with self._register_lock:
+            with self._lock:
+                entry = self._models.pop(model_id, None)
+                if entry is None:
+                    raise KeyError(f"unknown model id {model_id!r}")
+                self._order.remove(model_id)
+                if self.default_model_id == model_id:
+                    self.default_model_id = None
+                active = entry["active"]
+                if active is not None and active.share_key:
+                    n = self._share_counts.get(active.share_key, 0) - 1
+                    if n > 0:
+                        self._share_counts[active.share_key] = n
+                    else:
+                        self._share_counts.pop(active.share_key, None)
+                self._sheds = {k: v for k, v in self._sheds.items()
+                               if k[0] != model_id}
+                self._swaps.pop(model_id, None)
+            drained = True
+            if drain_timeout_s is None:
+                drain_timeout_s = self.drain_timeout_s
+            for rm in entry["versions"].values():
+                if rm.model is None:
+                    continue
+                rm.state = "draining"
+                if rm.drain(drain_timeout_s):
+                    rm.state = "retired"
+                    reset = getattr(rm.model, "reset", None)
+                    if reset is not None:
+                        try:
+                            reset()
+                        except Exception:
+                            logger.exception("reset of removed %s failed",
+                                             rm.label)
+                    rm.model = None
+                else:
+                    drained = False
+                    logger.warning(
+                        "unregister(%s): drain of %s incomplete (%d "
+                        "requests still pinned); they answer on their "
+                        "pinned version", model_id, rm.label, rm.inflight)
+            self._retire_tenant_labels(model_id)
+            self._flight.record("model_removed", model=model_id,
+                                drained=drained)
+            logger.info("unregistered %s (drained=%s)", model_id, drained)
+        self._notify_server_roster_changed()
+        return drained
+
+    def _retire_tenant_labels(self, model_id: str,
+                              version: Optional[int] = None) -> None:
+        """Drop a removed tenant's (or a retired version's) stale metric
+        label values on the attached server's registry — best-effort
+        cleanup; a failure is logged, never raised into the swap/remove
+        path."""
+
+        server = self._server
+        if server is None:
+            return
+        try:
+            meter = getattr(server, "_costmeter", None)
+            if meter is not None:
+                meter.retire_tenant(model_id, version=version)
+            if version is None:
+                server.metrics.retire_labels("dks_serve_padded_rows_total",
+                                             {"model": model_id})
+        except Exception:
+            logger.exception("label retirement for %s failed", model_id)
+
+    def _notify_server_roster_changed(self) -> None:
+        """Refresh the attached server's templated per-tenant SLOs after
+        a registration or removal (no-op without a server, or when the
+        operator pinned an explicit SLO set)."""
+
+        server = self._server
+        if server is None:
+            return
+        refresh = getattr(server, "_refresh_tenant_slos", None)
+        if refresh is not None:
+            refresh()
 
     @staticmethod
     def _deployment_path(model) -> Tuple[str, str]:

@@ -178,6 +178,10 @@ class CompileAccounting:
         # {(kind, signature): count}, {(kind, signature): seconds}
         self._counts: Dict[tuple, int] = {}
         self._seconds: Dict[tuple, float] = {}
+        # running scalar twin of sum(self._seconds.values()): the cost
+        # meter reads it twice per device dispatch, so it must not cost
+        # a dict scan
+        self._total_s = 0.0
         self._local = threading.local()
         self._listening = False
         self.supported = True
@@ -221,6 +225,7 @@ class CompileAccounting:
         with self._lock:
             self._counts[key] = self._counts.get(key, 0) + 1
             self._seconds[key] = self._seconds.get(key, 0.0) + float(duration)
+            self._total_s += float(duration)
         self._record_span(kind, sig, duration)
 
     def _record_span(self, kind: str, sig: str, duration: float) -> None:
@@ -255,6 +260,16 @@ class CompileAccounting:
             yield self
         finally:
             self._local.signature = prev
+
+    def total_seconds(self) -> float:
+        """Cumulative compile seconds across every kind and signature —
+        the cost meter's cheap per-dispatch read (an O(1) scalar under
+        the lock; ``snapshot()`` copies both dicts and builds per-kind
+        totals, too heavy to pay twice per device call)."""
+
+        self._ensure_listening()
+        with self._lock:
+            return self._total_s
 
     def snapshot(self) -> Dict[str, Dict]:
         """Structured copy of the counts: ``{"counts": {(kind, sig): n},

@@ -41,13 +41,19 @@ import subprocess
 import sys
 import threading
 import time
+import urllib.parse
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import distributedkernelshap_tpu.observability.tracing as _tracing
+from distributedkernelshap_tpu.observability import fleet as _fleet
 from distributedkernelshap_tpu.observability.flightrec import flightrec
-from distributedkernelshap_tpu.observability.metrics import MetricsRegistry
+from distributedkernelshap_tpu.observability.metrics import (
+    DEFAULT_EXEMPLAR_SLOTS,
+    MetricsRegistry,
+    parse_exposition,
+)
 from distributedkernelshap_tpu.observability.slo import default_proxy_slos
 from distributedkernelshap_tpu.observability.statusz import (
     HealthEngine,
@@ -218,7 +224,23 @@ class FanInProxy:
             "answers by priority class.",
             buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                      1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
-            labelnames=("class",))
+            labelnames=("class",),
+            exemplar_slots=DEFAULT_EXEMPLAR_SLOTS)
+        # federated fleet view (/fleetz, /metrics?federate=1): scrape
+        # accounting — per-replica failures already have their own
+        # attribution, so these stay unlabeled (bounded by construction)
+        self._m_fleet_scrapes = reg.counter(
+            "dks_fleet_scrapes_total",
+            "Federated scrape sweeps served (/fleetz and "
+            "/metrics?federate=1 each scrape every live replica).")
+        self._m_fleet_scrape_errors = reg.counter(
+            "dks_fleet_scrape_errors_total",
+            "Replica scrape failures during federated sweeps (the "
+            "replica's samples are missing from that rollup).")
+        self._m_fleet_scraped = reg.gauge(
+            "dks_fleet_replicas_scraped",
+            "Replicas whose exposition the last federated sweep "
+            "merged.")
         reg.gauge("dks_fanin_replica_up", "Replica liveness by index.",
                   labelnames=("replica", "address")).set_function(
             lambda: {(str(r.index), r.address): int(r.alive)
@@ -274,13 +296,124 @@ class FanInProxy:
 
     # ------------------------------------------------------------------ #
 
-    def _observe_latency(self, klass: str, seconds: float) -> None:
+    def _observe_latency(self, klass: str, seconds: float,
+                         exemplar: Optional[str] = None) -> None:
         """One successful answer's end-to-end latency: feeds the hedge
         policy's sliding quantiles AND the per-class histogram the
-        autoscaler's SLO burn rate reads."""
+        autoscaler's SLO burn rate reads; ``exemplar`` (the request's
+        trace id, when tracing is on) lands in the observation's bucket
+        so a proxy-side SLO breach links to a concrete trace."""
 
         self._latency.observe(klass, seconds)
-        self._m_class_latency.observe(seconds, **{"class": klass})
+        self._m_class_latency.observe(seconds, exemplar=exemplar,
+                                      **{"class": klass})
+
+    # -- federated fleet view (/fleetz, /metrics?federate=1) ------------ #
+
+    def _fleet_scrape_pool(self) -> ThreadPoolExecutor:
+        """Lazy small pool for federated sweeps: replicas are scraped
+        CONCURRENTLY so one slow member costs the sweep one timeout, not
+        the sum over the fleet (the /fleetz handler — which the
+        autoscaler may poll — blocks for the sweep's duration).  Pooled
+        forward connections are per-thread, so the fixed worker set also
+        keeps keep-alive sockets warm across sweeps."""
+
+        pool = getattr(self, "_fleet_pool", None)
+        if pool is None:
+            pool = self._fleet_pool = ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="dks-fleet")
+        return pool
+
+    def _scrape_replicas(self, timeout_s: float = 5.0,
+                         with_debugz: bool = False):
+        """One federated sweep: fetch ``/metrics`` (and, for the rollup,
+        ``/debugz`` exemplars) from every scrapable replica (alive,
+        draining or standby — a drain victim's tallies still belong in
+        the rollup; down/retired replicas are skipped), concurrently
+        over the pooled connections.  Returns ``({replica_index: body},
+        {replica_index: meta}, {replica_index: exemplars})``; failures
+        are counted and the replica simply missing from that sweep."""
+
+        targets = [r for r in list(self.replicas)
+                   if not r.retired and (r.alive or r.draining or r.standby)]
+        meta = {str(r.index): {"address": r.address, "state": r.state(),
+                               "scraped": False} for r in targets}
+        pages: Dict[str, bytes] = {}
+        exemplars: Dict[str, List[Dict]] = {}
+
+        def scrape(r):
+            key = str(r.index)
+            try:
+                status, body, _ = self._forward("GET", "/metrics", b"", r,
+                                                timeout_s=timeout_s)
+            except (OSError, http.client.HTTPException):
+                self._m_fleet_scrape_errors.inc()
+                return
+            if status != 200:
+                self._m_fleet_scrape_errors.inc()
+                return
+            pages[key] = body
+            meta[key]["scraped"] = True
+            if not with_debugz:
+                return
+            try:
+                status, body, _ = self._forward("GET", "/debugz", b"", r,
+                                                timeout_s=timeout_s)
+                if status == 200:
+                    doc = json.loads(body)
+                    if isinstance(doc.get("exemplars"), list):
+                        exemplars[key] = doc["exemplars"]
+            except (OSError, http.client.HTTPException, ValueError):
+                pass  # exemplars are garnish; the rollup stands without
+        if targets:
+            list(self._fleet_scrape_pool().map(scrape, targets))
+        self._m_fleet_scrapes.inc()
+        self._m_fleet_scraped.set(len(pages))
+        return pages, meta, exemplars
+
+    def federated_metrics(self) -> str:
+        """The ``/metrics?federate=1`` page: every scrapable replica's
+        exposition merged into one compliant page with a ``replica``
+        label (``observability/fleet.merge_expositions``; merge rules —
+        incl. conflicting-TYPE handling — documented there).  The
+        proxy's OWN series stay on the plain ``/metrics``."""
+
+        pages, meta, _ = self._scrape_replicas()
+        text, report = _fleet.merge_expositions(
+            {k: pages[k].decode("utf-8", errors="replace")
+             for k in sorted(pages, key=int)})
+        for fam, replica, kind in report["type_conflicts"]:
+            logger.warning("federate: replica %s declares %s as %s, "
+                           "conflicting with the merged page; its "
+                           "samples were dropped", replica, fam, kind)
+        for replica, error in report["parse_failures"]:
+            # same operator signal as a failed scrape: the replica's
+            # samples are missing from this page
+            self._m_fleet_scrape_errors.inc()
+            logger.warning("federate: replica %s served an unparseable "
+                           "exposition (%s); its samples were dropped",
+                           replica, error)
+        return text
+
+    def fleet_rollup(self) -> Dict:
+        """The ``/fleetz`` document: per-tenant cost rollups summed over
+        one fresh sweep of the fleet's ``/metrics`` + ``/debugz`` trace
+        exemplars, schema in ``observability/fleet.fleet_rollup`` /
+        docs/OBSERVABILITY.md.  Exposed as a method so the autoscaler
+        (or an EDF-packing policy) can consume the same rollup the
+        operator sees."""
+
+        pages, meta, exemplars = self._scrape_replicas(with_debugz=True)
+        parsed: Dict[str, Dict] = {}
+        for key, body in pages.items():
+            try:
+                parsed[key] = parse_exposition(
+                    body.decode("utf-8", errors="replace"))
+            except ValueError:
+                self._m_fleet_scrape_errors.inc()
+                meta[key]["scraped"] = False
+        return _fleet.fleet_rollup(parsed, exemplars=exemplars,
+                                   replica_meta=meta)
 
     # -- elastic membership (serving/autoscaler.py) --------------------- #
 
@@ -530,7 +663,10 @@ class FanInProxy:
                 result = self._route_explain(method, body, headers, klass,
                                              span_parent=root)
                 if result[0] == 200:
-                    self._observe_latency(klass, time.monotonic() - t0)
+                    self._observe_latency(
+                        klass, time.monotonic() - t0,
+                        exemplar=root.trace_id if root is not None
+                        else None)
             else:
                 result = self._handle_hedged(method, body, headers, klass,
                                              root=root)
@@ -614,7 +750,9 @@ class FanInProxy:
             self._m_hedge_wins.inc()
             self._flight.record("hedge_win", klass=klass)
         if res[0] == 200:
-            self._observe_latency(klass, lat)
+            self._observe_latency(klass, lat,
+                                  exemplar=root.trace_id if root is not None
+                                  else None)
         return res
 
     def _replica_failed(self, replica: _Replica) -> None:
@@ -998,12 +1136,32 @@ class FanInProxy:
                                     if r.standby]}).encode())
                     return
                 if route == "/metrics":
+                    # a real parameter match, not a substring scan:
+                    # ?federate=10 or ?unfederate=1 must NOT trigger an
+                    # N-replica scrape sweep
+                    federate = urllib.parse.parse_qs(
+                        query or "").get("federate", [])
+                    if federate and federate[-1] == "1":
+                        # the federated page: every replica's exposition
+                        # merged under a replica label (fleet view)
+                        self._reply(200, proxy.federated_metrics().encode(),
+                                    ctype="text/plain; version=0.0.4")
+                        return
                     self._reply(200, proxy._render_metrics().encode(),
                                 ctype="text/plain; version=0.0.4")
                     return
+                if route == "/fleetz":
+                    # the interpreted per-tenant cost rollup (JSON;
+                    # schema in docs/OBSERVABILITY.md)
+                    self._reply(200, json.dumps(proxy.fleet_rollup(),
+                                                default=repr).encode())
+                    return
                 if route == "/debugz":
-                    self._reply(200, json.dumps(
-                        proxy._flight.to_payload()).encode())
+                    payload = proxy._flight.to_payload()
+                    # trace exemplars from the proxy's own latency
+                    # histogram (replica exemplars ride /fleetz)
+                    payload["exemplars"] = proxy.metrics.exemplars()
+                    self._reply(200, json.dumps(payload).encode())
                     return
                 if route != "/explain":
                     self._reply(404, json.dumps(
@@ -1075,6 +1233,9 @@ class FanInProxy:
             # wait=False: a pass stuck in a transport timeout must not
             # stall shutdown; its thread is bounded by those timeouts
             self._hedge_pool.shutdown(wait=False)
+        pool = getattr(self, "_fleet_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=False)  # scrapes are timeout-bounded too
 
     def __enter__(self):
         return self.start()

@@ -46,8 +46,15 @@ import numpy as np
 
 import distributedkernelshap_tpu.observability.tracing as _tracing
 import distributedkernelshap_tpu.serving.wire as _wire
+from distributedkernelshap_tpu.observability.costmeter import (
+    CostMeter,
+    dispatch_shares,
+)
 from distributedkernelshap_tpu.observability.flightrec import flightrec
-from distributedkernelshap_tpu.observability.metrics import MetricsRegistry
+from distributedkernelshap_tpu.observability.metrics import (
+    DEFAULT_EXEMPLAR_SLOTS,
+    MetricsRegistry,
+)
 from distributedkernelshap_tpu.observability.slo import default_server_slos
 from distributedkernelshap_tpu.observability.statusz import (
     HealthEngine,
@@ -253,6 +260,18 @@ def resolve_shared_batch_env(default: bool) -> bool:
     return resolve_bool_env("DKS_SHARED_BATCH", default)
 
 
+def resolve_cost_meter_env(default: bool) -> bool:
+    """The ONE ``DKS_COST_METER`` parser (same contract as
+    :func:`resolve_warmup_env`).  ``DKS_COST_METER=0`` disables the
+    per-tenant device-time meter's write path (the metric families
+    still register, frozen at zero) — the cost-attribution bench's
+    control arm for its ≤1% overhead criterion."""
+
+    from distributedkernelshap_tpu.utils import resolve_bool_env
+
+    return resolve_bool_env("DKS_COST_METER", default)
+
+
 class _TenantGrouping:
     """Adapter between the server's tenant facts and the scheduler's
     grouped batch formation (``SLOScheduler._fill_grouped``): ``key`` maps
@@ -448,6 +467,19 @@ class ExplainerServer:
         active-tenant count capped at 4 — a cycle's tenant groups upload
         while earlier groups compute, instead of the batcher blocking
         after staging one group.
+    cost_metering
+        Per-tenant device-time metering + tenant cost counters
+        (``observability/costmeter.py``; docs/OBSERVABILITY.md "Cost
+        attribution & fleet view"): every dispatched device call is
+        bracketed dispatch→fetch on the monotonic clock (compile time
+        excluded via the compile accountant) and prorated across the
+        batch's tenants by row share into
+        ``dks_device_seconds_total{model,version,path}``, alongside
+        per-tenant rows / wire bytes / shed / cache-hit / latency
+        accounting.  ``None`` (default) resolves from ``DKS_COST_METER``
+        (ON unless falsy); ``False`` freezes the families at zero with
+        no write-path bookkeeping.  Single-model servers attribute to
+        ``model="default"``.
     """
 
     def __init__(self, model=None, host: str = "0.0.0.0", port: int = 8000,
@@ -470,6 +502,7 @@ class ExplainerServer:
                  staging: Optional[bool] = None,
                  shared_batching: Optional[bool] = None,
                  staging_depth: Optional[int] = None,
+                 cost_metering: Optional[bool] = None,
                  registry=None):
         # multi-tenant gateway mode (registry/registry.py): requests route
         # by X-DKS-Model (or the JSON/wire `model` field) to the named
@@ -555,15 +588,32 @@ class ExplainerServer:
         self.metrics = MetricsRegistry()
         self._flight = flightrec()
         self._tracer = _tracing.tracer()
+        # tenant cost-attribution plane (observability/costmeter.py):
+        # device-seconds per (model, version, path) + tenant counters,
+        # registered with everything else so the catalog is
+        # mode-independent; DKS_COST_METER=0 freezes the write path
+        if cost_metering is None:
+            cost_metering = resolve_cost_meter_env(default=True)
+        self._costmeter = CostMeter(enabled=bool(cost_metering))
         self._register_metrics()
         # SLO health engine (observability/statusz.py): samples the
         # registry into a bounded time-series store, evaluates burn-rate
         # SLOs + alert rules on the same tick, serves /statusz.  Built in
         # __init__ (not start()) so the dks_slo_*/dks_alerts_* series
         # register alongside the rest and obs-check sees them.
+        # With the default SLO set (slos=None) a registry-mode server
+        # additionally templates per-tenant latency/availability
+        # objectives for the current roster and REFRESHES them on
+        # registration/removal (_refresh_tenant_slos) — an explicit
+        # slos= override opts out of both.
+        self._auto_slos = slos is None
+        if slos is None:
+            slos = default_server_slos(
+                tenants=registry.model_ids() if registry is not None
+                else ())
         self.health = HealthEngine(
             self.metrics, component="server",
-            slos=default_server_slos() if slos is None else slos,
+            slos=slos,
             rules=alert_rules, sinks=alert_sinks, flight=self._flight,
             interval_s=health_interval_s,
             spark_names=("dks_serve_requests_total",
@@ -683,10 +733,17 @@ class ExplainerServer:
             "Bucket-padding rows dispatched to the device per model "
             "(rows the engine padded on top of real request rows).",
             labelnames=("model",))
+        # model-labeled: retired by ModelRegistry.unregister (the
+        # obs-check cardinality lint's retire-hook declaration)
+        reg.declare_retirement("dks_serve_padded_rows_total")
+        # latency histograms carry trace exemplars (last-K per bucket):
+        # an SLO breach on /statusz links to the trace ids that landed
+        # in the slow buckets (/debugz "exemplars")
         self._m_latency = reg.histogram(
             "dks_serve_request_latency_seconds",
             "Queue+explain latency of answered requests.",
-            buckets=LATENCY_BUCKETS_S)
+            buckets=LATENCY_BUCKETS_S,
+            exemplar_slots=DEFAULT_EXEMPLAR_SLOTS)
         # per-priority-class latency: the input the per-class latency
         # SLOs (observability/slo.py CLASS_LATENCY_TARGETS) burn against.
         # A separate family — adding a label to the unlabeled histogram
@@ -695,7 +752,19 @@ class ExplainerServer:
             "dks_serve_class_latency_seconds",
             "Queue+explain latency of answered requests by priority "
             "class.",
-            buckets=LATENCY_BUCKETS_S, labelnames=("class",))
+            buckets=LATENCY_BUCKETS_S, labelnames=("class",),
+            exemplar_slots=DEFAULT_EXEMPLAR_SLOTS)
+        # tenant cost attribution (observability/costmeter.py):
+        # dks_device_seconds_total + the dks_tenant_* families
+        self._costmeter.attach_metrics(reg)
+        # trace-sink rotation accounting (observability/tracing.py):
+        # spans this process deleted from its DKS_TRACE_DIR sink
+        reg.counter(
+            "dks_trace_dropped_total",
+            "Spans deleted from this process's DKS_TRACE_DIR JSONL sink "
+            "by size/age rotation (one rotated generation is kept; "
+            "older ones drop with their spans).").set_function(
+            lambda: float(self._tracer.sink_dropped_total))
         # the watchdog's progress view, made continuous for the staleness
         # SLO: seconds since dispatched work last progressed, 0 when idle
         # (an idle server is not stalling)
@@ -841,6 +910,14 @@ class ExplainerServer:
             "counts too; value N means N-1 hot swaps).",
             labelnames=("model",)).set_function(
             from_registry("metric_swaps"))
+        # all callback-sourced from the registry, whose unregister()
+        # removes a tenant at the source — the cardinality lint's
+        # retire-hook declaration for these model-labeled families
+        for name in ("dks_registry_models", "dks_registry_requests_total",
+                     "dks_registry_request_seconds_total",
+                     "dks_registry_inflight", "dks_registry_sheds_total",
+                     "dks_registry_swaps_total"):
+            reg.declare_retirement(name)
 
     def _count_request(self, pending, error=None):
         """Per-request counter accounting, shared by _complete's live loop
@@ -856,8 +933,19 @@ class ExplainerServer:
              else self._m_cache_misses).inc()
         elapsed = time.monotonic() - pending.t_enqueued
         self._m_request_seconds.inc(elapsed)
-        self._m_latency.observe(elapsed)
-        self._m_class_latency.observe(elapsed, **{"class": pending.klass})
+        # the request's trace id rides as a bucket exemplar so an SLO
+        # breach links straight to followable traces (None when tracing
+        # is off — exemplar storage then never engages)
+        exemplar = pending.trace.trace_id if pending.trace else None
+        self._m_latency.observe(elapsed, exemplar=exemplar)
+        self._m_class_latency.observe(elapsed, exemplar=exemplar,
+                                      **{"class": pending.klass})
+        # per-tenant cost accounting (model="default" in single-model
+        # mode): requests / errors / rows / cache hits / latency
+        self._costmeter.record_answer(
+            pending.model.model_id if pending.model is not None else None,
+            pending.array.shape[0], elapsed, error is not None,
+            pending.cache_hit, exemplar=exemplar)
         if pending.model is not None:
             # per-tenant accounting on the version that ADMITTED the
             # request (hot-swap safe: the pin, not the active pointer)
@@ -888,8 +976,12 @@ class ExplainerServer:
         # historical unsuffixed form — pre-PR-6 cache semantics unchanged.
         return key if wire_format == "json" else f"{key}#{wire_format}"
 
-    def _shed(self, reason: str) -> None:
+    def _shed(self, reason: str, rm=None) -> None:
         self._m_sheds.inc(reason=reason)
+        # per-tenant attribution of the same shed (model="default" when
+        # no tenant routed — single-model mode)
+        self._costmeter.record_shed(
+            rm.model_id if rm is not None else None, reason)
         self._flight.record("shed", component="server", reason=reason)
 
     def _fail_request(self, pending, error: str, status: int) -> None:
@@ -922,7 +1014,15 @@ class ExplainerServer:
     def _complete(self, batch, payloads=None, error=None, status: int = 500,
                   index_map=None, device_rows: int = 0,
                   t_dispatch: Optional[float] = None,
-                  t_fetch: Optional[float] = None, span_attrs=None):
+                  t_fetch: Optional[float] = None, span_attrs=None,
+                  cost=None):
+        # tenant device-time attribution FIRST (no lock needed): the
+        # fetch completing IS the block-until-ready boundary, so the
+        # bracket closes at t_fetch even when the watchdog already
+        # claimed the requests — the device work was genuinely consumed
+        # and must bill its tenants either way
+        if cost is not None and error is None and t_fetch is not None:
+            self._costmeter.settle(cost[0], cost[1], t_end=t_fetch)
         # counters update BEFORE the response events: a client that gets
         # its answer and immediately scrapes /metrics must see itself
         # counted.  Claiming happens under the metrics lock so a batch the
@@ -1351,6 +1451,25 @@ class ExplainerServer:
                     compile_summary["cache_hit"],
                     compile_summary["seconds"])
 
+    def _refresh_tenant_slos(self) -> None:
+        """Re-template the per-tenant SLO set from the registry's
+        current roster (``ModelRegistry`` calls this after every
+        registration and removal).  Only with the DEFAULT SLO set — an
+        explicit ``slos=`` override is the operator's contract and is
+        never rewritten.  Surviving SLOs keep their alert state (see
+        ``HealthEngine.set_slos``); a removed tenant's SLOs stop being
+        evaluated, which is the stale-label retirement's SLO-layer
+        twin."""
+
+        if self._registry is None or not self._auto_slos:
+            return
+        try:
+            self.health.set_slos(default_server_slos(
+                tenants=self._registry.model_ids()))
+        except Exception:
+            logger.exception("per-tenant SLO refresh failed; the previous "
+                             "SLO set stays in effect")
+
     def _group_key_for(self, rm):
         """The dispatch-group identity of a pinned tenant version:
         ``("share", key)`` for shared-program-eligible deployments when
@@ -1397,7 +1516,7 @@ class ExplainerServer:
         for p in expired:
             # the declared SLO is already missed: answering late would
             # waste a device slot on a response the client has abandoned
-            self._shed("deadline_expired")
+            self._shed("deadline_expired", rm=p.model)
             if tr.enabled and p.trace is not None:
                 tr.record_mono("server.queue_wait", p.t_enqueued,
                                t_claim, parent=p.trace, expired=True)
@@ -1473,6 +1592,15 @@ class ExplainerServer:
         span_attrs = {"path": getattr(model, "explain_path", None)}
         if shared is not None:
             span_attrs["shared"] = bool(shared)
+        # cost-attribution bracket: monotonic + compile-seconds snapshot
+        # opened just before the device call, settled at fetch; shares =
+        # per-tenant (model, version, path, rows) from the leaders (the
+        # split_sizes view) so a shared cross-tenant batch prorates by
+        # row share
+        cost_tx = self._costmeter.begin()
+        cost = ((cost_tx, dispatch_shares(leaders,
+                                          default_path=span_attrs["path"]))
+                if cost_tx is not None else None)
         if tr.enabled:
             for p in live:
                 if p.trace is not None:
@@ -1509,7 +1637,7 @@ class ExplainerServer:
                         split_sizes=sizes, **kwargs)
                 self._inflight.put((live, finalize, index_map,
                                     device_rows, t_dispatch,
-                                    batch_ctx, span_attrs))
+                                    batch_ctx, span_attrs, cost))
             else:
                 with _tracing.use_context(batch_ctx):
                     payloads = model.explain_batch(
@@ -1518,7 +1646,8 @@ class ExplainerServer:
                     live, payloads,
                     index_map=index_map, device_rows=device_rows,
                     t_dispatch=t_dispatch,
-                    t_fetch=time.monotonic(), span_attrs=span_attrs)
+                    t_fetch=time.monotonic(), span_attrs=span_attrs,
+                    cost=cost)
         except Exception as e:  # surface errors to waiting requests
             logger.exception("explain batch failed")
             self._complete(live, error=str(e))
@@ -1669,8 +1798,8 @@ class ExplainerServer:
         while not (self._dispatch_done.is_set() and self._inflight.empty()):
             try:
                 (batch, finalize, index_map, device_rows,
-                 t_dispatch, batch_ctx,
-                 span_attrs) = self._inflight.get(timeout=0.1)
+                 t_dispatch, batch_ctx, span_attrs,
+                 cost) = self._inflight.get(timeout=0.1)
             except queue.Empty:
                 continue
             try:
@@ -1680,7 +1809,7 @@ class ExplainerServer:
                                device_rows=device_rows,
                                t_dispatch=t_dispatch,
                                t_fetch=time.monotonic(),
-                               span_attrs=span_attrs)
+                               span_attrs=span_attrs, cost=cost)
             except Exception as e:
                 logger.exception("finalize batch failed")
                 self._complete(batch, error=str(e))
@@ -1842,7 +1971,7 @@ class ExplainerServer:
                 self.end_headers()
                 self.wfile.write(data)
 
-            def _reply_explain_ok(self, body):
+            def _reply_explain_ok(self, body, rm=None):
                 """Success reply for /explain, routed through the chaos
                 site ``server.explain``: crash/hang/slow happen inside
                 ``fire``; ``drop`` closes the socket without replying
@@ -1870,6 +1999,9 @@ class ExplainerServer:
                 server._m_wire_bytes.inc(
                     len(body), format="binary" if binary else "json",
                     direction="tx")
+                server._costmeter.record_wire(
+                    rm.model_id if rm is not None else None, "tx",
+                    len(body))
                 if action != "corrupt":
                     self._reply(200, body, ctype=ctype)
                     return
@@ -1904,8 +2036,13 @@ class ExplainerServer:
                     return
                 if route == "/debugz":
                     # the flight recorder's ring: bounded, thread-safe, the
-                    # first artifact to pull when a chaos run goes sideways
-                    self._reply(200, json.dumps(server._flight.to_payload()))
+                    # first artifact to pull when a chaos run goes
+                    # sideways — plus the latency histograms' trace
+                    # exemplars (bounded, last-K per bucket), so an SLO
+                    # breach on /statusz links straight to trace ids
+                    payload = server._flight.to_payload()
+                    payload["exemplars"] = server.metrics.exemplars()
+                    self._reply(200, json.dumps(payload))
                     return
                 if route == "/statusz":
                     # the interpreted health page: SLO budgets, alert
@@ -1977,15 +2114,21 @@ class ExplainerServer:
                         return
                     model = rm.model
                 try:
-                    self._explain_resolved(array, rm, model)
+                    self._explain_resolved(array, rm, model, len(body))
                 finally:
                     if rm is not None:
                         rm.release()
 
-            def _explain_resolved(self, array, rm, model):
+            def _explain_resolved(self, array, rm, model, body_len=0):
                 """The /explain path once the tenant (if any) is resolved
                 and pinned: negotiation, SLO headers, admission, enqueue,
                 reply.  The caller owns releasing the pin."""
+
+                # per-tenant request bytes, attributable only now that
+                # routing resolved (the format-labeled fleet counter
+                # already moved in _handle)
+                server._costmeter.record_wire(
+                    rm.model_id if rm is not None else None, "rx", body_len)
 
                 # response negotiation: binary only on an EXPLICIT Accept
                 # and only when the served model can encode it — otherwise
@@ -2069,7 +2212,7 @@ class ExplainerServer:
                     cached = server._cache.get(pending.cache_key)
                     if cached is not None:
                         server._answer_cached(pending, cached)
-                        self._reply_explain_ok(cached)
+                        self._reply_explain_ok(cached, rm=rm)
                         return
                 # admission control: shed NOW (429 + Retry-After) rather
                 # than letting an unservable request time out in the queue
@@ -2086,7 +2229,7 @@ class ExplainerServer:
                                  else server._sched.queued_rows()))
                     if server._admission is not None else True)
                 if not decision:
-                    server._shed(decision.reason)
+                    server._shed(decision.reason, rm=rm)
                     retry_s = max(1, int(math.ceil(decision.retry_after_s)))
                     self._reply(429, json.dumps({
                         "error": f"request shed ({decision.reason}); "
@@ -2104,7 +2247,7 @@ class ExplainerServer:
                     ok, reason, retry = server._registry.admit(
                         rm, exclude_self=True)
                     if not ok:
-                        server._shed(reason)
+                        server._shed(reason, rm=rm)
                         self._reply(429, json.dumps({
                             "error": f"request shed ({reason}) for model "
                                      f"{rm.model_id!r}; retry after "
@@ -2162,7 +2305,7 @@ class ExplainerServer:
                     self._reply(pending.status_code or 500,
                                 json.dumps({"error": pending.error}))
                 else:
-                    self._reply_explain_ok(pending.response)
+                    self._reply_explain_ok(pending.response, rm=rm)
 
             # the reference clients issue GETs with a JSON body
             # (serve_explanations.py:111); accept both verbs

@@ -1,7 +1,7 @@
 """Observability drift linter (``make obs-check``).
 
 New metrics must not drift undocumented and must not bypass the central
-registry.  Three checks, exit 1 on any failure:
+registry.  Four checks, exit 1 on any failure:
 
 1. **Catalog diff** — the live registries' self-description (every
    ``dks_*`` series the server, fan-in proxy, scheduler and profiler
@@ -16,6 +16,10 @@ registry.  Three checks, exit 1 on any failure:
 3. **Renderer scan** — no Prometheus exposition rendering (``# HELP`` /
    ``# TYPE`` string literals) outside ``observability/metrics.py``: the
    registry is the ONE renderer.
+4. **Label-cardinality lint** — every registered metric with a ``model``
+   label must declare a cardinality cap (``bound_cardinality``) or a
+   retire hook (``declare_retirement``): tenant churn must not grow the
+   registry forever.
 
 Run ``python scripts/obs_check.py --print-catalog`` to emit the markdown
 table for the docs after adding a metric.
@@ -45,10 +49,15 @@ DOCS_PATH = os.path.join(REPO_ROOT, "docs", "OBSERVABILITY.md")
 #: cross-tenant batching series (``dks_serve_batch_groups``,
 #: ``dks_serve_padded_rows_total``) ride the existing ``serve`` prefix.
 #: (``deepshap`` joined when the deep-model attribution engine landed
-#: its fallback accounting, ``dks_deepshap_*``.)
+#: its fallback accounting, ``dks_deepshap_*``; ``device``, ``tenant``,
+#: ``fleet`` and ``trace`` when the tenant cost-attribution plane landed
+#: ``dks_device_seconds_total``, the ``dks_tenant_*`` families, the
+#: federated ``dks_fleet_*`` scrape accounting and the trace-sink
+#: rotation counter ``dks_trace_dropped_total``.)
 _LITERAL_RE = re.compile(
     r"dks_(?:serve|fanin|sched|phase|slo|alerts|wire|staging|treeshap|"
-    r"tensor_shap|autoscale|registry|result_cache|deepshap)_[a-z0-9_]+")
+    r"tensor_shap|autoscale|registry|result_cache|deepshap|device|tenant|"
+    r"fleet|trace)_[a-z0-9_]+")
 
 #: directories never scanned for literals/renderers
 _SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "results", "data",
@@ -140,6 +149,21 @@ def check(verbose=True):
         for name in sorted(set(docs) - set(live)):
             problems.append(f"documented but not registered: {name} "
                             f"(stale docs/OBSERVABILITY.md row?)")
+
+    # label-cardinality lint: tenant-shaped labels (``model``) are the
+    # unbounded-by-default dimension in a multi-tenant fleet — every
+    # metric carrying one must either declare a hard series cap
+    # (``bound_cardinality``, enforced by an ``_overflow`` bucket) or a
+    # retire hook (``MetricsRegistry.declare_retirement`` + actual
+    # retirement on tenant removal/hot-swap), or deleted tenants grow
+    # the registry forever.
+    for name, d in sorted(live.items()):
+        if "model" in d.get("labels", []) and not d.get("cardinality"):
+            problems.append(
+                f"{name}: model-labeled metric declares neither a "
+                f"cardinality cap (bound_cardinality) nor a retire hook "
+                f"(declare_retirement) — a tenant flood or churn would "
+                f"grow its label space without bound")
 
     legal = sample_names(live)
     this_file = os.path.abspath(__file__)

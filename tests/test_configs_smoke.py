@@ -17,7 +17,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from benchmarks.configs import CONFIGS
 
 
-@pytest.mark.parametrize("name", ["adult", "adult_stress", "covertype"])
+# the two heaviest smokes (covertype ~29s, model_zoo ~40s on the CI
+# box) are marked slow to keep the whole tier-1 suite inside its 870s
+# budget (the same call made for test_multihost in PR 1): covertype is
+# a dataset-size variant of the adult config that stays, and every
+# model-zoo family has its own dedicated lift/explain tests — both
+# still run via `make test`.
+@pytest.mark.parametrize(
+    "name", ["adult", "adult_stress",
+             pytest.param("covertype", marks=pytest.mark.slow)])
 def test_config_smoke(name):
     result = CONFIGS[name](smoke=True)
     assert result["value"] > 0
@@ -42,6 +50,7 @@ def test_config_trees_smoke():
     assert result["device_lifted"], "GBT should lift onto the device"
 
 
+@pytest.mark.slow
 def test_config_model_zoo_smoke():
     result = CONFIGS["model_zoo"](smoke=True)
     assert result["additivity_err"] < 1e-3, result

