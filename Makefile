@@ -3,12 +3,15 @@
 
 PY ?= python
 
-.PHONY: test tier1 collect fuzz bench configs serve sweep-pool sweep-serve analysis multihost-ci sched-bench chaos-bench obs-check health-check perf-gate warmup-bench stream-bench exact-bench autoscale-bench accuracy-gate tenant-bench deepshap-bench cost-bench
+.PHONY: test tier1 collect fuzz bench configs serve sweep-pool sweep-serve analysis multihost-ci sched-bench chaos-bench lint obs-check health-check perf-gate warmup-bench stream-bench exact-bench autoscale-bench accuracy-gate tenant-bench deepshap-bench cost-bench
+
+lint:            ## unified static gate: dks-analyze (concurrency + JAX-contract + serving-ladder lints, scripts/dks_lint.py) + obs-check + health-check behind ONE exit code; <60s budget self-asserted
+	env JAX_PLATFORMS=cpu $(PY) scripts/dks_lint.py --check
 
 multihost-ci:    ## multi-host validation: 2-proc pool/phi/interactions, 4-proc 2x2 mesh, 2-proc serve (one JSON line, rc 0/1)
 	$(PY) benchmarks/multihost_ci.py
 
-test:            ## full suite on CPU with 8 virtual devices
+test: lint       ## full suite on CPU with 8 virtual devices (gated on `make lint`)
 	env PYTHONPATH= JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q
 
 tier1: SHELL := /bin/bash

@@ -1,0 +1,30 @@
+"""dks-analyze: repo-specific static analysis + runtime lock witness.
+
+Three stdlib-``ast`` analyzer families, each targeting a defect class this
+repo has actually shipped and re-fixed (ISSUE 15):
+
+* **concurrency** (``DKS-C0xx``, :mod:`.concurrency`) — shared-attribute
+  races, unlocked container iteration, lock-order cycles, blocking calls
+  under a lock, unguarded thread loops.
+* **JAX contract** (``DKS-J0xx``, :mod:`.jax_contract`) — unaudited
+  ``donate_argnums`` sites, cache-resident buffers fed to donated argnums,
+  host RNG/clock/numpy reads inside jit-traced functions, unhashable
+  static-arg defaults.
+* **serving ladder** (``DKS-L0xx``, :mod:`.ladder`) — every
+  ``registry/classify.ENGINE_PATHS`` entry must carry its full serving
+  rung: dispatch entry, fingerprint-keyed consts cache, path-label site,
+  fallback counter family, warmup signature wiring.
+
+The static side is complemented by :mod:`.lockwitness`, a TSan-lite
+runtime witness over the named control-plane locks (opt-in via
+``DKS_LOCK_WITNESS=1``).
+
+Driver: ``scripts/dks_lint.py`` / ``make lint``.  Catalog and suppression
+contract: ``docs/STATIC_ANALYSIS.md``.
+"""
+
+# Deliberately import-light: production modules import
+# `analysis.lockwitness` for their named locks, so this package __init__
+# must not drag the ast-based analyzer modules into the serving path.
+# The driver API lives at `analysis.driver.lint_repo`.
+from distributedkernelshap_tpu.analysis import lockwitness  # noqa: F401

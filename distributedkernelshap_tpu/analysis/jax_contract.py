@@ -1,0 +1,414 @@
+"""JAX-contract lints (``DKS-J0xx``).
+
+The engine's performance story rests on two contracts that nothing used
+to enforce:
+
+* **buffer donation** (docs/PERFORMANCE.md): only per-call batch buffers
+  may be donated — never the fingerprint-keyed ``_dev_cache`` /
+  ``*_consts`` cache entries, which a donation would invalidate in place
+  and silently poison every later cache hit.
+* **trace purity**: functions traced by ``jax.jit`` (here always through
+  ``ops/explain.jit_batch_entry``) run ONCE at trace time — host RNG /
+  clock reads silently constant-fold into the compiled program, and
+  ``np.`` calls on traced values raise (or worse, constant-fold when the
+  value is concrete at trace time only by accident).
+
+Checks:
+
+* ``DKS-J001`` *unaudited-donation* — a ``donate_argnums`` site outside
+  the audited :data:`DONATION_ALLOWLIST`.  Adding a donation site means
+  auditing its callers against the contract, then extending the list.
+* ``DKS-J002`` *donated-cache-alias* — a call to a known donated entry
+  passes a cache-resident buffer (an expression derived from
+  ``*cache*``/``*consts*`` state) at a donated argnum.
+* ``DKS-J003`` *host-impurity-in-trace* — RNG/clock reads anywhere in a
+  jit-reachable function, or an ``np.`` call applied to a traced
+  parameter of a function passed to jit.
+* ``DKS-J004`` *unhashable-static-default* — a jitted function marks a
+  parameter static while its default is an unhashable literal
+  (list/dict/set): every call that relies on the default raises at
+  dispatch.
+"""
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from distributedkernelshap_tpu.analysis.core import Finding
+
+#: audited ``donate_argnums`` sites: (repo-relative path, enclosing
+#: function name).  Every entry has been checked against the donation
+#: contract — its donated argnums receive only per-call buffers.
+DONATION_ALLOWLIST: Set[Tuple[str, str]] = {
+    # the ONE central wrapper all entry points go through
+    ("distributedkernelshap_tpu/ops/explain.py", "jit_batch_entry"),
+    # sampled pipeline entry (argnum 0 = per-call padded batch upload)
+    ("distributedkernelshap_tpu/kernel_shap.py", "_fn"),
+    # host-eval WLS solve (argnum 2 = per-call ey_adj upload)
+    ("distributedkernelshap_tpu/kernel_shap.py", "_solve_fn"),
+    # linear fast path fused entry (argnum 0 = per-call batch)
+    ("distributedkernelshap_tpu/kernel_shap.py", "_linear_fast_call"),
+    # D2H packing entry (argnum 0 = phi, produced fresh per call)
+    ("distributedkernelshap_tpu/kernel_shap.py", "_pack_fn"),
+    # exact-tree entry (argnum 0 = per-call padded batch)
+    ("distributedkernelshap_tpu/kernel_shap.py", "_exact_fn"),
+    # exact tensor-network entry (argnum 0 = per-call padded batch)
+    ("distributedkernelshap_tpu/kernel_shap.py", "_exact_tn_fn"),
+    # DeepSHAP backprop entry (argnum 0 = per-call padded batch)
+    ("distributedkernelshap_tpu/kernel_shap.py", "_deepshap_fn"),
+}
+
+#: producer methods returning donated entries, with their donated argnums
+#: — J002 tracks variables assigned from these and inspects call args
+DONATING_PRODUCERS: Dict[str, Tuple[int, ...]] = {
+    "_fn": (0,),
+    "_solve_fn": (2,),
+    "_exact_fn": (0,),
+    "_exact_tn_fn": (0,),
+    "_deepshap_fn": (0,),
+}
+
+#: expression text that marks a buffer as cache-resident
+_CACHE_NAME_RE = re.compile(r"(?:^|[._])(?:consts|cache[sd]?|_dev_cache)"
+                            r"(?:$|[._\[])|consts\b|_cache\b")
+
+_CLOCK_CALLS = {"time", "monotonic", "perf_counter", "process_time",
+                "time_ns", "monotonic_ns"}
+
+
+def _enclosing_functions(tree: ast.Module) -> Dict[int, str]:
+    """``{id(node): enclosing function name}`` for every node ('<module>'
+    at top level)."""
+
+    names: Dict[int, str] = {}
+
+    def assign(node: ast.AST, fn_name: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                names[id(child)] = fn_name  # the def itself lives outside
+                assign(child, child.name)
+            else:
+                names[id(child)] = fn_name
+                assign(child, fn_name)
+
+    names[id(tree)] = "<module>"
+    assign(tree, "<module>")
+    return names
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "jit":
+        return True
+    if isinstance(f, ast.Name) and f.id in ("jit", "jit_batch_entry"):
+        return True
+    return False
+
+
+def _kw(node: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in node.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def check_donation_sites(tree: ast.Module, path: str,
+                         allowlist: Optional[Set[Tuple[str, str]]] = None
+                         ) -> List[Finding]:
+    """DKS-J001.  ``allowlist`` defaults to the audited
+    :data:`DONATION_ALLOWLIST` (tests inject their own)."""
+
+    if allowlist is None:
+        allowlist = DONATION_ALLOWLIST
+    findings = []
+    enclosing = _enclosing_functions(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _kw(node, "donate_argnums") is None and \
+                _kw(node, "donate_argnames") is None:
+            continue
+        fn = enclosing.get(id(node), "<module>")
+        if (path, fn) in allowlist:
+            continue
+        findings.append(Finding(
+            "DKS-J001", path, node.lineno, fn,
+            f"`donate_argnums` site in `{fn}` is not on the audited "
+            f"donation allowlist (analysis/jax_contract.py)",
+            "audit the dispatch wrappers against the donation contract "
+            "(docs/PERFORMANCE.md), then add the site to "
+            "DONATION_ALLOWLIST"))
+    return findings
+
+
+def check_donated_args(tree: ast.Module, path: str) -> List[Finding]:
+    """DKS-J002: local dataflow around calls to donated entries."""
+
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        findings.extend(_check_donated_in_fn(node, path))
+    return findings
+
+
+def _check_donated_in_fn(fn: ast.FunctionDef, path: str) -> List[Finding]:
+    # Flow-sensitive in source order: each call is judged against the
+    # assignments COMPLETED before it, so `out = f(batch)` followed by
+    # `batch = self._dev_cache[key]` does not retroactively taint the
+    # earlier call (and a cache read shadowed before the call still
+    # flags).  Events sort by END position with calls before the
+    # assignment that contains them — the RHS evaluates before the
+    # target binds, so `batch = f(batch)` checks the old reaching def.
+    events: List[Tuple[int, int, int, ast.AST]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            events.append((node.end_lineno or node.lineno,
+                           node.end_col_offset or 0, 1, node))
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name):
+            events.append((node.end_lineno or node.lineno,
+                           node.end_col_offset or 0, 0, node))
+    events.sort(key=lambda e: e[:3])
+    # variable -> donated argnums (assigned from a donating producer)
+    donated_vars: Dict[str, Tuple[int, ...]] = {}
+    # variable -> source text of its RHS (one-hop reaching def)
+    reaching: Dict[str, str] = {}
+    findings: List[Finding] = []
+    for _, _, kind, node in events:
+        if kind == 1:
+            name = node.targets[0].id
+            value = node.value
+            if isinstance(value, ast.Call) and \
+                    isinstance(value.func, ast.Attribute) and \
+                    value.func.attr in DONATING_PRODUCERS:
+                donated_vars[name] = DONATING_PRODUCERS[value.func.attr]
+            else:
+                donated_vars.pop(name, None)
+            try:
+                reaching[name] = ast.unparse(value)
+            except Exception:
+                reaching.pop(name, None)
+            continue
+        argnums = donated_vars.get(node.func.id)
+        if argnums is None:
+            continue
+        for idx in argnums:
+            if idx >= len(node.args):
+                continue
+            arg = node.args[idx]
+            try:
+                text = ast.unparse(arg)
+            except Exception:
+                continue
+            derived = text
+            if isinstance(arg, ast.Name) and arg.id in reaching:
+                derived = f"{text} = {reaching[arg.id]}"
+            if _CACHE_NAME_RE.search(derived):
+                findings.append(Finding(
+                    "DKS-J002", path, node.lineno,
+                    f"{fn.name}.{node.func.id}",
+                    f"donated argnum {idx} of `{node.func.id}` receives "
+                    f"`{text}` — a cache-resident buffer; donation "
+                    f"invalidates the cached entry in place and poisons "
+                    f"every later hit",
+                    "pass only per-call buffers at donated argnums; "
+                    "cached consts belong at non-donated positions"))
+    return findings
+
+
+def check_trace_purity(tree: ast.Module, path: str) -> List[Finding]:
+    """DKS-J003."""
+
+    fns: Dict[str, List[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fns.setdefault(node.name, []).append(node)
+    roots: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_call(node) and node.args:
+            first = node.args[0]
+            if isinstance(first, ast.Name) and first.id in fns:
+                roots.add(first.id)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if (isinstance(dec, ast.Call) and _is_jit_call(dec)) or \
+                        (isinstance(dec, ast.Attribute) and
+                         dec.attr == "jit") or \
+                        (isinstance(dec, ast.Name) and dec.id == "jit"):
+                    roots.add(node.name)
+    if not roots:
+        return []
+    # same-module reachability by bare-name reference
+    reachable: Set[str] = set()
+    frontier = list(roots)
+    while frontier:
+        name = frontier.pop()
+        if name in reachable:
+            continue
+        reachable.add(name)
+        for fn in fns.get(name, []):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Name) and node.id in fns and \
+                        node.id not in reachable:
+                    frontier.append(node.id)
+    findings: List[Finding] = []
+    for name in sorted(reachable):
+        for fn in fns.get(name, []):
+            findings.extend(_check_purity_in_fn(fn, path,
+                                                taint=(name in roots)))
+    return findings
+
+
+def _check_purity_in_fn(fn: ast.FunctionDef, path: str,
+                        taint: bool) -> List[Finding]:
+    findings: List[Finding] = []
+    tainted: Set[str] = set()
+    if taint:
+        tainted = {a.arg for a in (fn.args.posonlyargs + fn.args.args +
+                                   fn.args.kwonlyargs) if a.arg != "self"}
+        # propagate through simple local assignments until stable
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    rhs_names = {n.id for n in ast.walk(node.value)
+                                 if isinstance(n, ast.Name)}
+                    if rhs_names & tainted:
+                        for t in node.targets:
+                            for n in ast.walk(t):
+                                if isinstance(n, ast.Name) and \
+                                        n.id not in tainted:
+                                    tainted.add(n.id)
+                                    changed = True
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            base, attr = f.value.id, f.attr
+            if base == "time" and attr in _CLOCK_CALLS:
+                findings.append(Finding(
+                    "DKS-J003", path, node.lineno, fn.name,
+                    f"host clock read `time.{attr}()` inside "
+                    f"jit-reachable `{fn.name}` — the value "
+                    f"constant-folds at trace time",
+                    "read the clock outside the traced function and "
+                    "pass it in (or drop it)"))
+            elif base == "random":
+                findings.append(Finding(
+                    "DKS-J003", path, node.lineno, fn.name,
+                    f"Python RNG call `random.{attr}()` inside "
+                    f"jit-reachable `{fn.name}` — one sample is baked "
+                    f"into the compiled program",
+                    "use jax.random with an explicit key threaded "
+                    "through the call"))
+            elif base == "np" and attr == "random":
+                pass  # handled below via the np.random chain
+            elif base == "np" and tainted:
+                arg_names = {n.id for a in node.args
+                             for n in ast.walk(a)
+                             if isinstance(n, ast.Name)}
+                if arg_names & tainted:
+                    findings.append(Finding(
+                        "DKS-J003", path, node.lineno, fn.name,
+                        f"`np.{attr}(...)` applied to traced argument "
+                        f"inside jitted `{fn.name}` — numpy cannot "
+                        f"consume tracers",
+                        "use jnp (or hoist the computation out of the "
+                        "traced function)"))
+        # np.random.X(...) chains
+        if isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Attribute) and \
+                isinstance(f.value.value, ast.Name) and \
+                f.value.value.id == "np" and f.value.attr == "random":
+            findings.append(Finding(
+                "DKS-J003", path, node.lineno, fn.name,
+                f"host RNG call `np.random.{f.attr}()` inside "
+                f"jit-reachable `{fn.name}` — one sample is baked into "
+                f"the compiled program",
+                "use jax.random with an explicit key threaded through "
+                "the call"))
+    return findings
+
+
+def check_static_defaults(tree: ast.Module, path: str) -> List[Finding]:
+    """DKS-J004."""
+
+    fns: Dict[str, List[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fns.setdefault(node.name, []).append(node)
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _is_jit_call(node)):
+            continue
+        static_nums = _kw(node, "static_argnums")
+        static_names = _kw(node, "static_argnames")
+        if static_nums is None and static_names is None:
+            continue
+        if not node.args or not isinstance(node.args[0], ast.Name):
+            continue
+        for fn in fns.get(node.args[0].id, []):
+            findings.extend(_check_static_fn(fn, static_nums,
+                                             static_names, path, node))
+    return findings
+
+
+def _literal_values(expr: Optional[ast.expr]) -> List:
+    if expr is None:
+        return []
+    try:
+        value = ast.literal_eval(expr)
+    except (ValueError, SyntaxError):
+        return []
+    if isinstance(value, (list, tuple, set)):
+        return list(value)
+    return [value]
+
+
+def _check_static_fn(fn: ast.FunctionDef, static_nums, static_names,
+                     path: str, call: ast.Call) -> List[Finding]:
+    params = fn.args.posonlyargs + fn.args.args
+    defaults = fn.args.defaults
+    default_of: Dict[str, ast.expr] = {}
+    for param, default in zip(params[len(params) - len(defaults):],
+                              defaults):
+        default_of[param.arg] = default
+    for param, default in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+        if default is not None:
+            default_of[param.arg] = default
+    marked: Set[str] = set()
+    for num in _literal_values(static_nums):
+        if isinstance(num, int) and 0 <= num < len(params):
+            marked.add(params[num].arg)
+    for name in _literal_values(static_names):
+        if isinstance(name, str):
+            marked.add(name)
+    findings = []
+    for name in sorted(marked):
+        default = default_of.get(name)
+        if default is None:
+            continue
+        if isinstance(default, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                                ast.DictComp, ast.SetComp)):
+            findings.append(Finding(
+                "DKS-J004", path, default.lineno, f"{fn.name}.{name}",
+                f"static arg `{name}` of jitted `{fn.name}` defaults to "
+                f"an unhashable literal — every default-using call "
+                f"raises at dispatch (static args are hashed into the "
+                f"compile key)",
+                "use a tuple/frozenset/None default"))
+    return findings
+
+
+def check_module(tree: ast.Module, path: str) -> List[Finding]:
+    """All JAX-contract findings for one parsed module."""
+
+    findings = check_donation_sites(tree, path)
+    findings += check_donated_args(tree, path)
+    findings += check_trace_purity(tree, path)
+    findings += check_static_defaults(tree, path)
+    return findings

@@ -42,6 +42,8 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from distributedkernelshap_tpu.analysis import lockwitness
+
 logger = logging.getLogger(__name__)
 
 #: default last-K exemplars kept per histogram bucket (bounded: the
@@ -100,7 +102,7 @@ class _Metric:
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("metrics.metric")
         self._values: Dict[Tuple[str, ...], float] = {}
         self._fn: Optional[Callable] = None
         # cardinality declaration (the obs-check label-cardinality lint):
@@ -408,7 +410,7 @@ class MetricsRegistry:
     would collide).  Thread-safe; renders in registration order."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("metrics.registry")
         self._metrics: Dict[str, _Metric] = {}
 
     def _register(self, metric: _Metric) -> _Metric:

@@ -38,6 +38,7 @@ from distributedkernelshap_tpu.observability.alerts import (
     WebhookSink,
     slo_burn_rule,
 )
+from distributedkernelshap_tpu.analysis import lockwitness
 from distributedkernelshap_tpu.observability.timeseries import (
     RegistrySampler,
     TimeSeriesStore,
@@ -128,7 +129,7 @@ class HealthEngine:
         self._status_ttl_s = (min(0.5, self.interval_s / 2)
                               if self.interval_s > 0 else 0.5)
         self._status_cache: tuple = (0.0, None)
-        self._status_lock = threading.Lock()
+        self._status_lock = lockwitness.make_lock("statusz.status")
         # logical evaluation time for deterministic tick(now=...): the
         # registry's dks_slo_* gauge callbacks take no arguments, so a
         # replayed tick routes its timestamp here — without it the

@@ -45,6 +45,8 @@ import time
 from collections import deque
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
+from distributedkernelshap_tpu.analysis import lockwitness
+
 logger = logging.getLogger(__name__)
 
 #: default samples kept per series — with the sampler's default 1 s
@@ -101,7 +103,7 @@ class TimeSeriesStore:
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
         self.capacity = max(2, int(capacity))
         self._series: Dict[tuple, _Series] = {}
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("timeseries.store")
         self.samples_total = 0
 
     # -- write path ---------------------------------------------------- #

@@ -42,6 +42,7 @@ from typing import Dict, List, Optional, Tuple
 from distributedkernelshap_tpu.observability.flightrec import flightrec
 from distributedkernelshap_tpu.registry.classify import classify_path
 from distributedkernelshap_tpu.scheduling.admission import TokenBucket
+from distributedkernelshap_tpu.analysis import lockwitness
 from distributedkernelshap_tpu.scheduling.result_cache import (
     model_fingerprint,
 )
@@ -123,7 +124,7 @@ class RegisteredModel:
         # ladder skips already-warm models instead of re-running them
         self.warmed = False
         self.created_at = time.time()
-        self._cond = threading.Condition()
+        self._cond = lockwitness.make_condition("registry.model")
         self._inflight = 0
         # per-tenant accounting, rendered via the server's registry
         # callbacks (dks_registry_requests_total etc.)
@@ -210,14 +211,14 @@ class ModelRegistry:
     def __init__(self, default_model_id: Optional[str] = None,
                  default_quota: Optional[TenantQuota] = None,
                  drain_timeout_s: float = 30.0):
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("registry.models")
         # registrations serialise END TO END (version allocation, warm,
         # insert, drain): two concurrent register() calls for one id
         # would otherwise allocate the same auto-version during the
         # seconds-long unlocked warm window and silently overwrite each
         # other.  A separate lock from _lock so draining requests (which
         # resolve/release under _lock) never deadlock a registration.
-        self._register_lock = threading.Lock()
+        self._register_lock = lockwitness.make_lock("registry.register")
         #: {model_id: {"active": RegisteredModel, "versions": {v: rm}}}
         self._models: Dict[str, Dict] = {}
         self._order: List[str] = []

@@ -34,6 +34,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from distributedkernelshap_tpu.analysis import lockwitness
 from distributedkernelshap_tpu.observability.flightrec import flightrec
 
 logger = logging.getLogger(__name__)
@@ -107,7 +108,14 @@ class ReplicaSupervisor:
         self.lock = lock or threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        # per-replica crash bookkeeping
+        # per-replica crash bookkeeping, guarded by its OWN lock
+        # (DKS-C001: track/retire/stats arrive from the autoscaler and
+        # statusz threads while _tick mutates).  Deliberately distinct
+        # from self.lock — the owner may pass its procs lock there and
+        # call is_retired() while holding it (ReplicaManager.
+        # spawn_replica does), so reusing it here would self-deadlock.
+        # Order is always self.lock -> _book_lock, never the reverse.
+        self._book_lock = lockwitness.make_lock("supervisor.book")
         self._consecutive: Dict[int, int] = {}
         self._last_start: Dict[int, float] = {}
         self._respawn_at: Dict[int, float] = {}
@@ -132,49 +140,76 @@ class ReplicaSupervisor:
                            "rotation pending restart", index)
 
     def _tick(self) -> None:
+        # crash bookkeeping (consecutive counts, respawn stamps, the
+        # retired set) is shared with the autoscaler thread (track /
+        # retire) and statusz readers (stats) — every touch goes through
+        # _book_lock (DKS-C001); the proxy/log/flightrec side effects and
+        # the spawn itself run after release so the lock never brackets
+        # I/O or process creation (DKS-C004)
         now = time.monotonic()
-        for i, proc in enumerate(self.procs):
-            if i in self._retired:
-                continue  # drained on purpose: its exit is the goal
+        for i, proc in enumerate(list(self.procs)):
             if proc is None or proc.poll() is None:
                 continue
+            backoff_event = None
+            respawn_due = False
+            with self._book_lock:
+                if i in self._retired:
+                    continue  # drained on purpose: its exit is the goal
+                due = self._respawn_at.get(i)
+                if due is None:
+                    lived = now - self._last_start.get(i, 0.0)
+                    if lived >= self.policy.healthy_reset_s:
+                        self._consecutive[i] = 1
+                    else:
+                        self._consecutive[i] = \
+                            self._consecutive.get(i, 0) + 1
+                    delay = self.policy.delay(self._consecutive[i])
+                    self._respawn_at[i] = now + delay
+                    if self._consecutive[i] > 1:
+                        self.crash_loops_backing_off += 1
+                    backoff_event = (proc.returncode,
+                                     self._consecutive[i], delay)
+                elif now >= due:
+                    respawn_due = True
             # dead: the proxy must stop routing to the corpse NOW — the
             # prober only recovers, the supervisor (and failed connects)
-            # declare death
+            # declare death.  Idempotent, so re-marking each tick while
+            # the backoff runs down is fine.
             self._mark_down(i)
-            due = self._respawn_at.get(i)
-            if due is None:
-                lived = now - self._last_start.get(i, 0.0)
-                if lived >= self.policy.healthy_reset_s:
-                    self._consecutive[i] = 1
-                else:
-                    self._consecutive[i] = self._consecutive.get(i, 0) + 1
-                delay = self.policy.delay(self._consecutive[i])
-                self._respawn_at[i] = now + delay
-                if self._consecutive[i] > 1:
-                    self.crash_loops_backing_off += 1
+            if backoff_event is not None:
+                returncode, consecutive, delay = backoff_event
                 logger.warning(
                     "supervisor: replica %d exited rc=%s (consecutive "
                     "crash #%d); restarting in %.2fs",
-                    i, proc.returncode, self._consecutive[i], delay)
+                    i, returncode, consecutive, delay)
                 flightrec().record("replica_exit", replica=i,
-                                   returncode=proc.returncode,
-                                   consecutive_crashes=self._consecutive[i],
+                                   returncode=returncode,
+                                   consecutive_crashes=consecutive,
                                    restart_in_s=round(delay, 3))
                 continue
-            if now < due:
+            if not respawn_due:
                 continue
             with self.lock:
                 if self._stop.is_set():
                     return  # shutdown won the race: never respawn
+                with self._book_lock:
+                    if i in self._retired:
+                        continue  # retire won the race mid-backoff
+                # the spawn (process creation, hundreds of ms) runs under
+                # self.lock ONLY — stats()/is_retired() must not stall
+                # behind it.  A retire landing in this window is the same
+                # pre-existing race as retire-after-respawn: the next
+                # tick sees the slot retired and never respawns again.
                 self.procs[i] = self.spawn(i)
-            self._last_start[i] = time.monotonic()
-            self._respawn_at.pop(i, None)
-            self.restarts_total += 1
+                with self._book_lock:
+                    self._last_start[i] = time.monotonic()
+                    self._respawn_at.pop(i, None)
+                    self.restarts_total += 1
+                    restarts = self.restarts_total
             logger.info("supervisor: replica %d respawned "
-                        "(restart #%d)", i, self.restarts_total)
+                        "(restart #%d)", i, restarts)
             flightrec().record("replica_restart", replica=i,
-                               restarts_total=self.restarts_total)
+                               restarts_total=restarts)
 
     def _loop(self) -> None:
         while not self._stop.wait(self.poll_interval_s):
@@ -189,8 +224,9 @@ class ReplicaSupervisor:
 
     def start(self) -> "ReplicaSupervisor":
         now = time.monotonic()
-        for i in range(len(self.procs)):
-            self._last_start[i] = now
+        with self._book_lock:
+            for i in range(len(self.procs)):
+                self._last_start[i] = now
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
         return self
@@ -211,10 +247,11 @@ class ReplicaSupervisor:
         any retirement left over from a previously drained slot being
         reused."""
 
-        self._last_start[index] = time.monotonic()
-        self._consecutive.pop(index, None)
-        self._respawn_at.pop(index, None)
-        self._retired.discard(index)
+        with self._book_lock:
+            self._last_start[index] = time.monotonic()
+            self._consecutive.pop(index, None)
+            self._respawn_at.pop(index, None)
+            self._retired.discard(index)
 
     def retire(self, index: int) -> None:
         """Mark one replica as retired ON PURPOSE (the autoscaler's
@@ -222,13 +259,17 @@ class ReplicaSupervisor:
         outcome, so the crash-restart loop must skip it.  Distinct from
         :meth:`stop`, which ends supervision fleet-wide."""
 
-        self._retired.add(index)
-        self._respawn_at.pop(index, None)
+        with self._book_lock:
+            self._retired.add(index)
+            self._respawn_at.pop(index, None)
 
     def is_retired(self, index: int) -> bool:
-        return index in self._retired
+        with self._book_lock:
+            return index in self._retired
 
     def stats(self) -> Dict[str, int]:
-        return {"restarts_total": self.restarts_total,
+        with self._book_lock:
+            return {
+                "restarts_total": self.restarts_total,
                 "crash_loops_backing_off": self.crash_loops_backing_off,
                 "retired": len(self._retired)}

@@ -30,6 +30,8 @@ import time
 from collections import OrderedDict
 from typing import Dict, Optional, Tuple
 
+from distributedkernelshap_tpu.analysis import lockwitness
+
 
 class TokenBucket:
     """Continuous-refill token bucket (``rate`` tokens/s, ``burst`` cap)."""
@@ -42,7 +44,7 @@ class TokenBucket:
         self._now = now
         self._tokens = float(burst)
         self._t_last = now()
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("admission.bucket")
 
     def try_acquire(self, n: float = 1.0) -> Tuple[bool, float]:
         """Take ``n`` tokens if available.  Returns ``(acquired,
@@ -91,7 +93,7 @@ class ServiceRateEstimator:
         self._rate: Optional[float] = None
         self._capacity_units: Optional[float] = None
         self._rows_total = 0
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("admission.estimator")
 
     def observe(self, rows: int, seconds: float) -> None:
         if seconds <= 0 or rows <= 0:
@@ -187,7 +189,7 @@ class AdmissionController:
         self.max_client_buckets = int(max_client_buckets)
         self._now = now
         self._buckets: "OrderedDict[str, TokenBucket]" = OrderedDict()
-        self._buckets_lock = threading.Lock()
+        self._buckets_lock = lockwitness.make_lock("admission.clients")
 
     def _bound_for(self, klass: str) -> int:
         return int(self._bounds.get(klass, self._default_bound) or 0)

@@ -41,6 +41,7 @@ import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
+from distributedkernelshap_tpu.analysis import lockwitness
 PRIORITY_CLASSES = ("interactive", "batch", "best_effort")
 
 # Ordering budgets (seconds): a request with no explicit deadline is
@@ -79,7 +80,8 @@ class SLOScheduler:
         if class_budgets:
             self._budgets.update(class_budgets)
         self._now = now
-        self._cond = threading.Condition()
+        # named for the runtime lock-order witness (DKS_LOCK_WITNESS)
+        self._cond = lockwitness.make_condition("scheduler.cond")
         self._heap: List[Tuple[float, int, object]] = []
         self._seq = 0
         self._depths: Dict[str, int] = {k: 0 for k in PRIORITY_CLASSES}

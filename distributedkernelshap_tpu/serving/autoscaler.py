@@ -69,6 +69,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from distributedkernelshap_tpu.analysis import lockwitness
 from distributedkernelshap_tpu.observability.flightrec import flightrec
 from distributedkernelshap_tpu.scheduling.admission import (
     ServiceRateEstimator,
@@ -250,7 +251,7 @@ class Autoscaler:
         self.ticks_total = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("autoscaler.state")
         # replica /statusz polls run concurrently: a tick must not stall
         # statusz_timeout_s x N sequentially exactly when the fleet is
         # overloaded and the scale-up is most urgent
@@ -299,15 +300,22 @@ class Autoscaler:
 
         now = time.monotonic()
         cfg = self.config
+        # every scaler-thread-mutated signal (streaks, cooldown stamps,
+        # tick count, draining book) reads under the SAME lock the tick
+        # path writes under (DKS-C001/DKS-C002) — the panel must never
+        # render a torn decision state
         with self._lock:
             last = dict(self._last_decision)
             signals = dict(self._last_signals)
             draining = {i: round(now - d["since"], 1)
                         for i, d in self._draining.items()}
-        up_cd = (max(0.0, cfg.up_cooldown_s - (now - self._last_up_t))
-                 if self._last_up_t is not None else 0.0)
-        down_cd = (max(0.0, cfg.down_cooldown_s - (now - self._last_down_t))
-                   if self._last_down_t is not None else 0.0)
+            up_streak, down_streak = self._up_streak, self._down_streak
+            last_up_t, last_down_t = self._last_up_t, self._last_down_t
+            ticks_total = self.ticks_total
+        up_cd = (max(0.0, cfg.up_cooldown_s - (now - last_up_t))
+                 if last_up_t is not None else 0.0)
+        down_cd = (max(0.0, cfg.down_cooldown_s - (now - last_down_t))
+                   if last_down_t is not None else 0.0)
         return {
             "bounds": [cfg.min_replicas, cfg.max_replicas],
             "warm_standby": cfg.warm_standby,
@@ -317,12 +325,12 @@ class Autoscaler:
                               "reason": last["reason"],
                               "age_s": round(now - last["t"], 1)},
             "signals": signals,
-            "up_streak": self._up_streak,
-            "down_streak": self._down_streak,
+            "up_streak": up_streak,
+            "down_streak": down_streak,
             "cooldown_up_remaining_s": round(up_cd, 1),
             "cooldown_down_remaining_s": round(down_cd, 1),
             "draining_age_s": draining,
-            "ticks_total": self.ticks_total,
+            "ticks_total": ticks_total,
             "alive": self._thread is not None and self._thread.is_alive(),
         }
 
@@ -513,9 +521,9 @@ class Autoscaler:
             self._flight.record("scale_up", reason=reason, replica=index,
                                 standby_activated=False)
             self._m_decisions.inc(action="scale_up", reason=reason)
-        self._last_up_t = now
-        self._up_streak = 0
         with self._lock:
+            self._last_up_t = now
+            self._up_streak = 0
             self._last_decision = {"action": "scale_up", "reason": reason,
                                    "t": now}
         if standby_idx is not None:
@@ -560,9 +568,9 @@ class Autoscaler:
         self._flight.record("scale_down", reason="idle",
                             replica=victim.index)
         self._m_decisions.inc(action="scale_down", reason="idle")
-        self._last_down_t = now
-        self._down_streak = 0
         with self._lock:
+            self._last_down_t = now
+            self._down_streak = 0
             self._last_decision = {"action": "scale_down", "reason": "idle",
                                    "t": now}
         # the victim stopped taking NEW work the moment start_drain
@@ -578,8 +586,11 @@ class Autoscaler:
         retriable pre-dispatch 503)."""
 
         cfg = self.config
-        for index in list(self._draining):
-            book = self._draining[index]
+        # snapshot under the lock: statusz handlers iterate _draining
+        # concurrently (DKS-C002); book dicts stay scaler-thread-private
+        with self._lock:
+            pending = list(self._draining.items())
+        for index, book in pending:
             replica = self.proxy.replicas[index]
             forced = now - book["since"] > cfg.drain_timeout_s
             if not forced:
@@ -635,7 +646,8 @@ class Autoscaler:
                 raise _ScalerCrashed("injected crash at scaler.tick")
         now = time.monotonic()
         cfg = self.config
-        self.ticks_total += 1
+        with self._lock:
+            self.ticks_total += 1
         self._m_ticks.inc()
         # replica-seconds accrue by state every tick, over the REAL time
         # since the last accrual — a tick stalled on statusz timeouts
@@ -653,30 +665,41 @@ class Autoscaler:
             self._last_signals = sig
         up_reason = self._up_reason(sig)
         if up_reason is not None:
-            self._up_streak += 1
-            self._down_streak = 0
-            if self._up_streak >= cfg.up_ticks:
-                if (self._last_up_t is not None
-                        and now - self._last_up_t < cfg.up_cooldown_s):
+            # streaks and cooldown stamps are panel-visible: mutate and
+            # read under the lock (DKS-C001), act after release —
+            # _scale_up re-acquires it for its own decision write
+            with self._lock:
+                self._up_streak += 1
+                self._down_streak = 0
+                fire = self._up_streak >= cfg.up_ticks
+                cooling = (self._last_up_t is not None
+                           and now - self._last_up_t < cfg.up_cooldown_s)
+            if fire:
+                if cooling:
                     self._m_decisions.inc(action="hold", reason="cooldown")
                 else:
                     self._scale_up(up_reason, now)
             return sig
-        self._up_streak = 0
+        with self._lock:
+            self._up_streak = 0
         # down only from a fully settled fleet: anything warming or
         # draining means the last action has not landed yet
         counts = self.proxy.replica_state_counts()
         settled = not counts.get("warming") and not self._draining
         if settled and self._down_ok(sig):
-            self._down_streak += 1
-            if self._down_streak >= cfg.down_ticks:
-                if (self._last_down_t is not None
-                        and now - self._last_down_t < cfg.down_cooldown_s):
+            with self._lock:
+                self._down_streak += 1
+                fire = self._down_streak >= cfg.down_ticks
+                cooling = (self._last_down_t is not None and
+                           now - self._last_down_t < cfg.down_cooldown_s)
+            if fire:
+                if cooling:
                     self._m_decisions.inc(action="hold", reason="cooldown")
                 else:
                     self._scale_down(now)
         else:
-            self._down_streak = 0
+            with self._lock:
+                self._down_streak = 0
         # keep the standby pool full even in steady state (covers the
         # initial fill when start() raced replica startup)
         if counts.get("standby", 0) < cfg.warm_standby and settled:

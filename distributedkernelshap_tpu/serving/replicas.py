@@ -49,6 +49,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import distributedkernelshap_tpu.observability.tracing as _tracing
 from distributedkernelshap_tpu.observability import fleet as _fleet
 from distributedkernelshap_tpu.observability.flightrec import flightrec
+from distributedkernelshap_tpu.analysis import lockwitness
 from distributedkernelshap_tpu.observability.metrics import (
     DEFAULT_EXEMPLAR_SLOTS,
     MetricsRegistry,
@@ -173,7 +174,7 @@ class FanInProxy:
         self.host, self.port = host, port
         self.request_timeout_s = request_timeout_s
         self.probe_interval_s = probe_interval_s
-        self._rr_lock = threading.Lock()
+        self._rr_lock = lockwitness.make_lock("proxy.rr")
         self._rr = 0
         # per-thread keep-alive connections to each replica (handler and
         # hedge threads are long-lived pool threads): without reuse every
@@ -1004,46 +1005,59 @@ class FanInProxy:
         a scaler decision.  Retired replicas are never probed."""
 
         while not self._stop.wait(self.probe_interval_s):
-            for r in list(self.replicas):
-                if self._stop.is_set():
-                    break
-                if r.retired or (r.alive and not r.standby):
+            try:
+                self._probe_sweep()
+            except Exception:
+                # the prober is the process's ONE dead-replica recovery
+                # path: an unexpected raise (beyond the per-probe
+                # OSError/HTTPException handling below) must cost one
+                # sweep, never the thread (DKS-C005)
+                logger.exception("prober sweep failed; retrying next "
+                                 "interval")
+
+    def _probe_sweep(self) -> None:
+        """One pass over the roster (see :meth:`_probe_loop`)."""
+
+        for r in list(self.replicas):
+            if self._stop.is_set():
+                break
+            if r.retired or (r.alive and not r.standby):
+                continue
+            try:
+                # short dedicated timeout: a wedged-but-accepting
+                # replica must not stall the prober for the full
+                # request timeout and starve other replicas' recovery
+                status, body, _ = self._forward("GET", "/healthz", b"",
+                                                r, timeout_s=5.0)
+            except (OSError, http.client.HTTPException):
+                # HTTPException too: a garbage health response must not
+                # kill the prober thread (that would silently disable
+                # dead-replica recovery for the process lifetime)
+                r.warm_ready = False
+                continue
+            if status == 200:
+                r.warming = False
+                if r.standby:
+                    # ready but deliberately held out of rotation: the
+                    # scaler's activate_standby() is the admission
+                    if not r.warm_ready:
+                        r.warm_ready = True
+                        logger.info("standby replica %s warm and "
+                                    "ready for activation", r.address)
                     continue
+                logger.info("replica %s recovered; back in rotation",
+                            r.address)
+                r.warm_ready = True
+                r.alive = True
+                self._flight.record("replica_recovered",
+                                    replica=r.index, address=r.address)
+            else:
+                r.warm_ready = False
                 try:
-                    # short dedicated timeout: a wedged-but-accepting
-                    # replica must not stall the prober for the full
-                    # request timeout and starve other replicas' recovery
-                    status, body, _ = self._forward("GET", "/healthz", b"",
-                                                    r, timeout_s=5.0)
-                except (OSError, http.client.HTTPException):
-                    # HTTPException too: a garbage health response must not
-                    # kill the prober thread (that would silently disable
-                    # dead-replica recovery for the process lifetime)
-                    r.warm_ready = False
-                    continue
-                if status == 200:
+                    r.warming = (json.loads(body).get("status")
+                                 == "warming")
+                except (ValueError, AttributeError):
                     r.warming = False
-                    if r.standby:
-                        # ready but deliberately held out of rotation: the
-                        # scaler's activate_standby() is the admission
-                        if not r.warm_ready:
-                            r.warm_ready = True
-                            logger.info("standby replica %s warm and "
-                                        "ready for activation", r.address)
-                        continue
-                    logger.info("replica %s recovered; back in rotation",
-                                r.address)
-                    r.warm_ready = True
-                    r.alive = True
-                    self._flight.record("replica_recovered",
-                                        replica=r.index, address=r.address)
-                else:
-                    r.warm_ready = False
-                    try:
-                        r.warming = (json.loads(body).get("status")
-                                     == "warming")
-                    except (ValueError, AttributeError):
-                        r.warming = False
 
     def _render_metrics(self) -> str:
         # rendered SOLELY by the shared registry (declarations live in

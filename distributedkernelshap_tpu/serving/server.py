@@ -46,6 +46,7 @@ import numpy as np
 
 import distributedkernelshap_tpu.observability.tracing as _tracing
 import distributedkernelshap_tpu.serving.wire as _wire
+from distributedkernelshap_tpu.analysis import lockwitness
 from distributedkernelshap_tpu.observability.costmeter import (
     CostMeter,
     dispatch_shares,
@@ -534,7 +535,7 @@ class ExplainerServer:
         # dispatched-but-unanswered batches, keyed by id(batch): the
         # watchdog's view of what a wedged device call is holding hostage
         self._active = {}
-        self._active_lock = threading.Lock()
+        self._active_lock = lockwitness.make_lock("server.active")
         self._last_progress = time.monotonic()
         self._ever_completed = False
         self._wedged = threading.Event()
@@ -542,7 +543,7 @@ class ExplainerServer:
         # wedged the probe thread is stuck inside an XLA call
         # (uncancellable) — concurrent health checks JOIN the in-flight
         # probe instead of stacking threads
-        self._probe_lock = threading.Lock()
+        self._probe_lock = lockwitness.make_lock("server.probe")
         self._probe_thread: Optional[threading.Thread] = None
         self._probe_done: Optional[threading.Event] = None
         self._probe_started = 0.0
@@ -552,7 +553,7 @@ class ExplainerServer:
         # themselves live in the shared observability registry (each
         # metric has its own lock; nesting is safe because registry locks
         # never acquire this one).
-        self._metrics_lock = threading.Lock()
+        self._metrics_lock = lockwitness.make_lock("server.requests")
         # scheduling subsystem: EDF (or FIFO-baseline) request queue,
         # admission control fed by an EWMA of observed device throughput,
         # optional content-addressed result cache
@@ -573,7 +574,7 @@ class ExplainerServer:
         # mutated only by the dispatcher thread under the lock
         if warmup is None:
             warmup = resolve_warmup_env(default=False)
-        self._warmup_lock = threading.Lock()
+        self._warmup_lock = lockwitness.make_lock("server.warmup")
         self._warmup_state = {
             "enabled": bool(warmup),
             "state": "pending" if warmup else "off",
@@ -633,7 +634,7 @@ class ExplainerServer:
         # object at a recycled address while the fingerprint is cached.
         self._model_fp: Optional[str] = None
         self._model_fp_model = None
-        self._model_fp_lock = threading.Lock()
+        self._model_fp_lock = lockwitness.make_lock("server.model_fp")
         self._last_complete_t = time.monotonic()
         # double-buffered host→device staging (see the ``staging``
         # parameter): requested here, resolved against the model's
@@ -771,7 +772,8 @@ class ExplainerServer:
         def _stall_age():
             with self._active_lock:
                 busy = bool(self._active)
-            return (time.monotonic() - self._last_progress) if busy else 0.0
+                last = self._last_progress
+            return (time.monotonic() - last) if busy else 0.0
 
         reg.gauge("dks_serve_last_progress_age_seconds",
                   "Seconds since in-flight device work last progressed "
@@ -1037,13 +1039,15 @@ class ExplainerServer:
                 # finishing is itself the recovery signal
                 with self._active_lock:
                     self._active.pop(id(batch), None)
-                self._last_progress = time.monotonic()
+                    self._last_progress = time.monotonic()
+                    if error is None:
+                        # the device demonstrably finished a full batch —
+                        # that is what _ever_completed represents, so a
+                        # first-batch wedge that later recovers must
+                        # graduate from the generous first_batch_grace_s
+                        # to the normal watchdog timeout
+                        self._ever_completed = True
                 if error is None:
-                    # the device demonstrably finished a full batch — that is
-                    # what _ever_completed represents, so a first-batch wedge
-                    # that later recovers must graduate from the generous
-                    # first_batch_grace_s to the normal watchdog timeout
-                    self._ever_completed = True
                     if self._wedged.is_set():
                         logger.warning("serving recovered: a previously "
                                        "failed batch's device work completed")
@@ -1054,12 +1058,13 @@ class ExplainerServer:
             self._m_batches.inc()
             for _, p in live:
                 self._count_request(p, error)
+        now = time.monotonic()
         with self._active_lock:
             self._active.pop(id(batch), None)
-        now = time.monotonic()
-        self._last_progress = now
+            self._last_progress = now
+            if error is None:
+                self._ever_completed = True
         if error is None:
-            self._ever_completed = True
             if device_rows:
                 # feed admission's projected-wait gate: min of the two
                 # windows is the better throughput estimate in both regimes
@@ -1123,9 +1128,11 @@ class ExplainerServer:
         """Server-specific block of the ``/statusz`` payload: liveness
         state plus the queue/cache views an operator triages with."""
 
+        with self._active_lock:
+            ever_completed = self._ever_completed
         detail = {
             "wedged": self._wedged.is_set(),
-            "ever_completed": self._ever_completed,
+            "ever_completed": ever_completed,
             "scheduling": type(self._sched).__name__,
             "queue_depths": dict(sorted(self._sched.depths().items())),
             "pipeline_depth": self.pipeline_depth or 0,
@@ -1414,7 +1421,8 @@ class ExplainerServer:
                                         root=root)
                         # warmup progress IS device progress — keep the
                         # watchdog's view current through a long ladder
-                        self._last_progress = time.monotonic()
+                        with self._active_lock:
+                            self._last_progress = time.monotonic()
                         with self._warmup_lock:
                             st["completed_buckets"].append(int(b))
                             st["current"] = None
@@ -1661,6 +1669,7 @@ class ExplainerServer:
         buffer: one batch computing, one staged, one forming."""
 
         tr = self._tracer
+        # dks: allow(DKS-C005): deliberate fail-fast — see the comment below
         while not self._stop.is_set():
             # deliberately NO try around batch formation: an exception in
             # next_batch/cache-split has already popped requests this
@@ -1760,6 +1769,12 @@ class ExplainerServer:
             # scheduler and land on warm programs
             self._run_warmup()
             if self._staging_enabled:
+                # deliberate fail-fast — a formation exception has already
+                # popped requests this frame holds no reference to;
+                # swallowing it would leak them into silent per-request
+                # hangs, while propagation kills the dispatcher loudly and
+                # the finally still drains staged leftovers.
+                # dks: allow(DKS-C005): deliberate fail-fast (see above)
                 while True:
                     got = self._staged.get(stop=self._stop)
                     if got is None:
@@ -1779,6 +1794,10 @@ class ExplainerServer:
                     self._complete(item[0], error="server shutting down",
                                    status=503)
                 return
+            # deliberate fail-fast — same contract as the staged branch
+            # above (dispatch errors are guarded inside _dispatch_batch; a
+            # formation error must not be swallowed).
+            # dks: allow(DKS-C005): deliberate fail-fast (see above)
             while not self._stop.is_set():
                 formed = self._form_batch()
                 if formed is None:
@@ -1835,47 +1854,63 @@ class ExplainerServer:
         while not self._stop.is_set():
             if self._stop.wait(min(1.0, self.watchdog_timeout_s / 4)):
                 break
-            with self._active_lock:
-                active = list(self._active.values())
+            try:
+                self._watchdog_tick()
+            except Exception:
+                # the watchdog IS the wedge detector: a transient raise
+                # (a dying registry mid-swap, a torn model reset) must
+                # cost one tick, never the thread — a silently dead
+                # watchdog turns the next device wedge into an
+                # every-socket-hangs-forever outage (DKS-C005)
+                logger.exception("watchdog tick failed")
+
+    def _watchdog_tick(self):
+        """One stall evaluation (see :meth:`_watchdog_loop`)."""
+
+        # progress markers are written by finalizer threads (_complete)
+        # and read by health/statusz handlers: all under _active_lock
+        # (DKS-C001) so a stall age can never pair a torn marker set
+        with self._active_lock:
+            active = list(self._active.values())
             if not active:
                 self._last_progress = time.monotonic()
-                continue
+                return
             stalled_s = time.monotonic() - self._last_progress
             # before the first completed batch, allow the first-compile
             # grace window instead of the steady-state timeout
             limit = (self.watchdog_timeout_s if self._ever_completed
                      else self.first_batch_grace_s)
-            if stalled_s <= limit:
-                continue
-            logger.error(
-                "watchdog: %d in-flight batch(es) made no progress for "
-                "%.0f s; failing them and marking the server wedged",
-                len(active), stalled_s)
-            self._wedged.set()
-            self._m_wedges.inc()
-            self._flight.record("wedge", component="server",
-                                stalled_s=round(stalled_s, 1),
-                                in_flight_batches=len(active))
-            msg = (f"device call exceeded the {limit:.0f}s "
-                   f"watchdog timeout; server marked unhealthy")
-            for batch in active:
-                self._complete(batch, error=msg)
-            # requests parked behind the wedged dispatcher never reach a
-            # device call: fail them too instead of letting them wait out
-            # the pod restart (new arrivals fast-503 via the handler)
-            drained = self._sched.drain()
-            if drained:
-                self._complete(drained, error=msg, status=503)
-            if self._registry is not None:
-                # fleet-wide: every active tenant's device caches ride the
-                # same (possibly restarted) backend
-                self._registry.reset_all()
-            reset = getattr(self.model, "reset", None)
-            if reset is not None:
-                try:
-                    reset()
-                except Exception:
-                    logger.exception("model reset after wedge failed")
+        if stalled_s <= limit:
+            return
+        logger.error(
+            "watchdog: %d in-flight batch(es) made no progress for "
+            "%.0f s; failing them and marking the server wedged",
+            len(active), stalled_s)
+        self._wedged.set()
+        self._m_wedges.inc()
+        self._flight.record("wedge", component="server",
+                            stalled_s=round(stalled_s, 1),
+                            in_flight_batches=len(active))
+        msg = (f"device call exceeded the {limit:.0f}s "
+               f"watchdog timeout; server marked unhealthy")
+        for batch in active:
+            self._complete(batch, error=msg)
+        # requests parked behind the wedged dispatcher never reach a
+        # device call: fail them too instead of letting them wait out
+        # the pod restart (new arrivals fast-503 via the handler)
+        drained = self._sched.drain()
+        if drained:
+            self._complete(drained, error=msg, status=503)
+        if self._registry is not None:
+            # fleet-wide: every active tenant's device caches ride the
+            # same (possibly restarted) backend
+            self._registry.reset_all()
+        reset = getattr(self.model, "reset", None)
+        if reset is not None:
+            try:
+                reset()
+            except Exception:
+                logger.exception("model reset after wedge failed")
 
     def _device_probe_ok(self) -> bool:
         """One tiny device round trip, bounded by ``device_probe_timeout_s``.
@@ -1935,7 +1970,8 @@ class ExplainerServer:
             return 503, {"status": "warming", "warmup": self.warmup_status()}
         with self._active_lock:
             busy = bool(self._active)
-        if busy and (time.monotonic() - self._last_progress
+            last_progress = self._last_progress
+        if busy and (time.monotonic() - last_progress
                      < self.watchdog_timeout_s):
             return 200, {"status": "ok", "detail": "in-flight work "
                          "progressing; device probe skipped"}

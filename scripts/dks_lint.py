@@ -1,0 +1,102 @@
+"""dks-analyze driver (``make lint``).
+
+Runs the three static analyzer families over the package
+(``distributedkernelshap_tpu/analysis/`` — concurrency, JAX contract,
+serving ladder), applies the inline-pragma + ``analysis/baseline.toml``
+suppression contract, and prints one line per finding::
+
+    file:line: DKS-C001 [Class.attr] message (fix: hint)
+
+``--check`` additionally chains the other repo gates — the
+observability drift lint (``scripts/obs_check.py``) and the alert-engine
+golden replay (``scripts/health_check.py``) — behind ONE exit code, and
+asserts the static pass itself stayed inside its 60 s runtime budget
+(the gate must be cheap enough to run on every test invocation).  The
+chained scripts stay working standalone entry points; this driver calls
+their library functions, it does not duplicate their checks.
+
+Exit 0: no unsuppressed findings, no stale baseline entries, gates
+green.  Exit 1 otherwise.
+
+    python scripts/dks_lint.py            # static findings only
+    python scripts/dks_lint.py --check    # the full unified gate
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+#: the static pass must stay cheap enough to gate every `make test`
+STATIC_BUDGET_S = 60.0
+
+
+def run_static(verbose: bool = True):
+    from distributedkernelshap_tpu.analysis.driver import lint_repo
+
+    result = lint_repo(REPO_ROOT)
+    if verbose:
+        for finding in result.active:
+            print(f"dks-lint: {finding.render()}")
+        for err in result.parse_errors:
+            print(f"dks-lint: PARSE ERROR {err}")
+        for entry in result.stale_baseline:
+            print(f"dks-lint: STALE BASELINE entry {entry.id} "
+                  f"{entry.file} [{entry.symbol or '*'}] — the accepted "
+                  f"finding no longer exists; delete the entry")
+    return result
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--check", action="store_true",
+                        help="unified gate: static lint + obs-check + "
+                             "health-check behind one exit code, with "
+                             "the static runtime budget asserted")
+    args = parser.parse_args()
+
+    result = run_static()
+    report = {
+        "files_scanned": result.files_scanned,
+        "findings": len(result.active),
+        "suppressed": len(result.suppressed),
+        "stale_baseline": len(result.stale_baseline),
+        "parse_errors": len(result.parse_errors),
+        "static_elapsed_s": round(result.elapsed_s, 3),
+    }
+    ok = result.ok
+
+    if args.check:
+        if result.elapsed_s > STATIC_BUDGET_S:
+            print(f"dks-lint: static pass took {result.elapsed_s:.1f}s "
+                  f"(budget {STATIC_BUDGET_S:.0f}s) — the gate is too "
+                  f"slow to run on every test invocation")
+            ok = False
+        report["static_budget_s"] = STATIC_BUDGET_S
+        # chained gates: thin delegation to the standalone scripts'
+        # library entry points (no argparse, no check duplication)
+        import scripts.obs_check as obs_check
+
+        obs_problems = obs_check.check(verbose=True)
+        report["obs_check_problems"] = len(obs_problems)
+        ok = ok and not obs_problems
+
+        import scripts.health_check as health_check
+
+        health_report = health_check.run_check()
+        report["health_check_ok"] = bool(health_report["ok"])
+        if not health_report["ok"]:
+            for p in health_report["problems"]:
+                print(f"health-check: {p}")
+        ok = ok and health_report["ok"]
+
+    report["ok"] = bool(ok)
+    print(json.dumps(report))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

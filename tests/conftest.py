@@ -47,6 +47,32 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _lockwitness_teardown():
+    """Runtime lock-order witness teardown (docs/STATIC_ANALYSIS.md).
+
+    Inert unless the suite runs with ``DKS_LOCK_WITNESS=1``: then every
+    named control-plane lock acquired anywhere in the session recorded
+    its acquisition order, and the session fails on a cycle (deadlock
+    hazard that never happened to interleave) or on a hold above the
+    budget.  The budget defaults generously here — a full suite holds
+    the registry's register-serialisation lock across seconds-long
+    warmups by design; ``DKS_LOCK_WITNESS_MAX_HOLD_S`` overrides.
+    """
+
+    from distributedkernelshap_tpu.analysis import lockwitness
+
+    yield
+    if lockwitness.enabled():
+        try:
+            budget = float(
+                os.environ.get("DKS_LOCK_WITNESS_MAX_HOLD_S", "30"))
+        except ValueError:
+            budget = 30.0  # malformed knob: keep the default, as
+            # lockwitness.problems() does for the same variable
+        lockwitness.assert_clean(max_hold_s=budget)
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(0)

@@ -1,0 +1,24 @@
+"""Known-bad: DKS-C001 — bare counter bumped from the worker thread,
+read by a panel method, no common lock."""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.ticks = 0
+
+    def _loop(self):
+        while not self._stop.wait(0.1):
+            try:
+                self.ticks += 1
+            except Exception:
+                pass
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def panel(self):
+        return {"ticks": self.ticks}

@@ -1,0 +1,25 @@
+"""Known-good twin: the counter is mutated and read under the lock."""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.ticks = 0
+
+    def _loop(self):
+        while not self._stop.wait(0.1):
+            try:
+                with self._lock:
+                    self.ticks += 1
+            except Exception:
+                pass
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def panel(self):
+        with self._lock:
+            return {"ticks": self.ticks}

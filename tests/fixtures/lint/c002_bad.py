@@ -1,0 +1,20 @@
+"""Known-bad: DKS-C002 — dict iterated outside the lock while another
+method mutates it in place."""
+
+import threading
+
+
+class Draining:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._draining = {}
+
+    def add(self, index):
+        with self._lock:
+            self._draining[index] = {"since": 0.0}
+
+    def poll(self):
+        ages = []
+        for index in list(self._draining):
+            ages.append(index)
+        return ages

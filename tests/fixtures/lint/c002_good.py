@@ -1,0 +1,21 @@
+"""Known-good twin: the iteration snapshots under the lock."""
+
+import threading
+
+
+class Draining:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._draining = {}
+
+    def add(self, index):
+        with self._lock:
+            self._draining[index] = {"since": 0.0}
+
+    def poll(self):
+        with self._lock:
+            pending = list(self._draining)
+        ages = []
+        for index in pending:
+            ages.append(index)
+        return ages

@@ -1,0 +1,20 @@
+"""Known-bad: DKS-C003 — two locks acquired in both orders."""
+
+import threading
+
+
+class TwoLocks:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.x = 0
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                self.x += 1
+
+    def ba(self):
+        with self._b:
+            with self._a:
+                self.x -= 1

@@ -1,0 +1,20 @@
+"""Known-good twin: one global acquisition order (a before b)."""
+
+import threading
+
+
+class TwoLocks:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.x = 0
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                self.x += 1
+
+    def ab2(self):
+        with self._a:
+            with self._b:
+                self.x -= 1

@@ -1,0 +1,17 @@
+"""Known-bad: DKS-C004 — untimed queue.get() while holding the lock."""
+
+import queue
+import threading
+
+
+class Consumer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self.seen = 0
+
+    def take(self):
+        with self._lock:
+            item = self._q.get()
+            self.seen += 1
+        return item

@@ -1,0 +1,18 @@
+"""Known-good twin: the blocking get happens outside the lock (and the
+in-lock variant is bounded by a timeout)."""
+
+import queue
+import threading
+
+
+class Consumer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self.seen = 0
+
+    def take(self):
+        item = self._q.get(timeout=1.0)
+        with self._lock:
+            self.seen += 1
+        return item

@@ -1,0 +1,18 @@
+"""Known-bad: DKS-C005 — thread loop body with no exception guard."""
+
+import threading
+
+
+class Sampler:
+    def __init__(self):
+        self._stop = threading.Event()
+
+    def _loop(self):
+        while not self._stop.wait(1.0):
+            self.sample_once()
+
+    def sample_once(self):
+        pass
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()

@@ -1,0 +1,24 @@
+"""Known-good twin: the loop body survives a bad tick."""
+
+import logging
+import threading
+
+logger = logging.getLogger(__name__)
+
+
+class Sampler:
+    def __init__(self):
+        self._stop = threading.Event()
+
+    def _loop(self):
+        while not self._stop.wait(1.0):
+            try:
+                self.sample_once()
+            except Exception:
+                logger.exception("tick failed")
+
+    def sample_once(self):
+        pass
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()

@@ -1,0 +1,7 @@
+"""Known-bad: DKS-J001 — a donate_argnums site off the audited list."""
+
+import jax
+
+
+def make_entry(fn):
+    return jax.jit(fn, donate_argnums=(0,))

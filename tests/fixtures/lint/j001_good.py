@@ -1,0 +1,7 @@
+"""Known-good twin: jit without donation needs no audit entry."""
+
+import jax
+
+
+def make_entry(fn):
+    return jax.jit(fn)

@@ -1,0 +1,29 @@
+"""Known-bad: DKS-J002 — a cached consts buffer fed to the donated
+argnum of a known donated entry."""
+
+
+class Engine:
+    def _exact_fn(self, consts):
+        raise NotImplementedError
+
+    def _exact_consts(self):
+        raise NotImplementedError
+
+    def dispatch(self, Xp):
+        consts = self._exact_consts()
+        fn = self._exact_fn(consts)
+        return fn(consts["reach"], Xp)
+
+    def dispatch_shadowed(self, Xp, key):
+        # the cache read reaches the donated call even though a per-call
+        # upload shadows the name afterwards — a last-assignment-wins
+        # (flow-insensitive) model misses this one
+        fn = self._exact_fn(self._exact_consts())
+        batch = self._dev_cache[key]
+        out = fn(batch)
+        batch = upload(Xp)
+        return out
+
+
+def upload(x):
+    raise NotImplementedError

@@ -1,0 +1,28 @@
+"""Known-good twin: only the per-call batch buffer is donated."""
+
+
+class Engine:
+    def _exact_fn(self, consts):
+        raise NotImplementedError
+
+    def _exact_consts(self):
+        raise NotImplementedError
+
+    def dispatch(self, Xp):
+        consts = self._exact_consts()
+        fn = self._exact_fn(consts)
+        return fn(Xp, consts["reach"])
+
+    def dispatch_name_reuse(self, Xp, key):
+        # a cache read assigned AFTER the donated call reuses the name:
+        # flow-sensitive J002 must judge the call against the per-call
+        # upload that actually reaches it, not the later assignment
+        fn = self._exact_fn(self._exact_consts())
+        batch = upload(Xp)
+        out = fn(batch)
+        batch = self._dev_cache[key]
+        return out, batch
+
+
+def upload(x):
+    raise NotImplementedError

@@ -1,0 +1,18 @@
+"""Known-bad: DKS-J003 — host RNG, clock and np-on-traced-arg inside a
+jitted function."""
+
+import time
+
+import numpy as np
+
+from distributedkernelshap_tpu.ops.explain import jit_batch_entry
+
+
+def build(pred):
+    def fn(Xp, consts):
+        noise = np.random.normal(size=3)
+        t0 = time.time()
+        mean = np.mean(Xp)
+        return pred(Xp) + noise[0] + t0 + mean
+
+    return jit_batch_entry(fn, donate_argnums=(0,))

@@ -1,0 +1,13 @@
+"""Known-good twin: jnp math only; constants computed outside."""
+
+import jax.numpy as jnp
+
+from distributedkernelshap_tpu.ops.explain import jit_batch_entry
+
+
+def build(pred, noise0, t0):
+    def fn(Xp, consts):
+        mean = jnp.mean(Xp)
+        return pred(Xp) + noise0 + t0 + mean
+
+    return jit_batch_entry(fn, donate_argnums=(0,))

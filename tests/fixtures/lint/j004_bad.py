@@ -1,0 +1,10 @@
+"""Known-bad: DKS-J004 — static arg defaulting to an unhashable list."""
+
+import jax
+
+
+def fn(x, sizes=[1, 2, 3]):
+    return x
+
+
+entry = jax.jit(fn, static_argnums=(1,))

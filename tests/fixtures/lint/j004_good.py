@@ -1,0 +1,10 @@
+"""Known-good twin: hashable tuple default."""
+
+import jax
+
+
+def fn(x, sizes=(1, 2, 3)):
+    return x
+
+
+entry = jax.jit(fn, static_argnums=(1,))

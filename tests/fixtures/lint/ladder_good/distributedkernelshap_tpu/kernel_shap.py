@@ -1,0 +1,33 @@
+"""Mini engine: one dispatch entry + fingerprint-keyed consts builder
+per structured path."""
+
+
+class Engine:
+    _DEV_CACHE_MAX_ENTRIES = 8
+
+    def content_fingerprint(self):
+        return "fp"
+
+    def _plan_consts(self, plan, chunk):
+        key = (self.content_fingerprint(), chunk)
+        if key in self._plan_consts_cache:
+            return self._plan_consts_cache[key]
+        consts = {"plan": plan}
+        self._plan_consts_cache[key] = consts
+        return consts
+
+    def _exact_consts(self):
+        key = ("exact_consts", self.content_fingerprint())
+        if key in self._plan_consts_cache:
+            return self._plan_consts_cache[key]
+        consts = {"reach": None}
+        self._plan_consts_cache[key] = consts
+        return consts
+
+    def _dispatch_array(self, X, plan):
+        consts = self._plan_consts(plan, 1)
+        return consts
+
+    def _dispatch_exact(self, X):
+        consts = self._exact_consts()
+        return consts

@@ -1,0 +1,305 @@
+"""Regression pins for the genuine defects the dks-analyze static pass
+surfaced on the tree it landed in (ISSUE 15 satellite: each fix cites
+its finding id).  The fixes live in ``resilience/supervisor.py``,
+``serving/autoscaler.py``, ``serving/replicas.py`` and
+``serving/server.py``; these tests fail against the pre-fix code —
+probabilistically for the data races (the hammers reliably trip
+"changed size during iteration" / torn counters within their budgets on
+unlocked code), deterministically for the dead-thread guards."""
+
+import threading
+import time
+
+import pytest
+
+from distributedkernelshap_tpu.resilience.supervisor import (
+    ReplicaSupervisor,
+    RestartPolicy,
+)
+from distributedkernelshap_tpu.serving.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+)
+from distributedkernelshap_tpu.serving.replicas import FanInProxy
+from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+
+class _FakeProc:
+    def __init__(self, returncode=None):
+        self.returncode = returncode
+
+    def poll(self):
+        return self.returncode
+
+
+# --------------------------------------------------------------------- #
+# DKS-C001/C002 @ resilience/supervisor.py — crash bookkeeping raced the
+# autoscaler's track/retire and the statusz stats() reader
+# --------------------------------------------------------------------- #
+
+
+def test_supervisor_bookkeeping_survives_concurrent_scaler_traffic():
+    """Finding DKS-C001 [ReplicaSupervisor._retired / _respawn_at /
+    _consecutive]: ``_tick`` mutated the crash books while the
+    autoscaler thread called ``track``/``retire`` and statusz handlers
+    called ``stats``/``is_retired`` — all unlocked."""
+
+    procs = [_FakeProc(returncode=0) for _ in range(8)]
+    sup = ReplicaSupervisor(
+        procs, lambda i: _FakeProc(),
+        policy=RestartPolicy(base_backoff_s=0.001, max_backoff_s=0.001,
+                             jitter_frac=0.0, seed=0),
+        poll_interval_s=3600)
+    stop = time.monotonic() + 1.0
+    errors = []
+
+    def scaler():
+        i = 0
+        while time.monotonic() < stop:
+            try:
+                sup.retire(i % 8)
+                sup.track(i % 8)
+                sup.is_retired((i + 3) % 8)
+            except Exception as e:      # pragma: no cover - the defect
+                errors.append(e)
+                return
+            i += 1
+
+    def panel():
+        while time.monotonic() < stop:
+            try:
+                s = sup.stats()
+                assert set(s) == {"restarts_total",
+                                  "crash_loops_backing_off", "retired"}
+            except Exception as e:      # pragma: no cover - the defect
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=scaler),
+               threading.Thread(target=scaler),
+               threading.Thread(target=panel)]
+    for t in threads:
+        t.start()
+    while time.monotonic() < stop:
+        sup._tick()
+    for t in threads:
+        t.join(10)
+    assert errors == []
+
+
+def test_supervisor_book_calls_never_deadlock_against_the_owner_lock():
+    """The fix deliberately gave the books their OWN lock: the owner
+    (``ReplicaManager.spawn_replica``) calls ``is_retired()`` while
+    holding the procs lock it passed as ``lock=`` — bookkeeping guarded
+    by that same lock would self-deadlock."""
+
+    owner_lock = threading.Lock()
+    sup = ReplicaSupervisor([_FakeProc()], lambda i: _FakeProc(),
+                            poll_interval_s=3600, lock=owner_lock)
+    done = threading.Event()
+
+    def owner_path():
+        with owner_lock:                # the spawn_replica pattern
+            sup.is_retired(0)
+            sup.stats()
+            sup.retire(0)
+            sup.track(0)
+        done.set()
+
+    t = threading.Thread(target=owner_path, daemon=True)
+    t.start()
+    assert done.wait(5), \
+        "supervisor bookkeeping deadlocked against the owner's procs lock"
+
+
+# --------------------------------------------------------------------- #
+# DKS-C001/C002 @ serving/autoscaler.py — the statusz panel read streaks,
+# cooldown stamps, tick counts and the draining book without the lock
+# --------------------------------------------------------------------- #
+
+
+class _IdleFleet:
+    def spawn_replica(self, standby=False):      # pragma: no cover
+        return 0
+
+    def retire_replica(self, index):             # pragma: no cover
+        pass
+
+
+def _proxy():
+    return FanInProxy([("127.0.0.1", 1)], probe_interval_s=3600,
+                      health_interval_s=0)
+
+
+def test_autoscaler_panel_survives_concurrent_tick_state():
+    """Finding DKS-C001/C002 [Autoscaler._draining / _up_streak /
+    ticks_total]: ``statusz_panel`` (proxy handler threads) iterated the
+    draining book and read the decision state while the scaler thread
+    mutated them."""
+
+    scaler = Autoscaler(_IdleFleet(), _proxy(),
+                        config=AutoscalerConfig(max_replicas=4))
+    stop = time.monotonic() + 1.0
+    errors = []
+
+    def mutator():
+        i = 0
+        while time.monotonic() < stop:
+            with scaler._lock:           # the tick path's write pattern
+                scaler._draining[i % 5] = {"since": time.monotonic()}
+                scaler._draining.pop((i + 2) % 5, None)
+                scaler._up_streak += 1
+                scaler.ticks_total += 1
+                scaler._last_decision = {"action": "none",
+                                         "reason": "test",
+                                         "t": time.monotonic()}
+            i += 1
+
+    def reader():
+        while time.monotonic() < stop:
+            try:
+                panel = scaler.statusz_panel()
+                assert isinstance(panel["ticks_total"], int)
+                assert isinstance(panel["draining_age_s"], dict)
+            except Exception as e:      # pragma: no cover - the defect
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=mutator)] + \
+        [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    assert errors == []
+
+
+# --------------------------------------------------------------------- #
+# DKS-C005 @ serving/replicas.py — an unexpected raise inside the probe
+# sweep silently killed the process's ONE dead-replica recovery thread
+# --------------------------------------------------------------------- #
+
+
+def test_prober_thread_survives_a_raising_sweep(monkeypatch):
+    """Finding DKS-C005 [_probe_loop]: per-probe OSError handling did
+    not cover e.g. a roster mutated mid-sweep; the first stray raise
+    ended the loop and dead replicas stayed dead forever."""
+
+    proxy = FanInProxy([("127.0.0.1", 1)], probe_interval_s=0.01,
+                       health_interval_s=0)
+    calls = []
+    survived = threading.Event()
+
+    def sweep():
+        calls.append(1)
+        if len(calls) == 1:
+            raise RuntimeError("roster mutated mid-sweep")
+        survived.set()
+
+    monkeypatch.setattr(proxy, "_probe_sweep", sweep)
+    t = threading.Thread(target=proxy._probe_loop, daemon=True)
+    t.start()
+    try:
+        assert survived.wait(10), \
+            "the prober thread died on the first sweep exception"
+    finally:
+        proxy._stop.set()
+        t.join(10)
+    assert len(calls) >= 2
+
+
+# --------------------------------------------------------------------- #
+# DKS-C005 @ serving/server.py — same class of defect in the watchdog:
+# a transient raise in the stall evaluation killed the wedge detector
+# --------------------------------------------------------------------- #
+
+
+def test_watchdog_thread_survives_a_raising_tick(monkeypatch):
+    """Finding DKS-C005 [_watchdog_loop]: a raise in the tick (a dying
+    registry mid-swap, a torn model reset) silently disabled wedge
+    detection — the next device hang became an every-socket-hangs
+    outage instead of a failed health check."""
+
+    class _Stub:
+        pass
+
+    srv = ExplainerServer(_Stub(), health_interval_s=0,
+                          watchdog_timeout_s=0.05)  # tick every ~12 ms
+    calls = []
+    survived = threading.Event()
+
+    def tick():
+        calls.append(1)
+        if len(calls) == 1:
+            raise RuntimeError("registry swap raced the tick")
+        survived.set()
+
+    monkeypatch.setattr(srv, "_watchdog_tick", tick)
+    t = threading.Thread(target=srv._watchdog_loop, daemon=True)
+    t.start()
+    try:
+        assert survived.wait(10), \
+            "the watchdog thread died on the first tick exception"
+    finally:
+        srv._stop.set()
+        t.join(10)
+    assert len(calls) >= 2
+
+
+# --------------------------------------------------------------------- #
+# DKS-C001 @ serving/server.py — progress markers (_last_progress,
+# _ever_completed) were written by finalizer threads and read by
+# health/statusz handlers without a common guard
+# --------------------------------------------------------------------- #
+
+
+def test_progress_markers_are_consistent_under_concurrent_completion():
+    """Finding DKS-C001 [ExplainerServer._last_progress /
+    _ever_completed]: the stall-age gauge could pair a stale
+    ``_last_progress`` with a fresh ``_active`` view (and vice versa),
+    yielding phantom stall ages; both markers now move under
+    ``_active_lock`` together with the active-batch book."""
+
+    import numpy as np
+
+    from distributedkernelshap_tpu.serving.server import _Pending
+
+    class _Stub:
+        pass
+
+    srv = ExplainerServer(_Stub(), health_interval_s=0)
+    stop = time.monotonic() + 1.0
+    errors = []
+
+    def completer():
+        while time.monotonic() < stop:
+            p = _Pending(np.ones((1, 2), dtype=np.float32))
+            p.done = True
+            batch = [p]
+            with srv._active_lock:
+                srv._active[id(batch)] = batch
+            srv._complete(batch, payloads=["{}"])
+
+    def health_reader():
+        while time.monotonic() < stop:
+            try:
+                with srv._active_lock:
+                    busy = bool(srv._active)
+                    last = srv._last_progress
+                age = (time.monotonic() - last) if busy else 0.0
+                # a marker paired under the lock can never be from the
+                # future, and an idle server never reports a stall
+                assert age >= 0.0
+                assert srv._ever_completed in (True, False)
+            except Exception as e:      # pragma: no cover - the defect
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=completer),
+               threading.Thread(target=health_reader)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    assert errors == []
+    assert srv._ever_completed          # completions really flowed

@@ -1,0 +1,207 @@
+"""Runtime lock-order witness (``analysis/lockwitness.py``): factory
+gating by the env knob, acquisition-order edge recording, cycle
+detection across threads, hold-time budgets, condition-wait accounting,
+and the tier-1 smoke — a live server start/probe/stop records a
+cycle-free graph over the named control-plane locks.  Full witness
+sweeps (every chaos scenario under ``DKS_LOCK_WITNESS=1``) stay behind
+``make chaos-bench``."""
+
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from distributedkernelshap_tpu.analysis import lockwitness
+
+
+@pytest.fixture()
+def witness(monkeypatch):
+    """Witness ON with clean process-wide state, reset afterwards so no
+    edges leak into other tests (or the conftest session teardown)."""
+
+    monkeypatch.setenv(lockwitness.ENV_KNOB, "1")
+    lockwitness.reset()
+    yield lockwitness
+    lockwitness.reset()
+
+
+def test_disabled_by_default_returns_plain_primitives(monkeypatch):
+    monkeypatch.delenv(lockwitness.ENV_KNOB, raising=False)
+    assert not lockwitness.enabled()
+    lock = lockwitness.make_lock("plain")
+    assert not isinstance(lock, lockwitness.WitnessedLock)
+    cond = lockwitness.make_condition("plain.cond")
+    assert isinstance(cond, threading.Condition)
+    assert not isinstance(cond._lock, lockwitness.WitnessedLock)
+    # "0"/"false"/"off" also mean off
+    for off in ("0", "false", "off"):
+        monkeypatch.setenv(lockwitness.ENV_KNOB, off)
+        assert not lockwitness.enabled()
+
+
+def test_consistent_order_records_edges_and_stays_clean(witness):
+    a = witness.make_lock("t.a")
+    b = witness.make_lock("t.b")
+    assert isinstance(a, witness.WitnessedLock)
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    snap = witness.assert_clean()          # acyclic: a -> b only
+    assert snap["edges"] == {("t.a", "t.b"): 3}
+    assert snap["acquisitions"] == {"t.a": 3, "t.b": 3}
+    assert witness.problems() == []
+
+
+def test_order_inversion_across_threads_is_a_cycle(witness):
+    """The TSan-lite property: the deadlock needs the threads to
+    interleave, but the witness flags the ORDER inversion even on a run
+    that got lucky and never hung."""
+
+    a = witness.make_lock("t.a")
+    b = witness.make_lock("t.b")
+    with a:
+        with b:
+            pass
+
+    def inverted():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=inverted)
+    t.start()
+    t.join(5)
+    issues = witness.problems()
+    assert len(issues) == 1 and "cycle" in issues[0]
+    assert "t.a" in issues[0] and "t.b" in issues[0]
+    with pytest.raises(AssertionError, match="cycle"):
+        witness.assert_clean()
+
+
+def test_same_thread_inversion_is_also_caught(witness):
+    a = witness.make_lock("s.a")
+    b = witness.make_lock("s.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert witness.find_cycle_in_edges(
+        witness.snapshot()["edges"]) is not None
+
+
+def test_hold_time_budget(witness):
+    a = witness.make_lock("slow.lock")
+    with a:
+        time.sleep(0.05)
+    assert witness.problems(max_hold_s=1.0) == []
+    issues = witness.problems(max_hold_s=0.01)
+    assert len(issues) == 1 and "slow.lock" in issues[0]
+    assert "must not bracket blocking work" in issues[0]
+
+
+def test_same_name_instances_never_fabricate_a_cycle(witness):
+    """Two DISTINCT locks sharing one factory name (two models'
+    ``registry.model`` conditions) must not produce a self-edge (an
+    instant false cycle); the nesting is counted in the snapshot
+    instead (documented limitation: their relative order is not
+    verifiable through the name-keyed graph)."""
+
+    a = witness.make_lock("model.cond")
+    b = witness.make_lock("model.cond")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    snap = witness.snapshot()
+    assert snap["edges"] == {}
+    assert snap["same_name_nestings"] == {"model.cond": 2}
+    assert witness.problems() == []
+    # and each instance's release matched ITS OWN acquisition
+    assert snap["acquisitions"]["model.cond"] == 4
+
+
+def test_rlock_nesting(witness):
+    r = witness.make_rlock("re.lock")
+    with r:
+        with r:
+            pass
+    snap = witness.snapshot()
+    assert snap["acquisitions"]["re.lock"] == 2
+    # re-acquiring the SAME lock is not an ordering edge
+    assert snap["edges"] == {}
+    assert witness.problems() == []
+
+
+def test_condition_wait_releases_the_hold_clock(witness):
+    """``Condition.wait`` releases through the wrapper, so a long wait
+    must NOT count as a long hold (waiters hold nothing)."""
+
+    cond = witness.make_condition("w.cond")
+    with cond:
+        cond.wait(0.3)
+    snap = witness.snapshot()
+    # two short holds (pre-wait, post-wakeup), not one 0.3 s hold
+    assert snap["acquisitions"]["w.cond"] == 2
+    assert snap["max_hold_s"]["w.cond"] < 0.2
+    assert witness.problems(max_hold_s=0.2) == []
+
+
+def test_reset_clears_all_state(witness):
+    a = witness.make_lock("r.a")
+    with a:
+        pass
+    assert witness.snapshot()["acquisitions"]
+    witness.reset()
+    snap = witness.snapshot()
+    assert snap["edges"] == {} and snap["acquisitions"] == {}
+    assert snap["overhead_s"] == 0.0
+
+
+def test_overhead_is_metered(witness):
+    a = witness.make_lock("o.a")
+    for _ in range(100):
+        with a:
+            pass
+    snap = witness.snapshot()
+    assert 0.0 < snap["overhead_s"] < 0.5
+
+
+# --------------------------------------------------------------------- #
+# tier-1 smoke: live server start/probe/stop under the witness
+# --------------------------------------------------------------------- #
+
+
+def test_live_server_lock_graph_is_acyclic(witness):
+    """The acceptance smoke: a real ``ExplainerServer`` start → health
+    probe → metrics scrape → statusz render → stop cycle, with every
+    named control-plane lock witnessed, must record an acyclic
+    acquisition graph and respect the hold budget (30 s here: the probe
+    compiles a trivial device op on first use)."""
+
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    class _Stub:                      # /healthz probes the DEVICE, not
+        pass                          # the model: a stub serves fine
+
+    srv = ExplainerServer(_Stub(), host="127.0.0.1", port=0,
+                          max_batch_size=1, health_interval_s=0).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        for route in ("/healthz", "/metrics", "/statusz?format=json"):
+            with urllib.request.urlopen(base + route, timeout=30) as resp:
+                assert resp.status == 200
+    finally:
+        srv.stop()
+    snap = lockwitness.assert_clean(max_hold_s=30.0)
+    assert snap["acquisitions"], \
+        "the witness observed no named locks — the server's control " \
+        "plane is no longer wired through lockwitness.make_lock"
+    observed = set(snap["acquisitions"])
+    assert any(name.startswith("server.") for name in observed)
+    assert "scheduler.cond" in observed

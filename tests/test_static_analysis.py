@@ -1,0 +1,337 @@
+"""dks-analyze static analyzer (``distributedkernelshap_tpu/analysis/``):
+every check id fires on its known-bad fixture and stays silent on the
+known-good twin, the pragma + baseline suppression contract, baseline
+drift, the serving-ladder rung-deletion failures (fixture tree AND the
+real artifacts), and the repo-wide ``make lint`` green invariant with
+its runtime budget."""
+
+import ast
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from distributedkernelshap_tpu.analysis import concurrency, jax_contract, \
+    ladder
+from distributedkernelshap_tpu.analysis.core import (
+    apply_suppressions,
+    load_baseline,
+    suppressed_lines,
+)
+from distributedkernelshap_tpu.analysis.driver import (
+    lint_repo,
+    package_sources,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO_ROOT, "tests", "fixtures", "lint")
+LADDER_GOOD = os.path.join(FIXTURES, "ladder_good")
+
+FAMILY = {"DKS-C": concurrency.check_module,
+          "DKS-J": jax_contract.check_module}
+
+
+def _findings(path: str, check_id: str):
+    """Findings of ONE check id from the family module that owns it (a
+    fixture may legitimately trip a sibling check — e.g. the J003 twins
+    both carry a ``donate_argnums`` site that J001 would flag)."""
+
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    tree = ast.parse(src, filename=path)
+    rel = os.path.relpath(path, REPO_ROOT).replace(os.sep, "/")
+    check = FAMILY[check_id[:5]]
+    return [f for f in check(tree, rel) if f.check_id == check_id], src
+
+
+CHECK_IDS = ["DKS-C001", "DKS-C002", "DKS-C003", "DKS-C004", "DKS-C005",
+             "DKS-J001", "DKS-J002", "DKS-J003", "DKS-J004"]
+
+
+@pytest.mark.parametrize("check_id", CHECK_IDS)
+def test_known_bad_fixture_fires(check_id):
+    stem = check_id.replace("DKS-", "").lower()
+    hits, _ = _findings(os.path.join(FIXTURES, f"{stem}_bad.py"), check_id)
+    assert hits, f"{check_id} did not fire on its known-bad fixture"
+    for f in hits:
+        assert f.line > 0
+        assert f.hint, "every finding must carry a fix hint"
+        rendered = f.render()
+        assert check_id in rendered and f"{f.file}:{f.line}" in rendered
+
+
+@pytest.mark.parametrize("check_id", CHECK_IDS)
+def test_known_good_twin_stays_clean(check_id):
+    stem = check_id.replace("DKS-", "").lower()
+    hits, _ = _findings(os.path.join(FIXTURES, f"{stem}_good.py"),
+                        check_id)
+    assert hits == [], f"{check_id} false-positives on its known-good twin"
+
+
+def test_j003_flags_all_three_impurity_kinds():
+    """The bad fixture carries host RNG, a clock read and np-on-traced —
+    each must be individually reported, not collapsed into one."""
+
+    hits, _ = _findings(os.path.join(FIXTURES, "j003_bad.py"), "DKS-J003")
+    messages = " ".join(f.message for f in hits)
+    assert "np.random" in messages
+    assert "time.time" in messages
+    assert "numpy cannot consume tracers" in messages
+
+
+# --------------------------------------------------------------------- #
+# suppression: inline pragmas
+# --------------------------------------------------------------------- #
+
+
+def test_pragma_covers_own_line_and_line_below():
+    src = ("x = 1  # dks: allow(DKS-C001)\n"
+           "\n"
+           "# dks: allow(DKS-C002, DKS-C004): deliberate, reviewed\n"
+           "y = 2\n")
+    allowed = suppressed_lines(src)
+    assert allowed[1] == {"DKS-C001"}
+    assert allowed[2] == {"DKS-C001"}          # line below the pragma
+    assert allowed[3] == {"DKS-C002", "DKS-C004"}
+    assert allowed[4] == {"DKS-C002", "DKS-C004"}
+    assert 5 not in allowed
+
+
+def test_pragma_suppresses_only_the_named_id(tmp_path):
+    bad = os.path.join(FIXTURES, "c001_bad.py")
+    with open(bad, encoding="utf-8") as fh:
+        src = fh.read()
+    assert "self.ticks += 1" in src
+    # the WRONG id on the flagged line must not suppress C001
+    wrong = src.replace("self.ticks += 1",
+                        "self.ticks += 1  # dks: allow(DKS-C002)")
+    right = src.replace("self.ticks += 1",
+                        "self.ticks += 1  # dks: allow(DKS-C001)")
+    for variant, expect_active in ((wrong, 1), (right, 0)):
+        tree = ast.parse(variant)
+        raw = [f for f in concurrency.check_module(tree, "pkg/mod.py")
+               if f.check_id == "DKS-C001"]
+        active, suppressed, stale = apply_suppressions(
+            raw, {"pkg/mod.py": variant}, [])
+        assert len(active) == expect_active
+        assert len(suppressed) == len(raw) - expect_active
+        assert stale == []
+
+
+# --------------------------------------------------------------------- #
+# suppression: committed baseline + drift
+# --------------------------------------------------------------------- #
+
+
+def _lint_tree(tmp_path, extra_module=None, baseline_text=None):
+    """A scannable tree: the ladder_good fixture package (rung-complete,
+    so the ladder family is quiet) plus an optional extra module and
+    baseline, linted via the real ``lint_repo`` entry point."""
+
+    root = tmp_path / "tree"
+    if not root.exists():
+        shutil.copytree(LADDER_GOOD, root)
+    if extra_module is not None:
+        (root / "distributedkernelshap_tpu" / "mod.py").write_text(
+            extra_module)
+    if baseline_text is not None:
+        adir = root / "distributedkernelshap_tpu" / "analysis"
+        adir.mkdir(exist_ok=True)
+        (adir / "baseline.toml").write_text(baseline_text)
+    return lint_repo(str(root))
+
+
+def test_ladder_good_tree_is_clean(tmp_path):
+    result = _lint_tree(tmp_path)
+    assert result.ok, [f.render() for f in result.active]
+    assert result.files_scanned >= 6
+
+
+def test_new_finding_fails_and_baseline_suppresses(tmp_path):
+    with open(os.path.join(FIXTURES, "c001_bad.py"),
+              encoding="utf-8") as fh:
+        bad_src = fh.read()
+    result = _lint_tree(tmp_path, extra_module=bad_src)
+    assert not result.ok
+    assert [f.check_id for f in result.active] == ["DKS-C001"]
+    finding = result.active[0]
+    baseline = (
+        '[[finding]]\n'
+        f'id = "{finding.check_id}"\n'
+        f'file = "{finding.file}"\n'
+        f'symbol = "{finding.symbol}"\n'
+        'justification = "pre-existing, tracked in ISSUE-99"\n')
+    result = _lint_tree(tmp_path, extra_module=bad_src,
+                        baseline_text=baseline)
+    assert result.ok
+    assert len(result.suppressed) == 1
+    # an empty-symbol entry matches any symbol in the file
+    result = _lint_tree(tmp_path, extra_module=bad_src, baseline_text=(
+        '[[finding]]\n'
+        f'id = "{finding.check_id}"\n'
+        f'file = "{finding.file}"\n'))
+    assert result.ok
+
+
+def test_stale_baseline_entry_fails_the_lint(tmp_path):
+    """Drift: once the accepted finding is fixed, its baseline entry must
+    be deleted — a matching-nothing entry is itself a failure."""
+
+    result = _lint_tree(tmp_path, baseline_text=(
+        '[[finding]]\n'
+        'id = "DKS-C001"\n'
+        'file = "distributedkernelshap_tpu/mod.py"\n'
+        'symbol = "Worker.ticks"\n'
+        'justification = "the debt was paid; this entry is now stale"\n'))
+    assert not result.ok
+    assert len(result.stale_baseline) == 1
+    assert result.stale_baseline[0].id == "DKS-C001"
+
+
+def test_malformed_baseline_raises(tmp_path):
+    p = tmp_path / "baseline.toml"
+    p.write_text('[[finding]]\nid = "DKS-C001"\nfile = unquoted\n')
+    with pytest.raises(ValueError, match="unparseable"):
+        load_baseline(str(p))
+    p.write_text('id = "DKS-C001"\n')
+    with pytest.raises(ValueError, match="outside"):
+        load_baseline(str(p))
+    p.write_text('[[finding]]\nid = "DKS-C001"\nfile = "f.py"\n'
+                 'severity = "high"\n')
+    with pytest.raises(ValueError, match="unknown baseline key"):
+        load_baseline(str(p))
+    assert load_baseline(str(tmp_path / "missing.toml")) == []
+
+
+# --------------------------------------------------------------------- #
+# serving-ladder contract: rung deletions must fail
+# --------------------------------------------------------------------- #
+
+
+def _ladder_findings(root):
+    return ladder.check_ladder(str(root), package_sources(str(root)))
+
+
+def _mutated_tree(tmp_path, rel, old, new):
+    root = tmp_path / "tree"
+    shutil.copytree(LADDER_GOOD, root)
+    target = root / rel
+    src = target.read_text()
+    assert old in src, f"mutation anchor {old!r} missing from {rel}"
+    target.write_text(src.replace(old, new))
+    return root
+
+
+PKG = "distributedkernelshap_tpu"
+RUNG_DELETIONS = [
+    # (deleted artifact, expected check id, rel path, old, new)
+    ("dispatch entry", "DKS-L001", f"{PKG}/kernel_shap.py",
+     "def _dispatch_exact(", "def _dispatch_exact_gone("),
+    ("consts builder", "DKS-L002", f"{PKG}/kernel_shap.py",
+     "def _exact_consts(", "def _exact_consts_gone("),
+    ("consts fingerprint key", "DKS-L002", f"{PKG}/kernel_shap.py",
+     'key = ("exact_consts", self.content_fingerprint())',
+     'key = ("exact_consts",)'),
+    ("serve label seed", "DKS-L003", f"{PKG}/serving/wrappers.py",
+     '"exact": 0.0, ', ""),
+    ("explain_path selection", "DKS-L003", f"{PKG}/serving/wrappers.py",
+     'self.explain_path = "exact"', "pass"),
+    ("fallback counter family", "DKS-L004", f"{PKG}/ops/treeshap.py",
+     '"dks_treeshap_fallback_total"', '"no_longer_registered_anywhere"'),
+    ("warmup path= literal", "DKS-L005", f"{PKG}/runtime/compile_cache.py",
+     ',path=', ',p='),
+    ("warmup explain_path pass-through", "DKS-L005",
+     f"{PKG}/serving/server.py",
+     'getattr(model, "explain_path", None)', "None"),
+]
+
+
+@pytest.mark.parametrize(
+    "artifact,check_id,rel,old,new", RUNG_DELETIONS,
+    ids=[r[0].replace(" ", "-") for r in RUNG_DELETIONS])
+def test_deleting_a_rung_artifact_fails(tmp_path, artifact, check_id,
+                                        rel, old, new):
+    root = _mutated_tree(tmp_path, rel, old, new)
+    hits = [f for f in _ladder_findings(root) if f.check_id == check_id]
+    assert hits, f"deleting the {artifact} did not raise {check_id}"
+
+
+def test_new_engine_path_fails_until_fully_wired(tmp_path):
+    """Adding a name to ENGINE_PATHS without its rung (the quadratic/GAM
+    scenario, ROADMAP item 4) must fail on every missing artifact."""
+
+    root = _mutated_tree(
+        tmp_path, f"{PKG}/registry/classify.py",
+        '("linear", "exact_tree", "sampled")',
+        '("linear", "exact_tree", "sampled", "quadratic")')
+    got = {f.check_id for f in _ladder_findings(root)
+           if f.symbol == "path:quadratic"}
+    assert got == {"DKS-L001", "DKS-L002", "DKS-L003", "DKS-L004"}
+
+
+def test_missing_engine_paths_is_itself_a_finding(tmp_path):
+    root = _mutated_tree(tmp_path, f"{PKG}/registry/classify.py",
+                         "ENGINE_PATHS", "OTHER_PATHS")
+    hits = _ladder_findings(root)
+    assert [f.check_id for f in hits] == ["DKS-L003"]
+    assert "no path universe" in hits[0].message
+
+
+def test_real_tree_rung_deletion_fails(tmp_path):
+    """The acceptance drill on the REAL artifacts: copy the ladder's
+    artifact files out of the repo, verify the copy lints clean, then
+    strip the warmup ``path=`` signature literal — DKS-L005 must fire."""
+
+    root = tmp_path / "repo"
+    for rel in (ladder.CLASSIFY, ladder.ENGINE, ladder.WRAPPERS,
+                ladder.COMPILE_CACHE, ladder.SERVER):
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copyfile(os.path.join(REPO_ROOT, rel), dst)
+    real_sources = package_sources(REPO_ROOT)
+    clean = ladder.check_ladder(str(root), real_sources)
+    assert clean == [], [f.render() for f in clean]
+    cc = root / ladder.COMPILE_CACHE
+    src = cc.read_text()
+    assert ",path=" in src
+    cc.write_text(src.replace(",path=", ",p="))
+    hits = ladder.check_ladder(str(root), real_sources)
+    assert any(f.check_id == "DKS-L005" and
+               f.file == ladder.COMPILE_CACHE for f in hits)
+
+
+# --------------------------------------------------------------------- #
+# repo-wide gate
+# --------------------------------------------------------------------- #
+
+
+def test_repo_lint_is_green_inside_budget():
+    """The tree this test ships in must lint clean — and fast enough to
+    gate every ``make test`` (the driver's --check asserts the same 60 s
+    budget on its own timing)."""
+
+    result = lint_repo(REPO_ROOT)
+    assert result.ok, [f.render() for f in result.active] + \
+        [str(e) for e in result.stale_baseline] + result.parse_errors
+    assert result.files_scanned >= 70
+    assert result.elapsed_s < 60.0
+
+
+def test_driver_cli_static_pass(tmp_path):
+    """``scripts/dks_lint.py`` (no flags) is the static-only entry point:
+    exit 0 on this tree, one JSON report line on stdout."""
+
+    import json
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts",
+                                      "dks_lint.py")],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["ok"] is True
+    assert report["findings"] == 0
+    assert report["stale_baseline"] == 0
+    assert report["parse_errors"] == 0
