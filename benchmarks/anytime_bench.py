@@ -1,0 +1,472 @@
+"""Anytime-refinement benchmark + CI gate (``make anytime-bench``).
+
+The anytime subsystem's three load-bearing claims, measured end to end
+(standalone, CPU backend, exits nonzero on ``--check`` fail):
+
+1. **Resume bit-identity** — a run preempted after round ``k``, exported
+   with :meth:`AnytimeRun.export_state` and restored onto a FRESH engine,
+   must finish with phi (and reported error) bit-identical to the
+   uninterrupted run at the same cumulative nsamples, for every split
+   point of the schedule.  This is what makes the scheduler's round
+   boundaries true preemption points: requeueing loses nothing.
+2. **Calibration honesty** — the engine's calibrated reported error must
+   bound the TRUE error (vs exact-TN ground truth) within
+   x``ANYTIME_ERR_BOUND`` at >= ``ANYTIME_COVERAGE`` of observed rounds.
+   The measurement is ``estimator_accuracy.sweep_anytime`` — the ONE
+   definition both gates share, so this bench and ``make accuracy-gate``
+   can never drift apart on what "honest" means.
+3. **Overload A/B** — an open-loop arrival stream (arrivals never wait
+   for completions) at ~2x the measured full-fidelity capacity, every
+   request interactive with a real deadline, against the SAME server
+   twice: the anytime arm declares an ``X-DKS-Error-Budget`` (plus a few
+   streamed-round probes), the control arm takes the classic
+   fixed-nsamples path.  Criteria: the anytime arm answers EVERY admitted
+   request by its deadline (degraded, never shed-after-admission) and
+   each streamed probe's reported error is monotone non-increasing with a
+   final frame; the control arm visibly degrades — sheds/expiries or an
+   interactive p99 past the deadline.
+
+Self-records ``wall_s``, ``err_at_deadline`` (mean reported error of the
+answers the anytime arm actually returned — the degradation depth the
+deadline bought) and ``rounds_per_request_p50`` into
+``results/perf_history.jsonl`` with ``checks_ok``, so ``make perf-gate``
+fails a commit that regresses refinement depth or residual error.
+
+    JAX_PLATFORMS=cpu python benchmarks/anytime_bench.py --check
+"""
+
+import argparse
+import http.client
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO_ROOT = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO_ROOT)
+
+from benchmarks.estimator_accuracy import (  # noqa: E402
+    ANYTIME_COVERAGE,
+    ANYTIME_ERR_BOUND,
+    ANYTIME_NSAMPLES,
+    _monotonic_ish,
+    build_anytime_model,
+    sweep_anytime,
+)
+from benchmarks.regression_gate import (  # noqa: E402
+    DEFAULT_HISTORY,
+    config_fingerprint,
+    record_run,
+)
+
+#: open-loop arrival rate as a multiple of measured full-fidelity
+#: capacity — the regime where the classic path must fall over and the
+#: anytime path must degrade instead
+OVERLOAD = 2.0
+#: per-request deadline (every request interactive)
+DEADLINE_MS = 400
+#: client-side slack on the deadline criterion: stdlib HTTP connection +
+#: thread-spawn overhead rides on top of the server-side answer
+DEADLINE_SLACK_S = 0.20
+#: declared error budget — far below the schedule's exhaustion error, so
+#: every request refines until the deadline or the schedule runs dry
+ERROR_BUDGET = "0.001"
+#: overload-phase request count and streamed-probe share
+N_REQUESTS = 80
+STREAM_EVERY = 10
+
+#: overload serving model: M=16 tensor-train at 4 rows/request sizes the
+#: full-fidelity request at ~60 ms on CPU (device work dominates the
+#: ~1 ms stdlib HTTP overhead) with a round-0 cost ~8x cheaper — real
+#: degradation headroom for the anytime arm
+SERVE_M = 16
+SERVE_RANK = 4
+SERVE_BG = 48
+SERVE_NSAMPLES = 768
+SERVE_ROWS = 4
+
+
+# --------------------------------------------------------------------- #
+# phase 1: resume bit-identity
+# --------------------------------------------------------------------- #
+
+
+def run_resume_phase(seed: int = 0) -> dict:
+    """Straight run vs export-after-round-k + restore-on-fresh-engine,
+    for every split point: final phi and reported error must be
+    bit-identical (``np.array_equal``, not allclose) at the same
+    cumulative nsamples."""
+
+    from distributedkernelshap_tpu import KernelShap
+    from distributedkernelshap_tpu.anytime.engine import AnytimeRun
+
+    pred, bg, X, _ = build_anytime_model(seed)
+
+    def fresh_engine():
+        explainer = KernelShap(pred, seed=seed)
+        explainer.fit(bg)
+        return explainer._explainer
+
+    engine = fresh_engine()
+    straight = engine.anytime_begin(X, nsamples=ANYTIME_NSAMPLES)
+    final = None
+    while not straight.done:
+        final = straight.step()
+
+    splits, identical = [], []
+    for k in range(1, straight.schedule.n_rounds):
+        part = engine.anytime_begin(X, nsamples=ANYTIME_NSAMPLES)
+        for _ in range(k):
+            part.step()
+        snap = part.export_state()
+        other = fresh_engine()
+        resumed = AnytimeRun.restore(
+            other, other._anytime_schedule(ANYTIME_NSAMPLES), snap)
+        res = None
+        while not resumed.done:
+            res = resumed.step()
+        splits.append(k)
+        identical.append(
+            res.cumulative_nsamples == final.cumulative_nsamples
+            and np.array_equal(res.phi, final.phi)
+            and np.array_equal(res.est_err, final.est_err))
+    return {"splits": splits, "identical": identical,
+            "rounds": straight.schedule.n_rounds,
+            "bit_identical": bool(identical and all(identical))}
+
+
+# --------------------------------------------------------------------- #
+# phase 3: overload A/B
+# --------------------------------------------------------------------- #
+
+
+def build_serving_model(seed: int = 0):
+    from distributedkernelshap_tpu.models.tensor_net import (
+        TensorTrainPredictor,
+    )
+    from distributedkernelshap_tpu.serving.wrappers import KernelShapModel
+
+    rng = np.random.default_rng(seed)
+    M, r = SERVE_M, SERVE_RANK
+    dims = [1] + [r] * (M - 1) + [1]
+    scale = 1.0 / np.sqrt(r)
+    cores = []
+    for i in range(M):
+        A = rng.normal(scale=scale, size=(dims[i], dims[i + 1]))
+        B = rng.normal(scale=0.3 * scale, size=(dims[i], dims[i + 1]))
+        cores.append((A.astype(np.float32), B.astype(np.float32)))
+    model = KernelShapModel(
+        TensorTrainPredictor(cores),
+        rng.normal(size=(SERVE_BG, M)).astype(np.float32),
+        {"seed": seed}, {},
+        # l1_reg pinned OFF: 'auto' would engage AIC at this sampled
+        # fraction and the deployment would not be anytime-eligible
+        explain_kwargs={"nsamples": SERVE_NSAMPLES, "l1_reg": False})
+    if not model.supports_anytime:
+        raise RuntimeError("overload model is not anytime-eligible")
+    return model
+
+
+def _post(host, port, body, headers, timeout):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("POST", "/explain", body=body,
+                     headers={"Content-Type": "application/json",
+                              **headers})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def open_loop(server, plan, timeout=120.0):
+    """Fire ``plan`` — ``[(t_offset_s, body, headers, tag), ...]`` — on
+    schedule, one thread per request (open loop: arrivals never wait for
+    completions).  Returns ``[(tag, status, latency_s, payload)]``."""
+
+    results = [None] * len(plan)
+    t0 = time.monotonic()
+
+    def fire(i, offset, body, headers, tag):
+        delay = t0 + offset - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        sent = time.monotonic()
+        try:
+            status, payload = _post(server.host, server.port, body,
+                                    headers, timeout)
+        except OSError:
+            status, payload = -1, b""
+        results[i] = (tag, status, time.monotonic() - sent, payload)
+
+    threads = [threading.Thread(target=fire, args=(i, *spec), daemon=True)
+               for i, spec in enumerate(plan)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout * 2)
+    return [r for r in results if r is not None]
+
+
+def percentile(values, q):
+    return float(np.percentile(np.asarray(values), q)) if values else None
+
+
+def _scrape_metrics(server):
+    conn = http.client.HTTPConnection(server.host, server.port, timeout=30)
+    try:
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+    finally:
+        conn.close()
+    out = {}
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            name, value = line.rsplit(" ", 1)
+            out[name] = float(value)
+    return out
+
+
+def _scrape_debugz(server):
+    conn = http.client.HTTPConnection(server.host, server.port, timeout=30)
+    try:
+        conn.request("GET", "/debugz")
+        return json.loads(conn.getresponse().read().decode())
+    finally:
+        conn.close()
+
+
+def _metric_sum(metrics, prefix):
+    return sum(v for k, v in metrics.items() if k.startswith(prefix))
+
+
+def build_plan(n_requests, rate_rps, anytime: bool, seed: int = 0):
+    from distributedkernelshap_tpu.serving import wire
+
+    rng = np.random.default_rng(seed)
+    plan = []
+    for i in range(n_requests):
+        rows = rng.normal(size=(SERVE_ROWS, SERVE_M)).astype(np.float32)
+        body = json.dumps({"array": rows.tolist()}).encode()
+        headers = {"X-DKS-Priority": "interactive",
+                   "X-DKS-Deadline-Ms": str(DEADLINE_MS)}
+        tag = "plain"
+        if anytime:
+            headers["X-DKS-Error-Budget"] = ERROR_BUDGET
+            if i % STREAM_EVERY == STREAM_EVERY // 2:
+                # streamed probes ride the same flood: Accept-negotiated
+                # round frames, decoded whole-body after the fact
+                headers["Accept"] = (f"{wire.STREAM_CONTENT_TYPE}, "
+                                     f"{wire.CONTENT_TYPE}")
+                tag = "stream"
+        plan.append((i / rate_rps, body, headers, tag))
+    return plan
+
+
+def measure_capacity(server, reps: int = 6, seed: int = 99) -> float:
+    """Median closed-loop full-fidelity latency (no budget, no deadline):
+    the classic path's service time, HTTP overhead included — the honest
+    denominator for the overload factor."""
+
+    rng = np.random.default_rng(seed)
+    times = []
+    for _ in range(reps):
+        rows = rng.normal(size=(SERVE_ROWS, SERVE_M)).astype(np.float32)
+        body = json.dumps({"array": rows.tolist()}).encode()
+        t0 = time.monotonic()
+        status, _ = _post(server.host, server.port, body, {}, timeout=60)
+        if status != 200:
+            raise RuntimeError(f"capacity probe failed: HTTP {status}")
+        times.append(time.monotonic() - t0)
+    return float(np.median(times))
+
+
+def _check_stream_payload(payload: bytes) -> dict:
+    """Decode one streamed probe's whole body: well-formed final-flagged
+    frame sequence with monotone non-increasing reported error."""
+
+    from distributedkernelshap_tpu.serving import wire
+
+    frames = wire.decode_round_frames(payload)
+    errs = [float(np.max(np.asarray(f["est_err"]))) for f in frames]
+    return {
+        "frames": len(frames),
+        "final": bool(frames[-1]["final"]),
+        "monotone": all(b <= a + 1e-12 for a, b in zip(errs, errs[1:])),
+        "final_err": errs[-1],
+    }
+
+
+def run_overload_phase(seed: int = 0) -> dict:
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    deadline_s = DEADLINE_MS / 1000.0
+    arms = {}
+    service_s = None
+    for arm in ("anytime", "control"):
+        # a FRESH server per arm: the keep-best cache and the health
+        # engine's windows must not leak across arms
+        server = ExplainerServer(
+            build_serving_model(seed), host="127.0.0.1", port=0,
+            max_batch_size=SERVE_ROWS, batch_timeout_s=0.002,
+            max_queue_per_class=256, warmup=True).start()
+        try:
+            # probe EVERY arm's server: the closed-loop classic requests
+            # double as end-to-end warmup (the ladder precompiles, but
+            # only a served request proves it), so neither arm's flood
+            # starts against a cold trace.  The rate comes from the
+            # first measurement — both arms must see the SAME arrivals
+            measured = measure_capacity(server)
+            if service_s is None:
+                service_s = measured
+            rate = OVERLOAD / service_s
+            plan = build_plan(N_REQUESTS, rate, anytime=(arm == "anytime"),
+                              seed=seed)
+            t0 = time.monotonic()
+            results = open_loop(server, plan)
+            wall = time.monotonic() - t0
+            metrics = _scrape_metrics(server)
+            debugz = _scrape_debugz(server)
+        finally:
+            server.stop()
+
+        ok_lat = [lat for _, s, lat, _ in results if s == 200]
+        admitted = [(tag, s, lat, p) for tag, s, lat, p in results
+                    if s != 429]
+        summary = {
+            "wall_s": round(wall, 3),
+            "rate_rps": round(rate, 1),
+            "n": len(results),
+            "ok": len(ok_lat),
+            "shed_429": sum(1 for _, s, _, _ in results if s == 429),
+            "expired_504": sum(1 for _, s, _, _ in results if s == 504),
+            "other": sorted({s for _, s, _, _ in results}
+                            - {200, 429, 504}),
+            "p50_s": round(percentile(ok_lat, 50), 4) if ok_lat else None,
+            "p99_s": round(percentile(ok_lat, 99), 4) if ok_lat else None,
+        }
+        if arm == "anytime":
+            streams = [_check_stream_payload(p) for tag, s, _, p in results
+                       if tag == "stream" and s == 200]
+            rounds_total = _metric_sum(metrics, "dks_anytime_rounds_total")
+            refines = _metric_sum(metrics, "dks_anytime_refines_total")
+            err_sum = _metric_sum(metrics, "dks_anytime_final_err_sum")
+            err_count = _metric_sum(metrics, "dks_anytime_final_err_count")
+            stop_rounds = [e["rounds"] for e in debugz.get("events", [])
+                           if e.get("kind") == "refine_stopped"]
+            summary.update({
+                "admitted": len(admitted),
+                "answered_by_deadline": sum(
+                    1 for _, s, lat, _ in admitted
+                    if s == 200 and lat <= deadline_s + DEADLINE_SLACK_S),
+                "streams": streams,
+                "rounds_total": int(rounds_total),
+                "refines_total": int(refines),
+                "err_at_deadline": (err_sum / err_count
+                                    if err_count else None),
+                # p50 over the flight recorder's refine_stopped events;
+                # the ring is bounded, so fall back to the metrics mean
+                # if the flood wrapped them out
+                "rounds_per_request_p50": (
+                    percentile(stop_rounds, 50) if len(stop_rounds) >= 10
+                    else (rounds_total / refines if refines else None)),
+            })
+        arms[arm] = summary
+    return {"service_s": round(service_s, 4),
+            "deadline_s": deadline_s, **arms}
+
+
+# --------------------------------------------------------------------- #
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--reps", type=int, default=3,
+                        help="calibration-phase batches")
+    parser.add_argument("--no-record", action="store_true",
+                        help="measure + check without touching the perf "
+                             "history")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 unless every criterion holds")
+    args = parser.parse_args(argv)
+
+    t_bench = time.monotonic()
+    resume = run_resume_phase(seed=args.seed)
+    calibration = sweep_anytime(seed=args.seed, reps=args.reps)
+    overload = run_overload_phase(seed=args.seed)
+    wall_s = time.monotonic() - t_bench
+
+    a, c = overload["anytime"], overload["control"]
+    checks = {
+        "resume_bit_identical": resume["bit_identical"],
+        "calibration_coverage_ok":
+            calibration["coverage"] >= ANYTIME_COVERAGE,
+        "calibration_monotonic_ish": _monotonic_ish(calibration["errors"]),
+        # the tentpole serving claim: under the same ~2x overload the
+        # anytime arm degrades fidelity instead of shedding admitted
+        # work, while the classic path visibly falls over
+        "anytime_answers_admitted_by_deadline":
+            a["admitted"] > 0
+            and a["answered_by_deadline"] == a["admitted"],
+        "anytime_refines": (a["refines_total"] > 0
+                            and a["rounds_total"] > a["refines_total"]),
+        "anytime_streams_monotone_final":
+            len(a["streams"]) > 0
+            and all(s["final"] and s["monotone"] for s in a["streams"]),
+        "control_degrades":
+            (c["shed_429"] + c["expired_504"]) > 0
+            or (c["p99_s"] is not None
+                and c["p99_s"] > overload["deadline_s"]),
+    }
+    checks_ok = all(checks.values())
+
+    config = {"bench": "anytime", "M": SERVE_M, "rank": SERVE_RANK,
+              "n_bg": SERVE_BG, "nsamples": SERVE_NSAMPLES,
+              "rows": SERVE_ROWS, "n_requests": N_REQUESTS,
+              "overload": OVERLOAD, "deadline_ms": DEADLINE_MS,
+              "error_budget": ERROR_BUDGET,
+              "calibration_nsamples": ANYTIME_NSAMPLES,
+              "err_bound": ANYTIME_ERR_BOUND, "seed": args.seed}
+    metrics = {"wall_s": round(wall_s, 3)}
+    if a["err_at_deadline"] is not None:
+        metrics["err_at_deadline"] = round(a["err_at_deadline"], 6)
+    if a["rounds_per_request_p50"] is not None:
+        metrics["rounds_per_request_p50"] = round(
+            a["rounds_per_request_p50"], 2)
+
+    if not args.no_record:
+        record_run(DEFAULT_HISTORY, "anytime_bench", config, metrics,
+                   extra={"checks_ok": checks_ok,
+                          "coverage": calibration["coverage"],
+                          "resume_splits": resume["splits"],
+                          "control_p99_s": c["p99_s"],
+                          "control_sheds": c["shed_429"] + c["expired_504"]})
+
+    result = {
+        "bench": "anytime_bench",
+        "config_fp": config_fingerprint(config),
+        "resume": resume,
+        "calibration": {
+            "coverage": round(calibration["coverage"], 4),
+            "n_pairs": calibration["n_pairs"],
+            "errors": {str(n): e
+                       for n, e in calibration["errors"].items()},
+            "reported": {str(n): e
+                         for n, e in calibration["reported"].items()},
+        },
+        "overload": overload,
+        "metrics": metrics,
+        "checks": checks,
+        "checks_ok": checks_ok,
+    }
+    print(json.dumps(result))
+    if args.check and not checks_ok:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -166,6 +166,21 @@ def _threshold_for(metric: str, max_wall: float,
         # expensive moves it); a median is far more stable than a p99,
         # so gate it like wall time
         return max_wall
+    if metric == "err_at_deadline":
+        # the anytime bench's degradation depth: mean reported error of
+        # the answers the deadline actually bought under overload.  An
+        # estimator, calibration or scheduler regression all surface as
+        # MORE residual error at the same deadline — gated like wall time
+        return max_wall
+    if metric == "rounds_per_request_p50":
+        # the complementary stop-rule sentinel: at a fixed schedule and
+        # deadline, rounds per request CLIMBING means requests keep
+        # buying rounds they should have stopped at (budget-met or
+        # deadline-imminent detection firing late) — device time other
+        # requests needed; rounds DROPPING shows up as err_at_deadline
+        # rising, which the branch above gates.  Scheduling-noisy, so
+        # use the p99 budget
+        return max_p99
     if metric.endswith("p99_s"):
         return max_p99
     return None  # informational metric: recorded, never gated

@@ -56,6 +56,10 @@ DONATION_ALLOWLIST: Set[Tuple[str, str]] = {
     ("distributedkernelshap_tpu/kernel_shap.py", "_exact_tn_fn"),
     # DeepSHAP backprop entry (argnum 0 = per-call padded batch)
     ("distributedkernelshap_tpu/kernel_shap.py", "_deepshap_fn"),
+    # anytime round entry (argnum 0 = round 0's per-call padded batch,
+    # later rounds' per-run WLS state — consumed and replaced each
+    # round, never cache-resident; consts ride argnum 2, undonated)
+    ("distributedkernelshap_tpu/kernel_shap.py", "_dispatch_anytime_round"),
 }
 
 #: producer methods returning donated entries, with their donated argnums

@@ -73,6 +73,13 @@ RUNG_SPECS: Dict[str, RungSpec] = {
     "deepshap": RungSpec("_dispatch_deepshap", "_deepshap_consts",
                          "deepshap", "dks_deepshap_fallback_total", True),
     "sampled": RungSpec("_dispatch_array", None, "sampled", None, False),
+    # anytime is not a classifier path (requests classify as `sampled`;
+    # refinement is a SERVING mode over that estimator), but its ladder
+    # is real: a round dispatch entry, a schedule-fingerprint-keyed
+    # consts cache and the shared sampled serve label.  Listing it here
+    # keeps the rung checked even though ENGINE_PATHS never names it.
+    "anytime": RungSpec("_dispatch_anytime_round", "_anytime_consts",
+                        "sampled", None, False),
 }
 
 
@@ -218,7 +225,17 @@ def check_ladder(root: str, package_sources: Dict[str, str]
     all_sources = "\n".join(
         src for rel, src in package_sources.items()
         if not rel.startswith(f"{PKG}/analysis/"))
-    for path_name in paths:
+    # audited specs outside the classifier's universe (serving modes
+    # like `anytime` that refine an existing path) get the same rung
+    # checks: their dispatch/consts artifacts are just as easy to lose.
+    # Each is mandatory only while its subsystem package ships in the
+    # scanned tree — reduced-universe trees (the test fixtures) stay
+    # judged by their own ENGINE_PATHS
+    extra = [name for name in RUNG_SPECS
+             if name not in paths
+             and any(rel.startswith(f"{PKG}/{name}/")
+                     for rel in package_sources)]
+    for path_name in paths + extra:
         spec = _spec_for(path_name)
         sym = f"path:{path_name}"
         dispatch = engine_methods.get(spec.dispatch)

@@ -1,0 +1,27 @@
+"""Anytime KernelSHAP: progressive-refinement estimation under an
+error-budget contract.
+
+The sampled estimator runs in **rounds** (geometric coalition schedule,
+``rounds.py``): every round appends a block of paired coalition draws to
+the WLS sufficient statistics accumulated on device (``engine.py`` — the
+Gram/moment state carries across rounds, nothing is recomputed), solves
+the constrained WLS from the running totals and emits a partial phi plus
+a split-half convergence estimate (``convergence.py``, calibrated by
+``calibration.py`` against the exact ground-truth paths via the accuracy
+bench).  Serving integration (the ``X-DKS-Error-Budget`` header, partial
+result streaming, between-round preemption) lives in ``serving/`` and
+``scheduling/``; this package is pure estimator machinery.
+"""
+
+from distributedkernelshap_tpu.anytime.calibration import (  # noqa: F401
+    calibration_factor,
+    fit_calibration,
+)
+from distributedkernelshap_tpu.anytime.convergence import (  # noqa: F401
+    monotone_min,
+)
+from distributedkernelshap_tpu.anytime.rounds import (  # noqa: F401
+    RoundSchedule,
+    build_schedule,
+    round_draw_mask,
+)

@@ -1,0 +1,324 @@
+"""The resumable round engine: accumulated WLS sufficient statistics.
+
+Both halves of the constrained WLS normal equations are **sums over
+coalition rows** (``ops/explain.normal_equations``), so a round can add
+its draw block's contribution to running totals and the solve from the
+totals is *the same estimator* as a single-shot solve over the
+concatenated rows — the accumulation is a refactor, not a new estimator
+(pinned numerically by ``tests/test_anytime.py`` and bit-wise, resumed
+vs from-scratch, by ``benchmarks/anytime_bench.py``).
+
+Decomposition (round ``k``, draw scale ``wl = weight_left``):
+
+* ``A(k)   = A_enum + (wl / N_k) * (A_a + A_b)``
+* ``rhs(k) = rhs_enum + (wl / N_k) * (rhs_a + rhs_b)``
+
+where ``A_enum`` / ``rhs_enum`` come from the fixed-weight enumerated
+block (``A_enum`` is X-independent — a device constant), the ``a`` / ``b``
+accumulators sum **unit-count** per-draw statistics split by convergence
+stratum, and ``N_k`` is the cumulative draw count — a static per-round
+scalar, so round entries stay shape-static and jittable.  Solving each
+stratum alone yields the split-half convergence estimate
+(``convergence.py``) from state the engine carries anyway.
+
+Per-request state is a flat dict of device arrays (donated through each
+round's ``jit_batch_entry``); everything X-independent lives in the
+engine's plan-constant cache (``kernel_shap._anytime_consts``).
+"""
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributedkernelshap_tpu.anytime.rounds import RoundSchedule
+from distributedkernelshap_tpu.ops.explain import (
+    _auto_chunk,
+    _ey_generic,
+    _ey_linear,
+    _use_masked_ey,
+    record_kernel_path,
+    resolve_use_pallas,
+    solve_from_normal,
+)
+from distributedkernelshap_tpu.ops.links import convert_to_link
+
+#: state-dict keys carried across rounds (donated each round)
+STATE_KEYS = ("X", "fx", "fx_minus_e", "rhs_enum",
+              "A_a", "A_b", "rhs_a", "rhs_b")
+
+
+def build_ey_fn(predictor, config) -> Callable:
+    """``ey(X, bg, bgw_n, mask, G) -> (B, S, K)`` mirroring the kernel
+    dispatch of ``ops/explain.build_explainer_fn`` (linear fast path,
+    structure-aware masked eval, row-materialising generic), so a round
+    block's expected outputs take structurally identical ops to the
+    classic single-shot plan's."""
+
+    linear = predictor.linear_decomposition
+
+    def ey(X, bg, bgw_n, mask, G):
+        B, D = X.shape
+        N = bg.shape[0]
+        S, M = mask.shape
+        K = predictor.n_outputs
+        if linear is not None:
+            W, b, activation = linear
+            use_pallas = resolve_use_pallas(config.use_pallas)
+            record_kernel_path('ey', 'pallas' if use_pallas
+                               and activation != 'identity' else 'einsum')
+            chunk = config.coalition_chunk or _auto_chunk(
+                S, B * N * K, config.target_chunk_elems)
+            return _ey_linear(W, b, activation, X, bg, bgw_n, mask, G,
+                              chunk, use_pallas=use_pallas)
+        if _use_masked_ey(predictor, B, N, S, M, config):
+            record_kernel_path('ey', 'masked_ey')
+            return predictor.masked_ey(X, bg, bgw_n, mask, G,
+                                       config.target_chunk_elems,
+                                       coalition_chunk=config.coalition_chunk)
+        record_kernel_path('ey', 'generic')
+        zc = mask @ G
+        chunk = config.coalition_chunk or _auto_chunk(
+            S, B * N * D, config.target_chunk_elems)
+        return _ey_generic(predictor, X, bg, bgw_n, zc, chunk)
+
+    return ey
+
+
+def _unit_normal_equations(mask, ey_adj, fx_minus_e):
+    """Unit-weight Gram/moment contribution of a draw block — the
+    ``w = 1`` specialisation of ``ops/explain.normal_equations`` (counts
+    become weights at solve time via the ``wl / N_k`` scale)."""
+
+    zl = mask[:, -1]
+    Zt = mask[:, :-1] - zl[:, None]
+    A = Zt.T @ Zt
+    rhs = jnp.einsum(
+        "sm,bsk->bkm", Zt,
+        ey_adj - zl[None, :, None] * fx_minus_e[:, None, :])
+    return A, rhs
+
+
+def build_anytime_consts_fn(predictor, config, link: str) -> Callable:
+    """Precompute fn for the X-independent anytime constants: device
+    copies of background/grouping, the link-space expected value, and the
+    enumerated block's weighted Gram matrix + eliminated mask columns.
+    Jitted once per engine; results live in the plan-constant cache."""
+
+    link_fn = convert_to_link(link)
+
+    def consts(bg, bgw, enum_mask, enum_w, G):
+        with jax.default_matmul_precision(config.matmul_precision):
+            bg = jnp.asarray(bg, jnp.float32)
+            bgw_n = bgw / jnp.sum(bgw)
+            e_out = jnp.einsum("nk,n->k", predictor(bg), bgw_n)
+            out = {"bg": bg, "bgw_n": bgw_n, "G": G,
+                   "enum_mask": enum_mask,
+                   "expected_value": link_fn(e_out)}
+            M = enum_mask.shape[1]
+            zl = enum_mask[:, -1]
+            Zt = enum_mask[:, :-1] - zl[:, None]
+            Aw = Zt * enum_w[:, None]
+            out.update(zl_enum=zl, Aw_enum=Aw, A_enum=Aw.T @ Zt)
+            return out
+
+    return consts
+
+
+def build_round_fn(predictor, config, link: str, ridge: float,
+                   schedule: RoundSchedule, round_idx: int) -> Callable:
+    """The round entry for ``round_idx``.
+
+    Round 0: ``(Xp, draw_mask, consts) -> (phi, raw_gap, state)`` —
+    evaluates the model on ``Xp``, builds the enumerated block's
+    right-hand sides and seeds the stratum accumulators from the first
+    draw block.  Later rounds: ``(state, draw_mask, consts) -> ...`` —
+    pure accumulation, nothing from earlier rounds is recomputed.  The
+    per-round scale factors are baked in as static floats (each round is
+    its own trace anyway — the draw-block shape differs).
+    """
+
+    link_fn = convert_to_link(link)
+    ey_fn = build_ey_fn(predictor, config)
+    wl = schedule.weight_left
+    n_half = schedule.cumulative_draws(round_idx) / 2.0
+    has_enum = schedule.n_enumerated > 0
+    M = schedule.M
+
+    def _accumulate(state, draw_mask, consts):
+        S = draw_mask.shape[0]
+        B = state["X"].shape[0]
+        K = predictor.n_outputs
+        e_val = consts["expected_value"]
+        ey_d = ey_fn(state["X"], consts["bg"], consts["bgw_n"],
+                     draw_mask, consts["G"])
+        ey_adj = link_fn(ey_d) - e_val[None, None, :]
+        # complement-pairs alternate strata in blocks of 4 rows (pair 2t
+        # -> stratum a, pair 2t+1 -> stratum b); splitting a PAIR across
+        # strata would correlate the halves (rounds.round_draw_mask)
+        quads_m = draw_mask.reshape(S // 4, 4, M)
+        quads_e = ey_adj.reshape(B, S // 4, 4, K)
+        mask_a = quads_m[:, :2].reshape(-1, M)
+        mask_b = quads_m[:, 2:].reshape(-1, M)
+        ey_a = quads_e[:, :, :2].reshape(B, -1, K)
+        ey_b = quads_e[:, :, 2:].reshape(B, -1, K)
+        dA_a, drhs_a = _unit_normal_equations(mask_a, ey_a,
+                                              state["fx_minus_e"])
+        dA_b, drhs_b = _unit_normal_equations(mask_b, ey_b,
+                                              state["fx_minus_e"])
+        new_state = dict(state)
+        new_state.update(A_a=state["A_a"] + dA_a,
+                         A_b=state["A_b"] + dA_b,
+                         rhs_a=state["rhs_a"] + drhs_a,
+                         rhs_b=state["rhs_b"] + drhs_b)
+        return new_state
+
+    def _solve(state, consts):
+        fx_minus_e = state["fx_minus_e"]
+        if has_enum:
+            A0, rhs0 = consts["A_enum"], state["rhs_enum"]
+        else:
+            A0, rhs0 = 0.0, 0.0
+        scale = wl / (2.0 * n_half)
+        phi = solve_from_normal(
+            A0 + scale * (state["A_a"] + state["A_b"]),
+            rhs0 + scale * (state["rhs_a"] + state["rhs_b"]),
+            fx_minus_e, ridge)
+        sa = wl / n_half
+        phi_a = solve_from_normal(A0 + sa * state["A_a"],
+                                  rhs0 + sa * state["rhs_a"],
+                                  fx_minus_e, ridge)
+        phi_b = solve_from_normal(A0 + sa * state["A_b"],
+                                  rhs0 + sa * state["rhs_b"],
+                                  fx_minus_e, ridge)
+        raw_gap = 0.5 * jnp.max(jnp.abs(phi_a - phi_b), axis=1)  # (B, M)
+        return phi, raw_gap
+
+    if round_idx == 0:
+        def round0(Xp, draw_mask, consts):
+            with jax.default_matmul_precision(config.matmul_precision):
+                X = jnp.asarray(Xp, jnp.float32)
+                B = X.shape[0]
+                K = predictor.n_outputs
+                e_val = consts["expected_value"]
+                fx = link_fn(predictor(X))
+                fx_minus_e = fx - e_val[None, :]
+                if has_enum:
+                    ey_e = ey_fn(X, consts["bg"], consts["bgw_n"],
+                                 consts["enum_mask"], consts["G"])
+                    ey_adj_e = link_fn(ey_e) - e_val[None, None, :]
+                    rhs_enum = jnp.einsum(
+                        "sm,bsk->bkm", consts["Aw_enum"],
+                        ey_adj_e - consts["zl_enum"][None, :, None]
+                        * fx_minus_e[:, None, :])
+                else:
+                    rhs_enum = jnp.zeros((B, K, M - 1), jnp.float32)
+                zero_A = jnp.zeros((M - 1, M - 1), jnp.float32)
+                zero_rhs = jnp.zeros((B, K, M - 1), jnp.float32)
+                state = {"X": X, "fx": fx, "fx_minus_e": fx_minus_e,
+                         "rhs_enum": rhs_enum,
+                         "A_a": zero_A, "A_b": zero_A,
+                         "rhs_a": zero_rhs, "rhs_b": zero_rhs}
+                state = _accumulate(state, draw_mask, consts)
+                phi, raw_gap = _solve(state, consts)
+                return phi, raw_gap, state
+
+        return round0
+
+    def round_k(state, draw_mask, consts):
+        with jax.default_matmul_precision(config.matmul_precision):
+            state = _accumulate(state, draw_mask, consts)
+            phi, raw_gap = _solve(state, consts)
+            return phi, raw_gap, state
+
+    return round_k
+
+
+@dataclass
+class RoundResult:
+    """One refinement round's outputs, host-side."""
+
+    round_index: int          # 0-based index of the round that just ran
+    phi: np.ndarray           # (B, K, M) partial Shapley values
+    expected_value: np.ndarray
+    raw_prediction: np.ndarray
+    est_err: np.ndarray       # (B, M) calibrated, monotone reported error
+    raw_gap: np.ndarray       # (B, M) uncalibrated split-half gap
+    cumulative_nsamples: int
+    done: bool                # schedule exhausted after this round
+
+    @property
+    def max_err(self) -> float:
+        return float(np.max(self.est_err)) if self.est_err.size else 0.0
+
+
+@dataclass
+class AnytimeRun:
+    """Per-request refinement handle.
+
+    Owns the donated device state between rounds; the owning engine's
+    ``_dispatch_anytime_round`` drives one round per :meth:`step` call.
+    The run object IS the resumable state — a server preempting between
+    rounds just re-enqueues the pending that holds it.
+    """
+
+    owner: Any                       # KernelExplainerEngine
+    schedule: RoundSchedule
+    Xp: np.ndarray                   # bucket-padded request rows
+    B: int                           # live rows (<= Xp.shape[0])
+    round_idx: int = 0
+    state: Optional[Dict[str, Any]] = None
+    reported_err: Optional[np.ndarray] = None
+    expected_value: Optional[np.ndarray] = None
+    raw_prediction: Optional[np.ndarray] = None
+    last_result: Optional[RoundResult] = None
+    last_round_s: float = 0.0
+    calibration: Optional[Dict[int, float]] = None
+
+    @property
+    def done(self) -> bool:
+        return self.round_idx >= self.schedule.n_rounds
+
+    @property
+    def rounds_run(self) -> int:
+        return self.round_idx
+
+    def step(self) -> RoundResult:
+        """Run the next round (blocking) and return its result."""
+
+        if self.done:
+            raise RuntimeError("anytime schedule exhausted")
+        return self.owner._dispatch_anytime_round(self)
+
+    # ---- resume support (state must survive engine restarts) --------- #
+
+    def export_state(self) -> Dict[str, Any]:
+        """Host-side snapshot of the carried state: everything a fresh
+        engine needs to continue from ``round_idx`` (used by the resume
+        bit-identity check; the serving path keeps the live run)."""
+
+        if self.state is None:
+            raise RuntimeError("no state to export before round 0 ran")
+        return {
+            "round_idx": self.round_idx,
+            "B": self.B,
+            "Xp": np.asarray(self.Xp),
+            "reported_err": None if self.reported_err is None
+            else np.asarray(self.reported_err),
+            "state": {k: np.asarray(v) for k, v in self.state.items()},
+        }
+
+    @classmethod
+    def restore(cls, owner, schedule: RoundSchedule,
+                snapshot: Dict[str, Any]) -> "AnytimeRun":
+        run = cls(owner=owner, schedule=schedule,
+                  Xp=snapshot["Xp"], B=int(snapshot["B"]),
+                  round_idx=int(snapshot["round_idx"]))
+        run.state = {k: jnp.asarray(v)
+                     for k, v in snapshot["state"].items()}
+        if snapshot.get("reported_err") is not None:
+            run.reported_err = np.asarray(snapshot["reported_err"])
+        run.raw_prediction = np.asarray(run.state["fx"])[:run.B]
+        return run

@@ -17,6 +17,7 @@ import logging
 import math
 import os
 import threading
+import time
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field, replace
@@ -1192,6 +1193,175 @@ class KernelExplainerEngine:
             }
 
         return finalize
+
+    # ------------------------------------------------------------------ #
+    # anytime refinement (progressive rounds, accumulated WLS state)
+
+    def _anytime_schedule(self, nsamples=None):
+        """The anytime round schedule at this nsamples budget (memoised
+        next to the coalition plans — pure host numpy, survives device
+        resets), or ``None`` when refinement cannot apply (exact
+        enumeration, ``M < 2``, pinned string budgets)."""
+
+        if isinstance(nsamples, str) and nsamples != 'auto':
+            return None  # 'exact' etc.: analytic paths have zero error
+        key = ('anytime', 'auto' if nsamples in (None, 'auto')
+               else int(nsamples))
+        if key not in self._plan_cache:
+            from distributedkernelshap_tpu.anytime.rounds import (
+                build_schedule,
+            )
+
+            n = None if key[1] == 'auto' else key[1]
+            self._plan_cache[key] = build_schedule(
+                self.M, nsamples=n, seed=self.config.seed or 0)
+        return self._plan_cache[key]
+
+    def anytime_supported(self, nsamples=None) -> bool:
+        """Whether this engine can serve progressive-refinement rounds at
+        the given budget: the sampled estimator on device (host-eval
+        keeps the whole evaluation off-device — no accumulated state to
+        carry) with a non-degenerate round schedule."""
+
+        if self.config.host_eval:
+            return False
+        return self._anytime_schedule(nsamples) is not None
+
+    def _anytime_consts(self, schedule):
+        """Device-resident X-independent constants for the anytime round
+        engine: background/grouping uploads, the link-space expected
+        value and the enumerated block's weighted Gram matrix — computed
+        once and served from the plan-constant cache keyed by
+        ``self.content_fingerprint()`` + the schedule's content
+        fingerprint (a cache hit must never serve a refitted engine's
+        stale constants; same invalidation contract as ``_plan_consts``).
+        """
+
+        key = (self.content_fingerprint(), 'anytime',
+               schedule.fingerprint())
+        if key in self._plan_consts_cache:
+            self._plan_consts_cache.move_to_end(key)
+            return self._plan_consts_cache[key]
+        from distributedkernelshap_tpu.anytime.engine import (
+            build_anytime_consts_fn,
+        )
+
+        fnkey = ('anytime_consts',)
+        if fnkey not in self._fn_cache:
+            self._fn_cache[fnkey] = jax.jit(build_anytime_consts_fn(
+                self.predictor,
+                replace(self.config.shap, link=self.config.link),
+                self.config.link))
+        with profiler().phase('plan_consts'):
+            consts = self._fn_cache[fnkey](
+                jnp.asarray(self.background),
+                jnp.asarray(self.bg_weights),
+                jnp.asarray(schedule.enum_mask),
+                jnp.asarray(schedule.enum_weights),
+                jnp.asarray(self.G))
+        self._plan_consts_cache[key] = consts
+        while len(self._plan_consts_cache) > self._DEV_CACHE_MAX_ENTRIES:
+            self._plan_consts_cache.popitem(last=False)
+        return consts
+
+    def anytime_begin(self, X, nsamples=None):
+        """Begin a progressive-refinement run for ``X``: returns an
+        :class:`~distributedkernelshap_tpu.anytime.engine.AnytimeRun`
+        whose :meth:`step` runs one accumulated round, or ``None`` when
+        the engine/budget is ineligible (the caller then takes the
+        classic single-shot path).  ``X`` may be a :class:`StagedRows`;
+        its host rows seed the run (the staged device buffer is left to
+        the classic path — round entries re-upload once per run, and the
+        donated state carries the rows from round 0 on)."""
+
+        if self.config.host_eval:
+            return None
+        schedule = self._anytime_schedule(nsamples)
+        if schedule is None:
+            return None
+        from distributedkernelshap_tpu.anytime.engine import AnytimeRun
+
+        X = X.host if isinstance(X, StagedRows) else X
+        X = np.atleast_2d(np.asarray(X, dtype=np.float32))
+        if self.config.instance_chunk and \
+                X.shape[0] > self.config.instance_chunk:
+            return None
+        Xp, B = self._pad_to_bucket(X)
+        return AnytimeRun(owner=self, schedule=schedule, Xp=Xp, B=B)
+
+    def _dispatch_anytime_round(self, run):
+        """One anytime refinement round: regenerate the round's draw
+        block (deterministic from ``(seed, round)``), feed it through the
+        round entry with the carried state donated, and return the
+        round's :class:`RoundResult`.  Round ``k+1`` reuses round ``k``'s
+        accumulated Gram/moment state — nothing is recomputed; the jitted
+        entry is cached per ``(schedule, round, padded-batch)`` so a
+        refining request retraces nothing after warmup."""
+
+        from distributedkernelshap_tpu.anytime.convergence import (
+            calibrated_err,
+            monotone_min,
+        )
+        from distributedkernelshap_tpu.anytime.engine import (
+            RoundResult,
+            build_round_fn,
+        )
+        from distributedkernelshap_tpu.anytime.rounds import (
+            round_draw_mask,
+        )
+        from distributedkernelshap_tpu.ops.explain import (
+            capture_kernel_paths,
+        )
+
+        schedule = run.schedule
+        r = run.round_idx
+        consts = self._anytime_consts(schedule)
+        draw_mask = round_draw_mask(schedule, r)
+        Bp = run.Xp.shape[0]
+        fnkey = ('anytime_round', schedule.fingerprint(), r, Bp)
+        if fnkey not in self._fn_cache:
+            base = build_round_fn(
+                self.predictor,
+                replace(self.config.shap, link=self.config.link),
+                self.config.link, self.config.shap.ridge, schedule, r)
+            # argnum 0 is per-call: the padded X upload (round 0) or the
+            # carried state (later rounds — consumed and replaced by the
+            # returned state, so donation is safe); consts (argnum 2) is
+            # a _plan_consts_cache entry and must never be donated
+            self._fn_cache[fnkey] = jit_batch_entry(base,
+                                                    donate_argnums=(0,))
+        t0 = time.monotonic()
+        with profiler().phase('device_explain'):
+            with capture_kernel_paths() as kp:
+                if r == 0:
+                    phi_d, gap_d, state = self._fn_cache[fnkey](
+                        jnp.asarray(run.Xp, jnp.float32),
+                        jnp.asarray(draw_mask), consts)
+                else:
+                    phi_d, gap_d, state = self._fn_cache[fnkey](
+                        run.state, jnp.asarray(draw_mask), consts)
+            self._kernel_paths.update(kp)
+            phi = np.asarray(phi_d)[:run.B]
+            gap = np.asarray(gap_d)[:run.B]
+        run.state = state
+        run.round_idx = r + 1
+        if run.expected_value is None:
+            run.expected_value = np.atleast_1d(
+                np.asarray(consts["expected_value"], dtype=np.float32))
+        if run.raw_prediction is None:
+            run.raw_prediction = np.asarray(state["fx"])[:run.B]
+        est = calibrated_err(gap, r, run.calibration)
+        run.reported_err = monotone_min(run.reported_err, est)
+        result = RoundResult(
+            round_index=r, phi=phi,
+            expected_value=run.expected_value,
+            raw_prediction=run.raw_prediction,
+            est_err=run.reported_err.copy(), raw_gap=gap,
+            cumulative_nsamples=schedule.cumulative_nsamples(r),
+            done=run.round_idx >= schedule.n_rounds)
+        run.last_result = result
+        run.last_round_s = time.monotonic() - t0
+        return result
 
     def _exact_flavor(self) -> Optional[str]:
         """Which analytic (sampling-free) path this engine's predictor

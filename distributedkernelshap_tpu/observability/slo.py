@@ -168,6 +168,28 @@ class LatencySLO(SLO):
         return max(0.0, min(1.0, 1.0 - good))
 
 
+class ErrorBudgetSLO(LatencySLO):
+    """``target`` fraction of anytime answers whose *reported* final
+    error bound is at or under ``max_err`` — the accuracy analogue of a
+    latency SLO, burned over the ``dks_anytime_final_err`` histogram.
+    Mechanically identical to :class:`LatencySLO` (a histogram and a
+    threshold); the separate kind keeps /slo output honest about what is
+    being promised: answer *quality* under deadline pressure, not answer
+    time."""
+
+    kind = "error_budget"
+
+    def __init__(self, name: str, histogram: str, max_err: float,
+                 target: float, labels: Optional[Dict[str, str]] = None,
+                 **kwargs):
+        super().__init__(name, histogram=histogram, threshold_s=max_err,
+                         target=target, labels=labels, **kwargs)
+
+    @property
+    def max_err(self) -> float:
+        return self.threshold_s
+
+
 class StalenessSLO(SLO):
     """``target`` fraction of window samples where a gauge stays at or
     under ``max_staleness_s`` (e.g. seconds since in-flight work last
@@ -205,6 +227,15 @@ CLASS_LATENCY_TARGETS: Dict[str, Tuple[float, float]] = {
     "batch": (30.0, 0.90),
     "best_effort": (60.0, 0.50),
 }
+
+#: default anytime error-budget objective: 90% of anytime answers must
+#: report a final error bound at or under 0.03 — aligned with a finite
+#: ``dks_anytime_final_err`` bucket bound (3e-2) for the same reason the
+#: latency thresholds align with LATENCY_BUCKETS_S: observations land in
+#: buckets, and a threshold between bounds would miscount the straddling
+#: bucket.  Burns only when anytime traffic flows (idle = None = no
+#: breach), so non-anytime deployments carry this SLO inert.
+ANYTIME_ERR_TARGET: Tuple[float, float] = (0.03, 0.90)
 
 #: default per-tenant objectives (the templated SLOs of
 #: :func:`tenant_slos`): latency over ``dks_tenant_latency_seconds`` —
@@ -301,6 +332,12 @@ def default_server_slos(
         "inflight_progress", gauge="dks_serve_last_progress_age_seconds",
         max_staleness_s=30.0, target=0.90, windows=windows,
         description="dispatched work progressing within 30s"))
+    max_err, target = ANYTIME_ERR_TARGET
+    slos.append(ErrorBudgetSLO(
+        "anytime_error", histogram="dks_anytime_final_err",
+        max_err=max_err, target=target, windows=windows,
+        description=f"anytime answers with a final reported error bound "
+                    f"at or under {max_err:g}"))
     if tenants:
         slos.extend(tenant_slos(tenants, windows=windows))
     return slos

@@ -244,42 +244,65 @@ def request_cache_key(array: np.ndarray, model_fp: str) -> str:
 class ResultCache:
     """Thread-safe LRU cache of response payload strings, bounded by an
     approximate byte budget (UTF-8 length of the stored payloads; the JSON
-    here is ASCII so ``len(payload)`` is the byte count)."""
+    here is ASCII so ``len(payload)`` is the byte count).
+
+    Entries carry a *fidelity*: the reported error bound of the stored
+    payload (``est_err``, 0.0 = full fidelity — every pre-anytime payload).
+    One content key stores the HIGHEST-fidelity payload seen (a coarser
+    anytime answer never overwrites a finer one), and a lookup only hits
+    when the stored fidelity satisfies the caller's error budget —
+    budget-less callers (``max_err=None``) are served full-fidelity
+    entries only, which is exactly the historical behaviour."""
 
     def __init__(self, max_bytes: int):
         if max_bytes <= 0:
             raise ValueError("max_bytes must be positive "
                              "(use no cache instead of a zero-byte one)")
         self.max_bytes = int(max_bytes)
-        self._entries: "OrderedDict[str, str]" = OrderedDict()
+        # key -> (payload, est_err)
+        self._entries: "OrderedDict[str, Tuple[str, float]]" = OrderedDict()
         self._bytes = 0
         self._lock = threading.Lock()
         self._hits = 0
         self._misses = 0
         self._evictions = 0
 
-    def get(self, key: str) -> Optional[str]:
+    def get(self, key: str,
+            max_err: Optional[float] = None) -> Optional[str]:
         with self._lock:
-            payload = self._entries.get(key)
-            if payload is None:
+            entry = self._entries.get(key)
+            if entry is None:
+                self._misses += 1
+                return None
+            payload, est_err = entry
+            if est_err > (0.0 if max_err is None else max_err):
+                # stored answer is coarser than this caller tolerates:
+                # a fidelity miss costs device work like a cold miss
                 self._misses += 1
                 return None
             self._entries.move_to_end(key)
             self._hits += 1
             return payload
 
-    def put(self, key: str, payload: str) -> None:
+    def put(self, key: str, payload: str, est_err: float = 0.0) -> None:
         size = len(payload)
         if size > self.max_bytes:
             return  # larger than the whole budget: caching it evicts all
+        est_err = max(0.0, float(est_err))
         with self._lock:
-            old = self._entries.pop(key, None)
+            old = self._entries.get(key)
             if old is not None:
-                self._bytes -= len(old)
-            self._entries[key] = payload
+                if old[1] < est_err:
+                    # keep-best: the stored payload is strictly finer;
+                    # equal fidelity replaces (historical last-write-wins)
+                    self._entries.move_to_end(key)
+                    return
+                self._entries.pop(key)
+                self._bytes -= len(old[0])
+            self._entries[key] = (payload, est_err)
             self._bytes += size
             while self._bytes > self.max_bytes and self._entries:
-                _, evicted = self._entries.popitem(last=False)
+                _, (evicted, _err) = self._entries.popitem(last=False)
                 self._bytes -= len(evicted)
                 self._evictions += 1
 

@@ -99,6 +99,7 @@ class SLOScheduler:
         self._m_queue_wait = None
         self._m_expired = None
         self._m_pushbacks = None
+        self._m_requeues = None
 
     def attach_metrics(self, registry) -> None:
         """Register this scheduler's ``dks_sched_*`` series on the
@@ -127,6 +128,13 @@ class SLOScheduler:
             "trims, deficit-round-robin displacement and quota-yield "
             "caps (routine under healthy multi-tenant load, not a "
             "pressure signal there).")
+        self._m_requeues = registry.counter(
+            "dks_sched_requeues_total",
+            "Partially-served requests re-entered into the queue at a "
+            "preemption point (anytime refinement round boundaries): each "
+            "re-entry competes under EDF again, so an earlier-deadline "
+            "arrival preempts further refinement.",
+            labelnames=("class",)).seed(*[(k,) for k in PRIORITY_CLASSES])
 
     # -- ordering hooks (FIFOScheduler overrides) ----------------------- #
 
@@ -154,6 +162,26 @@ class SLOScheduler:
             self._cond.notify()
         if self._m_enqueued is not None:
             self._m_enqueued.inc(**{"class": klass})
+
+    def requeue(self, item) -> None:
+        """Re-enter a partially-served request at a preemption point
+        (anytime round boundary).  Ordering is plain EDF — the item's
+        deadline has not changed, so it resumes ahead of later-deadline
+        work but yields to anything more urgent that arrived while its
+        last round ran.  Counted separately from fresh enqueues
+        (``dks_sched_requeues_total``) so queue-depth arithmetic against
+        ``dks_sched_enqueued_total`` stays honest."""
+
+        with self._cond:
+            heapq.heappush(self._heap,
+                           (self._effective_deadline(item), self._seq, item))
+            self._seq += 1
+            klass = getattr(item, "klass", "batch")
+            self._depths[klass] = self._depths.get(klass, 0) + 1
+            self._queued_rows += item.rows
+            self._cond.notify()
+        if self._m_requeues is not None:
+            self._m_requeues.inc(**{"class": klass})
 
     # -- introspection (admission control, metrics) --------------------- #
 

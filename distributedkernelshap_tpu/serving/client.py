@@ -98,9 +98,14 @@ def parse_retry_after(headers, payload) -> Optional[float]:
 
 
 def _request_body(instance: np.ndarray, binary: bool,
-                  extra_headers: Optional[dict]):
+                  extra_headers: Optional[dict], stream: bool = False):
     """(body, headers) for one transport: binary wire framing (raw float32
-    row bytes + binary Accept) or the historical JSON document."""
+    row bytes + binary Accept) or the historical JSON document.
+
+    ``stream`` prepends the round-stream content type to the Accept list.
+    A pre-anytime server ignores the unknown entry and matches whatever
+    else the list offers (plain wire, or nothing -> JSON) — streaming
+    negotiation rides the SAME request, no extra probe."""
 
     if binary:
         body = _wire.encode_request(instance)
@@ -109,14 +114,59 @@ def _request_body(instance: np.ndarray, binary: bool,
     else:
         body = json.dumps({"array": np.asarray(instance).tolist()}).encode()
         headers = {"Content-Type": "application/json"}
+    if stream:
+        headers["Accept"] = (_wire.STREAM_CONTENT_TYPE
+                             + (", " + headers["Accept"]
+                                if "Accept" in headers else ""))
     headers.update(extra_headers or {})
     return body, headers
+
+
+def _read_exact(resp, n: int) -> bytes:
+    """Read exactly ``n`` bytes from a response (http.client de-chunks);
+    a short read means the server tore the stream mid-frame."""
+
+    chunks = []
+    got = 0
+    while got < n:
+        piece = resp.read(n - got)
+        if not piece:
+            raise _wire.WireError(
+                f"stream torn mid-frame: wanted {n} bytes, got {got}")
+        chunks.append(piece)
+        got += len(piece)
+    return b"".join(chunks)
+
+
+def _read_stream(resp, on_partial: Optional[Callable]) -> dict:
+    """Consume a round-frame stream incrementally: each frame is decoded
+    the moment its bytes arrive (header first, then exactly the declared
+    payload — partial results reach ``on_partial`` without buffering the
+    whole response), and the final frame's structured dict is returned.
+    Raises :class:`wire.WireError`/:class:`wire.WireVersionError` on torn
+    frames or unknown stream versions — a half-written frame can never
+    surface as phi."""
+
+    while True:
+        header = _read_exact(resp, _wire.STREAM_HEADER_SIZE)
+        length = _wire.stream_frame_length(header)
+        payload = _read_exact(resp, length) if length else b""
+        frame, _ = _wire.decode_round_frame(header + payload)
+        if frame["final"]:
+            if resp.read():  # drain the chunked terminator for keep-alive
+                raise _wire.WireError("stream carries bytes past the "
+                                      "final frame")
+            return frame
+        if on_partial is not None:
+            on_partial(frame)
 
 
 def explain_request(url: str, instance: np.ndarray, timeout: float = 300.0,
                     max_retries: int = 4,
                     extra_headers: Optional[dict] = None,
                     wire_format: str = "json",
+                    stream: bool = False,
+                    on_partial: Optional[Callable[[dict], None]] = None,
                     _sleep: Callable[[float], None] = time.sleep,
                     _rng: Optional[random.Random] = None):
     """POST one instance (or minibatch) to the explanation endpoint and
@@ -137,6 +187,24 @@ def explain_request(url: str, instance: np.ndarray, timeout: float = 300.0,
       connection without consuming the retry budget, and the structured
       dict is then extracted from the JSON document — callers never see
       the transport.
+
+    ``stream=True`` asks for progressive refinement (anytime serving):
+    the round-stream content type is prepended to the Accept list, and
+    against a stream-capable server each partial round frame is decoded
+    the moment it arrives and handed to ``on_partial`` (a dict with
+    ``shap_values``/``expected_value``/``raw_prediction``/``round``/
+    ``converged``/``est_err``), in round order; the call returns the
+    FINAL frame's dict.  Against a pre-anytime server or proxy the
+    unknown Accept entry is ignored and the response degrades to one
+    ordinary answer (plain wire or JSON, whatever the rest of the list
+    negotiates): ``on_partial`` is never called and the single answer is
+    returned as the same structured dict — so ``stream=True`` always
+    returns a dict, whatever ``wire_format`` says, and works unchanged
+    against every server generation.  A stream torn mid-frame (or
+    carrying an unknown stream version) never surfaces partial phi: the
+    connection is dropped and the request retried within the ordinary
+    budget (``on_partial`` may then see early rounds again — partials
+    are idempotent refinements, replaying them is harmless).
 
     Retriable failures are retried within a bounded budget
     (``max_retries`` beyond the first attempt), with capped, jittered
@@ -181,7 +249,8 @@ def explain_request(url: str, instance: np.ndarray, timeout: float = 300.0,
         negotiated = _negotiated.get(host_key)
     # binary unless this host already downgraded; plain 'json' never probes
     sent_binary = wire_format != "json" and negotiated != "json"
-    body, headers = _request_body(instance, sent_binary, extra_headers)
+    body, headers = _request_body(instance, sent_binary, extra_headers,
+                                  stream=stream)
     rng = _rng or random.Random()
     tr = _tracing.tracer()
     root = None
@@ -212,6 +281,33 @@ def explain_request(url: str, instance: np.ndarray, timeout: float = 300.0,
             try:
                 conn.request("POST", path, body=body, headers=headers)
                 resp = conn.getresponse()
+                ctype = (resp.headers.get("Content-Type")
+                         or "").split(";", 1)[0].strip().lower()
+                if stream and resp.status == 200 \
+                        and ctype == _wire.STREAM_CONTENT_TYPE:
+                    last_status = resp.status
+                    try:
+                        frame = _read_stream(resp, on_partial)
+                        tr.end(aspan, status=resp.status,
+                               rounds=frame["round"] + 1)
+                        return frame
+                    except (_wire.WireError, ValueError) as e:
+                        # a torn/garbled stream never surfaces partial
+                        # phi: drop the (desynced) connection and
+                        # re-fetch — rounds are deterministic, so a
+                        # replayed stream is bit-identical
+                        tr.end(aspan, outcome="stream_torn")
+                        _drop_connection(parsed.scheme or "http",
+                                         parsed.netloc)
+                        if attempt >= max_retries:
+                            raise RuntimeError(
+                                f"HTTP 200: torn round-frame stream "
+                                f"({e})") from e
+                        backoff = BASE_BACKOFF_S * (2.0 ** attempt)
+                        attempt += 1
+                        _sleep(min(MAX_BACKOFF_S,
+                                   backoff * (1.0 + 0.25 * rng.random())))
+                        continue
                 raw = resp.read()
                 last_status = resp.status
                 tr.end(aspan, status=resp.status)
@@ -235,7 +331,8 @@ def explain_request(url: str, instance: np.ndarray, timeout: float = 300.0,
                         _negotiated[host_key] = "json"
                     sent_binary = False
                     body, headers = _request_body(instance, False,
-                                                  extra_headers)
+                                                  extra_headers,
+                                                  stream=stream)
                     continue
                 if tentative_400 and resp.status == 400:
                     # the JSON re-send failed identically: the 400 was
@@ -268,7 +365,7 @@ def explain_request(url: str, instance: np.ndarray, timeout: float = 300.0,
                         backoff = BASE_BACKOFF_S * (2.0 ** attempt)
                 if payload is not None:
                     if resp.status == 200:
-                        if wire_format == "json":
+                        if wire_format == "json" and not stream:
                             return payload
                         try:
                             return (_wire.decode_explanation(payload)

@@ -92,14 +92,16 @@ class _HTTPServer(ThreadingHTTPServer):
 class _Pending:
     __slots__ = ("array", "event", "response", "error", "t_enqueued", "done",
                  "klass", "deadline", "cache_key", "status_code", "cache_hit",
-                 "trace", "wire_format", "model", "group_key")
+                 "trace", "wire_format", "model", "group_key", "budget",
+                 "stream", "anytime_on", "anytime", "frames", "final_err")
 
     def __init__(self, array: np.ndarray, klass: str = "interactive",
                  deadline: Optional[float] = None,
                  cache_key: Optional[str] = None,
                  trace: Optional[_tracing.SpanContext] = None,
                  wire_format: str = "json",
-                 model=None):
+                 model=None, budget: Optional[float] = None,
+                 stream: bool = False, anytime_on: bool = False):
         self.array = array
         self.event = threading.Event()
         self.response: Optional[str] = None
@@ -139,6 +141,20 @@ class _Pending:
         # sighting — share-peer lookups take the registry lock, and the
         # scheduler calls key() inside its own critical section
         self.group_key = None
+        # anytime refinement (ISSUE 16): error budget from the
+        # X-DKS-Error-Budget header (None = none declared), whether the
+        # client negotiated streamed round frames, and whether this
+        # request refines progressively at all.  ``anytime`` holds the
+        # engine's AnytimeRun between rounds (the preempted state the
+        # scheduler requeues); ``frames`` the handler-facing stream
+        # queue; ``final_err`` the reported error of the answer actually
+        # sent (0.0 = full fidelity — the cache's keep-best key).
+        self.budget = budget
+        self.stream = stream
+        self.anytime_on = anytime_on
+        self.anytime = None
+        self.frames = queue.Queue() if stream else None
+        self.final_err = 0.0
 
     @property
     def rows(self) -> int:
@@ -715,6 +731,32 @@ class ExplainerServer:
             labelnames=("format", "direction")).seed(
             ("binary", "rx"), ("binary", "tx"),
             ("json", "rx"), ("json", "tx"))
+        # anytime refinement (ISSUE 16): rounds dispatched, stop-reason
+        # accounting (the three legs of the stop rule), frames streamed,
+        # and the reported error of answers actually sent — the
+        # error-budget SLO (observability/slo.py anytime_error_slo)
+        # burns against the histogram
+        self._m_anytime_rounds = reg.counter(
+            "dks_anytime_rounds_total",
+            "Refinement rounds dispatched to the device (each is one "
+            "accumulated-WLS device call; a request spans >=1).")
+        self._m_anytime_refines = reg.counter(
+            "dks_anytime_refines_total",
+            "Anytime requests answered, by stop reason (budget_met = "
+            "reported error under the declared X-DKS-Error-Budget; "
+            "deadline = next round would overrun X-DKS-Deadline-Ms; "
+            "exhausted = full nsamples schedule ran).",
+            labelnames=("reason",)).seed(
+            "budget_met", "deadline", "exhausted")
+        self._m_anytime_final_err = reg.histogram(
+            "dks_anytime_final_err",
+            "Reported (calibrated) max per-feature error of anytime "
+            "answers actually sent.",
+            buckets=(1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 1e-1, 3e-1, 1.0),
+            exemplar_slots=DEFAULT_EXEMPLAR_SLOTS)
+        self._m_anytime_stream_frames = reg.counter(
+            "dks_anytime_stream_frames_total",
+            "Partial-result DKSS frames written to streaming clients.")
         self._m_staging_overlap = reg.counter(
             "dks_staging_overlap_seconds_total",
             "Seconds staged batches sat device-ready before dispatch "
@@ -1098,7 +1140,11 @@ class ExplainerServer:
             else:
                 p.response = payloads[index_map[i] if index_map else i]
                 if self._cache is not None and p.cache_key is not None:
-                    self._cache.put(p.cache_key, p.response)
+                    # keep-best: anytime answers carry their reported
+                    # error (final_err; 0.0 = full fidelity), and the
+                    # cache only serves an entry to budgets it satisfies
+                    self._cache.put(p.cache_key, p.response,
+                                    est_err=getattr(p, "final_err", 0.0))
             if tr.enabled and p.trace is not None and t_dispatch is not None:
                 # per-request copies of the batch's device/finalize
                 # timings: a batch can mix trace ids, so each request gets
@@ -1186,7 +1232,7 @@ class ExplainerServer:
                 continue
             key = p.cache_key
             if key is not None:
-                payload = self._cache.get(key)
+                payload = self._cache.get(key, max_err=p.budget)
                 if payload is not None:
                     self._answer_cached(p, payload)
                     continue
@@ -1323,6 +1369,23 @@ class ExplainerServer:
                     compile_events().signature(sig):
                 model.explain_batch(np.tile(row, (b, 1)),
                                     split_sizes=[b])
+            # anytime deployments also warm their per-round entries at
+            # this bucket (distinct executables from the single-shot
+            # pipeline), declared under their own rounds=<k> suffix so
+            # the compile accounting attributes each rung honestly
+            if getattr(model, "supports_anytime", False) and \
+                    hasattr(model, "anytime_warm"):
+                try:
+                    n_rounds = model.anytime_rounds()
+                    if n_rounds:
+                        asig = shape_signature(
+                            b, f"sampled,rounds={n_rounds}", model=label)
+                        with profiler().phase("warmup"), \
+                                compile_events().signature(asig):
+                            model.anytime_warm([b])
+                except Exception:
+                    logger.exception("anytime warmup rung failed; round "
+                                     "entries will compile on first use")
         finally:
             if span is not None:
                 tr.end(span)
@@ -1522,6 +1585,16 @@ class ExplainerServer:
         tr = self._tracer
         t_claim = time.monotonic()
         for p in expired:
+            if getattr(p, "anytime", None) is not None and \
+                    p.anytime.last_result is not None:
+                # degrade before shed: a refining request whose deadline
+                # passed while requeued already HAS an answer with honest
+                # error bars — send the last partial instead of a 504
+                if tr.enabled and p.trace is not None:
+                    tr.record_mono("server.queue_wait", p.t_enqueued,
+                                   t_claim, parent=p.trace, expired=True)
+                self._finish_anytime(p, "deadline")
+                continue
             # the declared SLO is already missed: answering late would
             # waste a device slot on a response the client has abandoned
             self._shed("deadline_expired", rm=p.model)
@@ -1577,6 +1650,13 @@ class ExplainerServer:
         # read at dispatch: tests may swap self.model while the
         # dispatcher is parked in next_batch / the staging buffer
         model = rm.model if rm is not None else self.model
+        if len(live) == 1 and getattr(live[0], "anytime_on", False):
+            # anytime pendings form singleton groups (unique group_key):
+            # one refinement round per scheduler turn, requeued between
+            # rounds so earlier-deadline work preempts.  Falls through to
+            # the classic dispatch when the run cannot begin.
+            if self._dispatch_anytime(live[0], rm):
+                return
         pipelined = hasattr(model, "explain_batch_async")
         tr = self._tracer
         sizes = [p.array.shape[0] for p in leaders]
@@ -1659,6 +1739,132 @@ class ExplainerServer:
         except Exception as e:  # surface errors to waiting requests
             logger.exception("explain batch failed")
             self._complete(live, error=str(e))
+
+    # ------------------------------------------------------------------ #
+    # anytime refinement dispatch (ISSUE 16)
+
+    def _dispatch_anytime(self, p, rm) -> bool:
+        """Run ONE refinement round for an anytime pending (dispatcher
+        thread — the round entries live in the engine's jit caches).
+
+        Returns ``False`` (caller falls through to the classic one-shot
+        dispatch) when the engine cannot refine this request after all.
+        Otherwise the round runs, a partial frame streams out if the
+        client asked, and the pending either finishes (budget met /
+        deadline imminent / schedule exhausted — first wins) or requeues
+        at the scheduler, where the round boundary is an EDF preemption
+        point."""
+
+        model = rm.model if rm is not None else self.model
+        if p.anytime is None:
+            try:
+                p.anytime = model.anytime_begin(p.array)
+            except Exception:
+                logger.exception("anytime_begin failed; serving the "
+                                 "request single-shot")
+                p.anytime = None
+            if p.anytime is None:
+                p.anytime_on = False
+                return False
+        run = p.anytime
+        batch = [p]
+        with self._active_lock:
+            # registered like any device batch so the watchdog can fail
+            # a wedged round
+            self._active[id(batch)] = batch
+        t_dispatch = time.monotonic()
+        cost_tx = self._costmeter.begin()
+        try:
+            with _tracing.use_context(p.trace):
+                result = run.step()
+        except Exception as e:
+            logger.exception("anytime round failed")
+            self._complete(batch, error=str(e))
+            if p.stream:
+                p.frames.put(None)
+            return True
+        t_fetch = time.monotonic()
+        if cost_tx is not None:
+            # per-round cost bracket: every round bills its tenant as it
+            # runs, so a preempted request's spend is never orphaned
+            self._costmeter.settle(
+                cost_tx, dispatch_shares([p], default_path="sampled"),
+                t_end=t_fetch)
+        with self._active_lock:
+            self._active.pop(id(batch), None)
+            self._last_progress = t_fetch
+            self._ever_completed = True
+        self._m_anytime_rounds.inc()
+        if self._tracer.enabled and p.trace is not None:
+            self._tracer.record_mono(
+                "anytime.round", t_dispatch, t_fetch, parent=p.trace,
+                round=result.round_index,
+                nsamples=result.cumulative_nsamples,
+                max_err=result.max_err)
+        # stop rule: first of {error budget met, deadline imminent,
+        # schedule exhausted}.  "Imminent" projects the next round at 2x
+        # the last one (geometric draw growth): starting a round that
+        # cannot finish by the deadline would turn a servable request
+        # into a 504.
+        reason = None
+        if p.budget is not None and result.max_err <= p.budget:
+            reason = "budget_met"
+        if reason is None and result.done:
+            reason = "exhausted"
+        if reason is None and p.deadline is not None and \
+                time.monotonic() + 2.0 * run.last_round_s > p.deadline:
+            reason = "deadline"
+        if reason is not None:
+            self._finish_anytime(p, reason, t_dispatch=t_dispatch,
+                                 t_fetch=t_fetch)
+            return True
+        if p.stream:
+            p.frames.put(model.anytime_frame(result, final=False))
+        # preemption point: back into the EDF queue — an earlier-deadline
+        # arrival runs before this request's next round
+        self._sched.requeue(p)
+        return True
+
+    def _finish_anytime(self, p, reason: str,
+                        t_dispatch: Optional[float] = None,
+                        t_fetch: Optional[float] = None) -> None:
+        """Answer an anytime pending from its latest round result and
+        account the stop: final payload (or final stream frame), fidelity
+        recorded for the keep-best cache, ``refine_stopped`` flight
+        event + stop-reason counter + final-error histogram (the
+        error-budget SLO's input)."""
+
+        run = p.anytime
+        result = run.last_result
+        model = p.model.model if p.model is not None else self.model
+        p.final_err = result.max_err
+        exemplar = p.trace.trace_id if p.trace else None
+        self._m_anytime_refines.inc(reason=reason)
+        self._m_anytime_final_err.observe(result.max_err,
+                                          exemplar=exemplar)
+        self._flight.record("refine_stopped", component="server",
+                            reason=reason, rounds=run.rounds_run,
+                            max_err=round(result.max_err, 6))
+        try:
+            if p.stream:
+                p.frames.put(model.anytime_frame(result, final=True))
+                payload = b""  # the frames ARE the response body
+            else:
+                payload = model.anytime_payload(p.array, result,
+                                                fmt=p.wire_format)
+        except Exception as e:
+            logger.exception("anytime finalize failed")
+            self._complete([p], error=str(e))
+            if p.stream:
+                p.frames.put(None)
+            return
+        self._complete([p], payloads=[payload], index_map=[0],
+                       device_rows=p.array.shape[0],
+                       t_dispatch=t_dispatch, t_fetch=t_fetch,
+                       span_attrs={"path": "sampled", "anytime": True,
+                                   "stop": reason})
+        if p.stream:
+            p.frames.put(None)
 
     def _batcher_loop(self):
         """Staging half of the double-buffered pipeline (staging enabled
@@ -2057,6 +2263,83 @@ class ExplainerServer:
                 self.end_headers()
                 self.wfile.write(data)
 
+            def _reply_explain_stream(self, pending, rm):
+                """Streamed /explain reply: one chunked-transfer DKSS
+                frame per refinement round as the dispatcher produces
+                them (``pending.frames``), terminated after the frame
+                marked final.  Falls back to an ordinary single response
+                when refinement never engaged (``pending.response`` set
+                with no frames) — the client's downgrade path.  A failure
+                after frames went out tears the stream (connection close,
+                no final frame), which the client-side decoder rejects —
+                a torn stream must never be mistaken for a complete
+                answer."""
+
+                headers_sent = False
+                while True:
+                    try:
+                        item = pending.frames.get(timeout=0.5)
+                    except queue.Empty:
+                        if pending.event.is_set() and pending.frames.empty():
+                            # answered without streaming (fallback /
+                            # drain paths push no terminal sentinel)
+                            break
+                        if server._stop.is_set() or server._wedged.is_set():
+                            with server._metrics_lock:
+                                if not pending.done:
+                                    pending.done = True
+                                    pending.error = (
+                                        "server shutting down"
+                                        if server._stop.is_set() else
+                                        "server wedged: device made no "
+                                        "progress within the watchdog "
+                                        "timeout")
+                                    pending.status_code = 503
+                                    server._count_request(pending,
+                                                          pending.error)
+                            if pending.error is not None:
+                                break
+                        continue
+                    if item is None:  # terminal sentinel from the server
+                        break
+                    if not headers_sent:
+                        span = self.__dict__.pop("_dks_root", None)
+                        if span is not None:
+                            server._tracer.end(span, status=200)
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         _wire.STREAM_CONTENT_TYPE)
+                        self.send_header("Transfer-Encoding", "chunked")
+                        self.end_headers()
+                        headers_sent = True
+                    self.wfile.write(b"%x\r\n" % len(item) + item + b"\r\n")
+                    self.wfile.flush()
+                    server._m_anytime_stream_frames.inc()
+                    server._m_wire_bytes.inc(len(item), format="binary",
+                                             direction="tx")
+                    server._costmeter.record_wire(
+                        rm.model_id if rm is not None else None, "tx",
+                        len(item))
+                if pending.error is not None:
+                    if headers_sent:
+                        # mid-stream failure: tear the stream so the
+                        # decoder rejects it (no final frame)
+                        self.close_connection = True
+                        return
+                    self._reply(pending.status_code or 500,
+                                json.dumps({"error": pending.error}))
+                    return
+                if not headers_sent:
+                    if pending.response is not None:
+                        # downgrade: refinement never engaged, answer the
+                        # single payload under its own Content-Type
+                        self._reply_explain_ok(pending.response, rm=rm)
+                    else:
+                        self._reply(500, json.dumps(
+                            {"error": "stream produced no frames"}))
+                    return
+                self.wfile.write(b"0\r\n\r\n")
+
             def _handle(self):
                 # query string split off so /statusz?format=json routes
                 # (other routes ignore their query, as before)
@@ -2213,6 +2496,36 @@ class ExplainerServer:
                                      "number of milliseconds"}))
                         return
                     deadline = time.monotonic() + deadline_ms / 1000.0
+                # anytime error budget (ISSUE 16): the largest per-feature
+                # reported error the client accepts.  Parsed next to the
+                # deadline header — the two compose: refinement stops at
+                # whichever of {budget met, deadline imminent, schedule
+                # exhausted} comes first.
+                budget = None
+                budget_h = self.headers.get("X-DKS-Error-Budget")
+                if budget_h is not None:
+                    try:
+                        budget = float(budget_h)
+                        if not budget > 0:
+                            raise ValueError
+                    except ValueError:
+                        self._reply(400, json.dumps({
+                            "error": "X-DKS-Error-Budget must be a "
+                                     "positive error bound"}))
+                        return
+                # streamed partial results: explicit Accept entry AND a
+                # deployment that can refine.  A model that cannot refine
+                # quietly answers one ordinary (non-stream) response —
+                # the client's downgrade path, same as a pre-anytime
+                # server.  A budget against a non-refining model is also
+                # honest as-is: the full-fidelity answer satisfies every
+                # budget.
+                can_anytime = (getattr(model, "supports_anytime", False)
+                               and getattr(model, "supports_wire_formats",
+                                           False))
+                stream = (_wire.accepts_stream(self.headers.get("Accept"))
+                          and can_anytime)
+                anytime_on = can_anytime and (stream or budget is not None)
                 client_key = (self.headers.get("X-DKS-Client")
                               or self.client_address[0])
                 if server._wedged.is_set():
@@ -2236,16 +2549,26 @@ class ExplainerServer:
                     return
                 root = self.__dict__.get("_dks_root")
                 pending = _Pending(array, klass=klass, deadline=deadline,
-                                   cache_key=server._cache_key_for(
-                                       array, wire_format, rm=rm),
+                                   cache_key=(None if stream else
+                                              server._cache_key_for(
+                                                  array, wire_format,
+                                                  rm=rm)),
                                    trace=root.context if root is not None
                                    else None,
                                    wire_format=wire_format,
-                                   model=rm)
+                                   model=rm, budget=budget, stream=stream,
+                                   anytime_on=anytime_on)
+                if anytime_on:
+                    # refinement rounds are per-request device state:
+                    # never coalesce an anytime pending with anything
+                    pending.group_key = ("anytime", id(pending))
                 # cache fast path: a duplicate of an already-served request
-                # is answered bit-identically without queueing at all
+                # is answered bit-identically without queueing at all —
+                # budget-carrying requests accept any stored answer whose
+                # fidelity satisfies the budget (keep-best entries)
                 if pending.cache_key is not None:
-                    cached = server._cache.get(pending.cache_key)
+                    cached = server._cache.get(pending.cache_key,
+                                               max_err=pending.budget)
                     if cached is not None:
                         server._answer_cached(pending, cached)
                         self._reply_explain_ok(cached, rm=rm)
@@ -2302,6 +2625,9 @@ class ExplainerServer:
                 # (the hot-swap pin was acquired at resolve time and is
                 # released by _handle's finally once the reply is sent)
                 server._sched.put(pending)
+                if pending.stream:
+                    self._reply_explain_stream(pending, rm)
+                    return
                 # re-check shutdown/wedge periodically so in-flight
                 # requests fail fast instead of hanging on a dead
                 # dispatcher

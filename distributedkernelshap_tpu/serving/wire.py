@@ -326,3 +326,181 @@ def accepts_wire(accept: Optional[str]) -> bool:
         if part.split(";", 1)[0].strip().lower() == CONTENT_TYPE:
             return True
     return False
+
+
+# --------------------------------------------------------------------- #
+# anytime streaming: versioned round frames (ISSUE 16)
+#
+# A streaming /explain response is a sequence of self-delimiting frames,
+# one per refinement round, each wrapping a complete v1 DKSW message::
+#
+#     stream  := frame+
+#     frame   := magic(4s="DKSS") version(u16) flags(u16) length(u32)
+#                payload(length bytes, a DKSW message)
+#
+# flags bit 0 (:data:`STREAM_FLAG_FINAL`) marks the last frame; exactly
+# one frame per stream sets it.  The payload is a standard explanation
+# message (encode_explanation arrays) plus three anytime fields:
+# ``round`` (i32 scalar), ``converged`` (u8 scalar), ``est_err``
+# ((B, M) f32 calibrated per-feature error bars).  Reusing the DKSW
+# framing inside the envelope keeps one array codec: a client that can
+# read responses can read frames.
+#
+# Negotiation: clients ask with ``Accept: application/x-dks-wire-stream,
+# application/x-dks-wire``.  A pre-anytime server matches only the plain
+# wire entry and answers one ordinary binary response — the graceful
+# downgrade the client is built for — while an anytime server answers
+# ``Content-Type: application/x-dks-wire-stream`` with chunked frames.
+# ``accepts_wire`` deliberately does NOT match the stream media type, so
+# the two capabilities negotiate independently.
+
+#: media type of a streamed (multi-frame) response
+STREAM_CONTENT_TYPE = "application/x-dks-wire-stream"
+#: stream envelope version (independent of :data:`WIRE_VERSION`)
+STREAM_VERSION = 1
+#: flags bit marking the final frame of a stream
+STREAM_FLAG_FINAL = 0x1
+
+_STREAM_MAGIC = b"DKSS"
+_STREAM_HEADER = struct.Struct("<4sHHI")  # magic, version, flags, length
+#: cap on a single frame payload (64 MiB) — a garbled length field must
+#: not drive a multi-gigabyte allocation before the magic check fails
+_MAX_FRAME_BYTES = 64 << 20
+
+
+def accepts_stream(accept: Optional[str]) -> bool:
+    """Whether an ``Accept`` header asks for a streamed response (explicit
+    ``application/x-dks-wire-stream`` entry only, same rules as
+    :func:`accepts_wire`)."""
+
+    if not accept:
+        return False
+    for part in accept.split(","):
+        if part.split(";", 1)[0].strip().lower() == STREAM_CONTENT_TYPE:
+            return True
+    return False
+
+
+def encode_round_frame(shap_values, expected_value, raw_prediction,
+                       round_index: int, est_err, *,
+                       final: bool = False) -> bytes:
+    """One stream frame for refinement round ``round_index``: a full
+    explanation payload (every frame is independently usable — a client
+    that stops listening keeps the best answer it saw) plus the anytime
+    fields.  ``final=True`` sets :data:`STREAM_FLAG_FINAL`."""
+
+    payload = bytearray(encode_explanation(shap_values, expected_value,
+                                           raw_prediction))
+    # append the anytime fields as extra arrays in the same DKSW message:
+    # splice by rewriting n_arrays in the header, then extending the body
+    extra = {
+        "round": np.asarray([round_index], dtype=np.int32),
+        "converged": np.asarray([1 if final else 0], dtype=np.uint8),
+        "est_err": np.atleast_2d(np.asarray(est_err, dtype=np.float32)),
+    }
+    magic, version, n_arrays = _HEADER.unpack_from(payload, 0)
+    tail = encode_arrays(extra)
+    payload[:_HEADER.size] = _HEADER.pack(magic, version,
+                                          n_arrays + len(extra))
+    payload.extend(tail[_HEADER.size:])
+    flags = STREAM_FLAG_FINAL if final else 0
+    return _STREAM_HEADER.pack(_STREAM_MAGIC, STREAM_VERSION, flags,
+                               len(payload)) + bytes(payload)
+
+
+#: bytes an incremental reader must fetch before it can size a frame
+STREAM_HEADER_SIZE = _STREAM_HEADER.size
+
+
+def stream_frame_length(header: bytes) -> int:
+    """Payload length declared by one frame's envelope header — the
+    incremental reader's contract (read :data:`STREAM_HEADER_SIZE` bytes,
+    call this, read exactly that many more).  Validates magic/version/cap
+    with the same errors as :func:`decode_round_frame`, so a torn or
+    future-version stream fails at the first header, before any payload
+    bytes are waited for."""
+
+    if len(header) < _STREAM_HEADER.size:
+        raise WireError(
+            f"truncated stream frame header: {len(header)} bytes "
+            f"(need {_STREAM_HEADER.size})")
+    magic, version, _flags, length = _STREAM_HEADER.unpack_from(header, 0)
+    if magic != _STREAM_MAGIC:
+        raise WireError(f"bad stream magic {bytes(magic)!r} "
+                        f"(expected {_STREAM_MAGIC!r})")
+    if version != STREAM_VERSION:
+        raise WireVersionError(
+            f"stream version {version} not supported "
+            f"(this build speaks v{STREAM_VERSION})")
+    if length > _MAX_FRAME_BYTES:
+        raise WireError(f"stream frame declares {length} payload bytes "
+                        f"(cap: {_MAX_FRAME_BYTES})")
+    return int(length)
+
+
+def decode_round_frame(buf, offset: int = 0):
+    """Decode one frame at ``offset``.  Returns ``(frame_dict,
+    next_offset)`` where ``frame_dict`` is the :func:`decode_explanation`
+    structure plus ``round`` (int), ``converged`` (bool), ``est_err``
+    ((B, M) f32) and ``final`` (envelope flag).  Raises
+    :class:`WireError` on torn/truncated frames, :class:`WireVersionError`
+    on an unknown envelope version — exactly the response-body error
+    contract, so a half-written frame can never surface as phi."""
+
+    view = memoryview(buf)
+    if offset + _STREAM_HEADER.size > len(view):
+        raise WireError(
+            f"truncated stream frame header: {len(view) - offset} bytes "
+            f"(need {_STREAM_HEADER.size})")
+    magic, version, flags, length = _STREAM_HEADER.unpack_from(view, offset)
+    if magic != _STREAM_MAGIC:
+        raise WireError(f"bad stream magic {bytes(magic)!r} "
+                        f"(expected {_STREAM_MAGIC!r})")
+    if version != STREAM_VERSION:
+        raise WireVersionError(
+            f"stream version {version} not supported "
+            f"(this build speaks v{STREAM_VERSION})")
+    if length > _MAX_FRAME_BYTES:
+        raise WireError(f"stream frame declares {length} payload bytes "
+                        f"(cap: {_MAX_FRAME_BYTES})")
+    start = offset + _STREAM_HEADER.size
+    if start + length > len(view):
+        raise WireError(
+            f"torn stream frame: payload needs {length} bytes, "
+            f"{len(view) - start} remain")
+    arrays = decode_arrays(view[start:start + length])
+    for key in ("shap_values", "expected_value", "raw_prediction",
+                "round", "est_err"):
+        if key not in arrays:
+            raise WireError(f"stream frame carries no {key!r} field")
+    frame = {
+        "shap_values": [np.asarray(v) for v in arrays["shap_values"]],
+        "expected_value": np.asarray(arrays["expected_value"]),
+        "raw_prediction": np.asarray(arrays["raw_prediction"]),
+        "round": int(np.asarray(arrays["round"]).reshape(-1)[0]),
+        "converged": bool(np.asarray(
+            arrays.get("converged", [0])).reshape(-1)[0]),
+        "est_err": np.atleast_2d(np.asarray(arrays["est_err"],
+                                            dtype=np.float32)),
+        "final": bool(flags & STREAM_FLAG_FINAL),
+    }
+    return frame, start + length
+
+
+def decode_round_frames(buf) -> List[Dict]:
+    """Decode a complete stream body into its frames (in order).  Raises
+    :class:`WireError` if the body ends mid-frame, carries trailing bytes,
+    holds no frames at all, or its last frame is not marked final — a
+    truncated stream must be indistinguishable from a corrupt one."""
+
+    frames: List[Dict] = []
+    offset = 0
+    view = memoryview(buf)
+    while offset < len(view):
+        frame, offset = decode_round_frame(view, offset)
+        frames.append(frame)
+    if not frames:
+        raise WireError("stream body holds no frames")
+    if not frames[-1]["final"]:
+        raise WireError("stream ended without a final frame")
+    return frames

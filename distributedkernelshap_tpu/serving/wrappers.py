@@ -408,6 +408,118 @@ class KernelShapModel:
 
         return finalize
 
+    # ---- anytime refinement (progressive rounds, ISSUE 16) ----------- #
+
+    @property
+    def supports_anytime(self) -> bool:
+        """Whether this deployment can answer a request progressively
+        (``X-DKS-Error-Budget`` / streamed rounds).  Only the sampled
+        estimator refines: exact paths are already exact, interactions
+        and active l1 ride the sync fallback, host-eval cannot carry
+        device state across rounds.  The engine itself rejects budgets
+        whose coalition space enumerates exactly."""
+
+        if self.explain_path != "sampled":
+            return False
+        if self.explain_kwargs.get("interactions"):
+            return False
+        engine = getattr(self.explainer, "_explainer", None)
+        if engine is None or not hasattr(engine, "anytime_supported"):
+            return False
+        nsamples = self.explain_kwargs.get("nsamples")
+        try:
+            # mirror the engine's explain-time default ('auto'), not the
+            # kwarg's absence: the deployment's effective l1 behaviour is
+            # what the anytime path would silently diverge from
+            if engine._l1_active(self.explain_kwargs.get("l1_reg", "auto"),
+                                 nsamples):
+                return False
+            return bool(engine.anytime_supported(nsamples))
+        except Exception:  # never fail admission over eligibility probing
+            logger.debug("anytime eligibility probe failed", exc_info=True)
+            return False
+
+    def anytime_begin(self, instances: np.ndarray):
+        """Start a refinement run for one request's rows; returns the
+        engine's ``AnytimeRun`` handle (step it between scheduler turns)
+        or ``None`` when this request cannot refine after all."""
+
+        engine = self.explainer._explainer
+        return engine.anytime_begin(
+            np.atleast_2d(np.asarray(instances, dtype=np.float32)),
+            nsamples=self.explain_kwargs.get("nsamples"))
+
+    def anytime_payload(self, instances: np.ndarray, result,
+                        fmt: str = "json"):
+        """Final per-request payload from a round result — same encodings
+        as :meth:`_resplit_payloads` (one slot), so an anytime answer is
+        wire-identical to a single-shot one.  Records the request against
+        the sampled path (one request, however many rounds it took)."""
+
+        from distributedkernelshap_tpu.ops.explain import split_shap_values
+
+        engine = self.explainer._explainer
+        sv = split_shap_values(result.phi, engine.vector_out)
+        record_explain_path(self.explain_path, 1)
+        return self._resplit_payloads(
+            np.atleast_2d(np.asarray(instances, dtype=np.float32)),
+            sv, result.expected_value, result.raw_prediction,
+            [result.phi.shape[0]], formats=[fmt])[0]
+
+    def anytime_frame(self, result, final: bool = False) -> bytes:
+        """One stream frame (``serving/wire.py`` DKSS envelope) for a
+        round result."""
+
+        from distributedkernelshap_tpu.ops.explain import split_shap_values
+
+        engine = self.explainer._explainer
+        sv = split_shap_values(result.phi, engine.vector_out)
+        if not isinstance(sv, list):
+            sv = [sv]
+        if final:
+            # the final frame answers the request — path accounting's
+            # one-per-request increment (anytime_payload does the same
+            # for non-streamed anytime answers)
+            record_explain_path(self.explain_path, 1)
+        return wire.encode_round_frame(
+            sv, result.expected_value, result.raw_prediction,
+            result.round_index, result.est_err, final=final)
+
+    def anytime_rounds(self) -> int:
+        """Rounds in this deployment's refinement schedule (0 = cannot
+        refine) — the warmup ladder's ``rounds=<k>`` signature suffix."""
+
+        engine = getattr(self.explainer, "_explainer", None)
+        if engine is None or not hasattr(engine, "_anytime_schedule"):
+            return 0
+        schedule = engine._anytime_schedule(
+            self.explain_kwargs.get("nsamples"))
+        return 0 if schedule is None else schedule.n_rounds
+
+    def anytime_warm(self, batch_sizes, rounds: Optional[int] = None):
+        """Compile the per-round entries for the warmup ladder's batch
+        rungs: runs a zero-instance refinement to completion (or
+        ``rounds`` rounds) per batch size so serving traffic never pays
+        the round traces.  Returns the number of rounds compiled."""
+
+        engine = self.explainer._explainer
+        schedule = engine._anytime_schedule(
+            self.explain_kwargs.get("nsamples"))
+        if schedule is None:
+            return 0
+        compiled = 0
+        for b in batch_sizes:
+            run = self.anytime_begin(
+                np.zeros((int(b), engine.M), dtype=np.float32))
+            if run is None:
+                continue
+            limit = schedule.n_rounds if rounds is None \
+                else min(int(rounds), schedule.n_rounds)
+            for _ in range(limit):
+                run.step()
+                compiled += 1
+        return compiled
+
 
 class BatchKernelShapModel(KernelShapModel):
     """Explains a coalesced list of requests (reference ``wrappers.py:62-88``)

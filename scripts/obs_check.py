@@ -53,11 +53,13 @@ DOCS_PATH = os.path.join(REPO_ROOT, "docs", "OBSERVABILITY.md")
 #: ``fleet`` and ``trace`` when the tenant cost-attribution plane landed
 #: ``dks_device_seconds_total``, the ``dks_tenant_*`` families, the
 #: federated ``dks_fleet_*`` scrape accounting and the trace-sink
-#: rotation counter ``dks_trace_dropped_total``.)
+#: rotation counter ``dks_trace_dropped_total``.  ``anytime`` joined
+#: with the progressive-refinement estimator: ``dks_anytime_*`` counts
+#: rounds, stop reasons, final reported error and streamed frames.)
 _LITERAL_RE = re.compile(
     r"dks_(?:serve|fanin|sched|phase|slo|alerts|wire|staging|treeshap|"
     r"tensor_shap|autoscale|registry|result_cache|deepshap|device|tenant|"
-    r"fleet|trace)_[a-z0-9_]+")
+    r"fleet|trace|anytime)_[a-z0-9_]+")
 
 #: directories never scanned for literals/renderers
 _SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "results", "data",

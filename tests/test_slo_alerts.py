@@ -131,7 +131,8 @@ def test_slo_target_validation_and_defaults():
     server_slos = default_server_slos()
     names = {s.name for s in server_slos}
     assert {"availability", "interactive_latency", "batch_latency",
-            "best_effort_latency", "inflight_progress"} == names
+            "best_effort_latency", "inflight_progress",
+            "anytime_error"} == names
     assert {s.name for s in default_proxy_slos()} == {"proxy_availability"}
 
 

@@ -585,3 +585,229 @@ def test_staging_buffer_drain():
     buf.put("y")
     assert buf.drain() == ["x", "y"]
     assert buf.drain() == []
+
+
+# --------------------------------------------------------------------- #
+# anytime round-frame streaming (ISSUE 16): framing fuzz, client
+# partials, downgrade negotiation against every server generation
+# --------------------------------------------------------------------- #
+
+
+def _two_frame_body():
+    rng = np.random.default_rng(9)
+    sv = [rng.normal(size=(1, 6)).astype(np.float32) for _ in range(2)]
+    ev = np.array([0.3, 0.7], np.float32)
+    rp = rng.normal(size=(1, 2)).astype(np.float32)
+    err0 = np.full((1, 6), 0.5, np.float32)
+    err1 = np.full((1, 6), 0.1, np.float32)
+    return (wire.encode_round_frame(sv, ev, rp, 0, err0)
+            + wire.encode_round_frame(sv, ev, rp, 1, err1, final=True))
+
+
+def test_round_frames_roundtrip_in_order():
+    frames = wire.decode_round_frames(_two_frame_body())
+    assert [f["round"] for f in frames] == [0, 1]
+    assert [f["final"] for f in frames] == [False, True]
+    assert frames[0]["est_err"].shape == (1, 6)
+    assert float(frames[1]["est_err"].max()) < float(
+        frames[0]["est_err"].max())
+    assert len(frames[0]["shap_values"]) == 2
+
+
+def test_round_frame_stream_truncations_raise_wire_error():
+    body = _two_frame_body()
+    hdr = wire.STREAM_HEADER_SIZE
+    # cut mid-header, at the header boundary, mid-payload, and just
+    # before the final byte: every torn stream rejects cleanly
+    for cut in (3, hdr - 1, hdr, hdr + 17, len(body) // 2, len(body) - 1):
+        with pytest.raises(wire.WireError):
+            wire.decode_round_frames(body[:cut])
+
+
+def test_round_frame_stream_missing_final_raises():
+    body = _two_frame_body()
+    # drop the second (final) frame entirely: well-formed frames, but the
+    # stream never terminated — indistinguishable from truncation
+    first, _ = wire.decode_round_frame(body)
+    first_len = wire.STREAM_HEADER_SIZE + wire.stream_frame_length(
+        body[:wire.STREAM_HEADER_SIZE])
+    with pytest.raises(wire.WireError, match="final"):
+        wire.decode_round_frames(body[:first_len])
+    with pytest.raises(wire.WireError, match="frames"):
+        wire.decode_round_frames(b"")
+
+
+def test_round_frame_future_version_raises_version_error():
+    body = bytearray(_two_frame_body())
+    struct.pack_into("<H", body, 4, wire.STREAM_VERSION + 3)
+    with pytest.raises(wire.WireVersionError):
+        wire.decode_round_frames(bytes(body))
+    with pytest.raises(wire.WireVersionError):
+        wire.stream_frame_length(bytes(body[:wire.STREAM_HEADER_SIZE]))
+
+
+def test_round_frame_fuzz_never_crashes():
+    rng = np.random.default_rng(1)
+    base = _two_frame_body()
+    for _ in range(200):
+        buf = bytearray(base)
+        for _ in range(rng.integers(1, 6)):
+            buf[rng.integers(0, len(buf))] = rng.integers(0, 256)
+        try:
+            frames = wire.decode_round_frames(bytes(buf))
+        except wire.WireError:
+            continue  # includes WireVersionError — rejected cleanly
+        for f in frames:
+            assert isinstance(f["est_err"], np.ndarray)
+
+
+@pytest.fixture(scope="module")
+def anytime_server():
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+    from distributedkernelshap_tpu.serving.wrappers import KernelShapModel
+
+    M = 12
+    rng = np.random.default_rng(21)
+
+    class _Clf:
+        coef_ = rng.normal(size=(1, M)).astype(np.float64)
+        intercept_ = np.array([0.05])
+        classes_ = np.array([0, 1])
+
+        def predict_proba(self, X):
+            z = X @ self.coef_.T + self.intercept_
+            p = 1.0 / (1.0 + np.exp(-z))
+            return np.concatenate([1.0 - p, p], axis=1)
+
+    bg = rng.normal(size=(16, M)).astype(np.float32)
+    model = KernelShapModel(
+        _Clf().predict_proba, bg, {"seed": 5}, {},
+        explain_kwargs={"nsamples": 256, "l1_reg": False})
+    assert model.supports_anytime
+    srv = ExplainerServer(model, host="127.0.0.1", port=0,
+                          max_batch_size=2, cache_bytes=1 << 20,
+                          health_interval_s=0).start()
+    try:
+        yield srv
+    finally:
+        srv.stop()
+
+
+def test_client_stream_receives_partials_then_final(anytime_server):
+    client.reset_negotiation_cache()
+    row = np.random.default_rng(22).normal(size=(1, 12)).astype(np.float32)
+    partials = []
+    out = client.explain_request(_url(anytime_server), row, timeout=60,
+                                 max_retries=0, wire_format="binary",
+                                 stream=True, on_partial=partials.append)
+    assert out["final"] and "est_err" in out
+    assert all(not p["final"] for p in partials)
+    rounds = [p["round"] for p in partials] + [out["round"]]
+    assert rounds == list(range(len(rounds))) and len(rounds) >= 2
+    errs = [float(np.max(p["est_err"])) for p in partials] \
+        + [float(np.max(out["est_err"]))]
+    assert all(b <= a + 1e-12 for a, b in zip(errs, errs[1:]))
+    # every partial refines toward the final answer, same shapes
+    assert np.stack(out["shap_values"]).shape == \
+        np.stack(partials[0]["shap_values"]).shape
+
+
+def test_client_stream_downgrades_on_non_anytime_server(linear_server):
+    """A wire-capable but non-refining deployment ignores the stream
+    Accept entry and answers one plain binary explanation: the client
+    returns it as the same structured dict, no partials."""
+
+    client.reset_negotiation_cache()
+    row = np.random.default_rng(23).normal(size=(1, 6)).astype(np.float32)
+    partials = []
+    out = client.explain_request(_url(linear_server), row, timeout=60,
+                                 max_retries=0, wire_format="binary",
+                                 stream=True, on_partial=partials.append)
+    assert partials == []
+    assert "shap_values" in out and "final" not in out
+    # bit-identical to the non-stream binary answer (same cache entry)
+    ref = client.explain_request(_url(linear_server), row, timeout=60,
+                                 wire_format="binary")
+    assert np.array_equal(np.stack(out["shap_values"]),
+                          np.stack(ref["shap_values"]))
+
+
+@pytest.mark.parametrize("status", [415, 400])
+def test_client_stream_downgrades_on_pre_wire_server(status):
+    """PR 6's 415/400 tentative-downgrade rules hold unchanged when the
+    client also asks to stream: binary body rejected -> JSON re-send on
+    the same connection, stream Accept ignored, single JSON answer
+    returned structured."""
+
+    srv = _ScriptedOldServer(answer_binary=status)
+    client.reset_negotiation_cache()
+    try:
+        url = f"http://{'127.0.0.1'}:{srv.port}/explain"
+        partials = []
+        out = client.explain_request(url, np.zeros((1, 2)), timeout=30,
+                                     max_retries=0, wire_format="binary",
+                                     stream=True,
+                                     on_partial=partials.append)
+        assert partials == []
+        assert np.allclose(out["shap_values"][0], [[0.25, 0.75]])
+        assert srv.binary_hits == 1 and srv.json_hits == 1
+    finally:
+        srv.stop()
+        client.reset_negotiation_cache()
+
+
+def test_mixed_clients_bit_identical_on_anytime_hot_server(anytime_server):
+    """JSON and binary (non-stream) clients against an anytime-capable
+    server keep the PR 6 contract: same rows, bit-identical phi over
+    both transports — anytime capability changes nothing for clients
+    that did not opt in."""
+
+    client.reset_negotiation_cache()
+    row = np.random.default_rng(24).normal(size=(1, 12)).astype(np.float32)
+    payload = client.explain_request(_url(anytime_server), row, timeout=60)
+    phi_json = np.asarray(json.loads(payload)["data"]["shap_values"],
+                          dtype=np.float32)
+    out = client.explain_request(_url(anytime_server), row, timeout=60,
+                                 wire_format="binary")
+    assert np.array_equal(phi_json, np.stack(out["shap_values"]))
+
+
+def test_torn_mid_stream_never_surfaces_partial_phi():
+    """A server that dies mid-frame (torn chunked stream) must surface as
+    an error at the client, never as half-parsed phi."""
+
+    body = _two_frame_body()
+    torn = body[:len(body) - 9]  # valid first frame, torn final frame
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            self.send_response(200)
+            self.send_header("Content-Type", wire.STREAM_CONTENT_TYPE)
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            self.wfile.write(b"%x\r\n" % len(torn) + torn + b"\r\n")
+            self.wfile.write(b"0\r\n\r\n")
+
+        def log_message(self, fmt, *args):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    client.reset_negotiation_cache()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}/explain"
+        got = []
+        with pytest.raises(RuntimeError, match="torn round-frame stream"):
+            client.explain_request(url, np.zeros((1, 2)), timeout=30,
+                                   max_retries=0, wire_format="json",
+                                   stream=True, on_partial=got.append)
+        # the well-formed first frame MAY have been delivered as a
+        # partial (it is a valid refinement); the torn final never was
+        assert all(not p["final"] for p in got)
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        client.reset_negotiation_cache()
