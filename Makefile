@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test tier1 collect fuzz bench configs serve sweep-pool sweep-serve analysis multihost-ci sched-bench chaos-bench lint obs-check health-check perf-gate warmup-bench stream-bench exact-bench autoscale-bench accuracy-gate tenant-bench deepshap-bench cost-bench anytime-bench
+.PHONY: test tier1 collect fuzz bench configs serve sweep-pool sweep-serve analysis multihost-ci sched-bench chaos-bench lint obs-check health-check perf-gate warmup-bench stream-bench exact-bench autoscale-bench accuracy-gate tenant-bench deepshap-bench cost-bench anytime-bench profile-bench
 
 lint:            ## unified static gate: dks-analyze (concurrency + JAX-contract + serving-ladder lints, scripts/dks_lint.py) + obs-check + health-check behind ONE exit code; <60s budget self-asserted
 	env JAX_PLATFORMS=cpu $(PY) scripts/dks_lint.py --check
@@ -44,6 +44,9 @@ tenant-bench:    ## multi-tenant gateway: 3 families served concurrently (phi bi
 
 cost-bench:      ## tenant cost attribution: per-tenant device-seconds sum to the directly-measured dispatch total (shared AND serialized batching), metering overhead <=1%, /fleetz == sum of per-replica scrapes, SLO-breach exemplar -> Perfetto; self-records for perf-gate
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/cost_attribution_bench.py --check
+
+profile-bench:   ## continuous profiling + memory ledger: sampler on/off median overhead <=1% (per-request alternation), ledger total == independent cache walk, pressure drill evicts with bit-identical answers, hot-role samples land on engine frames, proxy /profilez?federate=1 == per-replica fold; self-records for perf-gate
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/profile_bench.py --check
 
 anytime-bench:   ## anytime refinement: resumed round-k phi bit-identical to from-scratch, reported error bounds true error within x2 at >=90% of rounds, overload A/B where the anytime arm answers every admitted request by deadline (monotone streamed error) while the fixed-nsamples control sheds or blows p99; self-records for perf-gate
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/anytime_bench.py --check

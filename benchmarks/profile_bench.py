@@ -1,0 +1,403 @@
+"""Continuous-profiling / memory-ledger bench: the sampler must be
+near-free and the ledger's books must balance (standalone, CPU backend,
+exits nonzero on ``--check`` fail).
+
+Five measured arms, one JSON line (ISSUE 18):
+
+1. **Ledger exactness** — from a fresh ledger epoch, one served linear
+   tenant answers a burst of requests; afterwards the ledger's total
+   must EQUAL an independent walk of everything it claims to track
+   (``approx_nbytes`` over the engine's device/plan-const caches plus
+   the result cache's own byte counter).  The ledger cannot grade its
+   own homework: the walk recomputes sizes from the live containers.
+2. **Pressure drill** — with a soft budget pinned below the live total,
+   further requests must fire ``memory_pressure`` (events and evicted
+   bytes both nonzero) and the drill's canary request must come back
+   BIT-IDENTICAL after eviction — pressure may only ever force a
+   re-upload/recompute, never change an answer.
+3. **Sampler overhead** — one live server, the sampler paused/resumed
+   PER REQUEST (strict on/off alternation, the drift-robust
+   methodology the cost-attribution bench settled on): the sampled
+   pool's median request latency must sit within 1% of the unsampled
+   pool's.  The ratio self-records as ``prof_overhead_factor`` so
+   ``make perf-gate`` covers sampler-overhead regressions.
+4. **Hot-path attribution** — a dedicated ``hot``-role thread runs
+   ``explain_batch`` in a tight loop under a private high-rate sampler;
+   at least half of that role's samples must carry an engine
+   (``kernel_shap``) frame, i.e. the profiler attributes hot time to
+   the code actually burning it, not to scaffolding.
+5. **Federation** — two in-process replicas behind a ``FanInProxy``;
+   with the sampler frozen, the proxy's ``/profilez?federate=1`` merge
+   must equal the fold of the per-replica collapsed pages.
+
+Self-records into ``results/perf_history.jsonl`` with ``checks_ok``.
+
+    JAX_PLATFORMS=cpu python benchmarks/profile_bench.py --check
+"""
+
+import argparse
+import gc
+import json
+import statistics
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO_ROOT = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO_ROOT)
+
+from benchmarks.cost_attribution_bench import (  # noqa: E402
+    http_get,
+    post_explain,
+    serve_fleet,
+)
+from benchmarks.multitenant_bench import build_linear  # noqa: E402
+
+D = 6  # the multitenant builders' feature width
+
+
+# --------------------------------------------------------------------- #
+# arms 1+2: ledger exactness, then the pressure drill on the same fleet
+# --------------------------------------------------------------------- #
+
+
+def independent_walk_bytes(model, server) -> int:
+    """Recompute, from the live containers, every byte the ledger claims
+    to be tracking for this server: ``approx_nbytes`` over the engine's
+    device/plan-const cache VALUES plus the result cache's own byte
+    counter.  Sizes are recomputed here, not read back from the ledger,
+    so agreement is a real cross-check."""
+
+    from distributedkernelshap_tpu.observability.memledger import (
+        approx_nbytes,
+    )
+
+    engine = model.explainer._explainer
+    total = 0
+    for cache in (engine._dev_cache, engine._plan_consts_cache):
+        for value in list(cache.values()):
+            total += approx_nbytes(value)
+    if server._cache is not None:
+        total += server._cache.stats()["bytes"]
+    return total
+
+
+def run_ledger_arm(requests=12, seed=3):
+    """Fresh ledger epoch -> serve a burst -> books must balance."""
+
+    from distributedkernelshap_tpu.observability.memledger import memledger
+
+    gc.collect()  # dead caches from earlier epochs release their charges
+    led = memledger()
+    led.reset()
+    model = build_linear(seed=seed)
+    server, _registry = serve_fleet([("tenant-led", model)],
+                                    cache_bytes=1 << 20)
+    rng = np.random.default_rng(42)
+    statuses = []
+    for _ in range(requests):
+        row = rng.normal(size=(1, D)).astype(np.float32)
+        status, _ = post_explain(server.host, server.port, row,
+                                 model="tenant-led")
+        statuses.append(status)
+    ledger_total = led.total_bytes()
+    walk_total = independent_walk_bytes(model, server)
+    result = {
+        "requests": requests,
+        "all_ok": all(s == 200 for s in statuses),
+        "ledger_total_bytes": ledger_total,
+        "independent_walk_bytes": walk_total,
+        "exact": ledger_total == walk_total,
+        "owners": led.owner_totals(),
+        "high_water_bytes": led.high_water_bytes(),
+    }
+    # the pressure drill reuses this live fleet, then tears it down
+    return result, (server, model, led)
+
+
+def run_pressure_arm(fleet, extra_requests=8):
+    """Pin the budget below the live total, push more work through, and
+    demand (a) pressure fired, (b) bytes were actually evicted, (c) the
+    canary answer survives eviction bit-for-bit."""
+
+    server, model, led = fleet
+    rng = np.random.default_rng(7)
+    canary = rng.normal(size=(1, D)).astype(np.float32)
+    try:
+        status, baseline = post_explain(server.host, server.port, canary,
+                                        model="tenant-led")
+        events_before = led.pressure_events()
+        evicted_before = led.evicted_bytes()
+        led.set_budget(max(4096, led.total_bytes() // 2))
+        try:
+            statuses = []
+            for _ in range(extra_requests):
+                row = rng.normal(size=(1, D)).astype(np.float32)
+                s, _ = post_explain(server.host, server.port, row,
+                                    model="tenant-led")
+                statuses.append(s)
+            led.poke()
+            events = led.pressure_events() - events_before
+            evicted = led.evicted_bytes() - evicted_before
+            status2, after = post_explain(server.host, server.port,
+                                          canary, model="tenant-led")
+        finally:
+            led.set_budget(0)
+    finally:
+        server.stop()
+    return {
+        "all_ok": (status == 200 and status2 == 200
+                   and all(s == 200 for s in statuses)),
+        "pressure_events": events,
+        "evicted_bytes": evicted,
+        "answer_bit_identical": after == baseline,
+        "total_after_drill_bytes": led.total_bytes(),
+    }
+
+
+# --------------------------------------------------------------------- #
+# arm 3: sampler overhead (the gated sentinel)
+# --------------------------------------------------------------------- #
+
+
+def run_overhead_arm(requests=300, seed=13):
+    """Sampler overhead on ONE live server, pausing/resuming the
+    process sampler PER REQUEST (strict alternation — any drift profile
+    hits both pools identically; the only difference between the pooled
+    medians is the sweep the sampler runs while a request is in
+    flight).  The on/off median ratio records as
+    ``prof_overhead_factor`` — pinned near 1.0 by construction, so the
+    perf gate's relative threshold reads directly as overhead drift."""
+
+    from distributedkernelshap_tpu.observability.contprof import contprof
+
+    model = build_linear(seed=1)
+    server, _registry = serve_fleet([("tenant-ovh", model)])
+    prof = contprof()
+    # hold the auto-disable valve open for the arm: if the safety valve
+    # fired mid-measurement the "on" pool would silently sample nothing
+    # and the ratio would be meaningless — the bench wants the true cost
+    budget_before = prof.overhead_budget
+    prof.overhead_budget = 10.0
+    lat = {"on": [], "off": []}
+    rng = np.random.default_rng(seed)
+    try:
+        for _ in range(10):  # untimed warm pass
+            post_explain(server.host, server.port,
+                         rng.normal(size=(1, D)).astype(np.float32),
+                         model="tenant-ovh")
+        for i in range(2 * requests):
+            arm = "on" if i % 2 == 0 else "off"
+            if arm == "on":
+                prof.resume()
+            else:
+                prof.pause()
+            row = rng.normal(size=(1, D)).astype(np.float32)
+            t0 = time.monotonic()
+            status, _ = post_explain(server.host, server.port, row,
+                                     model="tenant-ovh")
+            assert status == 200
+            lat[arm].append(time.monotonic() - t0)
+        sampler_alive = prof.running and not prof.auto_disabled
+    finally:
+        prof.resume()
+        prof.overhead_budget = budget_before
+        server.stop()
+    med_on = statistics.median(lat["on"])
+    med_off = statistics.median(lat["off"])
+    return {"median_on_s": round(med_on, 6),
+            "median_off_s": round(med_off, 6),
+            "overhead_frac": round(med_on / med_off - 1.0, 4),
+            "prof_overhead_factor": round(med_on / med_off, 4),
+            "sampler_alive": sampler_alive,
+            "requests_per_arm": requests}
+
+
+# --------------------------------------------------------------------- #
+# arm 4: hot-path attribution
+# --------------------------------------------------------------------- #
+
+
+def run_hotpath_arm(duration_s=1.5, hz=97.0):
+    """A ``hot``-role thread burns real engine time in a loop under a
+    private high-rate sampler; the profile must pin the majority of
+    that role's samples on frames from the engine module — the whole
+    point of a profiler is that hot time lands on the code burning it."""
+
+    from distributedkernelshap_tpu.observability.contprof import (
+        ContProf,
+        parse_collapsed,
+    )
+
+    model = build_linear(seed=9)
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(4, D)).astype(np.float32)
+    model.explain_batch(X, split_sizes=[4])  # compile outside the profile
+    prof = ContProf(hz=hz)
+    stop = threading.Event()
+
+    def hot_loop():
+        prof.register_current_thread("hot")
+        while not stop.is_set():
+            model.explain_batch(X, split_sizes=[4])
+
+    worker = threading.Thread(target=hot_loop, daemon=True)
+    prof.start()
+    worker.start()
+    try:
+        time.sleep(duration_s)
+    finally:
+        stop.set()
+        worker.join(30)
+        prof.stop()
+    counts = parse_collapsed(prof.collapsed())
+    hot_total = sum(n for s, n in counts.items()
+                    if s.startswith("thread:hot"))
+    hot_engine = sum(n for s, n in counts.items()
+                     if s.startswith("thread:hot") and "kernel_shap:" in s)
+    return {"hot_samples": hot_total,
+            "engine_samples": hot_engine,
+            "engine_frac": round(hot_engine / hot_total, 4)
+            if hot_total else 0.0,
+            "auto_disabled": prof.stats()["auto_disabled"]}
+
+
+# --------------------------------------------------------------------- #
+# arm 5: federated /profilez
+# --------------------------------------------------------------------- #
+
+
+def run_federation_arm():
+    """Two replicas behind a proxy: with the sampler frozen so the scrape
+    is a fixed point, the proxy's federated merge must equal the fold of
+    the per-replica collapsed pages."""
+
+    from distributedkernelshap_tpu.observability.contprof import (
+        contprof,
+        merge_collapsed,
+        parse_collapsed,
+    )
+    from distributedkernelshap_tpu.serving.replicas import FanInProxy
+
+    s1, _r1 = serve_fleet([("tenant-fed", build_linear(seed=11))])
+    s2, _r2 = serve_fleet([("tenant-fed", build_linear(seed=12))])
+    proxy = FanInProxy([(s1.host, s1.port), (s2.host, s2.port)],
+                       probe_interval_s=3600).start()
+    prof = contprof()
+    try:
+        deadline = time.monotonic() + 10.0
+        while prof.samples_total() == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        prof.pause()
+        try:
+            fed = http_get(proxy.host, proxy.port, "/profilez?federate=1")
+            solos = [http_get(s.host, s.port, "/profilez?format=collapsed")
+                     for s in (s1, s2)]
+        finally:
+            prof.resume()
+    finally:
+        proxy.stop()
+        s1.stop()
+        s2.stop()
+    fed_counts = parse_collapsed(fed)
+    merged = parse_collapsed(merge_collapsed(solos))
+    return {"federated_samples": sum(fed_counts.values()),
+            "matches_replica_fold": fed_counts == merged}
+
+
+# --------------------------------------------------------------------- #
+# checks / record / main
+# --------------------------------------------------------------------- #
+
+
+def run_checks(result):
+    led = result["ledger"]
+    prs = result["pressure"]
+    ovh = result["overhead"]
+    hot = result["hotpath"]
+    fed = result["federation"]
+    return {
+        "ledger_books_balance": led["all_ok"] and led["exact"],
+        "ledger_tracks_nonzero": led["independent_walk_bytes"] > 0,
+        "pressure_fired_and_evicted": (
+            prs["all_ok"] and prs["pressure_events"] > 0
+            and prs["evicted_bytes"] > 0),
+        "eviction_answer_bit_identical": prs["answer_bit_identical"],
+        "sampler_overhead_le_1pct": (
+            ovh["sampler_alive"] and ovh["overhead_frac"] <= 0.01),
+        "hot_engine_frames_dominate": (
+            hot["hot_samples"] > 0 and hot["engine_frac"] >= 0.5
+            and not hot["auto_disabled"]),
+        "federated_matches_replica_fold": (
+            fed["federated_samples"] > 0 and fed["matches_replica_fold"]),
+    }
+
+
+def record(result, checks_ok, no_record=False):
+    if no_record:
+        return
+    from benchmarks.regression_gate import DEFAULT_HISTORY, record_run
+
+    record_run(
+        DEFAULT_HISTORY, "profile",
+        config={"overhead_requests":
+                result["config"]["overhead_requests"],
+                "ledger_requests": result["config"]["ledger_requests"],
+                "hot_duration_s": result["config"]["hot_duration_s"]},
+        metrics={"wall_s": result["wall_s"],
+                 # the sampler-overhead sentinel perf-gate watches: the
+                 # on/off median latency ratio (a sampler that got
+                 # expensive moves it off 1.0)
+                 "prof_overhead_factor":
+                     result["overhead"]["prof_overhead_factor"]},
+        extra={"checks_ok": checks_ok,
+               "overhead_frac": result["overhead"]["overhead_frac"],
+               "engine_frac": result["hotpath"]["engine_frac"],
+               "ledger_total_bytes":
+                   result["ledger"]["ledger_total_bytes"]})
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--check", action="store_true",
+                        help="exit nonzero unless every criterion holds")
+    parser.add_argument("--ledger-requests", type=int, default=12)
+    parser.add_argument("--overhead-requests", type=int, default=300,
+                        help="requests per overhead arm (per-request "
+                             "pause/resume alternation on one server)")
+    parser.add_argument("--hot-duration", type=float, default=1.5,
+                        help="seconds the hot-path arm burns under the "
+                             "private high-rate sampler")
+    parser.add_argument("--no-record", action="store_true",
+                        help="skip the perf-history self-record")
+    args = parser.parse_args()
+
+    t0 = time.monotonic()
+    result = {"config": {"ledger_requests": args.ledger_requests,
+                         "overhead_requests": args.overhead_requests,
+                         "hot_duration_s": args.hot_duration}}
+    result["ledger"], fleet = run_ledger_arm(
+        requests=args.ledger_requests)
+    result["pressure"] = run_pressure_arm(fleet)
+    result["overhead"] = run_overhead_arm(
+        requests=args.overhead_requests)
+    result["hotpath"] = run_hotpath_arm(duration_s=args.hot_duration)
+    result["federation"] = run_federation_arm()
+    result["wall_s"] = round(time.monotonic() - t0, 2)
+    checks = run_checks(result)
+    result["checks"] = checks
+    checks_ok = all(checks.values())
+    result["checks_ok"] = checks_ok
+    record(result, checks_ok, no_record=args.no_record)
+    print(json.dumps(result))
+    if args.check and not checks_ok:
+        failed = [k for k, v in checks.items() if not v]
+        print(f"profile_bench: FAILED {failed}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

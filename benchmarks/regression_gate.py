@@ -166,6 +166,13 @@ def _threshold_for(metric: str, max_wall: float,
         # expensive moves it); a median is far more stable than a p99,
         # so gate it like wall time
         return max_wall
+    if metric == "prof_overhead_factor":
+        # the profiling bench's sampler-overhead sentinel: median
+        # request latency with the sampler on over median with it off
+        # (so pinned near 1.0 by construction).  A sampler that got
+        # expensive moves it directly; medians are stable, gate it like
+        # wall time
+        return max_wall
     if metric == "err_at_deadline":
         # the anytime bench's degradation depth: mean reported error of
         # the answers the deadline actually bought under overload.  An

@@ -49,12 +49,31 @@ from distributedkernelshap_tpu.ops.explain import (
     split_shap_values,
     unpack_transfer,
 )
+from distributedkernelshap_tpu.observability.memledger import memledger
 from distributedkernelshap_tpu.ops.links import convert_to_link
 from distributedkernelshap_tpu.ops.summarise import kmeans_summary, subsample
 from distributedkernelshap_tpu.profiling import profiler
 from distributedkernelshap_tpu.utils import methdispatch
 
 logger = logging.getLogger(__name__)
+
+
+def _plan_consts_owner(key) -> str:
+    """Ledger owner for one ``_plan_consts_cache`` key: the cache holds
+    linear plan consts (``(content_fp, plan_fp, chunk)`` tuples) next to
+    the exact/tensor-network/deepshap/anytime constants, whose keys lead
+    with a string discriminator — route each to its own device-byte
+    account so ``dks_device_bytes`` tells them apart."""
+
+    if isinstance(key, tuple):
+        for el in key:
+            if el in ('exact_consts', 'exact_reach_full'):
+                return 'exact_consts'
+            if el in ('exact_tn_consts', 'deepshap_consts'):
+                return el
+            if el == 'anytime':
+                return 'anytime_consts'
+    return 'plan_consts'
 
 # parameters recorded in explanation metadata (reference kernel_shap.py:23-31)
 KERNEL_SHAP_PARAMS = [
@@ -643,11 +662,21 @@ class KernelExplainerEngine:
         self._ready_cache: Dict[bool, bool] = {}
         # device-resident per-plan constants, keyed by CONTENT fingerprint
         # (id(plan) keys could alias a recycled address after GC and serve
-        # a different plan's constants); OrderedDict = LRU, entry-bounded
-        self._dev_cache: "OrderedDict[Any, Any]" = OrderedDict()
+        # a different plan's constants); OrderedDict = LRU, entry-bounded.
+        # Both caches are ledger-tracked: every insert/evict charges or
+        # releases computed nbytes against the process memory ledger
+        # (dks_device_bytes{owner,model}); under memory pressure the
+        # ledger LRU-shrinks them — only ever forcing a re-upload.
+        _ledger = memledger()
+        self._dev_cache: "OrderedDict[Any, Any]" = \
+            _ledger.tracked_cache("dev_cache")
         # plan-constant cache for the linear fast path (see
         # EngineConfig.plan_constant_cache): {(content_key, chunk): consts}
-        self._plan_consts_cache: "OrderedDict[Any, Any]" = OrderedDict()
+        # — also holds the exact/tensor-network/deepshap/anytime consts
+        # under distinct key shapes, routed to per-owner ledger accounts
+        self._plan_consts_cache: "OrderedDict[Any, Any]" = \
+            _ledger.tracked_cache("plan_consts",
+                                  owner_for_key=_plan_consts_owner)
         self._content_fp: Optional[str] = None
         self.last_raw_prediction: Optional[np.ndarray] = None
         #: list of K (B, M, M) arrays after an interactions=True explain

@@ -30,7 +30,15 @@ stack comes up):
   ``dks_alerts_firing`` gauge);
 * :mod:`~distributedkernelshap_tpu.observability.statusz` — the
   :class:`HealthEngine` bundling sampler + SLOs + alerts behind the
-  ``/statusz`` endpoint both serving components expose.
+  ``/statusz`` endpoint both serving components expose;
+* :mod:`~distributedkernelshap_tpu.observability.contprof` — the
+  always-on sampling wall-clock profiler (``sys._current_frames`` at a
+  prime default rate) behind ``/profilez``, with role/tenant-tagged
+  collapsed stacks, Perfetto export and federated merging;
+* :mod:`~distributedkernelshap_tpu.observability.memledger` — the
+  process-wide device-memory ledger: per-owner/per-tenant computed
+  byte accounting over every device-resident cache, with a soft budget
+  and pressure-driven LRU eviction.
 
 See ``docs/OBSERVABILITY.md`` for the metric catalog, trace header
 format, SLO/alert semantics, ``/statusz`` schema, ``/debugz`` schema and
@@ -57,6 +65,16 @@ from distributedkernelshap_tpu.observability.flightrec import (  # noqa: F401
 )
 from distributedkernelshap_tpu.observability.costmeter import (  # noqa: F401
     CostMeter,
+)
+from distributedkernelshap_tpu.observability.contprof import (  # noqa: F401
+    ContProf,
+    merge_collapsed,
+    parse_collapsed,
+)
+from distributedkernelshap_tpu.observability.memledger import (  # noqa: F401
+    MemLedger,
+    TrackedCache,
+    approx_nbytes,
 )
 from distributedkernelshap_tpu.observability.fleet import (  # noqa: F401
     fleet_rollup,

@@ -178,6 +178,18 @@ def _tenant_block(parsed: Dict) -> Dict[str, Dict]:
             if model is not None:
                 sheds[model] = sheds.get(model, 0.0) + value
     fold("sheds", sheds)
+    # device-memory ledger gauge (observability/memledger.py): computed
+    # device bytes by owning model — the capacity twin of device_seconds
+    # (gauge, so the fleet sum is a point-in-time footprint)
+    mem = {}
+    fam = parsed.get("dks_device_bytes")
+    if fam:
+        for name, labels, value in fam["samples"]:
+            model = labels.get("model")
+            if model is None:
+                continue
+            mem[model] = mem.get(model, 0.0) + value
+    fold("device_bytes", mem)
     wire = parsed.get("dks_tenant_wire_bytes_total")
     if wire:
         for name, labels, value in wire["samples"]:

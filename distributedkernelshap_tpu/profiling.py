@@ -126,11 +126,18 @@ class Profiler:
                                    parent=trace_parent)
 
     @contextlib.contextmanager
-    def trace(self, logdir: str = "/tmp/dks_trace"):
-        """Capture a jax.profiler device trace (TensorBoard format)."""
+    def trace(self, logdir: Optional[str] = None):
+        """Capture a jax.profiler device trace (TensorBoard format).
+
+        ``logdir`` defaults to ``DKS_DEVICE_TRACE_DIR`` when that is set
+        (operators steer traces to durable storage without touching call
+        sites), else ``/tmp/dks_trace``."""
 
         import jax
 
+        if logdir is None:
+            logdir = os.environ.get("DKS_DEVICE_TRACE_DIR") \
+                or "/tmp/dks_trace"
         jax.profiler.start_trace(logdir)
         try:
             yield logdir

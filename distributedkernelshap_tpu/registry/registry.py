@@ -312,6 +312,21 @@ class ModelRegistry:
         # model_fingerprint returns, so every cache key is scoped to this
         # (model_id, version, content) — and survives a restart
         model.fingerprint = rm.fingerprint
+        # relabel the engine's ledger-tracked device caches to this
+        # tenant/version so dks_device_bytes attributes engine consts to
+        # the model that owns them (best-effort: stub models have no
+        # engine, a pre-ledger engine no rebind)
+        try:
+            engine = getattr(getattr(model, "explainer", model),
+                             "_explainer", None)
+            for cache_attr in ("_dev_cache", "_plan_consts_cache"):
+                cache = getattr(engine, cache_attr, None)
+                rebind = getattr(cache, "rebind", None)
+                if rebind is not None:
+                    rebind(model=model_id, version=version, path=path)
+        except Exception:
+            logger.debug("ledger rebind failed for %s", rm.label,
+                         exc_info=True)
         # warm BEFORE the flip: the new version compiles its ladder while
         # the old one keeps serving, so the swap is hitless
         server = self._server
@@ -455,6 +470,14 @@ class ModelRegistry:
             meter = getattr(server, "_costmeter", None)
             if meter is not None:
                 meter.retire_tenant(model_id, version=version)
+            # drop the tenant's (or the retired version's) device-memory
+            # ledger accounts too, so dks_device_bytes{model=...} stops
+            # rendering alongside the cost series
+            from distributedkernelshap_tpu.observability.memledger import (
+                memledger,
+            )
+
+            memledger().retire(model_id, version=version)
             if version is None:
                 server.metrics.retire_labels("dks_serve_padded_rows_total",
                                              {"model": model_id})

@@ -254,7 +254,7 @@ class ResultCache:
     budget-less callers (``max_err=None``) are served full-fidelity
     entries only, which is exactly the historical behaviour."""
 
-    def __init__(self, max_bytes: int):
+    def __init__(self, max_bytes: int, mem_account=None):
         if max_bytes <= 0:
             raise ValueError("max_bytes must be positive "
                              "(use no cache instead of a zero-byte one)")
@@ -266,6 +266,21 @@ class ResultCache:
         self._hits = 0
         self._misses = 0
         self._evictions = 0
+        # optional memory-ledger account: payload bytes are host memory,
+        # but they hold device work hostage (a hit IS a device batch slot
+        # freed), so the ledger tracks them under owner=result_cache next
+        # to the true device buffers.  Charges are namespaced by this
+        # cache instance — several servers may share one process account.
+        self._mem = mem_account
+        self._mem_token = object()
+
+    def _mem_charge(self, key: str, size: int) -> None:
+        if self._mem is not None:
+            self._mem.charge((self._mem_token, key), size, sweep=False)
+
+    def _mem_release(self, key: str) -> None:
+        if self._mem is not None:
+            self._mem.release((self._mem_token, key))
 
     def get(self, key: str,
             max_err: Optional[float] = None) -> Optional[str]:
@@ -299,12 +314,35 @@ class ResultCache:
                     return
                 self._entries.pop(key)
                 self._bytes -= len(old[0])
+                self._mem_release(key)
             self._entries[key] = (payload, est_err)
             self._bytes += size
+            self._mem_charge(key, size)
             while self._bytes > self.max_bytes and self._entries:
-                _, (evicted, _err) = self._entries.popitem(last=False)
+                ev_key, (evicted, _err) = self._entries.popitem(last=False)
                 self._bytes -= len(evicted)
                 self._evictions += 1
+                self._mem_release(ev_key)
+        if self._mem is not None:
+            # the ledger's pressure sweep re-enters this cache through
+            # evict_bytes, so it must run with our lock released
+            self._mem.ledger.poke()
+
+    def evict_bytes(self, nbytes: int) -> int:
+        """LRU-evict until at least ``nbytes`` are freed (or the cache
+        is empty); the memory ledger's pressure hook.  Evicted answers
+        recompute bit-identically on the next request — content-
+        addressed keys make eviction always safe."""
+
+        freed = 0
+        with self._lock:
+            while self._entries and freed < int(nbytes):
+                key, (payload, _err) = self._entries.popitem(last=False)
+                self._bytes -= len(payload)
+                self._evictions += 1
+                freed += len(payload)
+                self._mem_release(key)
+        return freed
 
     def __len__(self) -> int:
         with self._lock:

@@ -35,6 +35,7 @@ and cost — as where accelerator serving throughput comes from.
 """
 
 import heapq
+import logging
 import queue
 import threading
 import time
@@ -42,6 +43,9 @@ from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 from distributedkernelshap_tpu.analysis import lockwitness
+
+logger = logging.getLogger(__name__)
+
 PRIORITY_CLASSES = ("interactive", "batch", "best_effort")
 
 # Ordering budgets (seconds): a request with no explicit deadline is
@@ -536,8 +540,25 @@ class StagingBuffer:
     values mean the upload fully hid behind device work).
     """
 
-    def __init__(self, depth: int = 1):
+    def __init__(self, depth: int = 1, mem_account=None,
+                 nbytes_fn=None):
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+        # optional memory-ledger account: each staged slot pins device
+        # buffers between put and get, so slots charge computed nbytes
+        # under owner=staging for their (bounded, but real) lifetime
+        self._mem = mem_account
+        self._mem_nbytes = nbytes_fn
+
+    def _mem_charge(self, item) -> None:
+        if self._mem is not None and self._mem_nbytes is not None:
+            try:
+                self._mem.charge(id(item), int(self._mem_nbytes(item)))
+            except Exception:
+                logger.exception("staging ledger charge failed")
+
+    def _mem_release(self, item) -> None:
+        if self._mem is not None:
+            self._mem.release(id(item))
 
     def put(self, item, stop: Optional[threading.Event] = None,
             poll_s: float = 0.1) -> bool:
@@ -547,8 +568,10 @@ class StagingBuffer:
         the batch."""
 
         entry = (item, time.monotonic())
+        self._mem_charge(item)
         while True:
             if stop is not None and stop.is_set():
+                self._mem_release(item)
                 return False
             try:
                 self._q.put(entry, timeout=poll_s)
@@ -570,6 +593,7 @@ class StagingBuffer:
                 if stop is not None and stop.is_set():
                     return None
                 continue
+            self._mem_release(item)
             return item, max(0.0, time.monotonic() - t_ready)
 
     def drain(self) -> List:
@@ -578,9 +602,11 @@ class StagingBuffer:
         items = []
         while True:
             try:
-                items.append(self._q.get_nowait()[0])
+                item = self._q.get_nowait()[0]
             except queue.Empty:
                 return items
+            self._mem_release(item)
+            items.append(item)
 
 
 class FIFOScheduler(SLOScheduler):

@@ -48,6 +48,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import distributedkernelshap_tpu.observability.tracing as _tracing
 from distributedkernelshap_tpu.observability import fleet as _fleet
+from distributedkernelshap_tpu.observability.contprof import (
+    contprof,
+    merge_collapsed,
+)
 from distributedkernelshap_tpu.observability.flightrec import flightrec
 from distributedkernelshap_tpu.analysis import lockwitness
 from distributedkernelshap_tpu.observability.metrics import (
@@ -242,6 +246,9 @@ class FanInProxy:
             "dks_fleet_replicas_scraped",
             "Replicas whose exposition the last federated sweep "
             "merged.")
+        # the always-on sampling profiler's self-metering (shared
+        # process-wide sampler; the proxy exposes it like any server)
+        contprof().attach_metrics(reg)
         reg.gauge("dks_fanin_replica_up", "Replica liveness by index.",
                   labelnames=("replica", "address")).set_function(
             lambda: {(str(r.index), r.address): int(r.alive)
@@ -395,6 +402,37 @@ class FanInProxy:
                            "exposition (%s); its samples were dropped",
                            replica, error)
         return text
+
+    def federated_profile(self, timeout_s: float = 5.0) -> str:
+        """The ``/profilez?federate=1`` page: every scrapable replica's
+        collapsed-stack profile fetched concurrently over the fleet
+        scrape pool and merged by summing per-stack sample counts
+        (``observability/contprof.merge_collapsed``).  A replica that
+        fails to answer is simply missing from the merge, counted like
+        any other federated scrape failure."""
+
+        targets = [r for r in list(self.replicas)
+                   if not r.retired and (r.alive or r.draining
+                                         or r.standby)]
+        pages: Dict[str, str] = {}
+
+        def scrape(r):
+            try:
+                status, body, _ = self._forward(
+                    "GET", "/profilez?format=collapsed", b"", r,
+                    timeout_s=timeout_s)
+            except (OSError, http.client.HTTPException):
+                self._m_fleet_scrape_errors.inc()
+                return
+            if status != 200:
+                self._m_fleet_scrape_errors.inc()
+                return
+            pages[str(r.index)] = body.decode("utf-8", errors="replace")
+        if targets:
+            list(self._fleet_scrape_pool().map(scrape, targets))
+        self._m_fleet_scrapes.inc()
+        return merge_collapsed(
+            [pages[k] for k in sorted(pages, key=int)])
 
     def fleet_rollup(self) -> Dict:
         """The ``/fleetz`` document: per-tenant cost rollups summed over
@@ -1004,6 +1042,7 @@ class FanInProxy:
         standbys ``warm_ready`` WITHOUT admitting them — activation stays
         a scaler decision.  Retired replicas are never probed."""
 
+        contprof().register_current_thread("tick")
         while not self._stop.wait(self.probe_interval_s):
             try:
                 self._probe_sweep()
@@ -1177,6 +1216,19 @@ class FanInProxy:
                     payload["exemplars"] = proxy.metrics.exemplars()
                     self._reply(200, json.dumps(payload).encode())
                     return
+                if route == "/profilez":
+                    params = urllib.parse.parse_qs(query or "")
+                    federate = params.get("federate", [])
+                    if federate and federate[-1] == "1":
+                        # fleet flamegraph: every replica's collapsed
+                        # stacks merged (counts sum) over the scrape pool
+                        self._reply(200,
+                                    proxy.federated_profile().encode(),
+                                    ctype="text/plain; charset=utf-8")
+                        return
+                    ctype, page = contprof().profilez_payload(params)
+                    self._reply(200, page, ctype=ctype)
+                    return
                 if route != "/explain":
                     self._reply(404, json.dumps(
                         {"error": "unknown route"}).encode())
@@ -1223,6 +1275,8 @@ class FanInProxy:
         return Handler
 
     def start(self) -> "FanInProxy":
+        contprof().acquire()
+        self._prof_released = False
         self._httpd = _ProxyHTTPServer((self.host, self.port),
                                        self._make_handler())
         self.port = self._httpd.server_address[1]
@@ -1239,6 +1293,11 @@ class FanInProxy:
 
     def stop(self):
         self._stop.set()
+        # one-shot: a double stop() must not release another holder's
+        # profiler reference
+        if not getattr(self, "_prof_released", True):
+            self._prof_released = True
+            contprof().release()
         self.health.stop()
         if self._httpd is not None:
             self._httpd.shutdown()

@@ -38,6 +38,7 @@ import os
 import queue
 import threading
 import time
+import urllib.parse
 from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
@@ -51,7 +52,12 @@ from distributedkernelshap_tpu.observability.costmeter import (
     CostMeter,
     dispatch_shares,
 )
+from distributedkernelshap_tpu.observability.contprof import (
+    contprof,
+    register_thread_role,
+)
 from distributedkernelshap_tpu.observability.flightrec import flightrec
+from distributedkernelshap_tpu.observability.memledger import memledger
 from distributedkernelshap_tpu.observability.metrics import (
     DEFAULT_EXEMPLAR_SLOTS,
     MetricsRegistry,
@@ -583,7 +589,17 @@ class ExplainerServer:
             max_queued_per_class=max_queue_per_class,
             rate_limit_per_client=rate_limit_per_client,
             estimator=self._service_rate) if admission_control else None)
-        self._cache = ResultCache(cache_bytes) if cache_bytes else None
+        # the result cache charges its byte budget into the process-wide
+        # device-memory ledger (observability/memledger.py) so /statusz
+        # and dks_device_bytes{owner="result_cache"} see it; under
+        # DKS_MEM_BUDGET_BYTES pressure the ledger evicts LRU entries
+        # through evict_bytes — answers recompute bit-identically
+        self._cache = (ResultCache(
+            cache_bytes,
+            mem_account=memledger().account("result_cache"))
+            if cache_bytes else None)
+        if self._cache is not None:
+            memledger().register_pressure_callback(self._cache.evict_bytes)
         self._faults = fault_injector
         # precompile warmup ladder (see the ``warmup`` parameter): state is
         # read by /healthz, /statusz and the dks_serve_warming metrics;
@@ -909,6 +925,13 @@ class ExplainerServer:
         )
 
         attach_weak_fingerprint_metric(reg)
+        # continuous sampling profiler (observability/contprof.py):
+        # sample/drop/overhead counters for the always-on wall-clock
+        # sampler behind /profilez
+        contprof().attach_metrics(reg)
+        # device-memory ledger (observability/memledger.py): per-owner
+        # device bytes + high-water/budget/pressure series
+        memledger().attach_metrics(reg)
 
     def _register_registry_metrics(self, reg) -> None:
         def from_registry(method):
@@ -1210,6 +1233,13 @@ class ExplainerServer:
         if self._cache is not None:
             detail["cache"] = self._cache.stats()
         detail["warmup"] = self.warmup_status()
+        # engine-phase timings (profiling.py, populated under
+        # DKS_PROFILE=1) + the always-on sampler's own health
+        detail["profiler"] = {"phases": profiler().summary(),
+                              "sampler": contprof().stats()}
+        # the device-memory ledger panel: per-owner/per-model computed
+        # bytes, budget/pressure state, device reconciliation gap
+        detail["memory"] = memledger().snapshot()
         if self._registry is not None:
             # the multi-tenant panel: per-model active version, engine
             # path, fingerprint, in-flight pins, quota and drain state
@@ -1874,6 +1904,7 @@ class ExplainerServer:
         computing.  The bounded :class:`StagingBuffer` is the double
         buffer: one batch computing, one staged, one forming."""
 
+        register_thread_role("batcher")
         tr = self._tracer
         # dks: allow(DKS-C005): deliberate fail-fast — see the comment below
         while not self._stop.is_set():
@@ -1968,6 +1999,7 @@ class ExplainerServer:
         :meth:`_batcher_loop` and this thread consumes the staging buffer —
         each batch it dispatches already has device-resident rows."""
 
+        register_thread_role("dispatcher")
         try:
             # precompile warmup ladder first: this thread owns the engine's
             # jit caches, and the readiness gate (/healthz "warming") keeps
@@ -2020,6 +2052,7 @@ class ExplainerServer:
         """Fetch + postprocess dispatched batches (several of these run so
         D2H round trips overlap)."""
 
+        register_thread_role("finalizer")
         while not (self._dispatch_done.is_set() and self._inflight.empty()):
             try:
                 (batch, finalize, index_map, device_rows,
@@ -2057,6 +2090,7 @@ class ExplainerServer:
         wedge; if it never does, the failing ``/healthz`` gets the pod
         restarted (``cluster/tpu_serve_cluster.yaml``)."""
 
+        register_thread_role("tick")
         while not self._stop.is_set():
             if self._stop.wait(min(1.0, self.watchdog_timeout_s / 4)):
                 break
@@ -2341,6 +2375,7 @@ class ExplainerServer:
                 self.wfile.write(b"0\r\n\r\n")
 
             def _handle(self):
+                register_thread_role("handler")
                 # query string split off so /statusz?format=json routes
                 # (other routes ignore their query, as before)
                 path_only, _, query = self.path.partition("?")
@@ -2369,6 +2404,14 @@ class ExplainerServer:
                     # JSON schema under ?format=json)
                     ctype, body = statusz_response(
                         server.health, query, detail=server._statusz_detail())
+                    self._reply(200, body, ctype=ctype)
+                    return
+                if route == "/profilez":
+                    # the always-on sampler's flamegraph endpoint:
+                    # ?format=collapsed|perfetto, ?window=<s> for the
+                    # last-60s ring instead of cumulative counts
+                    params = urllib.parse.parse_qs(query)
+                    ctype, body = contprof().profilez_payload(params)
                     self._reply(200, body, ctype=ctype)
                     return
                 if route != "/explain":
@@ -2432,9 +2475,18 @@ class ExplainerServer:
                             "models": server._registry.model_ids()}))
                         return
                     model = rm.model
+                # tag this handler thread for the sampling profiler: its
+                # stacks fold under tenant:<model> (and carry the trace
+                # id as an exemplar) for the duration of the request
+                prof = contprof()
+                prof.tag_current_thread(
+                    trace_id=(self.headers.get(_tracing.TRACE_HEADER)
+                              or "").split("-")[0] or None,
+                    tenant=rm.model_id if rm is not None else None)
                 try:
                     self._explain_resolved(array, rm, model, len(body))
                 finally:
+                    prof.untag_current_thread()
                     if rm is not None:
                         rm.release()
 
@@ -2690,6 +2742,11 @@ class ExplainerServer:
         )
 
         enable_persistent_cache()
+        # always-on sampling profiler (observability/contprof.py):
+        # refcounted — several servers per process share one sampler
+        # thread; DKS_CONTPROF=0 leaves it inert
+        contprof().acquire()
+        self._prof_released = False
         if self._registry is not None and self.model is None:
             # registry mode with no explicit default deployment: the
             # registry's default model anchors depth calibration, staging
@@ -2749,7 +2806,17 @@ class ExplainerServer:
                 depth = (min(4, max(1, len(staging_models)))
                          if self._registry is not None else 1)
             self._staging_slots = depth
-            self._staged = StagingBuffer(depth=depth)
+            # staged slots pin device buffers between put and get — the
+            # ledger charges the staged rows (item[5], falling back to
+            # the stacked host array item[4]) under owner=staging
+            from distributedkernelshap_tpu.observability.memledger import (
+                approx_nbytes,
+            )
+            self._staged = StagingBuffer(
+                depth=depth,
+                mem_account=memledger().account("staging"),
+                nbytes_fn=lambda item: approx_nbytes(
+                    item[5] if item[5] is not None else item[4]))
             t_batcher = threading.Thread(target=self._batcher_loop,
                                          daemon=True)
         t_disp = threading.Thread(target=self._dispatch_loop, daemon=True)
@@ -2776,6 +2843,11 @@ class ExplainerServer:
 
     def stop(self):
         self._stop.set()
+        # one-shot: a double stop() must not release another server's
+        # profiler reference
+        if not getattr(self, "_prof_released", True):
+            self._prof_released = True
+            contprof().release()
         self.health.stop()
         self._sched.stop()  # wake the dispatcher's condition wait
         # fail anything still queued — including items deferred for row

@@ -55,11 +55,15 @@ DOCS_PATH = os.path.join(REPO_ROOT, "docs", "OBSERVABILITY.md")
 #: federated ``dks_fleet_*`` scrape accounting and the trace-sink
 #: rotation counter ``dks_trace_dropped_total``.  ``anytime`` joined
 #: with the progressive-refinement estimator: ``dks_anytime_*`` counts
-#: rounds, stop reasons, final reported error and streamed frames.)
+#: rounds, stop reasons, final reported error and streamed frames.
+#: ``prof`` and ``mem`` joined with continuous profiling: the sampling
+#: profiler's self-metering (``dks_prof_*``) and the device-memory
+#: ledger's budget/pressure series (``dks_mem_*``;
+#: ``dks_device_bytes`` rides the existing ``device`` prefix.)
 _LITERAL_RE = re.compile(
     r"dks_(?:serve|fanin|sched|phase|slo|alerts|wire|staging|treeshap|"
     r"tensor_shap|autoscale|registry|result_cache|deepshap|device|tenant|"
-    r"fleet|trace|anytime)_[a-z0-9_]+")
+    r"fleet|trace|anytime|prof|mem)_[a-z0-9_]+")
 
 #: directories never scanned for literals/renderers
 _SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "results", "data",

@@ -1,0 +1,313 @@
+"""Continuous sampling profiler: env resolution, sampler lifecycle and
+refcounting, auto-disable under the overhead budget, fold-table bounds,
+role/tenant tagging, collapsed/Perfetto export round-trips, and the
+/profilez endpoint on the server and proxy (incl. federation)."""
+
+import json
+import http.client
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu.observability.contprof import (
+    ContProf,
+    contprof,
+    from_perfetto,
+    merge_collapsed,
+    parse_collapsed,
+    resolve_contprof_env,
+)
+
+
+# --------------------------------------------------------------------- #
+# env resolution
+# --------------------------------------------------------------------- #
+
+
+def test_env_resolution(monkeypatch):
+    monkeypatch.delenv("DKS_CONTPROF", raising=False)
+    assert resolve_contprof_env(default_hz=19.0) == 19.0
+    monkeypatch.setenv("DKS_CONTPROF", "0")
+    assert resolve_contprof_env() == 0.0
+    monkeypatch.setenv("DKS_CONTPROF", "off")
+    assert resolve_contprof_env() == 0.0
+    monkeypatch.setenv("DKS_CONTPROF", "1")
+    assert resolve_contprof_env(default_hz=19.0) == 19.0
+    monkeypatch.setenv("DKS_CONTPROF", "97")
+    assert resolve_contprof_env() == 97.0
+    monkeypatch.setenv("DKS_CONTPROF", "100000")
+    assert resolve_contprof_env() == 250.0  # clamped
+    monkeypatch.setenv("DKS_CONTPROF", "garbage")
+    assert resolve_contprof_env(default_hz=19.0) == 19.0
+
+
+# --------------------------------------------------------------------- #
+# helpers: a parked worker thread with a recognisable stack
+# --------------------------------------------------------------------- #
+
+
+def _parked_worker(prof, role, tenant=None, trace=None):
+    """Spawn a thread parked inside a distinct function frame; returns
+    (thread, release_event)."""
+
+    release = threading.Event()
+    ready = threading.Event()
+
+    def _worker_frame_for_contprof():
+        prof.register_current_thread(role)
+        if tenant or trace:
+            prof.tag_current_thread(trace_id=trace, tenant=tenant)
+        ready.set()
+        release.wait(30)
+
+    t = threading.Thread(target=_worker_frame_for_contprof, daemon=True)
+    t.start()
+    ready.wait(5)
+    return t, release
+
+
+# --------------------------------------------------------------------- #
+# lifecycle
+# --------------------------------------------------------------------- #
+
+
+def test_start_stop_and_refcounted_acquire():
+    p = ContProf(hz=200.0)
+    assert not p.running
+    p.acquire()
+    assert p.running
+    p.acquire()
+    p.release()
+    assert p.running      # second holder keeps it alive
+    p.release()
+    assert not p.running
+
+
+def test_sampler_collects_role_tagged_stacks():
+    p = ContProf(hz=200.0)
+    t, release = _parked_worker(p, "handler", tenant="alpha",
+                                trace="t-123")
+    try:
+        p.start()
+        deadline = time.monotonic() + 5.0
+        while p.samples_total() == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+    finally:
+        p.stop()
+        release.set()
+    assert p.samples_total() > 0
+    text = p.collapsed()
+    assert "thread:handler" in text
+    assert "tenant:alpha" in text
+    assert "_worker_frame_for_contprof" in text
+
+
+def test_hz_zero_never_starts():
+    p = ContProf(hz=0.0)
+    p.start()
+    assert not p.running
+
+
+def test_pause_resume_skips_sweeps():
+    p = ContProf(hz=100.0)
+    t, release = _parked_worker(p, "other")
+    try:
+        p.pause()
+        p._sweep()
+        assert p.samples_total() == 0
+        p.resume()
+        p._sweep()
+        assert p.samples_total() > 0
+    finally:
+        release.set()
+
+
+def test_auto_disable_over_overhead_budget():
+    p = ContProf(hz=100.0, overhead_budget=1e-12)
+    t, release = _parked_worker(p, "other")
+    try:
+        p._started_mono = time.monotonic() - 10.0  # well past the 1s grace
+        p._sweep()
+        assert p.auto_disabled
+        before = p.samples_total()
+        p._sweep()                   # disabled: sweeps now no-op
+        assert p.samples_total() == before
+    finally:
+        release.set()
+    assert p.stats()["auto_disabled"] is True
+
+
+def test_fold_table_bound_drops_and_counts():
+    p = ContProf(hz=100.0, max_stacks=1)
+    t1, r1 = _parked_worker(p, "role-a")
+    t2, r2 = _parked_worker(p, "role-b")
+    try:
+        p._sweep()
+    finally:
+        r1.set()
+        r2.set()
+    stats = p.stats()
+    assert stats["distinct_stacks"] <= 1
+    assert stats["dropped_stacks"] > 0
+
+
+# --------------------------------------------------------------------- #
+# export round-trips
+# --------------------------------------------------------------------- #
+
+
+def test_parse_and_merge_collapsed():
+    page_a = "thread:handler;mod:f;mod:g 3\nthread:tick;mod:h 1\n"
+    page_b = "thread:handler;mod:f;mod:g 2\n"
+    assert parse_collapsed(page_a) == {
+        "thread:handler;mod:f;mod:g": 3, "thread:tick;mod:h": 1}
+    merged = merge_collapsed([page_a, page_b])
+    assert parse_collapsed(merged) == {
+        "thread:handler;mod:f;mod:g": 5, "thread:tick;mod:h": 1}
+
+
+def test_perfetto_roundtrip_matches_collapsed():
+    p = ContProf(hz=100.0)
+    t, release = _parked_worker(p, "handler", tenant="alpha")
+    try:
+        for _ in range(3):
+            p._sweep()
+    finally:
+        release.set()
+    collapsed = parse_collapsed(p.collapsed())
+    assert collapsed
+    doc = p.perfetto()
+    assert doc["traceEvents"]
+    assert from_perfetto(doc) == collapsed
+
+
+def test_windowed_view_bounded_by_ring():
+    p = ContProf(hz=100.0)
+    t, release = _parked_worker(p, "other")
+    try:
+        p._sweep()
+    finally:
+        release.set()
+    # the 60s window holds everything just sampled; a 0-second window
+    # may only drop counts, never invent them
+    full = sum(parse_collapsed(p.collapsed()).values())
+    windowed = sum(parse_collapsed(p.collapsed(window_s=60)).values())
+    assert 0 < windowed <= full
+
+
+def test_profilez_payload_formats():
+    p = ContProf(hz=100.0)
+    t, release = _parked_worker(p, "handler")
+    try:
+        p._sweep()
+    finally:
+        release.set()
+    ctype, body = p.profilez_payload({})
+    assert ctype.startswith("application/json")
+    doc = json.loads(body)
+    assert "samples_total" in doc and "top_stacks" in doc
+    ctype, body = p.profilez_payload({"format": ["collapsed"]})
+    assert ctype.startswith("text/plain")
+    assert parse_collapsed(body.decode())
+    ctype, body = p.profilez_payload({"format": ["perfetto"]})
+    assert "traceEvents" in json.loads(body)
+
+
+def test_reset_zeroes_everything():
+    p = ContProf(hz=100.0)
+    t, release = _parked_worker(p, "other")
+    try:
+        p._sweep()
+    finally:
+        release.set()
+    assert p.samples_total() > 0
+    p.reset()
+    assert p.samples_total() == 0
+    assert p.collapsed() == ""
+
+
+# --------------------------------------------------------------------- #
+# serving integration: /profilez on server and proxy
+# --------------------------------------------------------------------- #
+
+
+def _get(host, port, path):
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+class _Stub:
+    max_rows = None
+
+    def explain_batch(self, instances, split_sizes=None):
+        return [json.dumps({"data": {}})] * len(split_sizes or [1])
+
+
+@pytest.fixture()
+def profiled_server():
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    server = ExplainerServer(_Stub(), host="127.0.0.1", port=0,
+                             max_batch_size=2, batch_timeout_s=0.002,
+                             health_interval_s=0).start()
+    try:
+        yield server
+    finally:
+        server.stop()
+
+
+def test_server_profilez_routes(profiled_server):
+    server = profiled_server
+    status, body = _get(server.host, server.port, "/profilez")
+    assert status == 200
+    doc = json.loads(body)
+    assert "samples_total" in doc and "hz" in doc
+    status, body = _get(server.host, server.port,
+                        "/profilez?format=collapsed")
+    assert status == 200
+    parse_collapsed(body.decode())  # well-formed (possibly empty early)
+    status, body = _get(server.host, server.port,
+                        "/profilez?format=perfetto")
+    assert status == 200
+    assert "traceEvents" in json.loads(body)
+    # self-metering rides the ordinary exposition
+    assert "dks_prof_samples_total" in server._render_metrics()
+
+
+def test_proxy_profilez_and_federation(profiled_server):
+    from distributedkernelshap_tpu.serving.replicas import FanInProxy
+
+    server = profiled_server
+    proxy = FanInProxy([(server.host, server.port)],
+                       probe_interval_s=3600).start()
+    try:
+        status, body = _get(proxy.host, proxy.port, "/profilez")
+        assert status == 200
+        assert "samples_total" in json.loads(body)
+        # give the shared sampler a beat so the merge carries content
+        prof = contprof()
+        deadline = time.monotonic() + 5.0
+        while prof.samples_total() == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        prof.pause()   # freeze counts so federated == replica scrape
+        try:
+            status, fed = _get(proxy.host, proxy.port,
+                               "/profilez?federate=1")
+            assert status == 200
+            status, solo = _get(server.host, server.port,
+                                "/profilez?format=collapsed")
+            assert status == 200
+            # one replica: the federated merge IS that replica's page
+            assert parse_collapsed(fed.decode()) == \
+                parse_collapsed(solo.decode())
+        finally:
+            prof.resume()
+    finally:
+        proxy.stop()

@@ -1,0 +1,306 @@
+"""Device-memory ledger: computed-byte exactness of accounts and
+tracked LRU caches, callback gauge rendering, pressure-driven eviction
+under a soft budget, tenant label retirement, the /statusz memory
+panel, and the disabled escape hatch."""
+
+import gc
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu.observability.memledger import (
+    DEFAULT_MODEL_LABEL,
+    MemLedger,
+    approx_nbytes,
+    memledger,
+    resolve_mem_budget_env,
+    resolve_mem_ledger_env,
+)
+from distributedkernelshap_tpu.observability.metrics import (
+    MetricsRegistry,
+    validate_exposition,
+)
+
+
+def _arr(n):
+    return np.zeros(n, dtype=np.uint8)
+
+
+# --------------------------------------------------------------------- #
+# approx_nbytes
+# --------------------------------------------------------------------- #
+
+
+def test_approx_nbytes_sums_array_leaves_through_containers():
+    v = {"a": _arr(10), "b": [_arr(3), ( _arr(4), None)], "c": "hello"}
+    assert approx_nbytes(v) == 10 + 3 + 4 + 5
+    assert approx_nbytes(b"12345678") == 8
+    assert approx_nbytes(object()) == 0
+    assert approx_nbytes(None) == 0
+
+
+def test_env_resolution(monkeypatch):
+    monkeypatch.delenv("DKS_MEM_LEDGER", raising=False)
+    assert resolve_mem_ledger_env() is True
+    monkeypatch.setenv("DKS_MEM_LEDGER", "0")
+    assert resolve_mem_ledger_env() is False
+    monkeypatch.setenv("DKS_MEM_BUDGET_BYTES", "1024")
+    assert resolve_mem_budget_env() == 1024
+    monkeypatch.setenv("DKS_MEM_BUDGET_BYTES", "garbage")
+    assert resolve_mem_budget_env() == 0
+
+
+# --------------------------------------------------------------------- #
+# accounts: charge/release exactness
+# --------------------------------------------------------------------- #
+
+
+def test_account_charge_release_is_exact():
+    led = MemLedger(enabled=True, budget_bytes=0)
+    acct = led.account("result_cache")
+    acct.charge("k1", 100)
+    acct.charge("k2", 50)
+    assert led.total_bytes() == 150
+    # re-charging a key replaces, never double-counts
+    acct.charge("k1", 70)
+    assert led.total_bytes() == 120
+    assert acct.release("k1") == 70
+    assert acct.release("k1") == 0  # idempotent
+    assert led.total_bytes() == 50
+    assert acct.clear() == 50
+    assert led.total_bytes() == 0
+    assert led.high_water_bytes() == 150
+
+
+def test_accounts_are_interned_by_labels():
+    led = MemLedger(enabled=True, budget_bytes=0)
+    a = led.account("staging", model="m", version=1, path="sampled")
+    b = led.account("staging", model="m", version=1, path="sampled")
+    assert a is b
+    assert led.account("staging", model="m", version=2) is not a
+
+
+# --------------------------------------------------------------------- #
+# TrackedCache: every mutation path mirrors into the ledger
+# --------------------------------------------------------------------- #
+
+
+def test_tracked_cache_mirrors_all_mutation_paths():
+    led = MemLedger(enabled=True, budget_bytes=0)
+    c = led.tracked_cache("dev_cache")
+    c["a"] = _arr(10)
+    c["b"] = _arr(20)
+    assert led.total_bytes() == 30
+    c["a"] = _arr(5)             # replace releases the old charge
+    assert led.total_bytes() == 25
+    del c["a"]
+    assert led.total_bytes() == 20
+    c.pop("b")                   # pop routes through __delitem__
+    assert led.total_bytes() == 0
+    c.update({"x": _arr(7), "y": _arr(8)})   # update via __setitem__
+    assert led.total_bytes() == 15
+    c.popitem(last=False)        # LRU evict, the engine's idiom
+    assert led.total_bytes() == 8
+    c.clear()
+    assert led.total_bytes() == 0
+    assert c.ledger_bytes == 0
+
+
+def test_tracked_cache_owner_for_key_routes_accounts():
+    led = MemLedger(enabled=True, budget_bytes=0)
+    c = led.tracked_cache(
+        "plan_consts",
+        owner_for_key=lambda k: "exact_consts"
+        if k[0] == "exact_consts" else "plan_consts")
+    c[("exact_consts", "fp")] = _arr(10)
+    c[("fp", "plan", 4)] = _arr(6)
+    assert led.owner_totals() == {"exact_consts": 10, "plan_consts": 6}
+
+
+def test_tracked_cache_rebind_relabels_live_charges():
+    led = MemLedger(enabled=True, budget_bytes=0)
+    c = led.tracked_cache("dev_cache")
+    c["k"] = _arr(12)
+    assert led.model_totals() == {DEFAULT_MODEL_LABEL: 12}
+    c.rebind(model="tenant-a", version=3, path="sampled")
+    assert led.model_totals() == {"tenant-a": 12}
+    assert led.total_bytes() == 12  # relabeled, not duplicated
+
+
+def test_dead_cache_finalizer_releases_charges():
+    led = MemLedger(enabled=True, budget_bytes=0)
+    c = led.tracked_cache("dev_cache")
+    c["k"] = _arr(64)
+    assert led.total_bytes() == 64
+    del c
+    gc.collect()
+    assert led.total_bytes() == 0
+
+
+# --------------------------------------------------------------------- #
+# metrics rendering
+# --------------------------------------------------------------------- #
+
+
+def test_callback_gauges_render_and_validate():
+    led = MemLedger(enabled=True, budget_bytes=4096)
+    cache = led.tracked_cache("dev_cache", model="alpha")
+    cache["k"] = _arr(100)
+    led.account("result_cache").charge("r", 50)
+    reg = MetricsRegistry()
+    led.attach_metrics(reg)
+    text = reg.render()
+    assert validate_exposition(text) == []
+    gauge = reg.get("dks_device_bytes")
+    assert gauge.value(owner="dev_cache", model="alpha") == 100
+    assert gauge.value(owner="result_cache",
+                       model=DEFAULT_MODEL_LABEL) == 50
+    assert "dks_mem_budget_bytes 4096" in text
+    assert "dks_mem_high_water_bytes 150" in text
+
+
+# --------------------------------------------------------------------- #
+# pressure: budget, eviction, MRU survival
+# --------------------------------------------------------------------- #
+
+
+def test_pressure_evicts_lru_but_never_mru():
+    led = MemLedger(enabled=True, budget_bytes=100)
+    c = led.tracked_cache("dev_cache")
+    for i in range(5):
+        c[i] = _arr(40)      # 200 bytes charged, budget 100
+    assert led.pressure_events() > 0
+    assert led.evicted_bytes() > 0
+    assert led.total_bytes() <= 100
+    assert 4 in c            # the most-recently-inserted entry survives
+    assert len(c) >= 1
+
+
+def test_pressure_callback_invoked_with_overage():
+    led = MemLedger(enabled=True, budget_bytes=100)
+    seen = []
+
+    def cb(overage):
+        seen.append(overage)
+        return 0
+
+    led.register_pressure_callback(cb)
+    acct = led.account("staging")
+    acct.charge("big", 150)
+    assert seen and seen[0] == 50
+    assert led.pressure_events() == 1
+
+
+def test_pressure_flight_event_recorded():
+    from distributedkernelshap_tpu.observability.flightrec import flightrec
+
+    led = MemLedger(enabled=True, budget_bytes=10)
+    led.account("staging").charge("x", 25)
+    kinds = [e["kind"] for e in flightrec().to_payload()["events"]]
+    assert "memory_pressure" in kinds
+
+
+# --------------------------------------------------------------------- #
+# label retirement
+# --------------------------------------------------------------------- #
+
+
+def test_retire_drops_model_and_version_scoped_charges():
+    led = MemLedger(enabled=True, budget_bytes=0)
+    led.account("dev_cache", model="a", version=1).charge("k", 10)
+    led.account("dev_cache", model="a", version=2).charge("k", 20)
+    led.account("dev_cache", model="b", version=1).charge("k", 40)
+    assert led.retire("a", version=1) == 10
+    assert led.model_totals() == {"a": 20, "b": 40}
+    assert led.retire("a") == 20
+    assert led.model_totals() == {"b": 40}
+    assert led.total_bytes() == 40
+
+
+# --------------------------------------------------------------------- #
+# snapshot / statusz panel schema
+# --------------------------------------------------------------------- #
+
+
+def test_snapshot_schema():
+    led = MemLedger(enabled=True, budget_bytes=1 << 20)
+    cache = led.tracked_cache("dev_cache", model="alpha")
+    cache["k"] = _arr(10)
+    doc = led.snapshot()
+    for key in ("enabled", "total_bytes", "high_water_bytes",
+                "budget_bytes", "pressure_events", "evicted_bytes",
+                "owners", "models", "reconcile"):
+        assert key in doc
+    assert doc["total_bytes"] == 10
+    assert doc["owners"] == {"dev_cache": 10}
+    assert doc["models"] == {"alpha": 10}
+    assert "ledger_bytes" in doc["reconcile"]
+
+
+# --------------------------------------------------------------------- #
+# disabled escape hatch
+# --------------------------------------------------------------------- #
+
+
+def test_disabled_ledger_is_inert():
+    led = MemLedger(enabled=False, budget_bytes=10)
+    c = led.tracked_cache("dev_cache")
+    c["k"] = _arr(100)          # caching still works...
+    assert np.asarray(c["k"]).nbytes == 100
+    assert led.total_bytes() == 0   # ...but nothing is charged
+    assert led.pressure_events() == 0
+    acct = led.account("staging")
+    acct.charge("x", 50)
+    assert led.total_bytes() == 0
+    assert acct.release("x") == 0
+    assert led.snapshot()["enabled"] is False
+
+
+# --------------------------------------------------------------------- #
+# serving integration: statusz panel + engine cache enrollment
+# --------------------------------------------------------------------- #
+
+
+def test_server_statusz_carries_memory_and_profiler_panels():
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    class _Stub:
+        max_rows = None
+
+        def explain_batch(self, instances, split_sizes=None):
+            return ["{}"] * len(split_sizes or [1])
+
+    server = ExplainerServer(_Stub(), host="127.0.0.1", port=0,
+                             cache_bytes=1024, health_interval_s=0)
+    detail = server._statusz_detail()
+    assert "memory" in detail and "total_bytes" in detail["memory"]
+    assert "profiler" in detail
+    assert "sampler" in detail["profiler"]
+    assert "phases" in detail["profiler"]
+    text = server._render_metrics()
+    assert "dks_mem_budget_bytes" in text
+    assert "dks_prof_samples_total" in text
+
+
+def test_engine_device_caches_are_ledger_tracked():
+    from distributedkernelshap_tpu.observability.memledger import (
+        TrackedCache,
+    )
+    from distributedkernelshap_tpu.models import LinearPredictor
+    from distributedkernelshap_tpu.serving.wrappers import (
+        BatchKernelShapModel,
+    )
+
+    rng = np.random.default_rng(0)
+    W = rng.normal(size=(4, 2)).astype(np.float32)
+    b = np.zeros(2, dtype=np.float32)
+    bg = rng.normal(size=(8, 4)).astype(np.float32)
+    model = BatchKernelShapModel(LinearPredictor(W, b), bg,
+                                 {"link": "identity", "seed": 0}, {})
+    engine = model.explainer._explainer
+    assert isinstance(engine._dev_cache, TrackedCache)
+    assert isinstance(engine._plan_consts_cache, TrackedCache)
+    before = memledger().total_bytes()
+    model.explain_batch(rng.normal(size=(1, 4)).astype(np.float32),
+                        split_sizes=[1])
+    assert memledger().total_bytes() >= before
