@@ -219,6 +219,16 @@ def run_benchmark(cpu_fallback: bool = False) -> int:
     # identity link; null when no exact route exists for the task)
     record["phi_vs_exact_err"] = _phi_vs_exact_err(explainer, X_explain,
                                                    explanation)
+    # the serving-side invariant screen (observability/quality.py) run
+    # over this bench's final explanation: a TPU rerun carries a
+    # correctness verdict next to its wall time, not just a speed
+    from distributedkernelshap_tpu.observability.quality import (
+        screen_arrays,
+    )
+
+    record["audit_violations"] = len(screen_arrays(
+        sv, explanation.expected_value,
+        explanation.data["raw"]["raw_prediction"], path="sampled"))
     record["compile_total"] = {
         k: int(v) for k, v in compile_delta["totals"].items()}
     record["compile_seconds_total"] = {

@@ -1,0 +1,420 @@
+"""Continuous-correctness bench: the in-band auditor must catch real
+numeric corruption, stay silent on healthy traffic, cost nothing, and
+the shadow oracle must respect its budget (standalone, CPU backend,
+exits nonzero on ``--check`` fail).
+
+Five measured arms, one JSON line (ISSUE 19):
+
+1. **Detection (true-positive)** — a live fleet with the ``engine.phi``
+   chaos site armed (``corrupt``, seeded): the injected numeric phi
+   corruption must be flagged by the invariant auditor on EVERY fired
+   hit — counted in ``dks_quality_violations_total``, landed on the
+   flight recorder as ``quality_violation`` events and captured into
+   the ``/qualityz`` repro ring — within the K-request run.
+2. **Clean (false-positive)** — the same serving setup with no faults:
+   zero violations over the whole run.  The screen's path-specific
+   tolerances must clear healthy solver noise with margin.
+3. **Audit overhead** — one live server, the auditor toggled PER
+   REQUEST (strict on/off alternation, the drift-robust methodology the
+   cost/profiling benches settled on): the audited pool's median
+   latency must sit within 1% of the unaudited pool's.  Records as
+   ``audit_overhead_factor`` for ``make perf-gate``.
+4. **Shadow budget** — sampler at fraction 1.0 under a deliberately
+   tiny ``DKS_QUALITY_BUDGET_S``-style budget: the oracle must run at
+   least once, then trip the cap — verified against the cost meter's
+   ``_quality`` tenant (device-seconds within budget + one run's cost,
+   the pre-gated cap's contract: a run cannot be preempted mid-explain).
+5. **Canary drift** — hot swaps on a live registry: an identical
+   re-register must replay ~zero drift (verdict ``ok``), a deliberately
+   perturbed version must report nonzero drift (verdict ``drift`` +
+   ``swap_drift`` flight event) BEFORE traffic moves, with the verdict
+   riding the ``model_swap`` event.
+
+Self-records into ``results/perf_history.jsonl`` with ``checks_ok``.
+
+    JAX_PLATFORMS=cpu python benchmarks/quality_bench.py --check
+"""
+
+import argparse
+import json
+import statistics
+import sys
+import time
+
+import numpy as np
+
+REPO_ROOT = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO_ROOT)
+
+from benchmarks.cost_attribution_bench import (  # noqa: E402
+    http_get,
+    post_explain,
+    serve_fleet,
+)
+from benchmarks.multitenant_bench import build_linear  # noqa: E402
+
+D = 6  # the multitenant builders' feature width
+
+
+def _flight_events(kind):
+    from distributedkernelshap_tpu.observability.flightrec import flightrec
+
+    return [e for e in flightrec().to_payload()["events"]
+            if e.get("kind") == kind]
+
+
+def _qualityz(server):
+    return json.loads(http_get(server.host, server.port, "/qualityz"))
+
+
+# --------------------------------------------------------------------- #
+# arm 1: detection (true-positive) under injected engine.phi corruption
+# --------------------------------------------------------------------- #
+
+
+def run_detect_arm(requests=12, corruptions=3, seed=7):
+    """K requests against a fleet whose ``engine.phi`` site corrupts
+    ``corruptions`` answers (seeded, deterministic): every fired hit
+    must be flagged — no more (that would be a false positive on the
+    clean majority), no fewer (a miss is the whole failure mode this
+    subsystem exists to kill)."""
+
+    from distributedkernelshap_tpu.resilience.faults import (
+        FaultInjector,
+        parse_faults,
+    )
+
+    inj = FaultInjector(parse_faults(
+        f"corrupt:site=engine.phi,after=2,times={corruptions},seed={seed}"))
+    events_before = len(_flight_events("quality_violation"))
+    server, _registry = serve_fleet([("tenant-det", build_linear(seed=1))],
+                                    fault_injector=inj)
+    rng = np.random.default_rng(0)
+    try:
+        statuses = []
+        for _ in range(requests):
+            s, _ = post_explain(server.host, server.port,
+                                rng.normal(size=(1, D)).astype(np.float32),
+                                model="tenant-det")
+            statuses.append(s)
+        server._quality.flush(timeout_s=10.0)  # let the deferred screen land
+        page = _qualityz(server)
+        fired = inj.hits("engine.phi")
+    finally:
+        server.stop()
+    events = len(_flight_events("quality_violation")) - events_before
+    audit = page["audit"]
+    return {
+        "requests": requests,
+        "all_ok": all(s == 200 for s in statuses),
+        "corruptions_armed": corruptions,
+        "site_hits": fired,
+        "violations": audit["violation_answers_total"],
+        "audited": audit["audited_total"],
+        "ring_entries": len(audit["ring"]),
+        "ring_checks": sorted({c for e in audit["ring"]
+                               for c in e["checks"]}),
+        "flight_events": events,
+    }
+
+
+# --------------------------------------------------------------------- #
+# arm 2: clean traffic (false-positive)
+# --------------------------------------------------------------------- #
+
+
+def run_clean_arm(requests=40):
+    """No faults, mixed batch sizes: the auditor must stay silent over
+    the whole run — the tolerances are calibrated to clear healthy
+    solver noise, and a single false positive would train operators to
+    ignore the alert."""
+
+    server, _registry = serve_fleet([("tenant-cln", build_linear(seed=2))])
+    rng = np.random.default_rng(1)
+    try:
+        statuses = []
+        for i in range(requests):
+            rows = 1 + (i % 3)
+            s, _ = post_explain(server.host, server.port,
+                                rng.normal(size=(rows, D)).astype(
+                                    np.float32),
+                                model="tenant-cln")
+            statuses.append(s)
+        server._quality.flush(timeout_s=10.0)
+        page = _qualityz(server)
+    finally:
+        server.stop()
+    audit = page["audit"]
+    return {
+        "requests": requests,
+        "all_ok": all(s == 200 for s in statuses),
+        "audited": audit["audited_total"],
+        "violations": audit["violation_answers_total"],
+    }
+
+
+# --------------------------------------------------------------------- #
+# arm 3: audit overhead (the gated sentinel)
+# --------------------------------------------------------------------- #
+
+
+def run_overhead_arm(requests=300, seed=13):
+    """Auditor cost on ONE live server, toggling the screen PER REQUEST
+    (strict alternation: any latency drift hits both pools identically;
+    the only difference between the pooled medians is the decode+screen
+    the audited pool runs at finalize).  The on/off median ratio records
+    as ``audit_overhead_factor`` for the perf gate."""
+
+    server, _registry = serve_fleet([("tenant-ovh", build_linear(seed=1))])
+    auditor = server._quality.auditor
+    lat = {"on": [], "off": []}
+    rng = np.random.default_rng(seed)
+    try:
+        for _ in range(10):  # untimed warm pass
+            post_explain(server.host, server.port,
+                         rng.normal(size=(1, D)).astype(np.float32),
+                         model="tenant-ovh")
+        for i in range(2 * requests):
+            arm = "on" if i % 2 == 0 else "off"
+            auditor.enabled = (arm == "on")
+            row = rng.normal(size=(1, D)).astype(np.float32)
+            t0 = time.monotonic()
+            status, _ = post_explain(server.host, server.port, row,
+                                     model="tenant-ovh")
+            assert status == 200
+            lat[arm].append(time.monotonic() - t0)
+        server._quality.flush(timeout_s=10.0)
+        audited = auditor.snapshot()["audited_total"]
+    finally:
+        auditor.enabled = True
+        server.stop()
+    med_on = statistics.median(lat["on"])
+    med_off = statistics.median(lat["off"])
+    return {"median_on_s": round(med_on, 6),
+            "median_off_s": round(med_off, 6),
+            "overhead_frac": round(med_on / med_off - 1.0, 4),
+            "audit_overhead_factor": round(med_on / med_off, 4),
+            "audited_in_on_pool": audited,
+            "requests_per_arm": requests}
+
+
+# --------------------------------------------------------------------- #
+# arm 4: shadow-oracle budget enforcement vs the cost meter
+# --------------------------------------------------------------------- #
+
+
+def _quality_tenant_seconds(server):
+    """The ``_quality`` system tenant's device-seconds, read back from
+    the cost meter's rendered series — the bench verifies the budget
+    against the METER, not the sampler's self-report."""
+
+    total = 0.0
+    for line in server.metrics.render().splitlines():
+        if line.startswith("dks_device_seconds_total{") \
+                and 'model="_quality"' in line:
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def run_budget_arm(budget_s=0.05, max_requests=400, timeout_s=120.0):
+    """Sampler at fraction 1.0 under a tiny budget: the oracle must get
+    real runs in, then trip the cap with the meter's ``_quality``
+    device-seconds inside budget + one run's cost (pre-gated cap).
+    Traffic is fed in rounds until the budget trips (oracle run cost is
+    machine-dependent; a fixed request count would be flaky)."""
+
+    server, _registry = serve_fleet([("tenant-bud", build_linear(seed=3))])
+    monitor = server._quality
+    sampler = monitor.sampler
+    sampler.fraction = 1.0
+    sampler.budget_s = float(budget_s)
+    monitor.stop()
+    monitor.start(tick_s=0.01)  # drain fast: the arm measures budget, not pacing
+    rng = np.random.default_rng(5)
+    sent = 0
+    try:
+        deadline = time.monotonic() + timeout_s
+        shadow = _qualityz(server)["shadow"]
+        while not shadow["exhausted"] and sent < max_requests \
+                and time.monotonic() < deadline:
+            for _ in range(20):
+                s, _ = post_explain(server.host, server.port,
+                                    rng.normal(size=(1, D)).astype(
+                                        np.float32),
+                                    model="tenant-bud")
+                assert s == 200
+                sent += 1
+            # let the audit + oracle drains catch up before sending more
+            monitor.flush(timeout_s=10.0)
+            while time.monotonic() < deadline:
+                shadow = _qualityz(server)["shadow"]
+                if shadow["exhausted"] or shadow["queued"] == 0:
+                    break
+                time.sleep(0.05)
+        meter_s = _quality_tenant_seconds(server)
+        shadow = _qualityz(server)["shadow"]
+    finally:
+        server.stop()
+    runs = sum(t["runs"] for t in shadow["tenants"].values())
+    return {
+        "requests_sent": sent,
+        "budget_s": budget_s,
+        "spent_s": round(shadow["spent_s"], 4),
+        "max_run_s": round(shadow["max_run_s"], 4),
+        "meter_quality_seconds": round(meter_s, 4),
+        "exhausted": shadow["exhausted"],
+        "oracle_runs": runs,
+        "sampled": shadow["sampled"],
+        "worst_err": max((t["last_err"] or 0.0
+                          for t in shadow["tenants"].values()),
+                         default=None),
+    }
+
+
+# --------------------------------------------------------------------- #
+# arm 5: canary drift across gated hot swaps
+# --------------------------------------------------------------------- #
+
+
+def run_canary_arm():
+    """Three swaps on one live registry: v2 adopts the baseline, an
+    identical v3 must replay ~zero drift (verdict ``ok``), a perturbed
+    v4 must report nonzero drift (verdict ``drift``) before traffic
+    moves — quantified on the ``model_swap`` event, alarmed via
+    ``swap_drift``."""
+
+    from distributedkernelshap_tpu.observability.quality import (
+        DRIFT_TOLERANCE,
+    )
+
+    drift_before = len(_flight_events("swap_drift"))
+    server, registry = serve_fleet([("tenant-can", build_linear(seed=1))])
+    try:
+        registry.register("tenant-can", build_linear(seed=1))  # v2: adopt
+        registry.register("tenant-can", build_linear(seed=1))  # v3: same
+        swaps = [e for e in _flight_events("model_swap")
+                 if e.get("model") == "tenant-can"]
+        identical = next(e for e in reversed(swaps)
+                         if e.get("to_version") == 3)
+        registry.register("tenant-can", build_linear(seed=9))  # v4: drifted
+        swaps = [e for e in _flight_events("model_swap")
+                 if e.get("model") == "tenant-can"]
+        perturbed = next(e for e in reversed(swaps)
+                         if e.get("to_version") == 4)
+        page = _qualityz(server)["canary"]
+    finally:
+        server.stop()
+    drift_events = len(_flight_events("swap_drift")) - drift_before
+    return {
+        "threshold": DRIFT_TOLERANCE,
+        "identical_drift": identical.get("canary_drift"),
+        "identical_verdict": identical.get("canary_verdict"),
+        "perturbed_drift": perturbed.get("canary_drift"),
+        "perturbed_verdict": perturbed.get("canary_verdict"),
+        "swap_drift_events": drift_events,
+        "qualityz_verdict": page["tenants"].get("tenant-can", {}),
+    }
+
+
+# --------------------------------------------------------------------- #
+# checks / record / main
+# --------------------------------------------------------------------- #
+
+
+def run_checks(result):
+    det = result["detect"]
+    cln = result["clean"]
+    ovh = result["overhead"]
+    bud = result["budget"]
+    can = result["canary"]
+    return {
+        # every fired corruption flagged, nothing else flagged, offenders
+        # on the ring AND the flight recorder — within the K-request run
+        "corruption_detected_within_k": (
+            det["all_ok"]
+            and det["violations"] == det["corruptions_armed"]
+            and det["ring_entries"] == det["corruptions_armed"]
+            and det["flight_events"] == det["corruptions_armed"]
+            and det["ring_checks"] == ["additivity"]),
+        "zero_false_positives": (
+            cln["all_ok"] and cln["violations"] == 0
+            and cln["audited"] >= cln["requests"]),
+        "audit_overhead_le_1pct": (
+            ovh["audited_in_on_pool"] > 0
+            and ovh["overhead_frac"] <= 0.01),
+        # the cap is pre-gated (a run cannot be preempted mid-explain):
+        # device-seconds must land within budget + one run's cost
+        "shadow_within_budget": (
+            bud["oracle_runs"] >= 1 and bud["exhausted"]
+            and bud["meter_quality_seconds"]
+            <= bud["budget_s"] + bud["max_run_s"]),
+        "canary_drift_verdicts": (
+            can["identical_verdict"] == "ok"
+            and (can["identical_drift"] or 0.0) <= can["threshold"]
+            and can["perturbed_verdict"] == "drift"
+            and (can["perturbed_drift"] or 0.0) > can["threshold"]
+            and can["swap_drift_events"] >= 1),
+    }
+
+
+def record(result, checks_ok, no_record=False):
+    if no_record:
+        return
+    from benchmarks.regression_gate import DEFAULT_HISTORY, record_run
+
+    record_run(
+        DEFAULT_HISTORY, "quality",
+        config={"detect_requests": result["config"]["detect_requests"],
+                "overhead_requests": result["config"]["overhead_requests"],
+                "budget_s": result["config"]["budget_s"]},
+        metrics={"wall_s": result["wall_s"],
+                 # the auditor-overhead sentinel perf-gate watches: the
+                 # on/off median latency ratio (a screen that got
+                 # expensive moves it off 1.0)
+                 "audit_overhead_factor":
+                     result["overhead"]["audit_overhead_factor"]},
+        extra={"checks_ok": checks_ok,
+               "overhead_frac": result["overhead"]["overhead_frac"],
+               "oracle_runs": result["budget"]["oracle_runs"],
+               "perturbed_drift": result["canary"]["perturbed_drift"]})
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--check", action="store_true",
+                        help="exit nonzero unless every criterion holds")
+    parser.add_argument("--detect-requests", type=int, default=12)
+    parser.add_argument("--overhead-requests", type=int, default=300,
+                        help="requests per overhead arm (per-request "
+                             "auditor on/off alternation on one server)")
+    parser.add_argument("--budget-s", type=float, default=0.05,
+                        help="shadow-oracle budget for the enforcement arm")
+    parser.add_argument("--no-record", action="store_true",
+                        help="skip the perf-history self-record")
+    args = parser.parse_args()
+
+    t0 = time.monotonic()
+    result = {"config": {"detect_requests": args.detect_requests,
+                         "overhead_requests": args.overhead_requests,
+                         "budget_s": args.budget_s}}
+    result["detect"] = run_detect_arm(requests=args.detect_requests)
+    result["clean"] = run_clean_arm()
+    result["overhead"] = run_overhead_arm(requests=args.overhead_requests)
+    result["budget"] = run_budget_arm(budget_s=args.budget_s)
+    result["canary"] = run_canary_arm()
+    result["wall_s"] = round(time.monotonic() - t0, 2)
+    checks = run_checks(result)
+    result["checks"] = checks
+    checks_ok = all(checks.values())
+    result["checks_ok"] = checks_ok
+    record(result, checks_ok, no_record=args.no_record)
+    print(json.dumps(result))
+    if args.check and not checks_ok:
+        failed = [k for k, v in checks.items() if not v]
+        print(f"quality_bench: FAILED {failed}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -173,6 +173,12 @@ def _threshold_for(metric: str, max_wall: float,
         # expensive moves it directly; medians are stable, gate it like
         # wall time
         return max_wall
+    if metric == "audit_overhead_factor":
+        # the quality bench's invariant-auditor sentinel, same shape as
+        # prof_overhead_factor: median latency audit-on over audit-off
+        # under per-request alternation.  Audit work leaking back onto
+        # the serving latency path moves it off 1.0
+        return max_wall
     if metric == "err_at_deadline":
         # the anytime bench's degradation depth: mean reported error of
         # the answers the deadline actually bought under overload.  An

@@ -38,7 +38,14 @@ stack comes up):
 * :mod:`~distributedkernelshap_tpu.observability.memledger` — the
   process-wide device-memory ledger: per-owner/per-tenant computed
   byte accounting over every device-resident cache, with a soft budget
-  and pressure-driven LRU eviction.
+  and pressure-driven LRU eviction;
+* :mod:`~distributedkernelshap_tpu.observability.quality` — continuous
+  correctness: the in-band invariant auditor (additivity/NaN/error-bound
+  screen on every served answer, ``/qualityz`` repro ring), the budgeted
+  shadow-oracle sampler (billed to the ``_quality`` tenant under
+  ``DKS_QUALITY_BUDGET_S``) and the hot-swap canary drift sentinel.
+  Stdlib-only at module scope like its siblings — numpy and the wire
+  codec load lazily inside the screening calls.
 
 See ``docs/OBSERVABILITY.md`` for the metric catalog, trace header
 format, SLO/alert semantics, ``/statusz`` schema, ``/debugz`` schema and
@@ -84,11 +91,21 @@ from distributedkernelshap_tpu.observability.slo import (  # noqa: F401
     AvailabilitySLO,
     BurnRateWindow,
     LatencySLO,
+    QualitySLO,
     SLO,
     StalenessSLO,
     default_proxy_slos,
     default_server_slos,
     tenant_slos,
+)
+from distributedkernelshap_tpu.observability.quality import (  # noqa: F401
+    QualityAuditor,
+    QualityMonitor,
+    ShadowSampler,
+    CanarySentinel,
+    merge_quality_pages,
+    screen_arrays,
+    screen_payload,
 )
 from distributedkernelshap_tpu.observability.statusz import (  # noqa: F401
     HealthEngine,

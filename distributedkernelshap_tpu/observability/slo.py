@@ -210,6 +210,36 @@ class StalenessSLO(SLO):
                                self.labels, now=now)
 
 
+class QualitySLO(SLO):
+    """``target`` fraction of audited answers passing the in-band
+    invariant screen (``observability/quality.py``): burned from the
+    windowed increments of ``dks_quality_violations_total`` (summed
+    across its ``{model, path, check}`` labelsets — the store's delta is
+    an exact-labelset lookup, so the fleet total is folded here) over
+    the unlabeled ``dks_quality_audited_total``.  Burns only when
+    audited traffic flows; with the auditor off this SLO is inert."""
+
+    kind = "quality"
+
+    def __init__(self, name: str,
+                 violations: str = "dks_quality_violations_total",
+                 audited: str = "dks_quality_audited_total",
+                 target: float = 0.999, **kwargs):
+        super().__init__(name, target, **kwargs)
+        self.violations = violations
+        self.audited = audited
+
+    def bad_fraction(self, store, window_s, now=None):
+        total = store.delta(self.audited, window_s, now=now)
+        if total is None or total <= 0:
+            return None  # nothing audited in the window: nothing burned
+        bad = 0.0
+        for labels in store.labelsets(self.violations):
+            bad += store.delta(self.violations, window_s, labels,
+                               now=now) or 0.0
+        return max(0.0, min(1.0, bad / total))
+
+
 # --------------------------------------------------------------------- #
 # default SLO sets for the two serving components
 # --------------------------------------------------------------------- #
@@ -236,6 +266,13 @@ CLASS_LATENCY_TARGETS: Dict[str, Tuple[float, float]] = {
 #: bucket.  Burns only when anytime traffic flows (idle = None = no
 #: breach), so non-anytime deployments carry this SLO inert.
 ANYTIME_ERR_TARGET: Tuple[float, float] = (0.03, 0.90)
+
+#: default answer-quality objective: 99.9% of audited answers must pass
+#: the invariant screen.  The screen's tolerances are path-calibrated
+#: (``quality.PATH_TOLERANCES``), so a healthy fleet sits at zero
+#: violations — any sustained burn here is a real correctness incident
+#: (device fault, engine regression, bad swap), not estimator variance.
+QUALITY_TARGET: float = 0.999
 
 #: default per-tenant objectives (the templated SLOs of
 #: :func:`tenant_slos`): latency over ``dks_tenant_latency_seconds`` —
@@ -338,6 +375,10 @@ def default_server_slos(
         max_err=max_err, target=target, windows=windows,
         description=f"anytime answers with a final reported error bound "
                     f"at or under {max_err:g}"))
+    slos.append(QualitySLO(
+        "answer_quality", target=QUALITY_TARGET, windows=windows,
+        description="audited answers passing the invariant screen "
+                    "(additivity, finiteness, error-bound sanity)"))
     if tenants:
         slos.extend(tenant_slos(tenants, windows=windows))
     return slos

@@ -338,6 +338,21 @@ class ModelRegistry:
             except Exception:
                 logger.exception("warmup of %s failed; serving it cold",
                                  rm.label)
+        # canary drift sentinel (observability/quality.py): replay the
+        # tenant's golden canary set against the INCOMING version before
+        # traffic moves, then re-capture the baseline from the version
+        # about to serve — the model_swap event below carries the
+        # quantified verdict.  Best-effort like warmup: a sentinel
+        # failure must never block a registration.
+        drift = None
+        quality = getattr(server, "_quality", None) \
+            if server is not None else None
+        if quality is not None:
+            try:
+                drift = quality.swap_check(model_id, rm.model,
+                                           fingerprint=rm.fingerprint)
+            except Exception:
+                logger.exception("canary swap check for %s failed", rm.label)
         with self._lock:
             entry = self._models.setdefault(
                 model_id, {"active": None, "versions": {}})
@@ -361,7 +376,10 @@ class ModelRegistry:
         self._flight.record("model_swap", model=model_id,
                             from_version=(prev.version if prev else None),
                             to_version=version, path=rm.path,
-                            fingerprint=rm.fingerprint)
+                            fingerprint=rm.fingerprint,
+                            canary_drift=(drift or {}).get("drift"),
+                            canary_verdict=(drift or {}).get("verdict"),
+                            canary_rows=(drift or {}).get("rows"))
         logger.info("registered %s (path=%s: %s)%s", rm.label, rm.path,
                     rm.path_reason,
                     f"; draining v{prev.version}" if prev else "")
@@ -481,6 +499,11 @@ class ModelRegistry:
             if version is None:
                 server.metrics.retire_labels("dks_serve_padded_rows_total",
                                              {"model": model_id})
+                # quality plane: drop the tenant's canary baseline,
+                # shadow-error series and dks_quality_* label values
+                quality = getattr(server, "_quality", None)
+                if quality is not None:
+                    quality.retire_tenant(model_id, registry=server.metrics)
         except Exception:
             logger.exception("label retirement for %s failed", model_id)
 

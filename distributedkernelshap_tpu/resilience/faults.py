@@ -68,8 +68,20 @@ Sites currently consulted:
     wedges the tick thread.  Either way the fleet must degrade to its
     CURRENT size and keep serving (never drain to zero) — the invariant
     ``chaos_bench.py --check`` asserts.
+``engine.phi``
+    ``ExplainerServer._complete``, after the engine's answer payload is
+    assigned and BEFORE the quality audit / result-cache insert.
+    ``corrupt`` here is a *numeric* fault, not a wire fault: the
+    cooperating call site rewrites the payload through
+    :func:`corrupt_phi_payload` — the document still parses, the phi
+    values inside are wrong (one attribution perturbed, seeded by the
+    site's hit count).  This is the "device computed a wrong answer"
+    drill the transport-level ``server.explain`` corrupt cannot script,
+    and the true-positive arm of ``benchmarks/quality_bench.py
+    --check``: the in-band invariant auditor must flag it.
 """
 
+import json
 import logging
 import os
 import random
@@ -257,6 +269,46 @@ def corrupt_payload(payload: bytes) -> bytes:
         return marker[:len(payload)]
     mid = (len(payload) - len(marker)) // 2
     return payload[:mid] + marker + payload[mid + len(marker):]
+
+
+def corrupt_phi_payload(payload, seed: int = 0):
+    """Numerically corrupt one served explanation payload (the
+    ``engine.phi`` site's cooperating rewrite): decode it, add a large
+    deterministic delta to one phi entry — chosen by ``seed``, normally
+    the site's hit count — and re-encode in the SAME wire format.  The
+    result still parses and still frames; only the additivity invariant
+    is broken, which is exactly what a silent device numeric fault looks
+    like.  Payloads that cannot be decoded are returned unchanged (the
+    drill needs a parsable-but-wrong answer, not a transport fault)."""
+
+    import numpy as np
+
+    from distributedkernelshap_tpu.serving import wire
+
+    binary = isinstance(payload, (bytes, bytearray))
+    try:
+        if binary:
+            arrays = wire.decode_explanation(bytes(payload))
+        else:
+            doc = json.loads(payload)
+            arrays = wire.explanation_payload_from_json(payload)
+    except Exception:  # noqa: BLE001 — leave undecodable payloads alone
+        return payload
+    sv = [np.array(v, dtype=np.float64)
+          for v in arrays["shap_values"]]
+    if not sv or not sv[0].size:
+        return payload
+    rng = random.Random(seed)
+    k = rng.randrange(len(sv))
+    flat = sv[k].reshape(-1)
+    flat[rng.randrange(flat.shape[0])] += 10.0 + rng.random()
+    if binary:
+        return wire.encode_explanation(
+            sv, np.asarray(arrays["expected_value"]),
+            np.asarray(arrays["raw_prediction"]),
+            interaction_values=arrays.get("interaction_values"))
+    doc["data"]["shap_values"] = [v.tolist() for v in sv]
+    return json.dumps(doc)
 
 
 def from_env(env: Optional[Dict[str, str]] = None) -> Optional[FaultInjector]:

@@ -266,6 +266,7 @@ class ResultCache:
         self._hits = 0
         self._misses = 0
         self._evictions = 0
+        self._audit_rejects = 0
         # optional memory-ledger account: payload bytes are host memory,
         # but they hold device work hostage (a hit IS a device batch slot
         # freed), so the ledger tracks them under owner=result_cache next
@@ -299,11 +300,29 @@ class ResultCache:
             self._hits += 1
             return payload
 
-    def put(self, key: str, payload: str, est_err: float = 0.0) -> None:
+    def put(self, key: str, payload: str, est_err: float = 0.0,
+            screened: bool = False) -> None:
+        """Insert (keep-best).  ``screened=True`` means the quality
+        invariant screen is the caller's responsibility (the server
+        queues every finalized answer for its deferred audit and
+        ``invalidate``\\ s any entry whose payload fails it); unscreened
+        callers pay the screen here — a phi payload violating
+        additivity/finiteness must never become a bit-identical repeat
+        offender (audit-on-insert, ``observability/quality.py``)."""
+
         size = len(payload)
         if size > self.max_bytes:
             return  # larger than the whole budget: caching it evicts all
         est_err = max(0.0, float(est_err))
+        if not screened:
+            from distributedkernelshap_tpu.observability.quality import (
+                cacheable_payload,
+            )
+
+            if not cacheable_payload(payload, final_err=est_err):
+                with self._lock:
+                    self._audit_rejects += 1
+                return
         with self._lock:
             old = self._entries.get(key)
             if old is not None:
@@ -327,6 +346,24 @@ class ResultCache:
             # the ledger's pressure sweep re-enters this cache through
             # evict_bytes, so it must run with our lock released
             self._mem.ledger.poke()
+
+    def invalidate(self, key: str, audit: bool = False) -> bool:
+        """Remove one entry outright.  ``audit=True`` is the deferred
+        quality audit's poison-removal hook: the server inserts at
+        finalize time (keeping the hot path lock-free of the screen) and
+        the audit thread pulls the entry back out if the payload fails
+        the invariant screen — counted with the insert-time rejects in
+        ``audit_rejects``."""
+
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is None:
+                return False
+            self._bytes -= len(entry[0])
+            self._mem_release(key)
+            if audit:
+                self._audit_rejects += 1
+        return True
 
     def evict_bytes(self, nbytes: int) -> int:
         """LRU-evict until at least ``nbytes`` are freed (or the cache
@@ -356,5 +393,6 @@ class ResultCache:
     def stats(self) -> Dict[str, int]:
         with self._lock:
             return {"hits": self._hits, "misses": self._misses,
-                    "evictions": self._evictions, "entries":
-                    len(self._entries), "bytes": self._bytes}
+                    "evictions": self._evictions,
+                    "audit_rejects": self._audit_rejects,
+                    "entries": len(self._entries), "bytes": self._bytes}

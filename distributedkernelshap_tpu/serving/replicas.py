@@ -53,6 +53,10 @@ from distributedkernelshap_tpu.observability.contprof import (
     merge_collapsed,
 )
 from distributedkernelshap_tpu.observability.flightrec import flightrec
+from distributedkernelshap_tpu.observability.quality import (
+    merge_quality_pages,
+    stub_doc as quality_stub_doc,
+)
 from distributedkernelshap_tpu.analysis import lockwitness
 from distributedkernelshap_tpu.observability.metrics import (
     DEFAULT_EXEMPLAR_SLOTS,
@@ -432,6 +436,37 @@ class FanInProxy:
             list(self._fleet_scrape_pool().map(scrape, targets))
         self._m_fleet_scrapes.inc()
         return merge_collapsed(
+            [pages[k] for k in sorted(pages, key=int)])
+
+    def federated_quality(self, timeout_s: float = 5.0) -> str:
+        """The ``/qualityz?federate=1`` page: every scrapable replica's
+        quality document fetched concurrently over the fleet scrape pool
+        and folded (``observability/quality.merge_quality_pages`` —
+        counters sum, repro rings concatenate under the bound, per-tenant
+        shadow/canary sections keep the worst error).  Same failure
+        accounting as the flamegraph federation: an unanswering replica
+        is missing from the fold and counted as a scrape error."""
+
+        targets = [r for r in list(self.replicas)
+                   if not r.retired and (r.alive or r.draining
+                                         or r.standby)]
+        pages: Dict[str, str] = {}
+
+        def scrape(r):
+            try:
+                status, body, _ = self._forward(
+                    "GET", "/qualityz", b"", r, timeout_s=timeout_s)
+            except (OSError, http.client.HTTPException):
+                self._m_fleet_scrape_errors.inc()
+                return
+            if status != 200:
+                self._m_fleet_scrape_errors.inc()
+                return
+            pages[str(r.index)] = body.decode("utf-8", errors="replace")
+        if targets:
+            list(self._fleet_scrape_pool().map(scrape, targets))
+        self._m_fleet_scrapes.inc()
+        return merge_quality_pages(
             [pages[k] for k in sorted(pages, key=int)])
 
     def fleet_rollup(self) -> Dict:
@@ -1228,6 +1263,20 @@ class FanInProxy:
                         return
                     ctype, page = contprof().profilez_payload(params)
                     self._reply(200, page, ctype=ctype)
+                    return
+                if route == "/qualityz":
+                    params = urllib.parse.parse_qs(query or "")
+                    federate = params.get("federate", [])
+                    if federate and federate[-1] == "1":
+                        # fleet correctness view: per-replica quality
+                        # documents folded over the scrape pool
+                        self._reply(200,
+                                    proxy.federated_quality().encode())
+                        return
+                    # the proxy audits nothing itself — the non-federated
+                    # answer is the empty schema document
+                    self._reply(200,
+                                json.dumps(quality_stub_doc()).encode())
                     return
                 if route != "/explain":
                     self._reply(404, json.dumps(

@@ -62,6 +62,7 @@ from distributedkernelshap_tpu.observability.metrics import (
     DEFAULT_EXEMPLAR_SLOTS,
     MetricsRegistry,
 )
+from distributedkernelshap_tpu.observability.quality import QualityMonitor
 from distributedkernelshap_tpu.observability.slo import default_server_slos
 from distributedkernelshap_tpu.observability.statusz import (
     HealthEngine,
@@ -628,6 +629,15 @@ class ExplainerServer:
         if cost_metering is None:
             cost_metering = resolve_cost_meter_env(default=True)
         self._costmeter = CostMeter(enabled=bool(cost_metering))
+        # continuous correctness plane (observability/quality.py):
+        # in-band invariant auditor on every finalized answer, budgeted
+        # shadow-oracle sampler billed to the ``_quality`` tenant, and
+        # the hot-swap canary drift sentinel the registry consults.
+        # Per-instance like the cost meter (tests and the obs-check live
+        # catalog run several servers per process); the background
+        # drain/canary thread starts with the server in start().
+        self._quality = QualityMonitor(server=self,
+                                       costmeter=self._costmeter)
         self._register_metrics()
         # SLO health engine (observability/statusz.py): samples the
         # registry into a bounded time-series store, evaluates burn-rate
@@ -932,6 +942,10 @@ class ExplainerServer:
         # device-memory ledger (observability/memledger.py): per-owner
         # device bytes + high-water/budget/pressure series
         memledger().attach_metrics(reg)
+        # continuous correctness plane (observability/quality.py):
+        # audit/violation counters, shadow-oracle error gauges and the
+        # canary drift gauge behind /qualityz
+        self._quality.attach_metrics(reg)
 
     def _register_registry_metrics(self, reg) -> None:
         def from_registry(method):
@@ -1156,18 +1170,33 @@ class ExplainerServer:
                 self._wedged.clear()
                 self._flight.record("wedge_recovered", component="server")
         tr = self._tracer
+        to_audit = []
         for i, p in live:
             if error is not None:
                 p.error = error
                 p.status_code = status
             else:
                 p.response = payloads[index_map[i] if index_map else i]
-                if self._cache is not None and p.cache_key is not None:
-                    # keep-best: anytime answers carry their reported
-                    # error (final_err; 0.0 = full fidelity), and the
-                    # cache only serves an entry to budgets it satisfies
-                    self._cache.put(p.cache_key, p.response,
-                                    est_err=getattr(p, "final_err", 0.0))
+                if p.response:  # streamed answers finalize with b""
+                    # chaos site ``engine.phi``: a numeric device fault —
+                    # the payload is rewritten to a parsable-but-wrong
+                    # answer BEFORE the waiter wakes, so the drill
+                    # corrupts what is actually served and exercises the
+                    # real detection path (resilience/faults.py)
+                    if self._faults is not None and \
+                            self._faults.fire("engine.phi") == "corrupt":
+                        from distributedkernelshap_tpu.resilience.faults \
+                            import corrupt_phi_payload
+
+                        p.response = corrupt_phi_payload(
+                            p.response,
+                            seed=self._faults.hits("engine.phi"))
+                # the invariant audit + cache insert run AFTER the
+                # waiters wake (post-signal pass below): the screen
+                # still gates the cache and still flags this very
+                # answer, but its decode+check cost never sits on the
+                # client-visible latency path
+                to_audit.append(p)
             if tr.enabled and p.trace is not None and t_dispatch is not None:
                 # per-request copies of the batch's device/finalize
                 # timings: a batch can mix trace ids, so each request gets
@@ -1186,6 +1215,29 @@ class ExplainerServer:
                 tr.record_mono("server.finalize", end_fetch,
                                time.monotonic(), parent=p.trace)
             p.event.set()
+        for p in to_audit:
+            if self._cache is not None and p.cache_key is not None:
+                # keep-best: anytime answers carry their reported error
+                # (final_err; 0.0 = full fidelity), and the cache only
+                # serves an entry to budgets it satisfies.  screened=True:
+                # the deferred audit queued below invalidates the entry
+                # if the payload fails the invariant screen
+                self._cache.put(p.cache_key, p.response,
+                                est_err=getattr(p, "final_err", 0.0),
+                                screened=True)
+            if p.response:
+                rm = p.model
+                self._quality.enqueue_answer(
+                    p.response,
+                    model_id=(rm.model_id if rm is not None else None),
+                    path=(rm.path if rm is not None
+                          else getattr(self.model, "explain_path",
+                                       "sampled")),
+                    final_err=getattr(p, "final_err", 0.0),
+                    rows=p.array,
+                    model=(rm.model if rm is not None else self.model),
+                    trace=(p.trace.trace_id if p.trace else None),
+                    cache=self._cache, cache_key=p.cache_key)
 
     def _render_metrics(self) -> str:
         # rendered SOLELY by the shared registry (one renderer for the
@@ -2414,6 +2466,13 @@ class ExplainerServer:
                     ctype, body = contprof().profilez_payload(params)
                     self._reply(200, body, ctype=ctype)
                     return
+                if route == "/qualityz":
+                    # continuous correctness: audit repro ring, shadow-
+                    # oracle error/budget state, canary drift verdicts
+                    params = urllib.parse.parse_qs(query)
+                    ctype, body = server._quality.qualityz_payload(params)
+                    self._reply(200, body, ctype=ctype)
+                    return
                 if route != "/explain":
                     self._reply(404, json.dumps({"error": "unknown route"}))
                     return
@@ -2834,6 +2893,8 @@ class ExplainerServer:
         # SLO health sampler/alert evaluator (no-op when
         # health_interval_s == 0)
         self.health.start()
+        # quality monitor: shadow-oracle drain + periodic canary replay
+        self._quality.start()
         self._threads = [t_http, t_disp, t_dog, *t_fin]
         if t_batcher is not None:
             self._threads.append(t_batcher)
@@ -2849,6 +2910,7 @@ class ExplainerServer:
             self._prof_released = True
             contprof().release()
         self.health.stop()
+        self._quality.stop()
         self._sched.stop()  # wake the dispatcher's condition wait
         # fail anything still queued — including items deferred for row
         # overflow, which live in the same heap — so no handler thread

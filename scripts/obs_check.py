@@ -60,10 +60,13 @@ DOCS_PATH = os.path.join(REPO_ROOT, "docs", "OBSERVABILITY.md")
 #: profiler's self-metering (``dks_prof_*``) and the device-memory
 #: ledger's budget/pressure series (``dks_mem_*``;
 #: ``dks_device_bytes`` rides the existing ``device`` prefix.)
+#: ``quality`` joined with continuous correctness observability: the
+#: in-band invariant auditor, shadow-oracle sampler and canary drift
+#: sentinel (``dks_quality_*``).
 _LITERAL_RE = re.compile(
     r"dks_(?:serve|fanin|sched|phase|slo|alerts|wire|staging|treeshap|"
     r"tensor_shap|autoscale|registry|result_cache|deepshap|device|tenant|"
-    r"fleet|trace|anytime|prof|mem)_[a-z0-9_]+")
+    r"fleet|trace|anytime|prof|mem|quality)_[a-z0-9_]+")
 
 #: directories never scanned for literals/renderers
 _SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "results", "data",

@@ -1,0 +1,492 @@
+"""Continuous correctness observability (``observability/quality.py``):
+the invariant screen's per-path tolerances, the auditor's bounded repro
+ring, the shadow-oracle sampler's budget/oracle-selection/inert modes,
+the canary sentinel across gated hot swaps, the ``/qualityz`` document
+and its federated fold, and tenant label retirement on unregister."""
+
+import json
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from distributedkernelshap_tpu.models import LinearPredictor
+from distributedkernelshap_tpu.observability.flightrec import flightrec
+from distributedkernelshap_tpu.observability.metrics import MetricsRegistry
+from distributedkernelshap_tpu.observability.quality import (
+    DRIFT_TOLERANCE,
+    CanarySentinel,
+    QualityAuditor,
+    QualityMonitor,
+    ShadowSampler,
+    cacheable_payload,
+    merge_quality_pages,
+    screen_arrays,
+    screen_payload,
+    stub_doc,
+)
+from distributedkernelshap_tpu.registry import ModelRegistry
+from distributedkernelshap_tpu.resilience.faults import corrupt_phi_payload
+from distributedkernelshap_tpu.scheduling.result_cache import ResultCache
+from distributedkernelshap_tpu.serving import wire
+from distributedkernelshap_tpu.serving.server import ExplainerServer
+from distributedkernelshap_tpu.serving.wrappers import BatchKernelShapModel
+
+D = 6
+
+
+def _flight_count(kind):
+    return sum(1 for e in flightrec().to_payload()["events"]
+               if e.get("kind") == kind)
+
+
+# --------------------------------------------------------------------- #
+# invariant screen
+# --------------------------------------------------------------------- #
+
+
+def _clean_answer(b=2, k=2, m=4, seed=0):
+    """Arrays satisfying additivity exactly: raw := sum(phi) + E[f]."""
+
+    rng = np.random.default_rng(seed)
+    sv = [rng.normal(size=(b, m)) for _ in range(k)]
+    ev = rng.normal(size=(k,))
+    raw = np.stack([v.sum(axis=-1) for v in sv], axis=-1) + ev
+    return sv, ev, raw
+
+
+def test_screen_clean_answer_passes():
+    sv, ev, raw = _clean_answer()
+    assert screen_arrays(sv, ev, raw, path="sampled") == []
+    assert screen_arrays(sv, ev, raw, path="exact") == []
+
+
+def test_screen_flags_additivity_break():
+    sv, ev, raw = _clean_answer()
+    sv[0][0, 1] += 0.5
+    checks = [c for c, _ in screen_arrays(sv, ev, raw, path="sampled")]
+    assert checks == ["additivity"]
+
+
+def test_screen_path_tolerances_tight_vs_loose():
+    # a 5e-3 residual on |raw| <= 1: inside the sampled path's bound
+    # (1e-3 + 1e-2), outside the exact path's (1e-4 + 1e-3)
+    sv, ev, raw = _clean_answer(b=1, k=1, m=3)
+    raw = np.clip(raw, -0.9, 0.9)
+    ev = raw[0] - sv[0].sum(axis=-1)  # re-solve additivity after the clip
+    sv[0][0, 0] += 5e-3
+    assert screen_arrays(sv, ev, raw, path="sampled") == []
+    checks = [c for c, _ in screen_arrays(sv, ev, raw, path="exact")]
+    assert checks == ["additivity"]
+    # unknown paths screen at the loose default, not the tight bound
+    assert screen_arrays(sv, ev, raw, path="no-such-path") == []
+
+
+def test_screen_final_err_widens_bound():
+    # anytime answers declare their residual; the screen honours it
+    sv, ev, raw = _clean_answer(b=1, k=1, m=3)
+    sv[0][0, 0] += 0.05
+    assert [c for c, _ in screen_arrays(sv, ev, raw, path="sampled")] \
+        == ["additivity"]
+    assert screen_arrays(sv, ev, raw, path="sampled", final_err=0.06) == []
+
+
+def test_screen_flags_nonfinite():
+    for poison in (np.nan, np.inf, -np.inf):
+        sv, ev, raw = _clean_answer()
+        sv[1][0, 2] = poison
+        checks = [c for c, _ in screen_arrays(sv, ev, raw)]
+        assert checks == ["finite"], poison
+    sv, ev, raw = _clean_answer()
+    raw = raw.copy()
+    raw[0, 0] = np.nan
+    assert [c for c, _ in screen_arrays(sv, ev, raw)] == ["finite"]
+
+
+def test_screen_flags_insane_error_bound():
+    sv, ev, raw = _clean_answer()
+    for bad in (-0.5, 1e9, float("nan")):
+        checks = [c for c, _ in screen_arrays(sv, ev, raw, path="sampled",
+                                              final_err=bad)]
+        assert "error_bound" in checks, bad
+
+
+def test_screen_payload_decode_violation():
+    violations, arrays = screen_payload(b"\x00garbage-not-an-explanation")
+    assert arrays is None
+    assert [c for c, _ in violations] == ["decode"]
+
+
+def _wire_payload(b=1, k=1, m=4, seed=3):
+    sv, ev, raw = _clean_answer(b=b, k=k, m=m, seed=seed)
+    return wire.encode_explanation(
+        [v.astype(np.float32) for v in sv], ev.astype(np.float32),
+        raw.astype(np.float32))
+
+
+def test_screen_payload_roundtrips_binary_wire():
+    violations, arrays = screen_payload(_wire_payload(), path="sampled")
+    assert violations == []
+    assert arrays is not None and len(arrays["shap_values"]) == 1
+
+
+def test_cacheable_payload_semantics(monkeypatch):
+    good = _wire_payload()
+    bad = corrupt_phi_payload(good, seed=5)
+    assert cacheable_payload(good, path="sampled")
+    assert not cacheable_payload(bad, path="sampled")
+    # non-explanation strings pass through: the result cache is generic
+    # keyed storage and its historical contract accepts arbitrary values
+    assert cacheable_payload("xxxx")
+    # screen disabled => pre-quality behaviour, everything passes
+    monkeypatch.setenv("DKS_QUALITY_AUDIT", "0")
+    assert cacheable_payload(bad, path="sampled")
+
+
+def test_result_cache_rejects_poisoned_insert_on_unscreened_put():
+    cache = ResultCache(max_bytes=1 << 16)
+    bad = corrupt_phi_payload(_wire_payload(), seed=7).hex()  # str payload?
+    # decodable-but-wrong phi must be refused at insert; arbitrary
+    # strings (the cache's historical contract) must still store
+    cache.put("k-bad", corrupt_phi_payload(
+        _wire_payload(), seed=7), screened=False)
+    assert cache.get("k-bad") is None
+    assert cache.stats()["audit_rejects"] == 1
+    cache.put("k-str", "not-an-explanation", screened=False)
+    assert cache.get("k-str") == "not-an-explanation"
+    del bad
+
+
+def test_result_cache_invalidate_is_audit_hook():
+    cache = ResultCache(max_bytes=1 << 16)
+    cache.put("k", "payload", screened=True)
+    assert cache.invalidate("k", audit=True)
+    assert cache.get("k") is None
+    assert not cache.invalidate("k", audit=True)  # idempotent
+    assert cache.stats()["audit_rejects"] == 1
+
+
+# --------------------------------------------------------------------- #
+# auditor: repro ring + flight events
+# --------------------------------------------------------------------- #
+
+
+def test_auditor_ring_bounded_and_counts():
+    auditor = QualityAuditor(ring_size=4)
+    before = _flight_count("quality_violation")
+    good = _wire_payload()
+    for i in range(10):
+        ok, _ = auditor.audit(corrupt_phi_payload(good, seed=i),
+                              model_id="t", path="sampled",
+                              trace=f"tr-{i}")
+        assert not ok
+    ok, _ = auditor.audit(good, model_id="t", path="sampled")
+    assert ok
+    snap = auditor.snapshot()
+    assert snap["audited_total"] == 11
+    assert snap["violation_answers_total"] == 10
+    assert len(snap["ring"]) == 4  # bounded, newest kept
+    assert snap["ring"][-1]["trace"] == "tr-9"
+    assert snap["ring"][-1]["checks"] == ["additivity"]
+    assert _flight_count("quality_violation") == before + 10
+
+
+def test_auditor_disabled_is_inert():
+    auditor = QualityAuditor(enabled=False)
+    ok, arrays = auditor.audit(b"garbage", model_id="t")
+    assert ok and arrays is None
+    assert auditor.snapshot()["audited_total"] == 0
+
+
+# --------------------------------------------------------------------- #
+# shadow sampler
+# --------------------------------------------------------------------- #
+
+
+class _FakeOracle:
+    """Duck-typed serving model: records oracle kwargs, returns phi of
+    ``scale * rows`` after an optional sleep (budget tests)."""
+
+    def __init__(self, scale=1.0, sleep_s=0.0,
+                 explain_kwargs=None):
+        self.scale = scale
+        self.sleep_s = sleep_s
+        self.explain_kwargs = {"nsamples": 64, "l1_reg": 0.0,
+                               "interactions": False} \
+            if explain_kwargs is None else explain_kwargs
+        self.calls = []
+        outer = self
+
+        class _Explainer:
+            def explain(self, rows, silent=True, **kwargs):
+                outer.calls.append(kwargs)
+                if outer.sleep_s:
+                    time.sleep(outer.sleep_s)
+                return SimpleNamespace(
+                    shap_values=[np.asarray(rows) * outer.scale])
+
+        self.explainer = _Explainer()
+
+
+def test_sampler_disabled_is_inert():
+    sampler = ShadowSampler(fraction=0.0)
+    assert not sampler.offer("t", "sampled", _FakeOracle(),
+                             np.ones((1, D)), [np.ones((1, D))])
+    assert sampler.drain_once() is None
+    snap = sampler.snapshot()
+    assert snap["sampled"] == 0 and snap["offered"] == 0
+
+
+def test_sampler_oracle_selection_by_path():
+    model = _FakeOracle()
+    sampler = ShadowSampler(fraction=1.0, oracle_nsamples=2048)
+    # sampled-path tenants get a high-nsamples re-run; the pinned
+    # interactions flag must NOT leak into the oracle call
+    kw = sampler._oracle_kwargs("sampled", model)
+    assert kw == {"nsamples": 2048, "l1_reg": 0.0}
+    kw = sampler._oracle_kwargs("linear", model)
+    assert kw["nsamples"] == 2048  # linear is still the sampled estimator
+    # exact paths are their own oracle: pinned kwargs pass unchanged
+    for path in ("exact", "exact_tree", "exact_tn", "deepshap"):
+        kw = sampler._oracle_kwargs(path, model)
+        assert kw == {"nsamples": 64, "l1_reg": 0.0}, path
+
+
+def test_sampler_budget_trips_and_gates_offers():
+    model = _FakeOracle(sleep_s=0.01)
+    sampler = ShadowSampler(fraction=1.0, budget_s=0.015)
+    rows = np.ones((1, D), dtype=np.float32)
+    for _ in range(5):
+        sampler.offer("t", "sampled", model, rows, [rows])
+    assert sampler.drain_once() is not None  # first run always allowed
+    assert sampler.drain_once() is None      # projected over budget
+    snap = sampler.snapshot()
+    assert snap["exhausted"]
+    assert snap["tenants"]["t"]["runs"] == 1
+    assert snap["spent_s"] <= 0.015 + snap["max_run_s"]
+    # exhausted sampler stops admitting new samples at offer time
+    assert not sampler.offer("t", "sampled", model, rows, [rows])
+
+
+def test_sampler_error_series_bounded():
+    model = _FakeOracle(scale=1.0)
+    sampler = ShadowSampler(fraction=1.0, budget_s=1e9, series_size=3)
+    rows = np.ones((1, D), dtype=np.float32)
+    served = [np.asarray(rows) + 0.25]  # served phi off by 0.25
+    for _ in range(5):
+        assert sampler.offer("t", "sampled", model, rows, served)
+        result = sampler.drain_once()
+        assert result is not None
+    snap = sampler.snapshot()["tenants"]["t"]
+    assert snap["runs"] == 5
+    assert snap["last_err"] == pytest.approx(0.25)
+    assert len(snap["series"]) == 3  # bounded time-series
+    sampler.retire("t")
+    assert "t" not in sampler.snapshot()["tenants"]
+
+
+# --------------------------------------------------------------------- #
+# canary sentinel
+# --------------------------------------------------------------------- #
+
+
+class _FakeCanaryModel(_FakeOracle):
+    """Fake with an inspectable engine background (canary row source)."""
+
+    def __init__(self, scale=1.0):
+        super().__init__(scale=scale)
+        background = np.arange(5 * D, dtype=np.float64).reshape(5, D)
+        self.explainer._explainer = SimpleNamespace(background=background)
+
+
+def test_canary_capture_replay_and_drift():
+    sentinel = CanarySentinel(n_rows=3)
+    model = _FakeCanaryModel(scale=1.0)
+    assert sentinel.capture("t", model, fingerprint="t@v1:abc")
+    assert sentinel.tenants() == ["t"]
+    verdict = sentinel.replay("t", model)
+    assert verdict["verdict"] == "ok"
+    assert verdict["drift"] <= DRIFT_TOLERANCE
+    assert verdict["rows"] == 3
+    before = _flight_count("swap_drift")
+    drifted = sentinel.replay("t", _FakeCanaryModel(scale=2.0))
+    assert drifted["verdict"] == "drift" and drifted["drift"] > 0
+    assert _flight_count("swap_drift") == before + 1
+    snap = sentinel.snapshot()["tenants"]["t"]
+    assert snap["verdict"] == "drift"
+    sentinel.retire("t")
+    assert sentinel.tenants() == []
+
+
+def test_canary_swap_check_recaptures_baseline():
+    sentinel = CanarySentinel(n_rows=2)
+    assert sentinel.swap_check("t", _FakeCanaryModel(1.0)) is None  # first
+    # the flip to scale=2 drifts against the v1 baseline...
+    verdict = sentinel.swap_check("t", _FakeCanaryModel(2.0))
+    assert verdict["verdict"] == "drift"
+    # ...and re-captures: the SAME content now replays clean
+    verdict = sentinel.swap_check("t", _FakeCanaryModel(2.0))
+    assert verdict["verdict"] == "ok"
+
+
+def test_canary_inert_for_stub_models():
+    sentinel = CanarySentinel()
+    assert not sentinel.capture("t", SimpleNamespace())  # no engine
+    assert sentinel.replay("t", SimpleNamespace()) is None
+
+
+# --------------------------------------------------------------------- #
+# monitor: deferred queue, metrics, /qualityz, label retirement
+# --------------------------------------------------------------------- #
+
+
+def _monitor(**kwargs):
+    kwargs.setdefault("audit", True)
+    kwargs.setdefault("sample", 0.0)
+    return QualityMonitor(server=None, costmeter=None, **kwargs)
+
+
+def test_monitor_deferred_queue_flush_drains_inline():
+    monitor = _monitor()
+    good = _wire_payload()
+    for _ in range(3):
+        monitor.enqueue_answer(good, model_id="t", path="sampled")
+    assert monitor.audit_backlog() == 3
+    assert monitor.flush()  # no thread running: drains inline
+    assert monitor.audit_backlog() == 0
+    assert monitor.auditor.snapshot()["audited_total"] == 3
+
+
+def test_monitor_deferred_audit_invalidates_poisoned_cache_entry():
+    monitor = _monitor()
+    cache = ResultCache(max_bytes=1 << 16)
+    bad = corrupt_phi_payload(_wire_payload(), seed=9)
+    cache.put("k", bad, screened=True)  # finalizer inserts optimistically
+    monitor.enqueue_answer(bad, model_id="t", path="sampled",
+                           cache=cache, cache_key="k")
+    assert monitor.flush()
+    assert cache.get("k") is None  # poison pulled back out
+    assert cache.stats()["audit_rejects"] == 1
+
+
+def test_monitor_metrics_and_label_retirement():
+    registry = MetricsRegistry()
+    monitor = _monitor()
+    monitor.attach_metrics(registry)
+    bad = corrupt_phi_payload(_wire_payload(), seed=11)
+    monitor.enqueue_answer(bad, model_id="tenant-x", path="sampled")
+    monitor.flush()
+    page = registry.render()
+    assert 'dks_quality_violations_total{model="tenant-x",' \
+        'path="sampled",check="additivity"}' in page
+    monitor.retire_tenant("tenant-x", registry=registry)
+    assert 'model="tenant-x"' not in registry.render()
+
+
+def test_qualityz_document_schema():
+    monitor = _monitor()
+    ctype, body = monitor.qualityz_payload()
+    assert ctype == "application/json"
+    doc = json.loads(body)
+    assert doc["component"] == "server"
+    for key in ("enabled", "audited_total", "violation_answers_total",
+                "backlog", "backlog_dropped", "ring_size", "ring"):
+        assert key in doc["audit"], key
+    for key in ("fraction", "budget_s", "spent_s", "max_run_s",
+                "exhausted", "offered", "sampled", "dropped", "queued",
+                "tenants"):
+        assert key in doc["shadow"], key
+    assert doc["canary"]["threshold"] == DRIFT_TOLERANCE
+    # the stub (proxy without ?federate=1) shares the schema
+    stub = stub_doc()
+    assert set(stub["audit"]) == set(doc["audit"])
+    assert set(stub["shadow"]) == set(doc["shadow"])
+
+
+def test_merge_quality_pages_folds_replicas():
+    page_a = stub_doc("server")
+    page_a["audit"].update(enabled=True, audited_total=10,
+                           violation_answers_total=2, ring_size=4,
+                           ring=[{"ts": 1.0, "checks": ["additivity"]},
+                                 {"ts": 3.0, "checks": ["finite"]}])
+    page_a["shadow"].update(spent_s=1.0, max_run_s=0.5,
+                            tenants={"t": {"runs": 2, "last_err": 0.1,
+                                           "series": [[1.0, 0.1]]}})
+    page_a["canary"]["tenants"]["t"] = {"drift": 0.0, "verdict": "ok"}
+    page_b = stub_doc("server")
+    page_b["audit"].update(audited_total=5, violation_answers_total=1,
+                           ring_size=4, ring=[{"ts": 2.0,
+                                               "checks": ["additivity"]}])
+    page_b["shadow"].update(spent_s=0.5, max_run_s=0.7, exhausted=True,
+                            tenants={"t": {"runs": 3, "last_err": 0.4,
+                                           "series": [[2.0, 0.4]]}})
+    page_b["canary"]["tenants"]["t"] = {"drift": 0.02, "verdict": "drift"}
+    merged = json.loads(merge_quality_pages(
+        [json.dumps(page_a), json.dumps(page_b), "not-json"]))
+    assert merged["component"] == "fleet"
+    assert merged["replicas"] == 2  # the garbage page is skipped
+    assert merged["audit"]["enabled"]
+    assert merged["audit"]["audited_total"] == 15
+    assert merged["audit"]["violation_answers_total"] == 3
+    # ring folds newest-first under the bound
+    assert [e["ts"] for e in merged["audit"]["ring"]] == [3.0, 2.0, 1.0]
+    shadow = merged["shadow"]
+    assert shadow["spent_s"] == pytest.approx(1.5)
+    assert shadow["max_run_s"] == pytest.approx(0.7)
+    assert shadow["exhausted"]
+    assert shadow["tenants"]["t"]["runs"] == 5
+    assert shadow["tenants"]["t"]["last_err"] == pytest.approx(0.4)
+    assert len(shadow["tenants"]["t"]["series"]) == 2
+    # canary keeps the worst replica's verdict per tenant
+    assert merged["canary"]["tenants"]["t"]["verdict"] == "drift"
+
+
+# --------------------------------------------------------------------- #
+# gated hot swap on the real registry + server (integration)
+# --------------------------------------------------------------------- #
+
+
+def _linear_model(seed):
+    rng = np.random.default_rng(seed)
+    W = rng.normal(size=(D, 2)).astype(np.float32)
+    b = rng.normal(size=(2,)).astype(np.float32)
+    bg = np.random.default_rng(99).normal(size=(8, D)).astype(np.float32)
+    return BatchKernelShapModel(LinearPredictor(W, b, activation="softmax"),
+                                bg, {"link": "logit", "seed": 0},
+                                {"nsamples": 32})
+
+
+def test_registry_swap_check_gates_hot_swap():
+    registry = ModelRegistry()
+    server = ExplainerServer(registry=registry)  # attach only, no start
+    try:
+        events = [e for e in flightrec().to_payload()["events"]
+                  if e.get("kind") == "model_swap"]
+        seen = len(events)
+        registry.register("tenant-q", _linear_model(seed=1), warm=False)
+        registry.register("tenant-q", _linear_model(seed=1), warm=False)
+        registry.register("tenant-q", _linear_model(seed=5), warm=False)
+        swaps = [e for e in flightrec().to_payload()["events"]
+                 if e.get("kind") == "model_swap"
+                 and e.get("model") == "tenant-q"][seen - len(events) or None:]
+        by_version = {e["to_version"]: e for e in swaps}
+        # v1: no baseline yet (first registration) — verdict absent
+        assert by_version[1].get("canary_verdict") is None
+        # v2: identical content replays ~zero drift against v1's baseline
+        assert by_version[2]["canary_verdict"] == "ok"
+        assert by_version[2]["canary_drift"] <= DRIFT_TOLERANCE
+        # v3: different weights drift loudly BEFORE traffic moved
+        assert by_version[3]["canary_verdict"] == "drift"
+        assert by_version[3]["canary_drift"] > DRIFT_TOLERANCE
+        # the sentinel's state shows through /qualityz
+        _, body = server._quality.qualityz_payload()
+        canary = json.loads(body)["canary"]["tenants"]["tenant-q"]
+        assert canary["verdict"] == "drift"
+        # unregister retires the tenant's quality state and labels
+        registry.unregister("tenant-q")
+        _, body = server._quality.qualityz_payload()
+        assert "tenant-q" not in json.loads(body)["canary"]["tenants"]
+        assert 'model="tenant-q"' not in server.metrics.render()
+    finally:
+        server._quality.stop()

@@ -132,7 +132,7 @@ def test_slo_target_validation_and_defaults():
     names = {s.name for s in server_slos}
     assert {"availability", "interactive_latency", "batch_latency",
             "best_effort_latency", "inflight_progress",
-            "anytime_error"} == names
+            "anytime_error", "answer_quality"} == names
     assert {s.name for s in default_proxy_slos()} == {"proxy_availability"}
 
 
