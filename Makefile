@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test tier1 collect fuzz bench configs serve sweep-pool sweep-serve analysis multihost-ci sched-bench chaos-bench lint obs-check health-check perf-gate warmup-bench stream-bench exact-bench autoscale-bench accuracy-gate tenant-bench deepshap-bench cost-bench anytime-bench profile-bench quality-bench
+.PHONY: test tier1 collect fuzz bench configs serve sweep-pool sweep-serve analysis multihost-ci sched-bench chaos-bench lint obs-check health-check perf-gate warmup-bench stream-bench exact-bench autoscale-bench accuracy-gate tenant-bench deepshap-bench cost-bench anytime-bench profile-bench quality-bench pod-bench
 
 lint:            ## unified static gate: dks-analyze (concurrency + JAX-contract + serving-ladder lints, scripts/dks_lint.py) + obs-check + health-check behind ONE exit code; <60s budget self-asserted
 	env JAX_PLATFORMS=cpu $(PY) scripts/dks_lint.py --check
@@ -50,6 +50,9 @@ profile-bench:   ## continuous profiling + memory ledger: sampler on/off median 
 
 quality-bench:   ## continuous correctness: injected engine.phi corruption flagged within K requests (zero false positives clean), audit on/off median overhead <=1%, shadow oracle trips its device-seconds budget (meter within budget + one run), canary verdicts ok/drift across gated hot swaps; self-records for perf-gate
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/quality_bench.py --check
+
+pod-bench:       ## pod serving fabric on a 2-process gloo CPU mesh: phi bit-identical to single-process serving, bucketed broadcasts smaller than full-slot at B=1, pipelined goodput >= 1.3x lock-step, drain loses/duplicates nothing, pod device-seconds within 5% of the per-process clock sum; self-records for perf-gate
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/pod_serve_bench.py --check
 
 anytime-bench:   ## anytime refinement: resumed round-k phi bit-identical to from-scratch, reported error bounds true error within x2 at >=90% of rounds, overload A/B where the anytime arm answers every admitted request by deadline (monotone streamed error) while the fixed-nsamples control sheds or blows p99; self-records for perf-gate
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/anytime_bench.py --check

@@ -206,6 +206,10 @@ def run_benchmark(cpu_fallback: bool = False) -> int:
         # from multi-model fleets stay attributable per tenant
         "model_id": "adult_lr",
         "model_version": 1,
+        # pod-fabric era: how many host processes this measurement's mesh
+        # spanned (1 = single-process; a TPU pod rerun records its true
+        # size so per-host and per-pod numbers never get conflated)
+        "pod_processes": jax.process_count(),
     }
     # compile accounting for the whole run (fit + warmup + timed loop):
     # fresh = XLA compiled, cache_hit = the persistent compile cache

@@ -1,0 +1,599 @@
+"""Pod-serving fabric benchmark (standalone; ``--check`` exits nonzero on
+criteria fail): the bucketed-broadcast + pipelined multi-host hot path on
+a REAL 2-process gloo CPU mesh (``serving/multihost.py``).
+
+Two OS processes join one ``jax.distributed`` runtime (2 virtual CPU
+devices each — 4 global devices) and serve the tiny synthetic deployment
+through BOTH protocols, lock-step then pipelined, on the same mesh:
+
+1. **parity** — served phi (B=1 requests over HTTP) matches a direct
+   sharded explain of the same rows on the same mesh, for both
+   protocols, and the mesh's phi matches a single-process run;
+2. **bucketed frames** — the measured broadcast bytes per B=1 request
+   (``dks_pod_bcast_bytes_total``) are at most half the full-slot
+   frame the pre-bucketed protocol would have broadcast every batch;
+3. **pipelined goodput** — a B=1 frame backlog driven through the pod
+   models exactly as the server's dispatcher runs them (real wire, real
+   collectives), both protocols.  On a host with CPU parallelism the
+   backlog must retire >= 1.3x faster pipelined than lock-step.  On a
+   single-CPU host both processes timeshare one core, so overlap cannot
+   buy throughput BY CONSTRUCTION (total work per row is the floor, and
+   the follower recomputes every frame either way) — there the bench
+   gates the *mechanism* instead: per-frame dispatcher occupancy.
+   Lock-step occupancy is wall time (the dispatcher is blocked
+   end-to-end by protocol: broadcast + full device call + result
+   fetch); pipelined occupancy is the dispatch thread's CPU time
+   (``time.thread_time`` — broadcast + async enqueue), because on one
+   core the frame's own XLA compute threads starve the dispatcher
+   mid-dispatch and inflate its *wall* to ~frame time even though it
+   never blocks (measured: ~3ms CPU inside ~22ms wall at any pipeline
+   depth).  Thread CPU is the starvation-free occupancy — it equals
+   the wall a >=2-core host would observe for a never-blocking
+   dispatcher, so the ratio is exactly what converts into goodput the
+   moment device work and dispatch run on distinct silicon — the
+   TPU-pod deployment this fabric exists for.  The gate: occupancy
+   ratio >= 1.3, AND pipelining must not cost goodput (pipelined wall
+   <= 1.15x lock-step);
+4. **drain** — a rollout-style ``drain_and_shutdown`` under live
+   traffic loses nothing and duplicates nothing: every request either
+   returns the correct phi for ITS row or is cleanly rejected, no
+   client hangs, and the drain completes inside its grace window;
+5. **pod chargeback** — the lead's ``dks_device_seconds_total`` accrual
+   over a sequential request stream is within 5% of the independent
+   per-process clock sum (2 x the lead's own dispatch-to-fetch wall —
+   the SPMD program occupies both processes' devices for the same
+   interval).
+
+Self-records into ``results/perf_history.jsonl`` with ``checks_ok``
+(``bcast_bytes_per_row_b1`` and ``pipelined_row_s`` are recorded
+higher-is-worse so ``make perf-gate`` gates them like wall time).
+
+    python benchmarks/pod_serve_bench.py --check        # = make pod-bench
+"""
+
+import argparse
+import http.client
+import json
+import os
+import queue
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_DEVICES = 4
+D, K = 6, 3
+N_BG = int(os.environ.get("DKS_POD_BENCH_NBG", "4"))
+NSAMPLES = int(os.environ.get("DKS_POD_BENCH_NSAMPLES", "16"))
+MAX_ROWS = 64
+PARITY_ROWS = 8
+GOODPUT_ROWS = 48
+METER_ROWS = 12
+DRAIN_ROWS = 16
+EXPLAIN_KWARGS = {"nsamples": NSAMPLES, "l1_reg": False}
+
+_WORKER = """
+import sys
+sys.path.insert(0, sys.argv[4])
+import jax
+jax.config.update("jax_platforms", "cpu")
+from distributedkernelshap_tpu.compat import force_cpu_devices
+force_cpu_devices(2)
+pid = int(sys.argv[1])
+from distributedkernelshap_tpu.parallel.mesh import initialize_multihost
+initialize_multihost("127.0.0.1:" + sys.argv[2], 2, pid)
+assert jax.process_count() == 2
+import benchmarks.pod_serve_bench as bench
+bench.pod_leg(sys.argv[3])
+"""
+
+
+def _tiny_problem():
+    """The tiny deterministic synthetic deployment every leg shares
+    (tests/test_multihost.py's recipe): a softmax-linear predictor the
+    jitted explain evaluates on-device — fast to fit, no dataset."""
+
+    rng = np.random.default_rng(0)
+    W = rng.normal(size=(D, K)).astype(np.float32)
+    bg = rng.normal(size=(N_BG, D)).astype(np.float32)
+    X = rng.normal(size=(PARITY_ROWS, D)).astype(np.float32)
+
+    def pred(A):
+        import jax.numpy as jnp
+
+        z = A @ W
+        return jnp.exp(z) / jnp.exp(z).sum(-1, keepdims=True)
+
+    return pred, bg, X
+
+
+def _direct_phi(pred, bg, X, opts):
+    from distributedkernelshap_tpu import KernelShap
+
+    ex = KernelShap(pred, link="identity", seed=0, distributed_opts=opts)
+    ex.fit(bg)
+    sv = ex.explain(X, silent=True, **EXPLAIN_KWARGS).shap_values
+    return np.stack(sv, 1)
+
+
+def _wait_ready(port: int, timeout_s: float = 120.0) -> None:
+    """Block until the lead's /healthz answers 200 — the warmup ladder
+    (broadcast ``_CMD_WARMUP`` rungs) must finish before any snapshot,
+    or warmup frames pollute the per-request byte accounting."""
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+            conn.request("GET", "/healthz")
+            status = conn.getresponse().status
+            conn.close()
+            if status == 200:
+                return
+        except OSError:
+            pass
+        time.sleep(0.2)
+    raise RuntimeError(f"server on :{port} not ready in {timeout_s:.0f}s")
+
+
+def _served_phi(port: int, X: np.ndarray, max_workers: int):
+    from distributedkernelshap_tpu.serving import client as cl
+
+    payloads = cl.distribute_requests(
+        f"http://127.0.0.1:{port}/explain", X, max_workers=max_workers)
+    return np.stack([
+        np.asarray(json.loads(p)["data"]["shap_values"])[:, 0]
+        for p in payloads])
+
+
+def _device_seconds(server) -> float:
+    total = 0.0
+    for line in server.metrics.render().splitlines():
+        if line.startswith("dks_device_seconds_total{"):
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def _pod_bytes_total() -> float:
+    from distributedkernelshap_tpu.serving.multihost import (
+        pod_bcast_byte_counts,
+    )
+
+    return sum(pod_bcast_byte_counts().values())
+
+
+def _raw_explain(port: int, row: np.ndarray, timeout_s: float = 120.0):
+    """One retry-free /explain POST: ``(status, phi | None)``.  Status -1
+    = connection-level rejection (server already stopped accepting) —
+    clean for the drain criterion; only a HANG counts as lost."""
+
+    body = json.dumps({"array": np.asarray(row)[None].tolist()}).encode()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=timeout_s)
+        conn.request("POST", "/explain", body,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        status, payload = resp.status, resp.read()
+        conn.close()
+    except OSError:
+        return -1, None
+    if status != 200:
+        return status, None
+    phi = np.asarray(
+        json.loads(payload)["data"]["shap_values"])[:, 0]
+    return status, phi
+
+
+def _serve_round(pred, bg, opts, pipeline_depth=None):
+    from distributedkernelshap_tpu.serving.multihost import serve_multihost
+
+    # staging=False on BOTH rounds: at max_batch_size=1 the staging
+    # batcher is pure added latency, and leaving it on only for the
+    # pipelined round (its production default) would conflate the
+    # batcher with the protocol this bench isolates
+    return serve_multihost(
+        pred, bg, {"link": "identity", "seed": 0}, {}, opts,
+        host="127.0.0.1", port=0, max_batch_size=1, max_rows=MAX_ROWS,
+        explain_kwargs=dict(EXPLAIN_KWARGS),
+        pipeline_depth=pipeline_depth, staging=False)
+
+
+def pod_leg(outdir: str) -> None:
+    """Per-process body: direct sharded explain (reference), then the
+    lock-step serve round, then the pipelined serve round with the drain
+    arm.  Followers participate via the broadcast loop each round; the
+    lead measures and saves the artifact."""
+
+    import jax
+
+    def mark(msg):
+        print(f"[pod_leg p{jax.process_index()}] {msg}", flush=True)
+
+    pred, bg, X = _tiny_problem()
+    is_lead = jax.process_index() == 0
+
+    # direct sharded explain FIRST, on every process simultaneously (a
+    # sharded explain is a collective program)
+    direct = _direct_phi(pred, bg, X, {"n_devices": N_DEVICES})
+    mark("direct explain done")
+
+    out = {}
+
+    # ---- round A: lock-step protocol --------------------------------- #
+    srv = _serve_round(pred, bg, {"n_devices": N_DEVICES,
+                                  "replicate_results": False})
+    mark("round A serve returned")
+    if srv is not None:
+        try:
+            _wait_ready(srv.port)
+            mark("round A ready")
+            from distributedkernelshap_tpu.serving.multihost import (
+                MultihostServingModel,
+                PipelinedMultihostServingModel,
+            )
+
+            assert isinstance(srv.model, MultihostServingModel)
+            assert not isinstance(srv.model, PipelinedMultihostServingModel)
+            # parity stream doubles as the B=1 frame-size measurement
+            bytes0 = _pod_bytes_total()
+            phi_lock = _served_phi(srv.port, X, max_workers=4)
+            out["bcast_bytes_per_row_b1"] = \
+                (_pod_bytes_total() - bytes0) / PARITY_ROWS
+            # what every frame would cost if padded to the full slot,
+            # under the SAME wire the round actually used (the KV wire
+            # carries frames as-is; the collective wire MTU-chunks them)
+            from distributedkernelshap_tpu.serving.multihost import (
+                _HEADER_LEN,
+                _chunk_elems,
+                _payload_chunks,
+            )
+
+            if srv.model._uniform_wire:
+                out["full_slot_frame_bytes"] = \
+                    (1 + _payload_chunks(MAX_ROWS, D)) * _chunk_elems(D) * 4
+            else:
+                out["full_slot_frame_bytes"] = \
+                    (_HEADER_LEN + MAX_ROWS * D) * 4
+
+            # pod chargeback: meter accrual vs 2x the lead's own
+            # dispatch-to-fetch clock over a sequential stream (shim the
+            # pod model's explain_batch — the exact span the costmeter
+            # brackets; everything is compiled by now, so the meter's
+            # compile exclusion subtracts nothing)
+            pod, shim = srv.model, {"s": 0.0}
+            orig = pod.explain_batch
+
+            def timed(stacked, split_sizes=None, formats=None):
+                t0 = time.monotonic()
+                try:
+                    return orig(stacked, split_sizes=split_sizes,
+                                formats=formats)
+                finally:
+                    shim["s"] += time.monotonic() - t0
+
+            pod.explain_batch = timed
+            meter0 = _device_seconds(srv)
+            _served_phi(srv.port, np.tile(X, (METER_ROWS // PARITY_ROWS
+                                              + 1, 1))[:METER_ROWS],
+                        max_workers=1)
+            out["meter_device_s"] = _device_seconds(srv) - meter0
+            out["clock_sum_device_s"] = 2.0 * shim["s"]
+            pod.explain_batch = orig
+
+            # lock-step goodput: the dispatcher hot path itself — a
+            # B=1 frame backlog through the pod model exactly as the
+            # server's dispatch loop runs it (broadcast, sync device
+            # call, cross-process result allgather), measured without
+            # the HTTP client sharing this process's interpreter
+            t0 = time.monotonic()
+            occ = 0.0
+            for i in range(GOODPUT_ROWS):
+                t1 = time.monotonic()
+                srv.model.explain_batch(X[i % PARITY_ROWS][None],
+                                        split_sizes=[1])
+                occ += time.monotonic() - t1
+            out["lockstep_wall_s"] = time.monotonic() - t0
+            out["lockstep_dispatch_occupancy_s"] = occ / GOODPUT_ROWS
+            np.save(os.path.join(outdir, "phi_lock.npy"), phi_lock)
+        finally:
+            srv.model.drain_and_shutdown(srv)
+    mark("round A done")
+
+    # ---- round B: pipelined protocol (the production default) -------- #
+    srv = _serve_round(pred, bg, {"n_devices": N_DEVICES},
+                       pipeline_depth=4)
+    mark("round B serve returned")
+    if srv is None:
+        return  # follower: released by round B's shutdown broadcast
+    try:
+        _wait_ready(srv.port)
+        mark("round B ready")
+        from distributedkernelshap_tpu.serving.multihost import (
+            PipelinedMultihostServingModel,
+        )
+
+        assert isinstance(srv.model, PipelinedMultihostServingModel)
+        phi_pipe = _served_phi(srv.port, X, max_workers=4)
+        # pipelined goodput: the same backlog through the pipelined
+        # dispatch — broadcast + async device dispatch up to depth in
+        # flight, finalizes (now local fetches) retired in dispatch
+        # order off the dispatcher's thread
+        depth = 4
+        sem = threading.Semaphore(depth)
+        fin_q = queue.Queue()
+
+        def _finisher():
+            while True:
+                fin = fin_q.get()
+                if fin is None:
+                    return
+                fin()
+                sem.release()
+
+        fth = threading.Thread(target=_finisher, daemon=True)
+        fth.start()
+        t0 = time.monotonic()
+        occ = 0.0
+        cpu = 0.0
+        for i in range(GOODPUT_ROWS):
+            sem.acquire()
+            t1 = time.monotonic()
+            c1 = time.thread_time()
+            fin = srv.model.explain_batch_async(
+                X[i % PARITY_ROWS][None], split_sizes=[1])
+            cpu += time.thread_time() - c1
+            occ += time.monotonic() - t1
+            fin_q.put(fin)
+        fin_q.put(None)
+        fth.join()
+        out["pipelined_wall_s"] = time.monotonic() - t0
+        out["pipelined_dispatch_occupancy_s"] = occ / GOODPUT_ROWS
+        # the starvation-free occupancy for the single-core gate (see
+        # module docstring check 3): the dispatch thread's own CPU time,
+        # which is the wall a multi-core host would observe for a
+        # dispatcher that never blocks
+        out["pipelined_dispatch_cpu_s"] = cpu / GOODPUT_ROWS
+        out["cpu_parallelism"] = len(os.sched_getaffinity(0))
+        mark("round B goodput done")
+
+        # ---- drain arm: rollout under live traffic ------------------- #
+        results = []  # (row_idx, status, phi | None)
+        res_lock = threading.Lock()
+
+        def _client(rows):
+            for i in rows:
+                status, phi = _raw_explain(srv.port, X[i % PARITY_ROWS])
+                with res_lock:
+                    results.append((i % PARITY_ROWS, status, phi))
+
+        threads = [threading.Thread(target=_client,
+                                    args=([2 * t, 2 * t + 1],),
+                                    daemon=True)
+                   for t in range(DRAIN_ROWS // 2)]
+        for t in threads:
+            t.start()
+        time.sleep(0.25)  # let some requests get in flight
+        out["drain_clean"] = bool(srv.model.drain_and_shutdown(
+            srv, grace_s=60.0))
+        for t in threads:
+            t.join(timeout=180)
+        out["drain_lost"] = sum(t.is_alive() for t in threads)
+        ok, rejected, wrong = 0, 0, 0
+        for row, status, phi in results:
+            if status == 200:
+                ok += 1
+                if not np.allclose(phi, direct[row], atol=1e-5):
+                    wrong += 1
+            else:
+                rejected += 1
+        out["drain_ok"] = ok
+        out["drain_rejected"] = rejected
+        out["drain_wrong_phi"] = wrong
+        out["drain_responses"] = len(results)
+    finally:
+        if not srv.model._shut:
+            srv.model.drain_and_shutdown(srv)
+
+    if is_lead:
+        np.save(os.path.join(outdir, "direct.npy"), direct)
+        np.save(os.path.join(outdir, "phi_pipe.npy"), phi_pipe)
+        with open(os.path.join(outdir, "pod_lead.json"), "w") as f:
+            json.dump(out, f)
+
+
+# ---------------------------------------------------------------------- #
+# driver
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_two(workdir: str, timeout: float):
+    port = _free_port()
+    worker = os.path.join(workdir, "worker.py")
+    with open(worker, "w") as f:
+        f.write(_WORKER)
+    env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu")
+    logs = [os.path.join(workdir, f"pod{pid}.log") for pid in range(2)]
+    procs = []
+    try:
+        for pid in range(2):
+            with open(logs[pid], "wb") as log:
+                procs.append(subprocess.Popen(
+                    [sys.executable, worker, str(pid), str(port),
+                     workdir, REPO],
+                    cwd=workdir, env=env, stdout=log,
+                    stderr=subprocess.STDOUT))
+        for p in procs:
+            p.wait(timeout=timeout)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    pass
+    texts = [open(log, errors="replace").read() for log in logs]
+    for pid, p in enumerate(procs):
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"pod process {pid} exited {p.returncode}:\n"
+                + texts[pid][-1500:]
+                + f"\n---- peer log (p{1 - pid}) ----\n"
+                + texts[1 - pid][-1500:])
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--check", action="store_true",
+                        help="exit nonzero unless every criterion holds")
+    parser.add_argument("--timeout", default=540.0, type=float)
+    parser.add_argument("--no-record", action="store_true",
+                        help="skip the perf-history append")
+    args = parser.parse_args()
+
+    t_start = time.monotonic()
+    checks, report = {}, {}
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            _run_two(tmp, args.timeout)
+            direct = np.load(os.path.join(tmp, "direct.npy"))
+            phi_lock = np.load(os.path.join(tmp, "phi_lock.npy"))
+            phi_pipe = np.load(os.path.join(tmp, "phi_pipe.npy"))
+            with open(os.path.join(tmp, "pod_lead.json")) as f:
+                lead = json.load(f)
+
+        # 1. parity: both protocols vs the same-mesh direct explain, and
+        # the mesh vs a single-process run of the same plan
+        report["parity_max_err_lockstep"] = float(
+            np.max(np.abs(phi_lock - direct)))
+        report["parity_max_err_pipelined"] = float(
+            np.max(np.abs(phi_pipe - direct)))
+        checks["phi_lockstep_matches_direct"] = bool(
+            np.allclose(phi_lock, direct, atol=1e-5))
+        checks["phi_pipelined_matches_direct"] = bool(
+            np.allclose(phi_pipe, direct, atol=1e-5))
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from distributedkernelshap_tpu.compat import force_cpu_devices
+
+        force_cpu_devices(N_DEVICES)
+        pred, bg, X = _tiny_problem()
+        single = _direct_phi(pred, bg, X, {"n_devices": N_DEVICES})
+        checks["phi_matches_single_process"] = bool(
+            np.allclose(direct, single, atol=1e-5))
+
+        # 2. bucketed frames beat the full slot on the B=1 stream
+        per_row = lead["bcast_bytes_per_row_b1"]
+        full_slot = lead["full_slot_frame_bytes"]
+        report["bcast_bytes_per_row_b1"] = round(per_row, 1)
+        report["full_slot_frame_bytes"] = full_slot
+        checks["bucketed_frames_beat_full_slot"] = \
+            per_row <= 0.5 * full_slot
+
+        # 3. pipelined goodput (see module docstring: on a single-CPU
+        # host overlap cannot buy throughput, so the gate moves to the
+        # dispatcher-occupancy mechanism + a no-overhead bound)
+        lock_rows_s = GOODPUT_ROWS / lead["lockstep_wall_s"]
+        pipe_rows_s = GOODPUT_ROWS / lead["pipelined_wall_s"]
+        ratio = pipe_rows_s / lock_rows_s
+        # lock-step occupancy is wall (blocked end-to-end by protocol);
+        # pipelined occupancy is the dispatch thread's CPU time (its
+        # wall is starvation-inflated on a single core — docstring #3)
+        occ_ratio = (lead["lockstep_dispatch_occupancy_s"]
+                     / max(lead["pipelined_dispatch_cpu_s"], 1e-9))
+        report["lockstep_rows_per_s"] = round(lock_rows_s, 1)
+        report["pipelined_rows_per_s"] = round(pipe_rows_s, 1)
+        report["pipelined_goodput_ratio"] = round(ratio, 2)
+        report["pipelined_dispatch_ms"] = round(
+            lead["pipelined_dispatch_cpu_s"] * 1e3, 2)
+        report["dispatch_occupancy_ratio"] = round(occ_ratio, 2)
+        report["cpu_parallelism"] = lead["cpu_parallelism"]
+        if lead["cpu_parallelism"] > 1:
+            checks["pipelined_goodput_ge_1_3x"] = ratio >= 1.3
+        else:
+            checks["pipelined_dispatch_occupancy_ge_1_3x"] = \
+                occ_ratio >= 1.3
+            checks["pipelining_costs_no_goodput"] = ratio >= 1 / 1.15
+
+        # 4. drain: nothing lost, nothing duplicated/cross-wired
+        report["drain"] = {k: lead[k] for k in
+                           ("drain_clean", "drain_lost", "drain_ok",
+                            "drain_rejected", "drain_wrong_phi",
+                            "drain_responses")}
+        checks["drain_zero_lost"] = (
+            lead["drain_lost"] == 0
+            and lead["drain_responses"] == DRAIN_ROWS)
+        checks["drain_zero_dup_or_mixup"] = lead["drain_wrong_phi"] == 0
+        checks["drain_served_some"] = lead["drain_ok"] >= 1
+        checks["drain_completed_in_grace"] = bool(lead["drain_clean"])
+
+        # 5. pod chargeback within 5% of the per-process clock sum
+        meter, clock = lead["meter_device_s"], lead["clock_sum_device_s"]
+        report["meter_device_s"] = round(meter, 4)
+        report["clock_sum_device_s"] = round(clock, 4)
+        checks["device_seconds_within_5pct"] = (
+            clock > 0 and abs(meter - clock) / clock <= 0.05)
+    except Exception as e:  # noqa: BLE001 - bench reports, never raises
+        checks["error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps({"pod_serve_bench": "fail", "checks": checks,
+                          **report}))
+        return 1
+
+    report["checks"] = checks
+    report["elapsed_s"] = round(time.monotonic() - t_start, 1)
+
+    if not args.no_record:
+        from benchmarks.regression_gate import DEFAULT_HISTORY, record_run
+
+        entry = record_run(
+            DEFAULT_HISTORY, "pod_serve_bench",
+            config={"processes": 2, "devices": N_DEVICES,
+                    "features": D, "max_rows": MAX_ROWS,
+                    "goodput_rows": GOODPUT_ROWS, "max_batch_size": 1},
+            metrics={
+                # the production (pipelined) arm's goodput wall
+                "wall_s": lead["pipelined_wall_s"],
+                # recorded higher-is-worse so perf-gate gates them
+                "pipelined_row_s": lead["pipelined_wall_s"]
+                / GOODPUT_ROWS,
+                "bcast_bytes_per_row_b1": per_row,
+            },
+            extra={"pod_processes": 2,
+                   "pipelined_goodput_ratio": round(ratio, 2),
+                   "dispatch_occupancy_ratio": round(occ_ratio, 2),
+                   "cpu_parallelism": lead["cpu_parallelism"],
+                   "lockstep_rows_per_s": round(lock_rows_s, 1),
+                   "pipelined_rows_per_s": round(pipe_rows_s, 1),
+                   # the key regression_gate filters failed runs out of
+                   # the baseline median by
+                   "checks_ok": all(checks.values())})
+        report["perf_history"] = {"git_sha": entry["git_sha"],
+                                  "config_fp": entry["config_fp"]}
+
+    print(json.dumps({"pod_serve_bench":
+                      "ok" if all(checks.values()) else "fail",
+                      **report}))
+    if args.check and not all(checks.values()):
+        print(json.dumps({"failed_checks":
+                          [k for k, v in checks.items() if not v]}),
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

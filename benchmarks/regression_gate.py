@@ -185,6 +185,17 @@ def _threshold_for(metric: str, max_wall: float,
         # estimator, calibration or scheduler regression all surface as
         # MORE residual error at the same deadline — gated like wall time
         return max_wall
+    if metric == "bcast_bytes_per_row_b1":
+        # the pod bench's broadcast-frame size on a B=1 stream, in bytes
+        # per row (HIGHER is worse — frames crept back toward the old
+        # full-slot padding).  Deterministic by construction (header +
+        # smallest-bucket payload), so gate it as tightly as wall time
+        return max_wall
+    if metric == "pipelined_row_s":
+        # the pod bench's pipelined goodput, recorded INVERTED (seconds
+        # per row, so higher is worse like every gated metric): the
+        # pipelined hot path losing overlap shows up here directly
+        return max_wall
     if metric == "rounds_per_request_p50":
         # the complementary stop-rule sentinel: at a fixed schedule and
         # deadline, rounds per request CLIMBING means requests keep

@@ -92,6 +92,13 @@ class CostMeter:
                  max_tenants: int = DEFAULT_MAX_TENANTS):
         self.enabled = bool(enabled)
         self.max_tenants = int(max_tenants)
+        #: device-seconds scale factor: 1.0 on single-process servers; a
+        #: multi-host pod lead sets it to the process count
+        #: (serving/multihost.serve_multihost) because one SPMD dispatch
+        #: occupies EVERY process's devices for the lead-measured
+        #: interval — billing only the lead's share under-charges an
+        #: N-host pod N-fold
+        self.device_multiplier = 1.0
         self._lock = threading.Lock()
         self._tenants: set = set()
         self._overflowed_total = 0
@@ -113,7 +120,8 @@ class CostMeter:
             "Device-seconds consumed per (model, version, evaluation "
             "path), measured at the dispatch-to-fetch boundary on the "
             "monotonic clock with backend compile time excluded; shared "
-            "cross-tenant batches are prorated by padded-row share "
+            "cross-tenant batches are prorated by padded-row share, and "
+            "multi-host pod leads scale by the pod's process count "
             "(docs/OBSERVABILITY.md, cost attribution).",
             labelnames=("model", "version", "path")).bound_cardinality(cap)
         self._m_rows = registry.counter(
@@ -203,6 +211,12 @@ class CostMeter:
 
     # -- device-time metering ------------------------------------------- #
 
+    def set_device_multiplier(self, n_processes) -> None:
+        """Scale every settled dispatch bracket by ``n_processes`` (see
+        ``device_multiplier``); clamped to >= 1."""
+
+        self.device_multiplier = max(1.0, float(n_processes))
+
     def _compile_seconds(self) -> float:
         if self._compile is None:
             from distributedkernelshap_tpu.runtime.compile_cache import (
@@ -243,7 +257,8 @@ class CostMeter:
             t_end = time.monotonic()
         if compile_end is None:
             compile_end = self._compile_seconds()
-        elapsed = max(0.0, (t_end - t0) - max(0.0, compile_end - c0))
+        elapsed = max(0.0, (t_end - t0) - max(0.0, compile_end - c0)) \
+            * self.device_multiplier
         total_rows = sum(max(0, int(r)) for _, _, _, r in shares)
         if total_rows <= 0:
             return 0.0

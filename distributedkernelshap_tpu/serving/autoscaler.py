@@ -220,6 +220,13 @@ class Autoscaler:
         self.fleet = fleet
         self.proxy = proxy
         self.config = config or AutoscalerConfig()
+        # pod-as-replica: with a pod fleet (ReplicaManager
+        # pod_processes > 1) every scale event spawns/retires a WHOLE pod
+        # and every provisioned second costs P process-seconds — the
+        # replica-seconds meter scales by this so chargeback matches what
+        # the cluster actually runs
+        self.unit_processes = max(1, int(getattr(fleet, "pod_processes",
+                                                 1) or 1))
         self._faults = fault_injector
         self._flight = flightrec()
         # fleet-capacity EWMA in rows/s, capacity-hinted on every scale
@@ -290,7 +297,9 @@ class Autoscaler:
         self._m_replica_seconds = registry.counter(
             "dks_autoscale_replica_seconds_total",
             "Replica-seconds accumulated by lifecycle state (the "
-            "provisioning cost the autoscaler exists to minimise).",
+            "provisioning cost the autoscaler exists to minimise); pod "
+            "fleets accrue in PROCESS units — each pod-second costs its "
+            "process count.",
             labelnames=("state",)).seed(
             ("ready",), ("warming",), ("draining",), ("standby",))
 
@@ -658,7 +667,8 @@ class Autoscaler:
         for state, count in self.proxy.replica_state_counts().items():
             if count and state in ("ready", "warming", "draining",
                                    "standby"):
-                self._m_replica_seconds.inc(count * accrue_s, state=state)
+                self._m_replica_seconds.inc(
+                    count * accrue_s * self.unit_processes, state=state)
         self._poll_draining(now)
         sig = self._gather()
         with self._lock:

@@ -46,8 +46,32 @@ def main():
     parser.add_argument("--replicate_results", action="store_true",
                         help="Multi-host only: all-gather results inside "
                              "the jitted program so the broadcast protocol "
-                             "PIPELINES device calls (serving/multihost.py) "
-                             "instead of running lock-step.")
+                             "PIPELINES device calls (serving/multihost.py)."
+                             " Now the DEFAULT production path; kept as an "
+                             "explicit no-op for compatibility — see "
+                             "--lockstep for the opt-out.")
+    parser.add_argument("--lockstep", action="store_true",
+                        help="Multi-host only: opt OUT of the pipelined "
+                             "default (replicate_results=False) and serve "
+                             "one device call at a time.")
+    parser.add_argument("--coalition_parallel", default=1, type=int,
+                        help="Multi-host only: shard the hot path 2D "
+                             "(batch x coalition) across the pod's mesh. "
+                             "Needs jax.shard_map (JAX >= 0.6) on "
+                             "multi-process meshes; old JAX rejects it "
+                             "loudly (parallel/mesh.py).")
+    parser.add_argument("--factory", default=None, type=str,
+                        help="module:function returning (predictor, "
+                             "background, ctor_kwargs, fit_kwargs) — the "
+                             "replica workers' deployment tuple, honoured "
+                             "by every serving mode incl. --coordinator "
+                             "pods (default: the Adult deployment).")
+    parser.add_argument("--pod_procs", default=1, type=int,
+                        help="With --replica_procs: processes per replica "
+                             "UNIT — each replica becomes a multi-host pod "
+                             "(lead + followers over a local coordinator) "
+                             "that the proxy/supervisor/autoscaler treat "
+                             "as one citizen (serving/replicas.py).")
     parser.add_argument("--replica_procs", default=0, type=int,
                         help="Replica-per-chip mode: spawn this many "
                              "crash-isolated single-device server PROCESSES "
@@ -63,48 +87,75 @@ def main():
         parser.error("--num_processes/--process_id require --coordinator "
                      "(a would-be follower must never start its own server)")
 
-    def _load_default_args():
-        # ONE definition of the default Adult deployment tuple, shared with
-        # the replica workers so --replica_procs can never serve a
-        # different explainer than the single-process modes
+    def _load_deployment_args():
+        # ONE definition of the deployment tuple, shared with the replica
+        # workers so --replica_procs / --coordinator pods can never serve
+        # a different explainer than the single-process modes: an explicit
+        # --factory wins, then --checkpoint (rebuilt through the ctor
+        # tuple so every pod process re-fits identically), else the
+        # default Adult deployment
         from distributedkernelshap_tpu.serving.replica_worker import (
             adult_factory,
+            checkpoint_factory,
+            resolve_factory,
         )
 
+        if args.factory:
+            return resolve_factory(args.factory)()
+        if args.checkpoint:
+            return checkpoint_factory(args.checkpoint)
         return adult_factory()
+
+    if args.pod_procs < 1:
+        parser.error("--pod_procs must be >= 1")
+    if args.pod_procs > 1 and not args.replica_procs:
+        parser.error("--pod_procs sizes the replica UNITS of the "
+                     "--replica_procs fleet; a standalone pod is "
+                     "--coordinator with one process per host")
+    if args.replicate_results and args.lockstep:
+        parser.error("--replicate_results and --lockstep are opposites")
+    if args.factory and args.checkpoint:
+        parser.error("--factory and --checkpoint both name a deployment; "
+                     "pick one")
 
     if args.replica_procs:
         if args.coordinator is not None or args.checkpoint or args.exact \
-                or args.replicate_results or args.max_rows is not None:
+                or args.replicate_results or args.lockstep \
+                or args.max_rows is not None:
             # fail loudly, same convention as the multihost branch: a flag
             # this mode cannot honour must never be silently dropped
-            parser.error("--replica_procs is the single-host replica-per-"
-                         "chip mode; it does not combine with "
+            # (--pod_procs composes: each replica unit becomes a pod)
+            parser.error("--replica_procs is the single-host replica "
+                         "fleet mode; it does not combine with "
                          "--coordinator/--checkpoint/--exact/"
-                         "--replicate_results/--max_rows")
+                         "--replicate_results/--lockstep/--max_rows")
+        from distributedkernelshap_tpu.serving.replica_worker import (
+            adult_factory,
+        )
         from distributedkernelshap_tpu.serving.replicas import ReplicaManager
 
         manager = ReplicaManager(
             args.replica_procs,
+            factory=args.factory or (adult_factory.__module__
+                                     + ":adult_factory"),
             max_batch_size=args.max_batch_size,
             pipeline_depth=args.pipeline_depth or None,
+            pod_processes=args.pod_procs,
         ).start(proxy_port=args.port, proxy_host=args.host)
-        banner = (f"replica-per-chip serving on "
+        unit = ("pods" if args.pod_procs > 1 else "worker processes")
+        banner = (f"replica serving on "
                   f"{manager.proxy.host}:{manager.proxy.port} "
-                  f"({args.replica_procs} worker processes)")
+                  f"({args.replica_procs} {unit}"
+                  + (f" x {args.pod_procs} processes" if args.pod_procs > 1
+                     else "") + ")")
         on_stop = manager.stop
     elif args.coordinator is not None:
-        # multi-host deployment: every pod runs this same entry (SPMD).
-        # Followers block inside serve_multihost until the shutdown
-        # broadcast; the flag combinations the branch cannot honour fail
-        # loudly instead of misrouting.
-        if args.checkpoint:
-            parser.error("--checkpoint is not supported with --coordinator "
-                         "yet (the multihost branch always fits the default "
-                         "Adult explainer)")
-        if args.exact:
-            parser.error("--exact needs a lifted tree-ensemble checkpoint, "
-                         "which the multihost branch cannot load yet")
+        # multi-host deployment: every pod process runs this same entry
+        # (SPMD).  Followers block inside serve_multihost until the
+        # shutdown broadcast.  --checkpoint/--exact/--factory all route
+        # through the same ctor-tuple loading the replica workers use, so
+        # any deployment — tree/TT/deepshap engine paths included —
+        # serves from a pod.
         # a pod-wide SIGTERM (k8s rollout) must not kill followers before
         # the lead broadcasts shutdown — their orderly exit IS the shutdown
         # broadcast.  The rank may be auto-inferred (unknown until after
@@ -122,10 +173,17 @@ def main():
 
         initialize_multihost(args.coordinator, args.num_processes,
                              args.process_id)
-        predictor, background, ctor_kwargs, fit_kwargs = _load_default_args()
+        predictor, background, ctor_kwargs, fit_kwargs = \
+            _load_deployment_args()
         opts = {"n_devices": len(jax.devices())}
-        if args.replicate_results:
-            opts["replicate_results"] = True
+        if args.coalition_parallel > 1:
+            # 2D sharding (batch x coalition) across the pod; on JAX too
+            # old for multi-process shard_map the mesh builder rejects it
+            # loudly with the upgrade hint (parallel/mesh.py)
+            opts["coalition_parallel"] = args.coalition_parallel
+        if args.lockstep:
+            opts["replicate_results"] = False
+        # pipelined (replicate_results=True) is serve_multihost's default
         server = serve_multihost(
             predictor, background, ctor_kwargs, fit_kwargs, opts,
             host=args.host, port=args.port,
@@ -141,8 +199,10 @@ def main():
                   f"(lead of {jax.process_count()} processes)")
 
         def on_stop():
-            server.stop()
-            server.model.shutdown_followers()
+            # drain handshake: stop accepting, flush in-flight broadcast
+            # dispatches, THEN broadcast shutdown — a k8s rollout must
+            # never strand followers in a half-finished collective
+            server.model.drain_and_shutdown(server)
     elif args.checkpoint:
         from distributedkernelshap_tpu.kernel_shap import KernelShap
         from distributedkernelshap_tpu.serving.server import ExplainerServer
@@ -157,7 +217,8 @@ def main():
         banner = f"serving on {server.host}:{server.port} — Ctrl-C to stop"
         on_stop = server.stop
     else:
-        predictor, background, ctor_kwargs, fit_kwargs = _load_default_args()
+        predictor, background, ctor_kwargs, fit_kwargs = \
+            _load_deployment_args()
         server = serve_explainer(
             predictor, background, ctor_kwargs, fit_kwargs,
             host=args.host, port=args.port, max_batch_size=args.max_batch_size,

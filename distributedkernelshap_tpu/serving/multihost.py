@@ -1,4 +1,4 @@
-"""SPMD serving over a multi-host mesh.
+"""SPMD serving over a multi-host mesh: the pod fabric.
 
 The single-host server (``serving/server.py``) owns the whole device mesh
 from one process.  On a multi-host mesh (``jax.distributed`` across
@@ -11,35 +11,91 @@ The bridge is a broadcast protocol, the serving-plane counterpart of the
 SPMD benchmark drivers (``benchmarks/multihost_pool.py``): the lead process
 runs the normal :class:`~distributedkernelshap_tpu.serving.server.ExplainerServer`
 around a :class:`MultihostServingModel`, which prefixes every device call
-with ``multihost_utils.broadcast_one_to_all`` of a fixed-shape header +
-padded batch; follower processes sit in :func:`follower_loop`, receive each
-broadcast, and enter the identical explain call so the mesh's collectives
-line up.  Responses are built on the lead only (host-side work, no
-collectives).  Shutdown is a zero header broadcast.
+with a broadcast frame: a ``[cmd, rows, bucket]`` header plus the batch
+padded to the selected *broadcast bucket* (the warmup ladder's compile
+rungs) — bytes proportional to the bucket, not the full slot, and explain
+shapes still static per rung so collectives stay recompile-free.  The
+default wire is the HOST-side :class:`KVStoreTransport` (the
+``jax.distributed`` coordination-service KV store): frames never enter
+the device queues, which matters because a device-level broadcast
+schedules behind every previously dispatched async explain and would
+serialize the pipelined protocol (see the class docstring).  The
+device-collective wire (:class:`CollectiveTransport`) remains available;
+on it every op is padded to ONE fixed MTU shape (:func:`_chunk_elems` —
+a transport-level correctness requirement), so a frame costs
+``1 + ceil(bucket*F/mtu)`` ops.  Follower processes sit in
+:func:`follower_loop`, size the frame from the header's bucket field,
+and enter the identical explain call so the mesh's collectives line up.
+Responses are built on the lead only (host-side work, no collectives).
+Warmup rungs broadcast as ``_CMD_WARMUP`` so every process compiles the
+same signatures in lockstep before ``/healthz`` flips; shutdown is a
+drain handshake (lead stops accepting, flushes in-flight dispatches,
+then broadcasts the shutdown header).
 
-Pipelining: the base protocol is lock-step (one device call at a time —
-the model does not expose ``explain_batch_async``, the server dispatches
-synchronously, ``pipeline_depth`` is 1), because a sharded fetch embeds a
-``process_allgather`` whose cross-process order concurrent finalizes would
-scramble.  With ``distributed_opts['replicate_results']=True`` the
-all-gather moves INSIDE the jitted program, fetches become local, and
-:class:`PipelinedMultihostServingModel` + the follower's async dispatch
-run several broadcast+explain calls in flight at the server's pipeline
-depth — collective order equals dispatch order on every process by
-construction.  Within one batch the device work is always fully sharded
-across all hosts' devices either way.
+Pipelining: the DEFAULT production path is the pipelined protocol —
+``serve_multihost`` defaults ``distributed_opts['replicate_results']=True``
+so the all-gather moves INSIDE the jitted program, fetches become local,
+and :class:`PipelinedMultihostServingModel` + the follower's async
+dispatch run several broadcast+explain calls in flight at the server's
+pipeline depth (collective order equals dispatch order on every process
+by construction), with the staging batcher forming batches one step
+ahead of dispatch.  The lock-step base protocol (one device call at a
+time, ``pipeline_depth`` 1) remains for explainers whose options cannot
+take the async fast path, and for ``replicate_results=False`` opt-outs:
+a sharded fetch embeds a ``process_allgather`` whose cross-process order
+concurrent finalizes would scramble.  Within one batch the device work
+is always fully sharded across all hosts' devices either way.
 """
 
+import itertools
 import logging
 import threading
-from typing import Optional
+import time
+from typing import List, Optional, Sequence
 
 import numpy as np
+
+from distributedkernelshap_tpu.analysis import lockwitness
+from distributedkernelshap_tpu.observability.flightrec import flightrec
 
 logger = logging.getLogger(__name__)
 
 _CMD_SHUTDOWN = 0
 _CMD_EXPLAIN = 1
+_CMD_WARMUP = 2
+
+#: broadcast header fields: ``[cmd, rows, bucket]``.  The bucket field
+#: lets followers size the payload without any ladder knowledge of their
+#: own — the header IS the framing contract.
+_HEADER_LEN = 3
+
+
+def _chunk_elems(n_features: int) -> int:
+    """The wire's fixed MTU, in float32 elements.
+
+    EVERY collective op on the wire is a float32 array of exactly this
+    many elements — the header chunk (``[cmd, rows, bucket]`` zero-padded)
+    and each payload chunk alike.  Shape-uniform ops are a CORRECTNESS
+    requirement, not a tidiness choice: gloo (the CPU collectives
+    backend) matches in-flight ops per connection pair by slot, and
+    back-to-back host-level collectives of *different* byte sizes can
+    cross-match under pipelining and abort the process with a preamble
+    length mismatch (``op.preamble.length <= op.nbytes``).  With one MTU
+    there is no op-size transition anywhere in the protocol — explain
+    frames, warmup rungs and the shutdown frame are all sequences of
+    identical ops, so no cross-op ordering guarantee is needed from the
+    transport.  Bucketing's win becomes op COUNT: a frame carries
+    ``1 + ceil(bucket*n_features/mtu)`` chunks, proportional to its
+    bucket instead of the full slot."""
+
+    return _HEADER_LEN + int(n_features)
+
+
+def _payload_chunks(bucket: int, n_features: int) -> int:
+    """Payload chunk count for one frame (header chunk excluded)."""
+
+    chunk = _chunk_elems(n_features)
+    return -(-(int(bucket) * int(n_features)) // chunk)
 
 
 def _broadcast(value, is_source: bool):
@@ -48,6 +104,229 @@ def _broadcast(value, is_source: bool):
 
     return np.asarray(multihost_utils.broadcast_one_to_all(
         value, is_source=is_source if jax.process_count() > 1 else True))
+
+
+class CollectiveTransport:
+    """The device-collective wire: ``multihost_utils.broadcast_one_to_all``
+    plus the process identity the protocol keys on.  Factored out so tier-1
+    tests can drive :class:`MultihostServingModel` and :func:`follower_loop`
+    with an in-process fake instead of real collectives.
+
+    ``needs_uniform_ops`` is True: every op on this wire must be one fixed
+    shape (see :func:`_chunk_elems`), so frames are MTU-chunked.  Note this
+    transport also makes every broadcast a DEVICE program that queues
+    behind previously dispatched async work — fine for the lock-step
+    protocol, but it serializes the pipelined one, which is why
+    :func:`_default_transport` prefers the host-side KV wire."""
+
+    needs_uniform_ops = True
+
+    @property
+    def is_lead(self) -> bool:
+        import jax
+
+        return jax.process_index() == 0
+
+    @property
+    def process_index(self) -> int:
+        import jax
+
+        return jax.process_index()
+
+    @property
+    def process_count(self) -> int:
+        import jax
+
+        return jax.process_count()
+
+    def broadcast(self, value, is_source: bool):
+        return _broadcast(value, is_source)
+
+
+#: Process-local count of KV transport constructions, used to derive the
+#: session key prefix WITHOUT any wire traffic: the lead constructs its
+#: transport once per serve (in the model) and each follower once per
+#: serve (at follower_loop entry), so the Nth construction on every
+#: process belongs to the same serve session and the prefixes pair up.
+_kv_session_counter = itertools.count()
+
+
+class KVStoreTransport:
+    """Host-side wire over the ``jax.distributed`` coordination-service
+    key-value store — the default serving wire.
+
+    The device-collective wire has a structural flaw for PIPELINED
+    serving: a broadcast is itself a device program, so it schedules in
+    the per-device FIFO queue BEHIND every previously dispatched async
+    explain.  The wire becomes a barrier that serializes the very
+    pipeline it feeds — the lead's dispatcher blocks roughly a full
+    compute time per frame no matter the pipeline depth.  Frames on the
+    KV store never touch the device queues (pure RPC to the coordination
+    service the mesh already runs for ``jax.distributed``), so dispatch
+    stays sub-millisecond regardless of device backlog, and arbitrary
+    message sizes are safe — no collective op-shape matching, hence no
+    MTU chunking (``needs_uniform_ops`` is False) and frame bytes exactly
+    proportional to the broadcast bucket.
+
+    Protocol: the lead publishes each op's bytes under a monotonically
+    increasing sequence key; followers block on the next key in order
+    (bounded-timeout gets in a retry loop — idle gaps between requests
+    are normal).  Keys ``_GC_WINDOW`` ops behind the head are deleted as
+    new ones are published — followers trail the lead by at most the
+    pipeline depth, so the window bounds coordination-service memory
+    without ever racing a reader."""
+
+    needs_uniform_ops = False
+    _GC_WINDOW = 4096
+
+    def __init__(self):
+        from jax._src import distributed
+
+        client = getattr(distributed.global_state, "client", None)
+        if client is None:
+            raise RuntimeError(
+                "jax.distributed is not initialized; the KV-store wire "
+                "needs the coordination service")
+        self._client = client
+        self._session = f"dks/pod/wire/s{next(_kv_session_counter)}"
+        self._seq = 0
+
+    @property
+    def is_lead(self) -> bool:
+        import jax
+
+        return jax.process_index() == 0
+
+    @property
+    def process_index(self) -> int:
+        import jax
+
+        return jax.process_index()
+
+    @property
+    def process_count(self) -> int:
+        import jax
+
+        return jax.process_count()
+
+    def broadcast(self, value, is_source: bool):
+        template = np.asarray(value)
+        key = f"{self._session}/{self._seq}"
+        self._seq += 1
+        if is_source:
+            self._client.key_value_set_bytes(
+                key, np.ascontiguousarray(template).tobytes())
+            stale = self._seq - self._GC_WINDOW - 1
+            if stale >= 0:
+                try:
+                    self._client.key_value_delete(
+                        f"{self._session}/{stale}")
+                except Exception:  # pragma: no cover - service going down
+                    pass
+            return template
+        waits = 0
+        while True:
+            try:
+                raw = self._client.blocking_key_value_get_bytes(key, 5000)
+                break
+            except Exception:
+                # DEADLINE_EXCEEDED between requests is the idle-server
+                # norm.  A dead coordination service also lands here, but
+                # that tears the process down on its next heartbeat anyway.
+                waits += 1
+                if waits % 24 == 0:
+                    logger.debug("follower still waiting on %s", key)
+        return np.frombuffer(raw, dtype=template.dtype).reshape(
+            template.shape).copy()
+
+
+def _default_transport():
+    """The serving wire: the host-side KV transport when the jax
+    distributed client is up (always true on a real multi-process mesh),
+    else the device-collective wire.  The resolution depends only on
+    process-global state that is identical across the mesh, so every
+    process picks the same wire."""
+
+    try:
+        return KVStoreTransport()
+    except Exception:
+        return CollectiveTransport()
+
+
+# ---------------------------------------------------------------------- #
+# Broadcast metering.  Process-global counters with a registry callback
+# (the ``attach_treeshap_metrics`` pattern): the pod model is constructed
+# before the server's registry exists, and the follower side has no
+# registry at all, so the counts live here and the lead's server renders
+# them as ``dks_pod_bcast_bytes_total{bucket}`` /
+# ``dks_pod_bcast_seconds_total``.
+
+_pod_meter_lock = lockwitness.make_lock("multihost.pod_meter")
+_pod_bcast_bytes: dict = {}
+_pod_bcast_seconds: float = 0.0
+
+
+def record_pod_bcast(bucket: int, nbytes: int, seconds: float) -> None:
+    """Count one framed broadcast (header + bucket-padded payload)."""
+
+    global _pod_bcast_seconds
+    key = str(int(bucket))
+    with _pod_meter_lock:
+        _pod_bcast_bytes[key] = _pod_bcast_bytes.get(key, 0.0) + float(nbytes)
+        _pod_bcast_seconds += float(seconds)
+
+
+def pod_bcast_byte_counts() -> dict:
+    """``{(bucket,): bytes}`` — the registry-callback shape."""
+
+    with _pod_meter_lock:
+        return {(b,): n for b, n in _pod_bcast_bytes.items()}
+
+
+def pod_bcast_seconds_total() -> float:
+    with _pod_meter_lock:
+        return _pod_bcast_seconds
+
+
+def attach_pod_metrics(registry) -> None:
+    """Register the ``dks_pod_*`` broadcast meters on ``registry`` as
+    callback counters over the process-global accounting.  The bucket
+    label space is the broadcast ladder — bounded by construction, so no
+    cardinality declaration is needed."""
+
+    registry.counter(
+        "dks_pod_bcast_bytes_total",
+        "Bytes broadcast lead-to-followers on the pod serving fabric "
+        "(header + payload padded to the broadcast bucket), by bucket "
+        "— proportional-to-bucket by construction, vs the old "
+        "protocol's every-batch full slot.",
+        labelnames=("bucket",)).set_function(pod_bcast_byte_counts)
+    registry.counter(
+        "dks_pod_bcast_seconds_total",
+        "Seconds the lead's dispatcher spent inside pod broadcast "
+        "sends (header + payload, explain and warmup "
+        "frames).").set_function(pod_bcast_seconds_total)
+
+
+def broadcast_buckets(model, max_rows: int) -> List[int]:
+    """The broadcast bucket ladder for ``model``: its engine's compile
+    buckets over ``1..max_rows`` (the warmup ladder's rungs — shapes the
+    mesh compiles anyway), capped at and always including ``max_rows``;
+    a power-of-two ladder when the engine's batches are not bucketed."""
+
+    from distributedkernelshap_tpu.serving.server import ExplainerServer
+
+    max_rows = int(max_rows)
+    bucket = ExplainerServer._bucket_fn(model)
+    if bucket is None:
+        sizes, b = {max_rows}, 1
+        while b < max_rows:
+            sizes.add(b)
+            b *= 2
+        return sorted(sizes)
+    sizes = {min(int(bucket(n)), max_rows) for n in range(1, max_rows + 1)}
+    sizes.add(max_rows)
+    return sorted(sizes)
 
 
 class MultihostServingModel:
@@ -61,30 +340,57 @@ class MultihostServingModel:
         A fitted single-process serving model whose explainer was built
         with ``distributed_opts`` spanning the multi-host mesh.
     max_rows
-        Broadcast slot size: every batch is padded to this many rows (the
-        collective needs one static shape on all processes).  The server
-        reads this attribute to reject single over-slot requests with 413
-        at enqueue time and to stop coalescing before a stacked batch
-        would overflow the slot; the check in :meth:`explain_batch` is the
-        backstop.
+        Broadcast slot bound: the largest batch the protocol carries.
+        The server reads this attribute to reject single over-slot
+        requests with 413 at enqueue time and to stop coalescing before
+        a stacked batch would overflow the slot; the check in
+        :meth:`explain_batch` is the backstop.  Batches are padded only
+        to the smallest broadcast *bucket* that fits them, not to this
+        slot.
+    buckets
+        Broadcast bucket ladder (sorted rung sizes, last == ``max_rows``).
+        Defaults to :func:`broadcast_buckets` — the engine's compile
+        rungs, so bucketing adds no new collective shapes beyond what
+        warmup compiles.
+    transport
+        Broadcast transport; defaults to the real collective wire
+        (:class:`CollectiveTransport`).  Tests inject an in-process fake.
     """
 
-    def __init__(self, model, max_rows: int = 256):
-        import jax
-
+    def __init__(self, model, max_rows: int = 256,
+                 buckets: Optional[Sequence[int]] = None,
+                 transport=None):
         self.model = model
         self.explainer = model.explainer  # passthrough for introspection
         self.max_rows = int(max_rows)
+        self._transport = transport if transport is not None \
+            else _default_transport()
+        # collective wires need every op shape-uniform (MTU chunking);
+        # host-side wires carry frames as-is
+        self._uniform_wire = bool(
+            getattr(self._transport, "needs_uniform_ops", True))
         self._n_features = int(
             model.explainer._explainer.background.shape[1])
+        self.buckets = sorted(int(b) for b in (
+            buckets if buckets is not None
+            else broadcast_buckets(model, self.max_rows)))
+        if not self.buckets or self.buckets[-1] != self.max_rows:
+            raise ValueError(
+                f"broadcast buckets {self.buckets} must be non-empty and "
+                f"end at max_rows={self.max_rows}")
         # one lock serialises EVERY lead-side broadcast: the server's
         # dispatcher thread runs explain_batch while shutdown_followers may
         # be called from the main thread — interleaved broadcasts would
         # desync the followers' header/payload pairing
-        self._bcast_lock = threading.Lock()
+        self._bcast_lock = lockwitness.make_lock("multihost.bcast")
         self._shut = False
-        self._is_lead = jax.process_index() == 0
-        if not self._is_lead:
+        # drain accounting: dispatches opened (broadcast sent) but not yet
+        # completed — the shutdown handshake must flush these before the
+        # shutdown broadcast, or a k8s rollout strands followers (and the
+        # lead's own finalizers) in half-finished collectives
+        self._drain_cv = lockwitness.make_condition("multihost.drain")
+        self._inflight = 0
+        if not self._transport.is_lead:
             raise RuntimeError(
                 "MultihostServingModel must be constructed on the lead "
                 "process only; followers run follower_loop()")
@@ -92,10 +398,26 @@ class MultihostServingModel:
     # the server treats the absence of explain_batch_async as "dispatch
     # synchronously" — exactly what the lock-step protocol needs.
 
-    def _broadcast_batch(self, stacked: np.ndarray) -> np.ndarray:
+    @property
+    def supports_wire_formats(self) -> bool:
+        # per-slot wire formats only change the LEAD's host-side response
+        # encoding (wrappers._resplit_payloads) — the device program and
+        # therefore the followers' collective sequence are format-blind,
+        # so the capability passes straight through
+        return bool(getattr(self.model, "supports_wire_formats", False))
+
+    def _bucket_for(self, rows: int) -> int:
+        for b in self.buckets:
+            if b >= rows:
+                return b
+        return self.max_rows
+
+    def _broadcast_batch(self, stacked: np.ndarray,
+                         cmd: int = _CMD_EXPLAIN) -> np.ndarray:
         """Validate + frame + broadcast one batch (caller holds
         ``_bcast_lock``); ONE implementation of the wire protocol so the
-        sync and pipelined dispatch paths cannot drift their framing."""
+        sync, pipelined and warmup dispatch paths cannot drift their
+        framing."""
 
         stacked = np.atleast_2d(np.asarray(stacked, dtype=np.float32))
         rows = stacked.shape[0]
@@ -109,68 +431,212 @@ class MultihostServingModel:
             # followers have already exited (peerless collective =
             # permanent hang)
             raise RuntimeError("multihost serving mesh already shut down")
-        header = np.array([_CMD_EXPLAIN, rows], np.int32)
-        padded = np.zeros((self.max_rows, self._n_features), np.float32)
-        padded[:rows] = stacked
-        _broadcast(header, is_source=True)
-        _broadcast(padded, is_source=True)
+        bucket = self._bucket_for(rows)
+        t0 = time.monotonic()
+        if self._uniform_wire:
+            chunk = _chunk_elems(self._n_features)
+            n_chunks = _payload_chunks(bucket, self._n_features)
+            header = np.zeros(chunk, np.float32)
+            header[:_HEADER_LEN] = (cmd, rows, bucket)
+            # bucket-padded payload, laid out as shape-uniform MTU chunks
+            # (see _chunk_elems for why every wire op must be one shape)
+            body = np.zeros(n_chunks * chunk, np.float32)
+            body[:rows * self._n_features] = stacked.ravel()
+            self._transport.broadcast(header, is_source=True)
+            for i in range(n_chunks):
+                self._transport.broadcast(body[i * chunk:(i + 1) * chunk],
+                                          is_source=True)
+            nbytes = (1 + n_chunks) * chunk * 4
+        else:
+            header = np.array([cmd, rows, bucket], np.float32)
+            padded = np.zeros((bucket, self._n_features), np.float32)
+            padded[:rows] = stacked
+            self._transport.broadcast(header, is_source=True)
+            self._transport.broadcast(padded, is_source=True)
+            nbytes = header.nbytes + padded.nbytes
+        record_pod_bcast(bucket, nbytes, time.monotonic() - t0)
         return stacked
 
-    def explain_batch(self, stacked: np.ndarray, split_sizes=None):
+    def _enter(self) -> None:
+        with self._drain_cv:
+            self._inflight += 1
+
+    def _leave(self) -> None:
+        with self._drain_cv:
+            self._inflight -= 1
+            if self._inflight <= 0:
+                self._drain_cv.notify_all()
+
+    def explain_batch(self, stacked: np.ndarray, split_sizes=None,
+                      formats=None):
+        kwargs = {} if formats is None else {"formats": formats}
         with self._bcast_lock:
             stacked = self._broadcast_batch(stacked)
-            return self.model.explain_batch(stacked, split_sizes=split_sizes)
+            self._enter()
+            try:
+                return self.model.explain_batch(stacked,
+                                                split_sizes=split_sizes,
+                                                **kwargs)
+            finally:
+                self._leave()
+
+    def warmup_batch(self, stacked: np.ndarray, split_sizes=None):
+        """One collective-safe warmup rung: broadcast the rows under
+        ``_CMD_WARMUP`` (followers run the SYNC explain, compiling the
+        same ``rows=<b>`` signature in lockstep) and run the lead's own
+        sync explain.  The server's warmup ladder calls this instead of
+        :meth:`explain_batch` when present, so every process finishes its
+        bucket compiles before ``/healthz`` flips ready."""
+
+        stacked = np.atleast_2d(np.asarray(stacked, dtype=np.float32))
+        flightrec().record("pod_warmup", role="lead",
+                           rows=int(stacked.shape[0]),
+                           bucket=self._bucket_for(int(stacked.shape[0])))
+        with self._bcast_lock:
+            stacked = self._broadcast_batch(stacked, cmd=_CMD_WARMUP)
+            self._enter()
+            try:
+                return self.model.explain_batch(stacked,
+                                                split_sizes=split_sizes)
+            finally:
+                self._leave()
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Wait until no broadcast-dispatched device call is still in
+        flight (sync calls in progress, pipelined dispatches whose
+        finalize has not completed).  Returns ``False`` on timeout."""
+
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        with self._drain_cv:
+            while self._inflight > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._drain_cv.wait(left)
+        return True
+
+    def drain_and_shutdown(self, server=None, grace_s: float = 30.0) -> bool:
+        """The rollout-safe shutdown handshake: stop accepting (``server
+        .stop()`` fails queued work with retriable 503s and parks the
+        dispatcher), flush every in-flight broadcast's device call, THEN
+        broadcast shutdown — so followers never exit with a half-finished
+        collective pending.  Returns whether the drain completed inside
+        ``grace_s`` (shutdown is broadcast either way: at the grace
+        boundary a wedged collective cannot be recovered from Python and
+        the deployment's liveness probe is the backstop)."""
+
+        if server is not None:
+            server.stop()
+        clean = self.drain(grace_s)
+        flightrec().record("pod_drain", role="lead", clean=clean,
+                           grace_s=grace_s)
+        if not clean:
+            logger.warning(
+                "pod drain did not complete within %.1fs; broadcasting "
+                "shutdown with work possibly in flight", grace_s)
+        self.shutdown_followers()
+        return clean
 
     def shutdown_followers(self):
         """Release the follower loops.  Idempotent: the first call
         broadcasts the shutdown header; later calls are no-ops (a second
-        broadcast would block forever — the followers are gone)."""
+        broadcast would block forever — the followers are gone).  Prefer
+        :meth:`drain_and_shutdown` on live deployments: broadcasting
+        shutdown with dispatches still in flight is only safe because the
+        broadcast order guarantees followers dispatched them first."""
 
         with self._bcast_lock:
             if self._shut:
                 return
             self._shut = True
-            _broadcast(np.array([_CMD_SHUTDOWN, 0], np.int32), is_source=True)
+            # bucket=0 -> zero payload: shutdown is a header-only frame
+            # (on collective wires still padded to the one MTU shape)
+            if self._uniform_wire:
+                header = np.zeros(_chunk_elems(self._n_features), np.float32)
+                header[:_HEADER_LEN] = (_CMD_SHUTDOWN, 0, 0)
+            else:
+                header = np.array([_CMD_SHUTDOWN, 0, 0], np.float32)
+            self._transport.broadcast(header, is_source=True)
 
 
-def follower_loop(model, max_rows: int = 256):
+def follower_loop(model, max_rows: int = 256, transport=None):
     """Run on every non-lead process: enter each broadcast explain call so
     the mesh collectives pair with the lead's, until shutdown.
 
     ``model`` must be built from the SAME constructor/fit arguments as the
     lead's (SPMD discipline — identical jitted programs and shardings),
-    with the same ``max_rows``.
+    with the same ``max_rows``.  Payload receive buffers are allocated
+    per broadcast bucket from the header's bucket field — followers need
+    no ladder knowledge of their own.
     """
 
-    import jax
-
-    if jax.process_index() == 0:
+    transport = transport if transport is not None else _default_transport()
+    if transport.is_lead:
         raise RuntimeError("follower_loop must not run on the lead process")
+    rank = transport.process_index
     inner = model.explainer._explainer
     n_features = int(inner.background.shape[1])
     # pipelined protocol (replicated results): the follower only needs to
     # ENTER each device program in broadcast order — dispatch async and
-    # drop the finalize (it fetches nothing the follower uses; buffers free
-    # once execution completes), so the loop returns to the broadcast
-    # immediately and the lead can run several calls in flight
+    # defer the finalize (it fetches nothing the follower uses; buffers
+    # free once execution completes), so the loop returns to the broadcast
+    # immediately and the lead can run several calls in flight.  The LAST
+    # finalize is kept: dispatches execute in order, so blocking on it at
+    # shutdown proves every earlier program completed before this process
+    # tears down its runtime (the lead's drain handshake mirrors this).
     pipelined = getattr(inner, 'replicate_results', False) \
         and hasattr(inner, 'get_explanation_async')
+    last_fin = None
+    uniform = bool(getattr(transport, "needs_uniform_ops", True))
+    chunk = _chunk_elems(n_features)
     while True:
-        header = _broadcast(np.zeros(2, np.int32), is_source=False)
-        if int(header[0]) == _CMD_SHUTDOWN:
-            logger.info("follower %d: shutdown", jax.process_index())
+        header = transport.broadcast(
+            np.zeros(chunk if uniform else _HEADER_LEN, np.float32),
+            is_source=False)
+        cmd = int(round(float(header[0])))
+        if cmd == _CMD_SHUTDOWN:
+            if last_fin is not None:
+                try:
+                    last_fin()
+                except Exception:
+                    logger.exception("follower %d: final pipelined fetch "
+                                     "failed at shutdown", rank)
+            flightrec().record("pod_drain", role="follower", rank=rank)
+            logger.info("follower %d: shutdown", rank)
             return
-        rows = int(header[1])
-        padded = _broadcast(np.zeros((max_rows, n_features), np.float32),
-                            is_source=False)
+        rows = int(round(float(header[1])))
+        bucket = int(round(float(header[2])))
+        if uniform:
+            n_chunks = _payload_chunks(bucket, n_features)
+            body = np.empty(n_chunks * chunk, np.float32)
+            for i in range(n_chunks):
+                body[i * chunk:(i + 1) * chunk] = transport.broadcast(
+                    np.zeros(chunk, np.float32), is_source=False)
+            padded = body[:bucket * n_features].reshape(bucket, n_features)
+        else:
+            padded = transport.broadcast(
+                np.zeros((bucket, n_features), np.float32), is_source=False)
+        if cmd == _CMD_WARMUP:
+            # warmup rungs run the SYNC explain even on the pipelined
+            # protocol: the point is finishing this process's compile
+            # before the lead's /healthz flips, not latency
+            flightrec().record("pod_warmup", role="follower", rank=rank,
+                               rows=rows, bucket=bucket)
+            try:
+                model.explainer.explain(padded[:rows], silent=True,
+                                        **model.explain_kwargs)
+            except Exception:
+                logger.exception("follower %d: warmup rung failed; "
+                                 "staying in loop", rank)
+            continue
         if pipelined:
             try:
-                inner.get_explanation_async(padded[:rows],
-                                            **model.explain_kwargs)
+                last_fin = inner.get_explanation_async(padded[:rows],
+                                                       **model.explain_kwargs)
             except Exception:
                 logger.exception(
                     "follower %d: async dispatch failed; staying in loop",
-                    jax.process_index())
+                    rank)
             continue
         # identical DEVICE call as the lead's explain_batch (explain_batch
         # == explainer.explain + host-side response building): same bucket
@@ -195,7 +661,7 @@ def follower_loop(model, max_rows: int = 256):
             # backstop — cluster/tpu_serve_cluster.yaml documents the
             # wiring.)
             logger.exception("follower %d: explain failed; staying in loop",
-                             jax.process_index())
+                             rank)
 
 
 class PipelinedMultihostServingModel(MultihostServingModel):
@@ -209,11 +675,14 @@ class PipelinedMultihostServingModel(MultihostServingModel):
     construction (all broadcasts + dispatches happen on the lead's single
     dispatcher thread, and the follower's loop mirrors them in the same
     order with async dispatches).  ``serve_multihost`` selects this class
-    automatically; the lock-step base class remains for explainers without
+    automatically (the pipelined protocol is the default production
+    path); the lock-step base class remains for explainers without
     replicated results."""
 
-    def __init__(self, model, max_rows: int = 256):
-        super().__init__(model, max_rows=max_rows)
+    def __init__(self, model, max_rows: int = 256,
+                 buckets: Optional[Sequence[int]] = None, transport=None):
+        super().__init__(model, max_rows=max_rows, buckets=buckets,
+                         transport=transport)
         inner = model.explainer._explainer
         if not getattr(inner, 'replicate_results', False):
             raise ValueError(
@@ -221,14 +690,37 @@ class PipelinedMultihostServingModel(MultihostServingModel):
                 "distributed_opts['replicate_results']=True (fetches must "
                 "be collective-free for pipelined finalizes)")
 
-    def explain_batch_async(self, stacked: np.ndarray, split_sizes=None):
+    def stage_rows(self, instances):
+        """Staging hook so the server's PR 6 batcher runs in front of the
+        pod: batches are FORMED and stacked one step ahead of dispatch on
+        the batcher thread.  Returns ``None`` deliberately — the H2D (and
+        the broadcast) must stay on the dispatcher thread under
+        ``_bcast_lock``, because a batcher-thread broadcast could
+        interleave with a concurrent shutdown broadcast and dispatch a
+        program on the followers that the lead never enters."""
+
+        return None
+
+    def explain_batch_async(self, stacked: np.ndarray, split_sizes=None,
+                            formats=None):
+        kwargs = {} if formats is None else {"formats": formats}
         with self._bcast_lock:
             stacked = self._broadcast_batch(stacked)
             # dispatch INSIDE the lock: broadcast->dispatch must be atomic
             # against a concurrent shutdown broadcast, and the server's
             # single dispatcher thread is the only explain caller anyway
-            return self.model.explain_batch_async(stacked,
-                                                  split_sizes=split_sizes)
+            fin = self.model.explain_batch_async(stacked,
+                                                 split_sizes=split_sizes,
+                                                 **kwargs)
+            self._enter()
+
+        def finalize():
+            try:
+                return fin()
+            finally:
+                self._leave()
+
+        return finalize
 
 
 def follower_health_server(port: int):
@@ -275,29 +767,48 @@ def serve_multihost(predictor, background_data, constructor_kwargs,
                     port: int = 8000, max_batch_size: int = 1,
                     max_rows: int = 256,
                     explain_kwargs: Optional[dict] = None,
-                    pipeline_depth: Optional[int] = 4):
+                    pipeline_depth: Optional[int] = 4,
+                    warmup: Optional[bool] = None,
+                    staging: Optional[bool] = None):
     """Entry point for every process of a multi-host serve deployment.
 
     On the lead process: builds the fitted model over the multi-host mesh,
     wraps it for broadcast, starts the HTTP server, and returns the server
-    (caller stops it with ``.stop()`` then ``model.shutdown_followers()``).
+    (caller stops it with ``model.drain_and_shutdown(server)``).
     On follower processes: starts the health listener on the same port
     (liveness/readiness probes must not kill pods that correctly serve no
     explain API), builds the identical model and blocks in
     :func:`follower_loop` until shutdown (returns None).
+
+    The pipelined protocol is the DEFAULT: ``replicate_results`` defaults
+    to True unless the caller pins it False in ``distributed_opts``
+    (every process applies the same default, so the mesh stays SPMD).
+    ``warmup`` defaults to the environment resolution with pods ON (like
+    replica workers — restarts are routine and the ladder broadcasts as
+    ``_CMD_WARMUP`` so all processes compile in lockstep before
+    ``/healthz`` flips); ``staging`` defaults ON for the pipelined path
+    (batch forming overlaps dispatch) and OFF for lock-step (no async
+    hook to overlap with).
     """
 
     import jax
 
-    from distributedkernelshap_tpu.serving.server import ExplainerServer
+    from distributedkernelshap_tpu.serving.server import (
+        ExplainerServer,
+        resolve_warmup_env,
+    )
     from distributedkernelshap_tpu.serving.wrappers import (
         BatchKernelShapModel,
         KernelShapModel,
     )
 
+    opts = dict(distributed_opts)
+    # pipelined-by-default: identical resolution on every process (the
+    # base model's jitted programs must agree across the mesh)
+    opts.setdefault("replicate_results", True)
     cls = BatchKernelShapModel if max_batch_size > 1 else KernelShapModel
     ctor = dict(constructor_kwargs)
-    ctor["distributed_opts"] = dict(distributed_opts)
+    ctor["distributed_opts"] = opts
     base = cls(predictor, background_data, ctor, fit_kwargs,
                explain_kwargs=explain_kwargs)
     if jax.process_index() != 0:
@@ -308,7 +819,7 @@ def serve_multihost(predictor, background_data, constructor_kwargs,
             health.shutdown()
             health.server_close()
         return None
-    pipelined = bool(dict(distributed_opts).get("replicate_results"))
+    pipelined = bool(opts.get("replicate_results"))
     if pipelined:
         # the deployment's explain options must actually take the async
         # fast path — otherwise every request lands in the synchronous
@@ -328,16 +839,27 @@ def serve_multihost(predictor, background_data, constructor_kwargs,
                 "serving LOCK-STEP instead — drop those options or set "
                 "l1_reg=False to pipeline.", kw)
             pipelined = False
+    if warmup is None:
+        warmup = resolve_warmup_env(default=True)
     if pipelined:
         # replicated results -> collective-free fetches -> the broadcast
-        # protocol pipelines at the server's calibrated depth
+        # protocol pipelines at the server's calibrated depth, with the
+        # staging batcher forming batches one step ahead
         model = PipelinedMultihostServingModel(base, max_rows=max_rows)
         server = ExplainerServer(model, host=host, port=port,
                                  max_batch_size=max_batch_size,
-                                 pipeline_depth=pipeline_depth)
+                                 pipeline_depth=pipeline_depth,
+                                 warmup=warmup,
+                                 staging=True if staging is None else staging)
     else:
         model = MultihostServingModel(base, max_rows=max_rows)
         server = ExplainerServer(model, host=host, port=port,
                                  max_batch_size=max_batch_size,
-                                 pipeline_depth=1)
+                                 pipeline_depth=1, warmup=warmup,
+                                 staging=bool(staging))
+    # chargeback: the pod's device-seconds span EVERY process's devices —
+    # the SPMD program occupies all hosts for the lead-measured interval,
+    # so the meter bills elapsed x process_count (billing only the lead's
+    # share under-charged an N-host pod N-fold)
+    server._costmeter.set_device_multiplier(jax.process_count())
     return server.start()

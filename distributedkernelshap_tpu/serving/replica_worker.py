@@ -53,6 +53,51 @@ def synthetic_factory():
     return (clf, X[:32], {"link": "logit", "seed": 0}, {})
 
 
+def checkpoint_factory(path: str):
+    """The ctor tuple behind a ``KernelShap.save`` checkpoint: rebuilds
+    ``(predictor, background, ctor_kwargs, fit_kwargs)`` from the saved
+    state so the model is re-fitted through the NORMAL constructor path.
+
+    ``KernelShap.load`` + ``from_explainer`` restores the fitted engine
+    directly — correct for a single process, but a multi-host pod must
+    rebuild on EVERY process with ``distributed_opts`` spanning the pod's
+    mesh (SPMD discipline), which only the ctor-tuple route allows.  The
+    single-host ``--checkpoint`` branch keeps using ``load`` (no refit);
+    pods route through here, so any checkpointed model — tree/TT/deepshap
+    engine paths included — serves from a pod too."""
+
+    import pickle
+
+    from distributedkernelshap_tpu.data import Data
+
+    with open(path, "rb") as f:
+        state = pickle.load(f)
+    bg = state["background_data"]
+    fit_kwargs = {}
+    if isinstance(bg, Data):
+        # grouped/weighted backgrounds round-trip through fit's grouping
+        # args; the raw matrix feeds the constructor path like any other
+        if state.get("use_groups"):
+            fit_kwargs["group_names"] = list(bg.group_names)
+            fit_kwargs["groups"] = bg.groups
+            weights = getattr(bg, "weights", None)
+            if weights is not None:
+                fit_kwargs["weights"] = weights
+        bg = bg.data
+    ctor_kwargs = {
+        "link": state["link"],
+        "feature_names": state["feature_names"],
+        "categorical_names": state["categorical_names"],
+        "task": state["task"],
+        "seed": state["seed"],
+        "engine_config": state.get("engine_config"),
+    }
+    provenance = (state.get("meta") or {}).get("data_provenance")
+    if provenance is not None:
+        fit_kwargs["data_provenance"] = provenance
+    return state["predictor"], bg, ctor_kwargs, fit_kwargs
+
+
 def resolve_factory(spec: str):
     mod_name, _, fn_name = spec.partition(":")
     if not fn_name:

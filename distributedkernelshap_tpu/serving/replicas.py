@@ -1375,9 +1375,75 @@ class _ConnectFailed(OSError):
 # --------------------------------------------------------------------- #
 
 
+class _PodProcess:
+    """``Popen``-shaped aggregate of one multi-host pod's member
+    processes — the unit the manager/supervisor/prober reason about.
+
+    A pod is one SPMD mesh: losing ANY member wedges the others' next
+    collective (no Python-level timeout can recover a blocked gloo/XLA
+    collective), so a dead member means a dead pod.  :meth:`poll`
+    encodes that: the first observed member exit SIGKILLs the survivors
+    (SIGTERM would be ignored — followers defer to the shutdown
+    broadcast that will never come) and reports the pod dead with the
+    first corpse's returncode, which is exactly what makes the existing
+    :class:`~distributedkernelshap_tpu.resilience.supervisor.
+    ReplicaSupervisor` restart whole pods with no pod-specific code.
+    Deliberate shutdown goes through :meth:`terminate`: the lead's
+    SIGTERM handler runs the drain handshake and releases the followers
+    via the shutdown broadcast (followers ignore SIGTERM by design)."""
+
+    def __init__(self, members: List[subprocess.Popen]):
+        if not members:
+            raise ValueError("a pod needs at least one member process")
+        self.members = list(members)
+        self.returncode: Optional[int] = None
+        self.pid = self.members[0].pid  # lead's pid, for logs
+
+    def poll(self) -> Optional[int]:
+        codes = [m.poll() for m in self.members]
+        if self.returncode is not None:
+            return self.returncode
+        dead = [c for c in codes if c is not None]
+        if not dead:
+            return None
+        for m, c in zip(self.members, codes):
+            if c is None:
+                m.kill()
+        self.returncode = dead[0]
+        return self.returncode
+
+    def terminate(self) -> None:
+        for m in self.members:
+            if m.poll() is None:
+                m.terminate()
+
+    def kill(self) -> None:
+        for m in self.members:
+            if m.poll() is None:
+                m.kill()
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[int]:
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        for m in self.members:
+            left = (None if deadline is None
+                    else max(0.05, deadline - time.monotonic()))
+            m.wait(timeout=left)  # TimeoutExpired propagates, like Popen
+        if self.returncode is None:
+            self.returncode = self.members[0].returncode
+        return self.returncode
+
+
 class ReplicaManager:
-    """Spawn + supervise N single-device worker processes
-    (``replica_worker.py``) and their fan-in proxy.
+    """Spawn + supervise N replica units — single-device worker processes
+    (``replica_worker.py``) or, with ``pod_processes > 1``, whole
+    multi-host PODS (``serving/main.py --coordinator``: one lead serving
+    HTTP + followers joining each device call via the broadcast
+    protocol) — and their fan-in proxy.  A pod is one fleet citizen: the
+    prober keys health off the lead's ``/healthz``, the supervisor
+    restarts the whole pod when any member dies, the autoscaler scales
+    in pod increments, and warm-standby pods pre-warm through the
+    broadcast warmup ladder like any replica.
 
     The in-process analog of the reference's Ray autorestart
     (``cluster/ray_cluster.yaml:63``): an exited worker is relaunched by a
@@ -1401,10 +1467,21 @@ class ReplicaManager:
                  startup_timeout_s: float = 300.0,
                  restart_policy: Optional[RestartPolicy] = None,
                  hedge_policy: Optional[HedgePolicy] = None,
-                 autoscale=None):
+                 autoscale=None,
+                 pod_processes: int = 1):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
+        if pod_processes < 1:
+            raise ValueError("pod_processes must be >= 1")
         self.n_replicas = n_replicas
+        #: processes per replica UNIT.  1 (default) spawns plain
+        #: single-device ``replica_worker`` processes; >1 spawns each
+        #: replica as a multi-host POD — ``serving/main.py --coordinator``
+        #: members over a local coordinator, aggregated behind one
+        #: ``_PodProcess`` so the proxy/supervisor/autoscaler stay
+        #: pod-oblivious.  The autoscaler reads this attribute to accrue
+        #: replica-seconds in process units (pods cost P x per second).
+        self.pod_processes = pod_processes
         self.factory = factory
         self.host = host
         self.max_batch_size = max_batch_size
@@ -1456,6 +1533,8 @@ class ReplicaManager:
         return ports
 
     def _spawn(self, index: int) -> subprocess.Popen:
+        if self.pod_processes > 1:
+            return self._spawn_pod(index)
         env = dict(os.environ, **self.env_extra)
         # always stamped (not only under pin_devices): the fault harness
         # filters replica=K specs on it, and logs/metrics want it too
@@ -1475,6 +1554,45 @@ class ReplicaManager:
         logger.info("spawning replica %d on port %d", index,
                     self.ports[index])
         return subprocess.Popen(argv, env=env)
+
+    def _spawn_pod(self, index: int) -> _PodProcess:
+        """One replica unit as a multi-host pod: ``pod_processes`` members
+        of ``serving/main.py --coordinator`` over a locally reserved
+        coordinator port.  The lead serves HTTP on the unit's probed port
+        (``self.ports[index]`` — the proxy/prober/supervisor see exactly
+        the surface a plain worker exposes); followers get their own
+        reserved ports for the liveness-only follower health listener.
+        Ports are reserved FRESH per spawn: a restarted pod must
+        rendezvous on its own coordinator, never a half-dead
+        predecessor's."""
+
+        P = self.pod_processes
+        cport, *follower_ports = self._reserve_ports(P)
+        members = []
+        for k in range(P):
+            env = dict(os.environ, **self.env_extra)
+            env["DKS_REPLICA_INDEX"] = str(index)
+            if self.pin_devices:
+                # contiguous chip blocks per pod: member k of pod i owns
+                # chip i*P + k, so pods never share a device
+                env["TPU_VISIBLE_CHIPS"] = str(index * P + k)
+            argv = [sys.executable, "-m",
+                    "distributedkernelshap_tpu.serving.main",
+                    "--coordinator", f"127.0.0.1:{cport}",
+                    "--num_processes", str(P),
+                    "--process_id", str(k),
+                    "--factory", self.factory,
+                    "--host", self.host,
+                    "--port", str(self.ports[index] if k == 0
+                                  else follower_ports[k - 1]),
+                    "--max_batch_size", str(self.max_batch_size)]
+            if self.pipeline_depth:
+                argv += ["--pipeline_depth", str(self.pipeline_depth)]
+            members.append(subprocess.Popen(argv, env=env))
+        logger.info("spawning pod %d (%d processes, lead on port %d, "
+                    "coordinator 127.0.0.1:%d)", index, P,
+                    self.ports[index], cport)
+        return _PodProcess(members)
 
     def _wait_healthy(self, index: int, timeout_s: float):
         """``True`` (ready), ``False`` (dead/unreachable) or ``"warming"``

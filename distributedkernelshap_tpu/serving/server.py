@@ -903,6 +903,15 @@ class ExplainerServer:
         attach_treeshap_metrics(reg)
         attach_tensor_shap_metrics(reg)
         attach_deepshap_metrics(reg)
+        # pod broadcast metering (serving/multihost.py): process-global
+        # like the fallback accountants — zero series until a pod model
+        # actually broadcasts, but always registered so the catalog is
+        # mode-independent
+        from distributedkernelshap_tpu.serving.multihost import (
+            attach_pod_metrics,
+        )
+
+        attach_pod_metrics(reg)
         # the scheduler registers its own dks_sched_* series (queue wait,
         # expiries) on the same registry so one page carries everything
         attach = getattr(self._sched, "attach_metrics", None)
@@ -1447,10 +1456,16 @@ class ExplainerServer:
             # warmed
             sig = shape_signature(b, getattr(model, "explain_path", None),
                                   model=label)
+            # pod models substitute their collective-safe warmup entry
+            # (broadcast as _CMD_WARMUP so every process in the pod
+            # compiles this rung in lockstep — a plain explain_batch here
+            # would warm the followers through the pipelined async path
+            # while /healthz still reads warming)
+            warm_entry = getattr(model, "warmup_batch", None) \
+                or model.explain_batch
             with profiler().phase("warmup"), \
                     compile_events().signature(sig):
-                model.explain_batch(np.tile(row, (b, 1)),
-                                    split_sizes=[b])
+                warm_entry(np.tile(row, (b, 1)), split_sizes=[b])
             # anytime deployments also warm their per-round entries at
             # this bucket (distinct executables from the single-shot
             # pipeline), declared under their own rounds=<k> suffix so

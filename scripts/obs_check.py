@@ -62,11 +62,13 @@ DOCS_PATH = os.path.join(REPO_ROOT, "docs", "OBSERVABILITY.md")
 #: ``dks_device_bytes`` rides the existing ``device`` prefix.)
 #: ``quality`` joined with continuous correctness observability: the
 #: in-band invariant auditor, shadow-oracle sampler and canary drift
-#: sentinel (``dks_quality_*``).
+#: sentinel (``dks_quality_*``).  ``pod`` joined with the pod-serving
+#: fabric: bucketed broadcast-frame accounting on multi-host leads
+#: (``dks_pod_bcast_*``).
 _LITERAL_RE = re.compile(
     r"dks_(?:serve|fanin|sched|phase|slo|alerts|wire|staging|treeshap|"
     r"tensor_shap|autoscale|registry|result_cache|deepshap|device|tenant|"
-    r"fleet|trace|anytime|prof|mem|quality)_[a-z0-9_]+")
+    r"fleet|trace|anytime|prof|mem|quality|pod)_[a-z0-9_]+")
 
 #: directories never scanned for literals/renderers
 _SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "results", "data",

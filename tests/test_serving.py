@@ -435,9 +435,11 @@ def test_serving_interactions_require_exact_at_construction(model_setup):
 
 def test_serving_main_flag_guards(monkeypatch, capsys):
     """serving.main must refuse incompatible flag combinations at parse
-    time instead of silently misrouting (multihost branch ignores
-    --checkpoint; follower flags without a coordinator would start a
-    stray single-host server)."""
+    time instead of silently misrouting (follower flags without a
+    coordinator would start a stray single-host server; the single-host
+    replica-fleet mode cannot honour multihost flags).  --checkpoint /
+    --exact / --factory under --coordinator are deliberately ABSENT
+    here: any deployment tuple serves from a pod."""
 
     import pytest as _pytest
 
@@ -452,10 +454,14 @@ def test_serving_main_flag_guards(monkeypatch, capsys):
 
     err = run(["--num_processes", "2", "--process_id", "1"])
     assert "require --coordinator" in err
-    err = run(["--coordinator", "127.0.0.1:1", "--checkpoint", "x.pkl"])
-    assert "--checkpoint is not supported" in err
-    err = run(["--coordinator", "127.0.0.1:1", "--exact"])
-    assert "--exact needs" in err
+    err = run(["--factory", "mod:fn", "--checkpoint", "x.pkl"])
+    assert "pick one" in err
+    err = run(["--replicate_results", "--lockstep"])
+    assert "opposites" in err
+    err = run(["--replica_procs", "2", "--coordinator", "127.0.0.1:1"])
+    assert "single-host replica" in err
+    err = run(["--pod_procs", "2"])
+    assert "--replica_procs fleet" in err
 
 
 def test_metrics_endpoint(model_setup):
